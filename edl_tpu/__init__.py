@@ -1,0 +1,17 @@
+"""edl_tpu — a TPU-native elastic deep learning framework.
+
+A ground-up JAX/XLA/pjit rebuild of the capabilities of elasticdeeplearning/edl
+(reference layer map: SURVEY.md §1):
+
+- elastic, fault-tolerant collective training: an in-tree coordination store
+  (``edl_tpu.coordination``) replaces etcd; a per-host launcher daemon
+  (``edl_tpu.controller``) does leader election, membership, stage-keyed
+  barrier, and stop-resume elasticity;
+- an in-tree JAX training runtime (``edl_tpu.runtime``) replaces Paddle Fleet:
+  device meshes, pjit/shard_map train steps with XLA collectives over ICI/DCN,
+  atomic versioned checkpointing, elastic State;
+- an elastic distillation service plane (``edl_tpu.distill``): TPU-hosted
+  teacher inference servers, service discovery and client/teacher balancing.
+"""
+
+__version__ = "0.1.0"

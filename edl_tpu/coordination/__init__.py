@@ -1,0 +1,3 @@
+from edl_tpu.coordination.client import CoordClient
+
+__all__ = ["CoordClient"]

@@ -1,0 +1,247 @@
+"""Coordination client — the API surface the whole control plane talks to.
+
+Mirrors the reference's EtcdClient contract (edl/discovery/etcd_client.py:
+51-263): namespaced keys ``/<root>/<service>/nodes/<server>``, TTL-leased
+registration, put-if-absent election, guarded transactions, and prefix watches
+with add/remove diffing — but speaks to the in-tree Store over framed RPC.
+"""
+
+import threading
+
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class Watcher(object):
+    """Background prefix watch that diffs service membership.
+
+    Calls ``callback(added, removed, all_servers)`` where each is a dict
+    server_name -> value, whenever membership/values change (reference parity:
+    etcd_client.py:122-155 watch_service add/rm diffing).
+    """
+
+    def __init__(self, client, service, callback, poll_timeout=5.0):
+        self._client = client
+        self._service = service
+        self._callback = callback
+        self._poll_timeout = poll_timeout
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="coord-watch-%s" % service)
+        self._thread.start()
+
+    def _snapshot(self):
+        servers, rev = self._client.get_service_with_revision(self._service)
+        return dict(servers), rev
+
+    def _run(self):
+        current, rev = {}, 0
+        first = True
+        while not self._stop.is_set():
+            try:
+                if first:
+                    new, rev = self._snapshot()
+                    self._diff_and_fire(current, new)
+                    current = new
+                    first = False
+                    continue
+                events, new_rev = self._client.wait_events(
+                    self._client.service_prefix(self._service), rev,
+                    self._poll_timeout)
+                if not events:
+                    rev = new_rev
+                    continue
+                if any(e["type"] == "reset" for e in events):
+                    new, rev = self._snapshot()
+                else:
+                    new = dict(current)
+                    prefix = self._client.service_prefix(self._service)
+                    for e in events:
+                        name = e["key"][len(prefix):]
+                        if e["type"] == "put":
+                            new[name] = e["value"]
+                        elif e["type"] == "delete":
+                            new.pop(name, None)
+                    rev = new_rev
+                self._diff_and_fire(current, new)
+                current = new
+            except errors.EdlError as e:
+                logger.warning("watch %s error: %r; re-listing", self._service,
+                               e)
+                first = True
+                self._stop.wait(1.0)
+            except Exception:
+                logger.exception("watch %s callback failed", self._service)
+                self._stop.wait(1.0)
+
+    def _diff_and_fire(self, old, new):
+        if self._stop.is_set():  # never fire after stop() was requested
+            return
+        added = {k: v for k, v in new.items()
+                 if k not in old or old[k] != v}
+        removed = {k: v for k, v in old.items() if k not in new}
+        if added or removed:
+            self._callback(added, removed, dict(new))
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=self._poll_timeout + 2)
+
+
+class CoordClient(object):
+    def __init__(self, endpoints, root="edl", timeout=60.0):
+        if isinstance(endpoints, str):
+            endpoints = [e for e in endpoints.split(",") if e]
+        self._endpoints = list(endpoints)
+        if not self._endpoints:
+            raise errors.ConnectError("no coordination endpoints given")
+        self._root = root
+        self._timeout = timeout
+        # per-thread connections: a watcher's long-poll must not block
+        # lease-refresh heartbeats issued from other threads
+        self._local = threading.local()
+        self._ep_lock = threading.Lock()
+
+    # -- key namespace ------------------------------------------------------
+
+    def service_prefix(self, service):
+        return "/%s/%s/nodes/" % (self._root, service)
+
+    def _key(self, service, server):
+        return self.service_prefix(service) + server
+
+    @property
+    def root(self):
+        return self._root
+
+    # -- transport ----------------------------------------------------------
+
+    def _call(self, method, *args, **kwargs):
+        last = None
+        for _ in range(len(self._endpoints)):
+            rpc = getattr(self._local, "rpc", None)
+            if rpc is None:
+                with self._ep_lock:
+                    endpoint = self._endpoints[0]
+                rpc = self._local.rpc = RpcClient(endpoint,
+                                                  timeout=self._timeout)
+            try:
+                return rpc.call(method, *args, **kwargs)
+            except errors.ConnectError as e:
+                last = e
+                rpc.close()
+                self._local.rpc = None
+                with self._ep_lock:
+                    if self._endpoints[0] == rpc.endpoint:
+                        self._endpoints.append(self._endpoints.pop(0))
+        raise last
+
+    # -- raw KV -------------------------------------------------------------
+
+    def put(self, key, value, lease_id=None):
+        return self._call("store_put", key, value, lease_id)
+
+    def get_key(self, key):
+        return self._call("store_get", key)
+
+    def delete(self, key):
+        return self._call("store_delete", key)
+
+    def revision(self):
+        return self._call("store_revision")
+
+    def wait_events(self, prefix, since_rev, poll_timeout):
+        return self._call("store_wait_events", prefix, since_rev,
+                          poll_timeout, timeout=poll_timeout + 30)
+
+    # -- leases --------------------------------------------------------------
+
+    def lease_grant(self, ttl):
+        return self._call("store_lease_grant", ttl)
+
+    def lease_refresh(self, lease_id):
+        return self._call("store_lease_refresh", lease_id)
+
+    def lease_revoke(self, lease_id):
+        return self._call("store_lease_revoke", lease_id)
+
+    # -- service registry (reference etcd_client.py surface) -----------------
+
+    def get_service(self, service):
+        """[(server_name, value)] sorted by server name."""
+        servers, _ = self.get_service_with_revision(service)
+        return servers
+
+    def get_service_with_revision(self, service):
+        kvs, rev = self._call("store_get_prefix",
+                              self.service_prefix(service))
+        prefix = self.service_prefix(service)
+        return [(kv["key"][len(prefix):], kv["value"]) for kv in kvs], rev
+
+    def get_value(self, service, server):
+        kv = self.get_key(self._key(service, server))
+        return None if kv is None else kv["value"]
+
+    def set_server_permanent(self, service, server, value):
+        return self.put(self._key(service, server), value)
+
+    def set_server_not_exists(self, service, server, value, ttl):
+        """Put-if-absent with a fresh TTL lease — the election primitive.
+
+        Returns the lease_id on success, None if the key already exists
+        (reference parity: etcd_client.py:177-197).
+        """
+        lease_id = self.lease_grant(ttl)
+        ok, _ = self._call("store_put_if_absent", self._key(service, server),
+                           value, lease_id)
+        if not ok:
+            self.lease_revoke(lease_id)
+            return None
+        return lease_id
+
+    def set_server_with_lease(self, service, server, value, ttl):
+        """Unconditional TTL-leased registration; returns lease_id."""
+        lease_id = self.lease_grant(ttl)
+        self.put(self._key(service, server), value, lease_id)
+        return lease_id
+
+    def refresh_server(self, service, server, lease_id):
+        """Refresh the lease keeping a registration alive.
+
+        Raises LeaseExpiredError if the lease (and hence the registration)
+        has already expired — the caller must re-register or die.
+        """
+        if not self.lease_refresh(lease_id):
+            raise errors.LeaseExpiredError(
+                "lease %s for %s/%s expired" % (lease_id, service, server))
+
+    def remove_server(self, service, server):
+        return self.delete(self._key(service, server))
+
+    def watch_service(self, service, callback, poll_timeout=5.0):
+        return Watcher(self, service, callback, poll_timeout=poll_timeout)
+
+    # -- transactions ---------------------------------------------------------
+
+    def txn(self, compares, on_success, on_failure=()):
+        return self._call("store_txn", list(compares), list(on_success),
+                          list(on_failure))
+
+    def put_if_leader(self, leader_service, leader_server, leader_value,
+                      puts):
+        """Commit ``puts`` [(key, value)] iff the leader key still holds
+        ``leader_value`` — the guarded-transaction idiom of the reference
+        (cluster_generator.py:223-250, state.py:186-200)."""
+        ok, _ = self.txn(
+            [(self._key(leader_service, leader_server), "value_eq",
+              leader_value)],
+            [("put", k, v) for k, v in puts])
+        return ok
+
+    # -- maintenance ----------------------------------------------------------
+
+    def clean_root(self):
+        """Delete every key under this client's root (test isolation;
+        reference parity: constants.clean_etcd)."""
+        return self._call("store_delete_prefix", "/%s/" % self._root)

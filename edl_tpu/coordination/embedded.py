@@ -1,0 +1,62 @@
+"""Embedded store for tests + process-global client accessor.
+
+Reference parity: edl/utils/etcd_db.py:19 (process-global EtcdClient) and the
+EtcdTestBase fixture shape (tests run against a real local etcd; here tests
+run against an in-process StoreServer).
+"""
+
+import os
+import threading
+
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.coordination.server import StoreServer
+
+ENV_ENDPOINTS = "EDL_TPU_STORE_ENDPOINTS"
+
+_global_lock = threading.Lock()
+_global_client = None
+_global_key = None
+
+
+class EmbeddedStore(object):
+    """An in-process StoreServer; use as a context manager in tests."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._server = StoreServer(host=host, port=port)
+
+    def __enter__(self):
+        return self.start()
+
+    def start(self):
+        self._server.start()
+        return self
+
+    @property
+    def endpoint(self):
+        return self._server.endpoint
+
+    def client(self, root="edl"):
+        return CoordClient([self.endpoint], root=root)
+
+    def stop(self):
+        self._server.stop()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def set_global_endpoints(endpoints):
+    os.environ[ENV_ENDPOINTS] = (endpoints if isinstance(endpoints, str)
+                                 else ",".join(endpoints))
+
+
+def get_global_store(root="edl"):
+    """Process-global CoordClient from $EDL_TPU_STORE_ENDPOINTS."""
+    global _global_client, _global_key
+    endpoints = os.environ.get(ENV_ENDPOINTS, "127.0.0.1:2379")
+    with _global_lock:
+        key = (endpoints, root)
+        if _global_client is None or _global_key != key:
+            _global_client = CoordClient(endpoints, root=root)
+            _global_key = key
+        return _global_client

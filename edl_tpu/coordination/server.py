@@ -1,0 +1,58 @@
+"""Serve a coordination Store over the RPC substrate.
+
+Run standalone (replacing the external etcd server the reference downloads in
+scripts/download_etcd.sh):  python -m edl_tpu.coordination.server --port 2379
+"""
+
+import argparse
+import signal
+import threading
+
+from edl_tpu.coordination.store import Store
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.logger import logger
+
+
+class StoreServer(object):
+    def __init__(self, host="0.0.0.0", port=0):
+        self.store = Store()
+        self._rpc = RpcServer(host=host, port=port)
+        s = self.store
+        for name in ("put", "put_if_absent", "get", "get_prefix", "delete",
+                     "delete_prefix", "txn", "wait_events", "lease_grant",
+                     "lease_refresh", "lease_revoke", "revision"):
+            self._rpc.register("store_" + name, getattr(s, name))
+
+    def start(self):
+        self._rpc.start()
+        return self
+
+    @property
+    def endpoint(self):
+        return self._rpc.endpoint
+
+    @property
+    def port(self):
+        return self._rpc.port
+
+    def stop(self):
+        self._rpc.stop()
+        self.store.close()
+
+
+def main():
+    parser = argparse.ArgumentParser("edl_tpu coordination store server")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=2379)
+    args = parser.parse_args()
+    server = StoreServer(host=args.host, port=args.port).start()
+    logger.info("coordination store serving on %s", server.endpoint)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

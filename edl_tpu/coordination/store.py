@@ -1,0 +1,231 @@
+"""In-memory coordination store: revisioned KV + TTL leases + txn + watch.
+
+This is the in-tree replacement for the etcd v3 server the reference depends
+on (SURVEY.md §2.6): the subset of etcd semantics the control plane actually
+uses — namespaced keys, TTL leases with refresh, put-if-absent (the election
+primitive, reference edl/discovery/etcd_client.py:177-197), guarded
+transactions (reference cluster_generator.py:223-250, state.py:192-196), and
+revisioned prefix watches (reference etcd_client.py:122-155).
+
+Concurrency model: one big lock + a condition variable; watchers long-poll via
+``wait_events``. A background sweeper expires leases. All state fits in memory;
+the control plane writes are tiny and infrequent (heartbeats every ttl/2).
+"""
+
+import threading
+import time
+from collections import deque
+
+
+class KeyValue(object):
+    __slots__ = ("key", "value", "lease_id", "create_rev", "mod_rev")
+
+    def __init__(self, key, value, lease_id, create_rev, mod_rev):
+        self.key = key
+        self.value = value
+        self.lease_id = lease_id
+        self.create_rev = create_rev
+        self.mod_rev = mod_rev
+
+
+class Store(object):
+    # retain this many recent events for watch catch-up
+    EVENT_HISTORY = 10000
+
+    def __init__(self):
+        self._kv = {}            # key -> KeyValue
+        self._leases = {}        # lease_id -> (ttl, deadline, set(keys))
+        self._rev = 0
+        self._next_lease = 1
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._events = deque(maxlen=self.EVENT_HISTORY)
+        self._stop = threading.Event()
+        self._sweeper = threading.Thread(
+            target=self._sweep_loop, daemon=True, name="store-sweeper")
+        self._sweeper.start()
+
+    # -- internal helpers (hold self._lock) --------------------------------
+
+    def _bump(self):
+        self._rev += 1
+        return self._rev
+
+    def _emit(self, etype, key, value):
+        rev = self._bump()
+        self._events.append(
+            {"type": etype, "key": key, "value": value, "rev": rev})
+        self._cond.notify_all()
+        return rev
+
+    def _put_locked(self, key, value, lease_id):
+        old = self._kv.get(key)
+        if old is not None and old.lease_id and old.lease_id != lease_id:
+            lease = self._leases.get(old.lease_id)
+            if lease:
+                lease[2].discard(key)
+        create_rev = old.create_rev if old is not None else self._rev + 1
+        rev = self._emit("put", key, value)
+        self._kv[key] = KeyValue(key, value, lease_id, create_rev, rev)
+        if lease_id:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise KeyError("lease %d not found" % lease_id)
+            lease[2].add(key)
+        return rev
+
+    def _delete_locked(self, key):
+        old = self._kv.pop(key, None)
+        if old is None:
+            return None
+        if old.lease_id:
+            lease = self._leases.get(old.lease_id)
+            if lease:
+                lease[2].discard(key)
+        return self._emit("delete", key, None)
+
+    def _sweep_loop(self):
+        while not self._stop.wait(0.2):
+            now = time.monotonic()
+            with self._lock:
+                dead = [lid for lid, (_, dl, _k) in self._leases.items()
+                        if dl <= now]
+                for lid in dead:
+                    _, _, keys = self._leases.pop(lid)
+                    for k in list(keys):
+                        self._delete_locked(k)
+
+    # -- public API --------------------------------------------------------
+
+    def close(self):
+        self._stop.set()
+
+    def revision(self):
+        with self._lock:
+            return self._rev
+
+    def lease_grant(self, ttl):
+        with self._lock:
+            lid = self._next_lease
+            self._next_lease += 1
+            self._leases[lid] = [ttl, time.monotonic() + ttl, set()]
+            return lid
+
+    def lease_refresh(self, lease_id):
+        """Extend the lease by its ttl; False if already expired/unknown."""
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return False
+            lease[1] = time.monotonic() + lease[0]
+            return True
+
+    def lease_revoke(self, lease_id):
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                return False
+            for k in list(lease[2]):
+                self._delete_locked(k)
+            return True
+
+    def put(self, key, value, lease_id=None):
+        with self._lock:
+            return self._put_locked(key, value, lease_id)
+
+    def put_if_absent(self, key, value, lease_id=None):
+        """The election primitive: returns (True, rev) only if key was free."""
+        with self._lock:
+            if key in self._kv:
+                return False, self._kv[key].mod_rev
+            return True, self._put_locked(key, value, lease_id)
+
+    def get(self, key):
+        with self._lock:
+            kv = self._kv.get(key)
+            if kv is None:
+                return None
+            return {"key": kv.key, "value": kv.value, "mod_rev": kv.mod_rev,
+                    "create_rev": kv.create_rev, "lease_id": kv.lease_id}
+
+    def get_prefix(self, prefix):
+        """Returns (sorted kv dicts, current revision)."""
+        with self._lock:
+            out = [{"key": kv.key, "value": kv.value, "mod_rev": kv.mod_rev,
+                    "create_rev": kv.create_rev, "lease_id": kv.lease_id}
+                   for k, kv in self._kv.items() if k.startswith(prefix)]
+            out.sort(key=lambda d: d["key"])
+            return out, self._rev
+
+    def delete(self, key):
+        with self._lock:
+            return self._delete_locked(key) is not None
+
+    def delete_prefix(self, prefix):
+        with self._lock:
+            keys = [k for k in self._kv if k.startswith(prefix)]
+            for k in keys:
+                self._delete_locked(k)
+            return len(keys)
+
+    def txn(self, compares, on_success, on_failure=()):
+        """Atomic compare-and-mutate.
+
+        compares: list of (key, op, expected) with op in
+          {"value_eq", "exists", "not_exists", "mod_rev_eq"}; expected is the
+          value / revision to compare (ignored for exists/not_exists).
+        on_success / on_failure: list of ("put", key, value, lease_id) or
+          ("delete", key).
+        Returns (succeeded, revision).
+        """
+        with self._lock:
+            ok = True
+            for key, op, expected in compares:
+                kv = self._kv.get(key)
+                if op == "value_eq":
+                    ok = kv is not None and kv.value == expected
+                elif op == "exists":
+                    ok = kv is not None
+                elif op == "not_exists":
+                    ok = kv is None
+                elif op == "mod_rev_eq":
+                    ok = kv is not None and kv.mod_rev == expected
+                else:
+                    raise ValueError("bad compare op %r" % op)
+                if not ok:
+                    break
+            for action in (on_success if ok else on_failure):
+                if action[0] == "put":
+                    _, key, value = action[:3]
+                    lease_id = action[3] if len(action) > 3 else None
+                    self._put_locked(key, value, lease_id or None)
+                elif action[0] == "delete":
+                    self._delete_locked(action[1])
+                else:
+                    raise ValueError("bad txn action %r" % (action,))
+            return ok, self._rev
+
+    def wait_events(self, prefix, since_rev, timeout):
+        """Long-poll: block until an event with rev > since_rev under prefix.
+
+        Returns (events, current_rev). events == [] means timeout. If
+        since_rev has fallen out of the history window, returns a single
+        synthetic {"type": "reset"} event — the watcher should re-list.
+        """
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                # history truncated past the watcher's position → tell it to
+                # re-list instead of silently dropping events
+                if (self._rev > since_rev and self._events
+                        and self._events[0]["rev"] > since_rev + 1):
+                    return ([{"type": "reset", "key": prefix, "value": None,
+                              "rev": self._rev}], self._rev)
+                evs = [e for e in self._events
+                       if e["rev"] > since_rev and e["key"].startswith(prefix)]
+                if evs:
+                    return evs, self._rev
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return [], self._rev
+                self._cond.wait(remaining)

@@ -1,0 +1,4 @@
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.rpc.client import RpcClient, call
+
+__all__ = ["RpcServer", "RpcClient", "call"]

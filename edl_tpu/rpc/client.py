@@ -1,0 +1,71 @@
+"""Blocking RPC client with reconnect, per-endpoint channel cache.
+
+Reference parity: edl/utils/client.py + data_server_client.py channel cache;
+errors re-raise by class name (edl/utils/exceptions.py:93-103).
+"""
+
+import itertools
+import socket
+import threading
+
+from edl_tpu.rpc import framing
+from edl_tpu.utils import errors
+
+
+class RpcClient(object):
+    def __init__(self, endpoint, timeout=60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self.endpoint = endpoint
+        self._timeout = timeout
+        self._sock = None
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+
+    def _connect(self):
+        if self._sock is None:
+            try:
+                self._sock = socket.create_connection(
+                    self._addr, timeout=self._timeout)
+                framing.set_keepalive(self._sock)
+            except OSError as e:
+                self._sock = None
+                raise errors.ConnectError(
+                    "connect %s:%s failed: %s" % (*self._addr, e))
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+
+    def call(self, method, *args, timeout=None, **kwargs):
+        """Invoke ``method`` remotely; one in-flight request per client."""
+        with self._lock:
+            self._connect()
+            req = {"id": next(self._ids), "method": method,
+                   "args": list(args), "kwargs": kwargs}
+            try:
+                self._sock.settimeout(timeout or self._timeout)
+                framing.write_frame(self._sock, req)
+                resp = framing.read_frame(self._sock)
+            except (OSError, ConnectionError, framing.FramingError) as e:
+                self.close()
+                raise errors.ConnectError(
+                    "rpc %s to %s failed: %s" % (method, self.endpoint, e))
+            if resp.get("ok"):
+                return resp.get("result")
+            err = resp.get("error", {})
+            raise errors.deserialize_error(
+                err.get("name", "RpcError"), err.get("detail", ""))
+
+
+def call(endpoint, method, *args, **kwargs):
+    """One-shot convenience call (opens and closes a connection)."""
+    c = RpcClient(endpoint)
+    try:
+        return c.call(method, *args, **kwargs)
+    finally:
+        c.close()

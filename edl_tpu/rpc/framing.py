@@ -1,0 +1,59 @@
+"""Wire framing for the in-tree RPC substrate.
+
+Frame = 8-byte header (4 magic bytes + uint32 big-endian body length) followed
+by a msgpack-encoded body. The magic doubles as a protocol-version check.
+
+This replaces both gRPC and the reference's hand-rolled epoll TCP protocol
+(reference: edl/distill/redis/balance_server.py:41-124 framed `!4si` + JSON);
+msgpack is used instead of JSON so tensor batches can ride the same frames.
+"""
+
+import struct
+import socket
+
+import msgpack
+
+MAGIC = b"\xed\x17\x00\x01"
+_HEADER = struct.Struct("!4sI")
+MAX_FRAME = 1 << 30  # 1 GB, matching the reference pod server's max message
+
+
+class FramingError(Exception):
+    pass
+
+
+def pack_frame(obj):
+    body = msgpack.packb(obj, use_bin_type=True)
+    if len(body) > MAX_FRAME:
+        raise FramingError("frame too large: %d" % len(body))
+    return _HEADER.pack(MAGIC, len(body)) + body
+
+
+def recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def read_frame(sock):
+    header = recv_exact(sock, _HEADER.size)
+    magic, length = _HEADER.unpack(header)
+    if magic != MAGIC:
+        raise FramingError("bad magic %r" % magic)
+    if length > MAX_FRAME:
+        raise FramingError("frame too large: %d" % length)
+    body = recv_exact(sock, length)
+    return msgpack.unpackb(body, raw=False)
+
+
+def write_frame(sock, obj):
+    sock.sendall(pack_frame(obj))
+
+
+def set_keepalive(sock):
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)

@@ -1,0 +1,114 @@
+"""Threaded RPC server: method-name dispatch over framed msgpack TCP.
+
+Replaces the reference's three gRPC services (PodServer, DataServer,
+DiscoveryService — protos/*.proto) and its raw epoll server with one
+substrate. Handlers raise EdlError subclasses; the error envelope carries the
+class name so clients re-raise the same type (reference parity:
+edl/utils/exceptions.py:93-114 serialize/deserialize).
+"""
+
+import socket
+import socketserver
+import threading
+
+from edl_tpu.rpc import framing
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        framing.set_keepalive(self.request)
+        while True:
+            try:
+                req = framing.read_frame(self.request)
+            except (ConnectionError, OSError, framing.FramingError):
+                return
+            resp = {"id": req.get("id")}
+            try:
+                method = req["method"]
+                fn = self.server.methods.get(method)
+                if fn is None:
+                    raise errors.RpcError("no such method: %s" % method)
+                resp["ok"] = True
+                resp["result"] = fn(*req.get("args", []),
+                                    **req.get("kwargs", {}))
+            except Exception as e:  # noqa: BLE001 — envelope every failure
+                if not isinstance(e, errors.EdlError):
+                    logger.exception("rpc handler %s failed",
+                                     req.get("method"))
+                name, detail = errors.serialize_error(e)
+                resp["ok"] = False
+                resp["error"] = {"name": name, "detail": detail}
+            try:
+                frame = framing.pack_frame(resp)
+            except (TypeError, ValueError, framing.FramingError) as e:
+                # result not wire-encodable → error envelope, keep connection
+                frame = framing.pack_frame({
+                    "id": resp.get("id"), "ok": False,
+                    "error": {"name": "RpcError",
+                              "detail": "unencodable response: %s" % e}})
+            try:
+                self.request.sendall(frame)
+            except (ConnectionError, OSError):
+                return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    request_queue_size = 128
+
+
+class RpcServer(object):
+    """Register callables by name, serve them on host:port.
+
+    port=0 picks a free port; the bound port is available as ``.port`` after
+    ``start()`` (reference parity: pod_server started on port 0 then wrote the
+    real port back into the pod — edl/utils/pod_server.py:130-147).
+    """
+
+    def __init__(self, host="0.0.0.0", port=0):
+        self._host = host
+        self._port = port
+        self._server = None
+        self._thread = None
+        self.methods = {}
+
+    def register(self, name, fn):
+        self.methods[name] = fn
+        return self
+
+    def register_object(self, obj, prefix=""):
+        """Expose every public method of ``obj`` as ``prefix + name``."""
+        for name in dir(obj):
+            if name.startswith("_"):
+                continue
+            fn = getattr(obj, name)
+            if callable(fn):
+                self.register(prefix + name, fn)
+        return self
+
+    def start(self):
+        self._server = _TCPServer((self._host, self._port), _Handler)
+        self._server.methods = self.methods
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True, name="rpc-server")
+        self._thread.start()
+        return self
+
+    @property
+    def port(self):
+        return self._server.server_address[1]
+
+    @property
+    def endpoint(self):
+        host = self._host if self._host != "0.0.0.0" else "127.0.0.1"
+        return "%s:%d" % (host, self.port)
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

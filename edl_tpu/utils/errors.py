@@ -1,0 +1,137 @@
+"""Exception taxonomy and the retry-until-timeout resilience idiom.
+
+Reference parity: edl/utils/exceptions.py (17 Edl* types, serialize/deserialize
+by class name) and edl/utils/error_utils.py:22-39 (@handle_errors_until_timeout).
+"""
+
+import functools
+import time
+
+
+class EdlError(Exception):
+    """Base class for all framework errors; retryable by default."""
+
+
+class DeserializeError(EdlError):
+    pass
+
+
+class ConnectError(EdlError):
+    pass
+
+
+class RpcError(EdlError):
+    pass
+
+
+class NotFoundError(EdlError):
+    pass
+
+
+class LeaseExpiredError(EdlError):
+    pass
+
+
+class KeyExistsError(EdlError):
+    pass
+
+
+class TxnFailedError(EdlError):
+    pass
+
+
+class NotLeaderError(EdlError):
+    pass
+
+
+class BarrierError(EdlError):
+    pass
+
+
+class ClusterChangedError(EdlError):
+    pass
+
+
+class RankError(EdlError):
+    pass
+
+
+class StatusError(EdlError):
+    pass
+
+
+class TrainProcessError(EdlError):
+    pass
+
+
+class DataAccessError(EdlError):
+    pass
+
+
+class DataEndError(EdlError):
+    """All data has been consumed for this epoch."""
+
+
+class StopError(EdlError):
+    """A component was asked to stop; not retryable."""
+
+
+class TimeoutError_(EdlError):
+    """Raised when handle_errors_until_timeout gives up."""
+
+
+_NAME_TO_CLS = None
+
+
+def _name_to_cls():
+    global _NAME_TO_CLS
+    if _NAME_TO_CLS is None:
+        _NAME_TO_CLS = {
+            c.__name__: c for c in list(globals().values())
+            if isinstance(c, type) and issubclass(c, EdlError)
+        }
+    return _NAME_TO_CLS
+
+
+def serialize_error(exc):
+    """Encode an exception as (class_name, detail) for the RPC envelope."""
+    return type(exc).__name__, str(exc)
+
+
+def deserialize_error(name, detail):
+    """Rebuild an exception from its class name; unknown names → RpcError."""
+    cls = _name_to_cls().get(name)
+    if cls is None:
+        return RpcError("%s: %s" % (name, detail))
+    return cls(detail)
+
+
+def handle_errors_until_timeout(func):
+    """Retry ``func`` on EdlError every ``interval`` seconds until ``timeout``.
+
+    The wrapped function must be called with a ``timeout`` kwarg (seconds);
+    optional ``interval`` kwarg (default 1s). StopError is never retried.
+    Mirrors the universal resilience idiom of the reference
+    (edl/utils/error_utils.py:22-39, which used a 3s fixed interval).
+    """
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        timeout = kwargs.pop("timeout")
+        interval = kwargs.pop("interval", 1.0)
+        deadline = time.monotonic() + timeout
+        last = None
+        while True:
+            try:
+                return func(*args, **kwargs)
+            except StopError:
+                raise
+            except EdlError as e:
+                last = e
+                if time.monotonic() >= deadline:
+                    raise TimeoutError_(
+                        "%s timed out after %ss; last error: %r"
+                        % (func.__name__, timeout, last))
+                time.sleep(min(interval, max(0.0, deadline - time.monotonic())))
+
+    return wrapper

@@ -1,0 +1,19 @@
+"""Unique id generation (reference parity: edl/utils/unique_name.py)."""
+
+import itertools
+import threading
+import uuid
+
+_lock = threading.Lock()
+_counters = {}
+
+
+def generate(prefix=""):
+    """Monotonic per-prefix counter name, e.g. generate("reader") -> reader_0."""
+    with _lock:
+        c = _counters.setdefault(prefix, itertools.count())
+        return "%s_%d" % (prefix, next(c))
+
+
+def uid():
+    return uuid.uuid4().hex

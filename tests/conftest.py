@@ -1,0 +1,37 @@
+"""Test harness config.
+
+Tests never touch real TPU hardware: JAX is forced onto CPU with 8 virtual
+devices so multi-chip sharding (dp/tp/sp meshes) is exercised hermetically —
+the TPU analogue of the reference's "many actors against a local etcd" test
+strategy (SURVEY.md §4).
+"""
+
+import os
+
+# must run before jax is imported anywhere
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from edl_tpu.coordination.embedded import (  # noqa: E402
+    EmbeddedStore, set_global_endpoints)
+
+
+@pytest.fixture()
+def store():
+    """A fresh in-process coordination store per test."""
+    with EmbeddedStore() as s:
+        set_global_endpoints(s.endpoint)
+        yield s
+
+
+@pytest.fixture()
+def coord(store):
+    """A CoordClient on an isolated root namespace."""
+    client = store.client(root="test_job")
+    yield client
+    client.clean_root()

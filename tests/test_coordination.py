@@ -1,0 +1,131 @@
+"""Coordination store tests: KV, leases, election, txn, watch.
+
+Mirrors the reference's etcd_client_test.py / test_leader_pod.py shapes
+against the in-tree store instead of a real etcd.
+"""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.utils import errors
+
+
+def test_kv_roundtrip(coord):
+    coord.set_server_permanent("svc", "a", "va")
+    coord.set_server_permanent("svc", "b", "vb")
+    assert coord.get_service("svc") == [("a", "va"), ("b", "vb")]
+    assert coord.get_value("svc", "a") == "va"
+    assert coord.get_value("svc", "zz") is None
+    coord.remove_server("svc", "a")
+    assert coord.get_service("svc") == [("b", "vb")]
+
+
+def test_lease_expiry_removes_server(coord):
+    lease = coord.set_server_with_lease("svc", "x", "v", ttl=1)
+    assert coord.get_value("svc", "x") == "v"
+    coord.refresh_server("svc", "x", lease)
+    time.sleep(1.6)
+    assert coord.get_value("svc", "x") is None
+    with pytest.raises(errors.LeaseExpiredError):
+        coord.refresh_server("svc", "x", lease)
+
+
+def test_put_if_absent_election(coord):
+    l1 = coord.set_server_not_exists("leader", "0", "pod_a", ttl=5)
+    assert l1 is not None
+    # second contender loses
+    assert coord.set_server_not_exists("leader", "0", "pod_b", ttl=5) is None
+    assert coord.get_value("leader", "0") == "pod_a"
+    # leader revokes → key released → next contender wins
+    coord.lease_revoke(l1)
+    l2 = coord.set_server_not_exists("leader", "0", "pod_b", ttl=5)
+    assert l2 is not None
+    assert coord.get_value("leader", "0") == "pod_b"
+
+
+def test_leadership_expires_on_ttl(coord):
+    lease = coord.set_server_not_exists("leader", "0", "pod_a", ttl=1)
+    assert lease is not None
+    time.sleep(1.6)  # no refresh → lease expires → key deleted
+    l2 = coord.set_server_not_exists("leader", "0", "pod_b", ttl=5)
+    assert l2 is not None
+
+
+def test_guarded_txn(coord):
+    coord.set_server_permanent("leader", "0", "me")
+    assert coord.put_if_leader("leader", "0", "me",
+                               [("/test_job/cluster/nodes/c", "v1")])
+    assert coord.get_value("cluster", "c") == "v1"
+    # wrong leader value → txn rejected
+    assert not coord.put_if_leader("leader", "0", "not_me",
+                                   [("/test_job/cluster/nodes/c", "v2")])
+    assert coord.get_value("cluster", "c") == "v1"
+
+
+def test_txn_compare_ops(coord):
+    key = "/test_job/k"
+    ok, _ = coord.txn([(key, "not_exists", None)], [("put", key, "1")])
+    assert ok
+    ok, _ = coord.txn([(key, "not_exists", None)], [("put", key, "2")])
+    assert not ok
+    ok, _ = coord.txn([(key, "value_eq", "1")], [("put", key, "3")])
+    assert ok
+    assert coord.get_key(key)["value"] == "3"
+
+
+def test_watch_service_diffing(coord):
+    events = []
+    done = threading.Event()
+
+    def cb(added, removed, all_servers):
+        events.append((dict(added), dict(removed)))
+        if len(events) >= 3:
+            done.set()
+
+    w = coord.watch_service("svc", cb, poll_timeout=0.5)
+    try:
+        coord.set_server_permanent("svc", "a", "va")
+        time.sleep(0.3)
+        coord.set_server_permanent("svc", "b", "vb")
+        time.sleep(0.3)
+        coord.remove_server("svc", "a")
+        assert done.wait(5.0)
+    finally:
+        w.stop()
+    flat_added = {}
+    flat_removed = {}
+    for added, removed in events:
+        flat_added.update(added)
+        flat_removed.update(removed)
+    assert flat_added == {"a": "va", "b": "vb"}
+    assert "a" in flat_removed
+
+
+def test_watch_sees_lease_expiry(coord):
+    removed_names = []
+    got = threading.Event()
+
+    def cb(added, removed, all_servers):
+        removed_names.extend(removed.keys())
+        if removed:
+            got.set()
+
+    coord.set_server_with_lease("svc", "dying", "v", ttl=1)
+    w = coord.watch_service("svc", cb, poll_timeout=0.5)
+    try:
+        assert got.wait(5.0)
+        assert removed_names == ["dying"]
+    finally:
+        w.stop()
+
+
+def test_clean_root_isolates_namespaces(store):
+    c1 = store.client(root="job1")
+    c2 = store.client(root="job2")
+    c1.set_server_permanent("svc", "a", "1")
+    c2.set_server_permanent("svc", "a", "2")
+    c1.clean_root()
+    assert c1.get_service("svc") == []
+    assert c2.get_service("svc") == [("a", "2")]

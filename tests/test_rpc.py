@@ -1,0 +1,94 @@
+"""RPC substrate tests: framing, dispatch, error envelopes, reconnect."""
+
+import threading
+
+import pytest
+
+from edl_tpu.rpc import framing
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import errors
+
+
+def test_framing_roundtrip():
+    obj = {"a": 1, "b": [1, 2, 3], "c": b"\x00\xff", "d": "héllo"}
+    frame = framing.pack_frame(obj)
+    assert frame[:4] == framing.MAGIC
+
+    class FakeSock(object):
+        def __init__(self, data):
+            self._data = data
+
+        def recv(self, n):
+            chunk, self._data = self._data[:n], self._data[n:]
+            return chunk
+
+    assert framing.read_frame(FakeSock(frame)) == obj
+    with pytest.raises(framing.FramingError, match="bad magic"):
+        framing.read_frame(FakeSock(b"XXXX" + frame[4:]))
+
+
+def test_rpc_call_and_errors():
+    server = RpcServer(host="127.0.0.1")
+    server.register("add", lambda a, b: a + b)
+
+    def boom():
+        raise errors.NotFoundError("nothing here")
+
+    server.register("boom", boom)
+    server.start()
+    try:
+        client = RpcClient(server.endpoint)
+        assert client.call("add", 2, 3) == 5
+        assert client.call("add", a=10, b=20) == 30
+        with pytest.raises(errors.NotFoundError, match="nothing here"):
+            client.call("boom")
+        with pytest.raises(errors.RpcError, match="no such method"):
+            client.call("missing")
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_concurrent_clients():
+    server = RpcServer(host="127.0.0.1")
+    server.register("echo", lambda x: x)
+    server.start()
+    results = {}
+
+    def worker(i):
+        c = RpcClient(server.endpoint)
+        for _ in range(20):
+            results[i] = c.call("echo", i)
+        c.close()
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == {i: i for i in range(8)}
+    finally:
+        server.stop()
+
+
+def test_rpc_reconnect_after_server_restart():
+    server = RpcServer(host="127.0.0.1")
+    server.register("ping", lambda: "pong")
+    server.start()
+    port = server.port
+    client = RpcClient(server.endpoint)
+    assert client.call("ping") == "pong"
+    server.stop()
+    client.close()  # existing handler threads outlive stop(); force reconnect
+    with pytest.raises(errors.ConnectError):
+        client.call("ping")
+    server2 = RpcServer(host="127.0.0.1", port=port)
+    server2.register("ping", lambda: "pong2")
+    server2.start()
+    try:
+        assert client.call("ping") == "pong2"
+    finally:
+        server2.stop()
