@@ -1,0 +1,31 @@
+"""Launcher CLI argument parsing.
+
+Reference parity: edl/utils/args_utils.py:32-96 (nodes_range,
+nproc_per_node, etcd endpoints → store endpoints, job_id, log flags, hdfs →
+checkpoint_path, positional training_script + args).
+"""
+
+import argparse
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        "edl-tpu-run",
+        description="Elastic TPU collective training launcher")
+    p.add_argument("--job_id", default=None,
+                   help="job id (or $EDL_TPU_JOB_ID)")
+    p.add_argument("--store_endpoints", default=None,
+                   help="coordination store endpoints, comma separated")
+    p.add_argument("--nodes_range", default=None,
+                   help="elastic node range 'min:max' (or a single count)")
+    p.add_argument("--nproc_per_node", type=int, default=None,
+                   help="trainer processes per host (default 1 on TPU)")
+    p.add_argument("--pod_ip", default=None,
+                   help="this host's IP as seen by peers")
+    p.add_argument("--checkpoint_path", default=None,
+                   help="shared checkpoint directory for elastic resume")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--log_level", default=None)
+    p.add_argument("training_script", help="the training program to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)

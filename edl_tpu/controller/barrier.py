@@ -1,0 +1,129 @@
+"""Stage-keyed barrier: pods check in under the current cluster stage; when
+the check-in set covers the cluster's pod set, everyone gets the cluster map.
+
+Reference parity: edl/utils/pod_server.py:69-116 (Barrier collects pod_ids
+per stage and returns the cluster JSON or a retryable error) and
+pod_server_client.py:37-60 (retry-loop client). Served on the leader's pod
+RPC server; clients locate the leader through the resource registry.
+"""
+
+import threading
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants, leader
+from edl_tpu.controller.resource_pods import load_resource_pods
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import errors
+from edl_tpu.utils.errors import handle_errors_until_timeout
+
+
+class BarrierServicer(object):
+    def __init__(self, coord):
+        self._coord = coord
+        self._lock = threading.Lock()
+        self._stages = {}  # stage -> set(pod_id)
+
+    def barrier(self, stage, pod_id):
+        cluster = cluster_mod.load_from_store(self._coord)
+        if cluster is None:
+            raise errors.BarrierError("cluster not generated yet")
+        if stage != cluster.stage:
+            raise errors.BarrierError(
+                "stage %s != current stage %s" % (stage, cluster.stage))
+        with self._lock:
+            checked = self._stages.setdefault(stage, set())
+            checked.add(pod_id)
+            want = set(cluster.pod_ids())
+            if want.issubset(checked):
+                # drop stale stages to bound memory
+                for s in list(self._stages):
+                    if s != stage:
+                        del self._stages[s]
+                return cluster.to_json()
+        raise errors.BarrierError(
+            "barrier waiting: %d/%d pods at stage %s"
+            % (len(checked & want), len(want), stage))
+
+
+class PodServer(object):
+    """Per-pod RPC server hosting the barrier servicer (and, on the leader,
+    answering every pod's barrier calls)."""
+
+    def __init__(self, coord, pod):
+        self._rpc = RpcServer(host="0.0.0.0", port=0)
+        self._servicer = BarrierServicer(coord)
+        self._rpc.register("barrier", self._servicer.barrier)
+        self._pod = pod
+
+    def start(self):
+        self._rpc.start()
+        self._pod.port = self._rpc.port
+        return self
+
+    @property
+    def port(self):
+        return self._rpc.port
+
+    def stop(self):
+        self._rpc.stop()
+
+
+class _BarrierSession(object):
+    """Caches the leader lookup and its RPC connection across the 0.5s
+    retry loop; refreshed only when a call fails (leadership may move)."""
+
+    def __init__(self, coord, pod_id):
+        self._coord = coord
+        self._pod_id = pod_id
+        self._client = None
+        self._leader_id = None
+
+    def _connect(self):
+        leader_id = leader.get_leader_id(self._coord)
+        if leader_id is None:
+            raise errors.BarrierError("no leader elected yet")
+        if self._client is not None and leader_id == self._leader_id:
+            return
+        self.close()
+        resources = load_resource_pods(self._coord)
+        leader_pod = resources.get(leader_id)
+        if leader_pod is None or leader_pod.port is None:
+            raise errors.BarrierError(
+                "leader pod %s not in resources" % leader_id)
+        self._client = RpcClient(leader_pod.endpoint, timeout=10)
+        self._leader_id = leader_id
+
+    def attempt(self):
+        self._connect()
+        cluster = cluster_mod.load_from_store(self._coord)
+        if cluster is None:
+            raise errors.BarrierError("cluster not generated yet")
+        try:
+            cluster_json = self._client.call("barrier", cluster.stage,
+                                             self._pod_id)
+        except errors.ConnectError:
+            self.close()
+            raise
+        return cluster_mod.Cluster().from_json(cluster_json)
+
+    def close(self):
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        self._leader_id = None
+
+
+def barrier_wait(coord, pod_id, timeout=constants.BARRIER_TIMEOUT):
+    """Block until every pod of the current cluster has checked in; returns
+    the agreed Cluster. Raises TimeoutError_ after ``timeout`` seconds."""
+    session = _BarrierSession(coord, pod_id)
+
+    @handle_errors_until_timeout
+    def _once():
+        return session.attempt()
+
+    try:
+        return _once(timeout=timeout, interval=0.5)
+    finally:
+        session.close()

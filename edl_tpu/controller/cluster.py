@@ -1,0 +1,76 @@
+"""Cluster model: the rank-ordered pod map plus a stage uuid.
+
+Reference parity: edl/utils/cluster.py — stage uuid regenerated on every
+membership change (:137-138), leader = pods[0] (:129), store load helpers
+(:153-175). The stage is the epoch token of the barrier protocol.
+"""
+
+from edl_tpu.controller import constants
+from edl_tpu.controller.pod import Pod
+from edl_tpu.controller.status import Status
+from edl_tpu.utils import errors, unique_name
+from edl_tpu.utils.json_serializable import Serializable
+from edl_tpu.utils.errors import handle_errors_until_timeout
+
+
+class Cluster(Serializable):
+    _json_types = {"pods": [Pod]}
+
+    def __init__(self):
+        self.stage = unique_name.uid()
+        self.pods = []
+        self.status = Status.INITIAL
+
+    def new_stage(self):
+        self.stage = unique_name.uid()
+
+    def assign_ranks(self):
+        base = 0
+        for rank, pod in enumerate(self.pods):
+            base = pod.set_rank(rank, base)
+
+    def pod_ids(self):
+        return [p.id for p in self.pods]
+
+    def get_pod(self, pod_id):
+        for p in self.pods:
+            if p.id == pod_id:
+                return p
+        return None
+
+    def leader_pod(self):
+        return self.pods[0] if self.pods else None
+
+    def get_leader_endpoint(self):
+        leader = self.leader_pod()
+        return leader.endpoint if leader else None
+
+    def trainer_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def world_size(self):
+        return sum(len(p.trainers) for p in self.pods)
+
+    def total_devices(self):
+        return sum(len(t.devices) for p in self.pods for t in p.trainers)
+
+
+def save_to_store(coord, cluster):
+    coord.set_server_permanent(constants.SERVICE_CLUSTER,
+                               constants.CLUSTER_SERVER, cluster.to_json())
+
+
+def load_from_store(coord):
+    value = coord.get_value(constants.SERVICE_CLUSTER,
+                            constants.CLUSTER_SERVER)
+    if value is None:
+        return None
+    return Cluster().from_json(value)
+
+
+@handle_errors_until_timeout
+def wait_to_load_from_store(coord):
+    cluster = load_from_store(coord)
+    if cluster is None:
+        raise errors.NotFoundError("cluster not generated yet")
+    return cluster

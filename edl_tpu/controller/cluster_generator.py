@@ -1,0 +1,175 @@
+"""Leader-only cluster generator: computes the next cluster map from live
+resources + statuses and commits it behind a leadership-guarded transaction.
+
+Reference parity: edl/utils/cluster_generator.py — initial assembly from
+resource pods (:95-134), disappeared/failed detection (:179-192), appending
+INITIAL pods while below max_nodes (:136-153), min_nodes enforcement
+(:255-264), and the leadership-guarded commit (:223-250).
+
+TPU twist: a ``topology_valid`` hook constrains legal world sizes — TPU
+slices only support certain host counts (SURVEY.md §7 "hard parts"), unlike
+the reference's any-count-in-[min,max].
+"""
+
+import threading
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants, status, train_status
+from edl_tpu.controller.cluster import Cluster
+from edl_tpu.controller.resource_pods import load_resource_pods
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class Generator(object):
+    def __init__(self, coord, pod_id, min_nodes, max_nodes,
+                 topology_valid=None):
+        self._coord = coord
+        self._pod_id = pod_id
+        self._min = min_nodes
+        self._max = max_nodes
+        self._topology_valid = topology_valid or (lambda n: True)
+        self._stop = threading.Event()
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def start(self):
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="cluster-generator")
+            self._thread.start()
+        return self
+
+    def stop(self):
+        with self._lock:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+                self._thread = None
+
+    def _run(self):
+        while not self._stop.wait(constants.GENERATE_INTERVAL):
+            try:
+                self._generate_once()
+            except errors.EdlError as e:
+                logger.warning("cluster generation error: %r", e)
+            except Exception:
+                logger.exception("cluster generation failed")
+
+    # -- the actual policy ---------------------------------------------------
+
+    def _generate_once(self):
+        job = status.load_job_status(self._coord)
+        if job in (status.Status.SUCCEED, status.Status.FAILED):
+            return
+        current = cluster_mod.load_from_store(self._coord)
+        resources = load_resource_pods(self._coord)
+        statuses = status.load_pods_status(self._coord)
+
+        if current is None or not current.pods:
+            new = self._initial_cluster(resources)
+        else:
+            new = self._next_cluster(current, resources, statuses)
+        if new is None:
+            return
+        new.assign_ranks()
+        self._commit(new)
+
+    def _initial_cluster(self, resources):
+        if len(resources) < self._min:
+            return None
+        n = min(len(resources), self._max)
+        while n >= self._min and not self._topology_valid(n):
+            n -= 1
+        if n < self._min:
+            logger.warning("no topology-valid size in [%d,%d] for %d pods",
+                           self._min, self._max, len(resources))
+            return None
+        cluster = Cluster()
+        # deterministic order: leader pod first, then by pod id
+        ids = sorted(resources.keys())
+        if self._pod_id in ids:
+            ids.remove(self._pod_id)
+            ids.insert(0, self._pod_id)
+        cluster.pods = [resources[i] for i in ids[:n]]
+        cluster.status = status.Status.RUNNING
+        logger.info("initial cluster: %d pods, stage %s", n, cluster.stage)
+        return cluster
+
+    def _next_cluster(self, current, resources, statuses):
+        alive, gone, finished = [], [], []
+        for pod in current.pods:
+            if statuses.get(pod.id) == status.Status.SUCCEED:
+                # graceful departure: exclude from future clusters but do
+                # not count as a failure (its launcher has exited and can
+                # never answer a barrier again)
+                finished.append(pod.id)
+            elif pod.id not in resources:
+                gone.append(pod.id)
+            elif statuses.get(pod.id) == status.Status.FAILED:
+                gone.append(pod.id)
+            else:
+                alive.append(pod)
+
+        added = []
+        if not finished and self._scale_out_allowed(statuses):
+            room = self._max - len(alive)
+            joinable = sorted(i for i in resources
+                              if i not in set(current.pod_ids()))
+            for pod_id in joinable[:max(0, room)]:
+                added.append(resources[pod_id])
+
+        if not gone and not added and not finished:
+            return None
+        if finished and not gone:
+            # pods are completing; don't churn the cluster under them
+            return None
+
+        # shrink to the largest topology-valid size >= min (drop newly
+        # added pods first, then alive pods from the tail)
+        candidates = alive + added
+        n = len(candidates)
+        while n >= self._min and not self._topology_valid(n):
+            n -= 1
+        if n < self._min:
+            logger.error(
+                "no topology-valid cluster size in [%d,%d] reachable from "
+                "%d live pods; marking job FAILED", self._min, self._max,
+                len(candidates))
+            status.save_job_status(self._coord, status.Status.FAILED)
+            return None
+        candidates = candidates[:n]
+
+        new = Cluster()
+        new.pods = candidates
+        new.status = status.Status.RUNNING
+        logger.info("new cluster: %d pods (%d gone, %d finished, %d added), "
+                    "stage %s", n, len(gone), len(finished),
+                    len([p for p in candidates if p in added]), new.stage)
+        return new
+
+    def _scale_out_allowed(self, statuses):
+        """Don't bother scaling out when training is nearly done
+        (reference parity: doc/edl_collective_design_doc.md:27)."""
+        if status.Status.SUCCEED in statuses.values():
+            return False
+        all_ts = self._coord.get_service(constants.SERVICE_TRAIN_STATUS)
+        for _, ts in all_ts:
+            if ts in (train_status.TrainStatus.NEARTHEEND,
+                      train_status.TrainStatus.SUCCEED):
+                return False
+        return True
+
+    def _commit(self, new):
+        cluster_key = self._coord.service_prefix(
+            constants.SERVICE_CLUSTER) + constants.CLUSTER_SERVER
+        ok = self._coord.put_if_leader(
+            constants.SERVICE_LEADER, constants.LEADER_SERVER, self._pod_id,
+            [(cluster_key, new.to_json())])
+        if not ok:
+            raise errors.NotLeaderError(
+                "pod %s is no longer leader; cluster not committed"
+                % self._pod_id)

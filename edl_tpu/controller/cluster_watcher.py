@@ -1,0 +1,53 @@
+"""Per-pod watcher on the cluster key; flags membership/stage changes.
+
+Reference parity: edl/utils/cluster_watcher.py (_is_world_changed:71-95 —
+changed when stage or the rank-ordered pod-id list differ). Built on the
+store's long-poll watch instead of polling.
+"""
+
+import threading
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class ClusterWatcher(object):
+    def __init__(self, coord, current_cluster):
+        self._coord = coord
+        self._current = current_cluster
+        self._changed = threading.Event()
+        self._new_cluster = None
+        self._lock = threading.Lock()
+        self._watcher = coord.watch_service(
+            constants.SERVICE_CLUSTER, self._on_event,
+            poll_timeout=constants.WATCH_INTERVAL)
+
+    def _on_event(self, added, removed, all_servers):
+        value = all_servers.get(constants.CLUSTER_SERVER)
+        if value is None:
+            return
+        try:
+            new = cluster_mod.Cluster().from_json(value)
+        except Exception:
+            logger.exception("bad cluster value in store")
+            return
+        if (new.stage != self._current.stage
+                or new.pod_ids() != self._current.pod_ids()):
+            with self._lock:
+                self._new_cluster = new
+            self._changed.set()
+
+    def changed(self):
+        return self._changed.is_set()
+
+    def wait_changed(self, timeout):
+        return self._changed.wait(timeout)
+
+    def get_new_cluster(self):
+        with self._lock:
+            return self._new_cluster
+
+    def stop(self):
+        self._watcher.stop()

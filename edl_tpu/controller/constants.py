@@ -1,0 +1,32 @@
+"""Coordination-store service names and timing constants.
+
+Reference parity: edl/utils/constants.py:15-27 (service names, TTL=15s).
+Keys live under /<job_id>/<service>/nodes/<server> — job_id is the client
+root, so jobs are fully namespace-isolated.
+"""
+
+SERVICE_RESOURCE = "resource"
+SERVICE_LEADER = "leader"
+SERVICE_CLUSTER = "cluster"
+SERVICE_POD_STATUS = "pod_status"
+SERVICE_JOB_STATUS = "job_status"
+SERVICE_TRAIN_STATUS = "train_status"
+SERVICE_READER = "reader"
+SERVICE_STATE = "state"
+SERVICE_JOB_FLAG = "job_flag"
+
+LEADER_SERVER = "0"          # the single leader key
+CLUSTER_SERVER = "cluster"   # the single cluster-map key
+JOB_STATUS_SERVER = "job_status"
+
+import os
+
+ETCD_TTL = int(os.environ.get("EDL_TPU_TTL", "10"))  # registration lease TTL
+REFRESH_INTERVAL = ETCD_TTL / 3.0
+GENERATE_INTERVAL = 1.0      # leader cluster-generator period
+WATCH_INTERVAL = 1.0         # cluster watcher poll period
+SUPERVISE_INTERVAL = 1.0     # launcher supervision loop period
+BARRIER_TIMEOUT = int(os.environ.get("EDL_TPU_BARRIER_TIMEOUT", "600"))
+RESIZE_BARRIER_TIMEOUT = int(
+    os.environ.get("EDL_TPU_RESIZE_BARRIER_TIMEOUT", "120"))
+FLAG_WAIT_TIMEOUT = int(os.environ.get("EDL_TPU_FLAG_WAIT_TIMEOUT", "300"))

@@ -1,0 +1,227 @@
+"""The per-host launcher daemon: register → elect → barrier → spawn →
+supervise → stop-resume on membership change.
+
+Reference parity: edl/utils/launcher.py (init:58, _barrier:69, _launch:160,
+supervision loop :202-246, _exit:99-130). The launch call stack is
+SURVEY.md §3.1. The TPU difference: one trainer process per host owning all
+local chips; gradient communication is XLA collectives inside the trainer,
+so the launcher only manages membership + barrier + processes.
+"""
+
+import time
+
+from edl_tpu.controller import barrier as barrier_mod
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants, status, train_process
+from edl_tpu.controller.cluster_generator import Generator
+from edl_tpu.controller.cluster_watcher import ClusterWatcher
+from edl_tpu.controller.leader import LeaderElector
+from edl_tpu.controller.resource_pods import ResourceRegister
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class Launcher(object):
+    def __init__(self, job_env, pod, coord, training_script, script_args=(),
+                 topology_valid=None):
+        self._job_env = job_env
+        self._pod = pod
+        self._coord = coord
+        self._script = training_script
+        self._script_args = list(script_args)
+        self._topology_valid = topology_valid
+
+        self._pod_server = None
+        self._resource_register = None
+        self._elector = None
+        self._generator = None
+        self._watcher = None
+        self._procs = []
+        self._cluster = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def init(self):
+        status.save_pod_status(self._coord, self._pod.id,
+                               status.Status.INITIAL)
+        self._pod_server = barrier_mod.PodServer(self._coord,
+                                                 self._pod).start()
+        logger.info("pod %s serving barrier on port %d", self._pod.id,
+                    self._pod.port)
+        return self
+
+    def launch(self):
+        """Run the job to completion; returns True on success."""
+        try:
+            return self._launch()
+        finally:
+            self._cleanup()
+
+    # -- internals -----------------------------------------------------------
+
+    def _launch(self):
+        je = self._job_env
+        self._resource_register = ResourceRegister(self._coord, self._pod)
+        self._generator = Generator(
+            self._coord, self._pod.id, je.min_nodes, je.max_nodes,
+            topology_valid=self._topology_valid)
+        self._elector = LeaderElector(
+            self._coord, self._pod.id,
+            on_elected=lambda: self._generator.start(),
+            on_lost=lambda: self._generator.stop()).start()
+
+        if not self._join_cluster():
+            logger.info("pod %s never admitted to the cluster; exiting as "
+                        "surplus", self._pod.id)
+            return True
+        status.save_pod_status(self._coord, self._pod.id,
+                               status.Status.RUNNING)
+        self._watcher = ClusterWatcher(self._coord, self._cluster)
+        self._procs = train_process.start_trainers(
+            je, self._pod, self._cluster, self._script, self._script_args,
+            je.log_dir)
+        return self._supervise()
+
+    def _join_cluster(self):
+        """Barrier until a cluster that *includes this pod* is agreed.
+
+        A pod not in the current map is a late joiner waiting for the
+        generator to scale it in (reference: INITIAL pods appended while
+        below max_nodes, cluster_generator.py:136-153) — it stays PENDING
+        and re-barriers rather than exiting."""
+        deadline = time.monotonic() + constants.BARRIER_TIMEOUT
+        pending = False
+        while time.monotonic() < deadline:
+            remaining = max(5.0, deadline - time.monotonic())
+            try:
+                self._cluster = barrier_mod.barrier_wait(
+                    self._coord, self._pod.id, timeout=remaining)
+            except errors.TimeoutError_:
+                break
+            if self._update_local_pod():
+                return True
+            job = status.load_job_status(self._coord)
+            if job in (status.Status.SUCCEED, status.Status.FAILED):
+                return False
+            if not pending:
+                status.save_pod_status(self._coord, self._pod.id,
+                                       status.Status.PENDING)
+                pending = True
+                logger.info("pod %s waiting to be scaled in", self._pod.id)
+            time.sleep(constants.GENERATE_INTERVAL)
+        return False
+
+    def _update_local_pod(self):
+        """Adopt rank/trainer-rank assignments from the agreed cluster;
+        False if this pod was evicted (reference: launcher.py:142-158)."""
+        mine = self._cluster.get_pod(self._pod.id)
+        if mine is None:
+            return False
+        mine.addr, mine.port = self._pod.addr, self._pod.port
+        self._pod = mine
+        return True
+
+    def _supervise(self):
+        while True:
+            time.sleep(constants.SUPERVISE_INTERVAL)
+
+            done, failed = train_process.watch_trainers(self._procs)
+            if failed:
+                logger.error("a trainer failed on pod %s", self._pod.id)
+                return self._exit(False)
+            if done:
+                logger.info("all trainers on pod %s finished", self._pod.id)
+                return self._exit(True)
+
+            if self._resource_register.is_broken():
+                logger.error("resource registration lost; killing trainers")
+                return self._exit(False)
+
+            if status.load_job_status(self._coord) == status.Status.FAILED:
+                logger.error("job marked FAILED; exiting")
+                return self._exit(False)
+
+            if self._watcher.changed():
+                try:
+                    if not self._resize():
+                        logger.info("pod %s evicted during resize; clean "
+                                    "exit", self._pod.id)
+                        return True
+                except errors.EdlError as e:
+                    logger.error("resize failed on pod %s: %r", self._pod.id,
+                                 e)
+                    return self._exit(False)
+
+    def _resize(self):
+        """Stop-resume elasticity (reference: launcher.py:221-244): kill
+        trainers, re-barrier on the new cluster, respawn. Returns False if
+        this pod was evicted by the new cluster map."""
+        logger.info("membership changed; stop-resume resize on pod %s",
+                    self._pod.id)
+        train_process.terminate_trainers(self._procs)
+        self._procs = []
+        self._watcher.stop()
+
+        try:
+            self._cluster = barrier_mod.barrier_wait(
+                self._coord, self._pod.id,
+                timeout=constants.RESIZE_BARRIER_TIMEOUT)
+        except errors.TimeoutError_:
+            logger.error("resize barrier timed out on pod %s", self._pod.id)
+            raise errors.BarrierError("resize barrier timed out")
+        if not self._update_local_pod():
+            return False
+        self._watcher = ClusterWatcher(self._coord, self._cluster)
+        self._procs = train_process.start_trainers(
+            self._job_env, self._pod, self._cluster, self._script,
+            self._script_args, self._job_env.log_dir)
+        logger.info("resize complete: world=%d stage=%s",
+                    self._cluster.world_size(), self._cluster.stage)
+        return True
+
+    def _exit(self, ok):
+        """Write the pod flag; the leader aggregates all flags into the job
+        status (reference: launcher.py:99-130)."""
+        status.save_pod_status(
+            self._coord, self._pod.id,
+            status.Status.SUCCEED if ok else status.Status.FAILED)
+        status.save_job_flag(self._coord, self._pod.id, ok)
+        if not ok:
+            # NOT a global job failure: the generator removes this pod and
+            # the survivors resize; the job only fails below min_nodes.
+            return False
+        if self._elector is not None and self._elector.is_leader():
+            self._leader_wait_and_finalize()
+        return ok
+
+    def _leader_wait_and_finalize(self):
+        """Leader waits for every cluster pod's flag, then writes the job
+        status. Pods that died (lease gone) fail the job."""
+        deadline = time.monotonic() + constants.FLAG_WAIT_TIMEOUT
+        want = set(self._cluster.pod_ids()) if self._cluster else set()
+        while time.monotonic() < deadline:
+            flags = status.load_job_flags(self._coord)
+            # only flags of *current* cluster members matter — pods resized
+            # away earlier may have left FAILED flags behind
+            if any(flags.get(pid) == status.Status.FAILED for pid in want):
+                status.save_job_status(self._coord, status.Status.FAILED)
+                return
+            if want.issubset(flags.keys()):
+                status.save_job_status(self._coord, status.Status.SUCCEED)
+                logger.info("job %s SUCCEED", self._job_env.job_id)
+                return
+            time.sleep(0.5)
+        logger.warning("leader timed out waiting for pod flags %s",
+                       want - set(status.load_job_flags(self._coord)))
+        status.save_job_status(self._coord, status.Status.FAILED)
+
+    def _cleanup(self):
+        if self._procs:
+            train_process.terminate_trainers(self._procs)
+        for closer in (self._watcher, self._generator, self._elector,
+                       self._resource_register, self._pod_server):
+            if closer is not None:
+                try:
+                    closer.stop()
+                except Exception:
+                    logger.exception("cleanup failed for %r", closer)

@@ -1,0 +1,81 @@
+"""Leader election: seize a TTL-leased key, keep it refreshed, run the
+cluster generator while leading.
+
+Reference parity: edl/utils/leader_pod.py (_seize_leader:57-88 put-if-absent
+with TTL lease; losers retry every 3s :104-119; winner starts the generator).
+Improvement over the reference: a leader that loses its lease stops its
+generator and rejoins the election instead of going silent.
+"""
+
+import threading
+
+from edl_tpu.controller import constants
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class LeaderElector(object):
+    def __init__(self, coord, pod_id, on_elected=None, on_lost=None,
+                 ttl=constants.ETCD_TTL):
+        self._coord = coord
+        self._pod_id = pod_id
+        self._ttl = ttl
+        self._on_elected = on_elected
+        self._on_lost = on_lost
+        self._is_leader = threading.Event()
+        self._stop = threading.Event()
+        self._broken = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="leader-elector")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        lease_id = None
+        while not self._stop.is_set():
+            try:
+                if lease_id is None:
+                    lease_id = self._coord.set_server_not_exists(
+                        constants.SERVICE_LEADER, constants.LEADER_SERVER,
+                        self._pod_id, self._ttl)
+                    if lease_id is not None:
+                        logger.info("pod %s became leader", self._pod_id)
+                        self._is_leader.set()
+                        if self._on_elected:
+                            self._on_elected()
+                    self._stop.wait(1.0)
+                else:
+                    if not self._coord.lease_refresh(lease_id):
+                        raise errors.LeaseExpiredError("leader lease expired")
+                    self._stop.wait(self._ttl / 3.0)
+            except errors.EdlError as e:
+                if self._is_leader.is_set():
+                    logger.error("pod %s lost leadership: %r", self._pod_id,
+                                 e)
+                    self._is_leader.clear()
+                    if self._on_lost:
+                        self._on_lost()
+                lease_id = None
+                self._stop.wait(1.0)
+
+    def is_leader(self):
+        return self._is_leader.is_set()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=self._ttl)
+        if self._is_leader.is_set():
+            try:
+                self._coord.remove_server(constants.SERVICE_LEADER,
+                                          constants.LEADER_SERVER)
+            except errors.EdlError:
+                pass
+            self._is_leader.clear()
+            if self._on_lost:
+                self._on_lost()
+
+
+def get_leader_id(coord):
+    return coord.get_value(constants.SERVICE_LEADER, constants.LEADER_SERVER)

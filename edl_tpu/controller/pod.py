@@ -1,0 +1,64 @@
+"""Pod model: one launcher daemon on one TPU-VM host.
+
+Reference parity: edl/utils/pod.py (uuid id, rank, addr, port, device list,
+trainers; rank setter propagates global trainer ranks — pod.py:145-150;
+from_env splits devices across nproc_per_node — pod.py:72-103). The TPU
+default is one trainer process per host owning every local chip (the JAX
+process model), rather than the per-GPU fan-out of the reference.
+"""
+
+from edl_tpu.controller.status import Status
+from edl_tpu.controller.trainer import Trainer
+from edl_tpu.utils import unique_name
+from edl_tpu.utils.json_serializable import Serializable
+from edl_tpu.utils.network import find_free_ports
+
+
+class Pod(Serializable):
+    _json_types = {"trainers": [Trainer]}
+
+    def __init__(self):
+        self.id = None
+        self.rank = None
+        self.addr = None
+        self.port = None        # barrier/pod RPC port
+        self.devices = []       # local chip indices on this host
+        self.trainers = []
+        self.status = Status.INITIAL
+
+    @staticmethod
+    def from_env(job_env):
+        pod = Pod()
+        pod.id = unique_name.uid()
+        pod.rank = None
+        pod.addr = job_env.pod_ip
+        pod.port = None
+        pod.devices = list(job_env.devices)
+        n = job_env.nproc_per_node
+        if pod.devices and n > len(pod.devices):
+            raise ValueError(
+                "nproc_per_node=%d exceeds %d local devices"
+                % (n, len(pod.devices)))
+        ports = find_free_ports(n)
+        # contiguous split with the remainder spread over the first chunks,
+        # so every device is assigned to exactly one trainer
+        base, rem = divmod(len(pod.devices), n)
+        offset = 0
+        for i in range(n):
+            size = base + (1 if i < rem else 0)
+            devs = pod.devices[offset:offset + size]
+            offset += size
+            pod.trainers.append(Trainer.make(
+                i, devs, "%s:%d" % (pod.addr, ports[i])))
+        return pod
+
+    def set_rank(self, rank, trainer_rank_base):
+        """Assign pod rank and propagate global trainer ranks."""
+        self.rank = rank
+        for i, t in enumerate(self.trainers):
+            t.global_rank = trainer_rank_base + i
+        return trainer_rank_base + len(self.trainers)
+
+    @property
+    def endpoint(self):
+        return "%s:%s" % (self.addr, self.port)

@@ -1,0 +1,52 @@
+"""TTL-leased registration with a background refresh thread.
+
+Reference parity: edl/utils/register.py (refresh every ttl/2; refresh
+failure ⇒ the node silently drops out of the cluster :57-68). Here refresh
+failure marks the register stopped so the launcher notices and exits.
+"""
+
+import threading
+
+from edl_tpu.controller import constants
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class Register(object):
+    def __init__(self, coord, service, server, value,
+                 ttl=constants.ETCD_TTL):
+        self._coord = coord
+        self._service = service
+        self._server = server
+        self._ttl = ttl
+        self._lease_id = coord.set_server_with_lease(service, server, value,
+                                                     ttl)
+        self._stop = threading.Event()
+        self._broken = threading.Event()
+        self._thread = threading.Thread(
+            target=self._refresher, daemon=True,
+            name="register-%s-%s" % (service, server))
+        self._thread.start()
+
+    def _refresher(self):
+        while not self._stop.wait(self._ttl / 3.0):
+            try:
+                self._coord.refresh_server(self._service, self._server,
+                                           self._lease_id)
+            except errors.EdlError as e:
+                logger.error("registration %s/%s lost: %r", self._service,
+                             self._server, e)
+                self._broken.set()
+                return
+
+    def is_broken(self):
+        return self._broken.is_set()
+
+    def stop(self, revoke=True):
+        self._stop.set()
+        self._thread.join(timeout=self._ttl)
+        if revoke:
+            try:
+                self._coord.lease_revoke(self._lease_id)
+            except errors.EdlError:
+                pass

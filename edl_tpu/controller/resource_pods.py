@@ -1,0 +1,22 @@
+"""Resource registry: every live launcher advertises its Pod JSON with a TTL.
+
+Reference parity: edl/utils/resource_pods.py (keys
+/<job>/resource/nodes/<pod_id>, TTL heartbeat; load_from_etcd:44;
+wait_resource:57).
+"""
+
+from edl_tpu.controller import constants
+from edl_tpu.controller.pod import Pod
+from edl_tpu.controller.register import Register
+
+
+class ResourceRegister(Register):
+    def __init__(self, coord, pod):
+        super().__init__(coord, constants.SERVICE_RESOURCE, pod.id,
+                         pod.to_json())
+
+
+def load_resource_pods(coord):
+    """pod_id -> Pod for every live launcher."""
+    return {name: Pod().from_json(value)
+            for name, value in coord.get_service(constants.SERVICE_RESOURCE)}

@@ -1,0 +1,55 @@
+"""Pod/job status enums + coordination-store persistence.
+
+Reference parity: edl/utils/status.py (Status enum :22-27, save/load pod and
+job status under the pod_status/job_status services :37-113).
+"""
+
+from edl_tpu.controller import constants
+from edl_tpu.utils import errors
+
+
+class Status(object):
+    INITIAL = "INITIAL"
+    RUNNING = "RUNNING"
+    PENDING = "PENDING"
+    SUCCEED = "SUCCEED"
+    FAILED = "FAILED"
+
+
+def save_pod_status(coord, pod_id, status):
+    coord.set_server_permanent(constants.SERVICE_POD_STATUS, pod_id, status)
+
+
+def load_pod_status(coord, pod_id):
+    return coord.get_value(constants.SERVICE_POD_STATUS, pod_id)
+
+
+def load_pods_status(coord):
+    """pod_id -> status for every pod that ever reported."""
+    return dict(coord.get_service(constants.SERVICE_POD_STATUS))
+
+
+def save_job_status(coord, status):
+    coord.set_server_permanent(constants.SERVICE_JOB_STATUS,
+                               constants.JOB_STATUS_SERVER, status)
+
+
+def load_job_status(coord):
+    return coord.get_value(constants.SERVICE_JOB_STATUS,
+                           constants.JOB_STATUS_SERVER)
+
+
+def save_job_flag(coord, pod_id, ok):
+    """Per-pod exit flag; the leader aggregates these into the job status
+    (reference parity: launcher.py:99-130 _exit)."""
+    coord.set_server_permanent(constants.SERVICE_JOB_FLAG, pod_id,
+                               Status.SUCCEED if ok else Status.FAILED)
+
+
+def load_job_flags(coord):
+    return dict(coord.get_service(constants.SERVICE_JOB_FLAG))
+
+
+def check_not_failed(coord):
+    if load_job_status(coord) == Status.FAILED:
+        raise errors.StatusError("job status is FAILED")

@@ -1,0 +1,28 @@
+"""Trainer model: one training process on a pod.
+
+Reference parity: edl/utils/trainer.py (uuid, rank_in_pod, device slice,
+endpoint, global_rank). On TPU a trainer is a JAX host process owning a set
+of local chips — usually all of them (one process per host).
+"""
+
+from edl_tpu.utils import unique_name
+from edl_tpu.utils.json_serializable import Serializable
+
+
+class Trainer(Serializable):
+    def __init__(self):
+        self.id = None
+        self.rank_in_pod = None
+        self.devices = []       # local chip indices owned by this process
+        self.endpoint = None    # host:port for jax.distributed / data plane
+        self.global_rank = None
+
+    @staticmethod
+    def make(rank_in_pod, devices, endpoint):
+        t = Trainer()
+        t.id = unique_name.uid()
+        t.rank_in_pod = rank_in_pod
+        t.devices = list(devices)
+        t.endpoint = endpoint
+        t.global_rank = None
+        return t

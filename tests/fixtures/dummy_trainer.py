@@ -1,0 +1,31 @@
+"""Exit-code-controlled dummy trainer for launcher tests.
+
+Reference parity: example/demo/collective demo trainer + launch_demo.py
+(exit-code-controlled, SURVEY.md §4). Usage:
+    dummy_trainer.py [sleep_seconds] [exit_code]
+Prints its rank/world/stage so tests can assert the env contract.
+"""
+
+import sys
+import time
+
+from edl_tpu.controller.env import TrainerEnv
+
+
+def main():
+    sleep_s = float(sys.argv[1]) if len(sys.argv) > 1 else 0.5
+    exit_code = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    env = TrainerEnv()
+    print("dummy_trainer rank=%d world=%d stage=%s pod=%s devices=%s"
+          % (env.global_rank, env.world_size, env.cluster_stage, env.pod_id,
+             env.local_devices), flush=True)
+    deadline = time.time() + sleep_s
+    while time.time() < deadline:
+        time.sleep(0.1)
+    print("dummy_trainer rank=%d exiting %d" % (env.global_rank, exit_code),
+          flush=True)
+    sys.exit(exit_code)
+
+
+if __name__ == "__main__":
+    main()

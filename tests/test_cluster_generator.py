@@ -1,0 +1,135 @@
+"""Cluster generator + barrier protocol tests — many actors in one process
+against the embedded store (reference parity: test_cluster_generator.py,
+test_leader_pod.py shapes)."""
+
+import os
+import threading
+import time
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants, status
+from edl_tpu.controller.barrier import PodServer, barrier_wait
+from edl_tpu.controller.cluster_generator import Generator
+from edl_tpu.controller.env import JobEnv
+from edl_tpu.controller.leader import LeaderElector, get_leader_id
+from edl_tpu.controller.pod import Pod
+from edl_tpu.controller.resource_pods import ResourceRegister
+
+
+def _pod():
+    os.environ["EDL_TPU_POD_IP"] = "127.0.0.1"
+    args = type("A", (), dict(
+        job_id="test_job", store_endpoints="x", nodes_range="1:4",
+        nproc_per_node=1, pod_ip="127.0.0.1", checkpoint_path=None,
+        log_dir=None, log_level=None))()
+    return Pod.from_env(JobEnv(args))
+
+
+def _wait(pred, timeout=15.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within %ss" % timeout)
+
+
+def test_generator_initial_scale_out_and_shrink(coord):
+    pod_a, pod_b, pod_c = _pod(), _pod(), _pod()
+    reg_a = ResourceRegister(coord, pod_a)
+    reg_b = ResourceRegister(coord, pod_b)
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, pod_a.id)
+    gen = Generator(coord, pod_a.id, min_nodes=2, max_nodes=3).start()
+    try:
+        c1 = _wait(lambda: cluster_mod.load_from_store(coord))
+        assert len(c1.pods) == 2
+        assert c1.pods[0].id == pod_a.id  # leader first
+        assert [p.rank for p in c1.pods] == [0, 1]
+
+        # scale out: pod_c joins
+        reg_c = ResourceRegister(coord, pod_c)
+        c2 = _wait(lambda: (lambda c: c if c and len(c.pods) == 3 else None)(
+            cluster_mod.load_from_store(coord)))
+        assert c2.stage != c1.stage
+        assert pod_c.id in c2.pod_ids()
+
+        # shrink: pod_c dies (lease revoked)
+        reg_c.stop()
+        c3 = _wait(lambda: (lambda c: c if c and len(c.pods) == 2 else None)(
+            cluster_mod.load_from_store(coord)))
+        assert c3.stage != c2.stage
+        assert pod_c.id not in c3.pod_ids()
+
+        # below min: pod_b dies → job FAILED
+        reg_b.stop()
+        _wait(lambda: status.load_job_status(coord) == status.Status.FAILED)
+    finally:
+        gen.stop()
+        reg_a.stop()
+
+
+def test_generator_commit_requires_leadership(coord):
+    pod_a = _pod()
+    reg = ResourceRegister(coord, pod_a)
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "someone_else")
+    gen = Generator(coord, pod_a.id, min_nodes=1, max_nodes=2).start()
+    try:
+        time.sleep(3)
+        assert cluster_mod.load_from_store(coord) is None
+    finally:
+        gen.stop()
+        reg.stop()
+
+
+def test_leader_elector_failover(coord):
+    events = []
+    e1 = LeaderElector(coord, "pod_1",
+                       on_elected=lambda: events.append("e1+"),
+                       on_lost=lambda: events.append("e1-")).start()
+    _wait(lambda: e1.is_leader())
+    assert get_leader_id(coord) == "pod_1"
+    e2 = LeaderElector(coord, "pod_2",
+                       on_elected=lambda: events.append("e2+")).start()
+    time.sleep(1.0)
+    assert not e2.is_leader()
+    e1.stop()
+    _wait(lambda: e2.is_leader(), timeout=20)
+    assert get_leader_id(coord) == "pod_2"
+    e2.stop()
+    assert events[0] == "e1+" and "e2+" in events
+
+
+def test_barrier_all_pods_get_cluster(coord):
+    pod_a, pod_b = _pod(), _pod()
+    regs = [ResourceRegister(coord, pod_a)]
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, pod_a.id)
+    server = PodServer(coord, pod_a).start()
+    # re-register pod_a now that its barrier port is known
+    regs[0].stop()
+    regs = [ResourceRegister(coord, pod_a), ResourceRegister(coord, pod_b)]
+    gen = Generator(coord, pod_a.id, min_nodes=2, max_nodes=2).start()
+    results = {}
+
+    def arrive(pod):
+        results[pod.id] = barrier_wait(coord, pod.id, timeout=30)
+
+    try:
+        threads = [threading.Thread(target=arrive, args=(p,))
+                   for p in (pod_a, pod_b)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=40)
+        assert set(results) == {pod_a.id, pod_b.id}
+        stages = {c.stage for c in results.values()}
+        assert len(stages) == 1
+        assert all(len(c.pods) == 2 for c in results.values())
+    finally:
+        gen.stop()
+        server.stop()
+        for r in regs:
+            r.stop()

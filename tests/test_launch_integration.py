@@ -1,0 +1,158 @@
+"""Full-launch integration: real launcher processes + real store server.
+
+Reference parity: test_launch.sh:40-77 — export job env, start two launch
+processes with an exit-code-controlled dummy trainer, assert both exit 0 and
+the job status key is set. Plus the elastic cases the reference never had
+green: resize-survival after SIGKILL and below-min job failure.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import status
+from edl_tpu.controller.status import Status
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, "tests", "fixtures", "dummy_trainer.py")
+
+
+def _spawn_launcher(store_endpoint, job_id, nodes_range, tmp_path, name,
+                    trainer_args=("0.5", "0")):
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO,
+        "EDL_TPU_POD_IP": "127.0.0.1",
+        "EDL_TPU_TTL": "3",
+        "JAX_PLATFORMS": "cpu",
+    })
+    log = open(str(tmp_path / ("%s.log" % name)), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
+         "--job_id", job_id, "--store_endpoints", store_endpoint,
+         "--nodes_range", nodes_range,
+         "--log_dir", str(tmp_path / ("%s_logs" % name)),
+         TRAINER] + list(trainer_args),
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+        preexec_fn=os.setsid)
+    log.close()
+    return proc
+
+
+def _kill_group(proc):
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def _wait_cluster_size(coord, n, timeout=30):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        c = cluster_mod.load_from_store(coord)
+        if c is not None and len(c.pods) == n:
+            return c
+        time.sleep(0.2)
+    raise AssertionError("cluster never reached %d pods" % n)
+
+
+def _dump_logs(tmp_path):
+    out = []
+    for root, _, files in os.walk(str(tmp_path)):
+        for f in files:
+            if f.endswith(".log") or f.startswith("workerlog"):
+                p = os.path.join(root, f)
+                with open(p, "rb") as fh:
+                    out.append("==== %s ====\n%s" % (
+                        p, fh.read().decode("utf-8", "replace")))
+    return "\n".join(out)
+
+
+@pytest.mark.integration
+def test_two_pod_launch_success(store, tmp_path):
+    job = "launch_ok"
+    coord = store.client(root=job)
+    p1 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod1")
+    p2 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod2")
+    try:
+        r1 = p1.wait(timeout=90)
+        r2 = p2.wait(timeout=90)
+        assert (r1, r2) == (0, 0), _dump_logs(tmp_path)
+        assert status.load_job_status(coord) == Status.SUCCEED, \
+            _dump_logs(tmp_path)
+    finally:
+        _kill_group(p1)
+        _kill_group(p2)
+
+
+@pytest.mark.integration
+def test_elastic_resize_survives_pod_kill(store, tmp_path):
+    """8→4→8 in miniature: 1→2 pods (scale out), SIGKILL one (shrink),
+    survivor resizes and completes; job SUCCEED."""
+    job = "launch_elastic"
+    coord = store.client(root=job)
+    p1 = _spawn_launcher(store.endpoint, job, "1:2", tmp_path, "pod1",
+                         trainer_args=("10", "0"))
+    try:
+        _wait_cluster_size(coord, 1)
+        p2 = _spawn_launcher(store.endpoint, job, "1:2", tmp_path, "pod2",
+                             trainer_args=("10", "0"))
+        c2 = _wait_cluster_size(coord, 2)
+        # pod1 started first → it is the leader (pods[0]); kill the joiner
+        _kill_group(p2)
+        c1b = _wait_cluster_size(coord, 1, timeout=30)
+        assert c1b.stage != c2.stage
+        r1 = p1.wait(timeout=120)
+        assert r1 == 0, _dump_logs(tmp_path)
+        assert status.load_job_status(coord) == Status.SUCCEED, \
+            _dump_logs(tmp_path)
+        # the survivor's trainer was restarted across cluster incarnations
+        # (the middle 2-pod incarnation may be torn down before its trainer
+        # prints, so require >= 2 distinct stages)
+        worker_log = (tmp_path / "pod1_logs" / "workerlog.0").read_text()
+        stages = {line.split("stage=")[1].split()[0]
+                  for line in worker_log.splitlines() if "stage=" in line}
+        assert len(stages) >= 2, worker_log
+    finally:
+        _kill_group(p1)
+        _kill_group(p2)
+
+
+@pytest.mark.integration
+def test_below_min_nodes_fails_job(store, tmp_path):
+    job = "launch_below_min"
+    coord = store.client(root=job)
+    p1 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod1",
+                         trainer_args=("30", "0"))
+    p2 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod2",
+                         trainer_args=("30", "0"))
+    try:
+        _wait_cluster_size(coord, 2)
+        _kill_group(p2)
+        r1 = p1.wait(timeout=120)
+        assert r1 == 1, _dump_logs(tmp_path)
+        assert status.load_job_status(coord) == Status.FAILED
+    finally:
+        _kill_group(p1)
+        _kill_group(p2)
+
+
+@pytest.mark.integration
+def test_failed_trainer_fails_pod(store, tmp_path):
+    job = "launch_trainer_fail"
+    coord = store.client(root=job)
+    p1 = _spawn_launcher(store.endpoint, job, "1:1", tmp_path, "pod1",
+                         trainer_args=("0.5", "7"))  # trainer exits 7
+    try:
+        r1 = p1.wait(timeout=60)
+        assert r1 == 1, _dump_logs(tmp_path)
+        flags = status.load_job_flags(coord)
+        assert list(flags.values()) == [Status.FAILED]
+    finally:
+        _kill_group(p1)
