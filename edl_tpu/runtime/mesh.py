@@ -1,0 +1,71 @@
+"""Device-mesh construction for dp/tp/sp/pp axes + topology validity.
+
+The TPU replacement for the reference's NCCL world bootstrap: there is no
+rendezvous to manage — `jax.devices()` exposes the slice topology and pjit /
+shard_map lower collectives onto ICI/DCN (SURVEY.md §2.7, §5.8). The
+launcher contributes only host membership; this module turns the surviving
+hosts' devices into a Mesh.
+"""
+
+import math
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "dp"
+MODEL_AXIS = "tp"
+SEQ_AXIS = "sp"
+PIPE_AXIS = "pp"
+EXPERT_AXIS = "ep"
+
+
+def make_mesh(dp=None, tp=1, sp=1, pp=1, ep=1, devices=None):
+    """Build a Mesh with axes (pp, dp, ep, sp, tp) over ``devices``.
+
+    dp=None ⇒ fill dp with whatever remains after the fixed axes. Axis order
+    puts tp innermost so tensor-parallel collectives ride the fastest ICI
+    links, and pp outermost (classic TPU layout; cf. the scaling-book
+    recipe of mesh-then-annotate).
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    fixed = tp * sp * pp * ep
+    if dp is None:
+        if n % fixed != 0:
+            raise ValueError("devices=%d not divisible by tp*sp*pp*ep=%d"
+                             % (n, fixed))
+        dp = n // fixed
+    if dp * fixed != n:
+        raise ValueError("mesh %dx%dx%dx%dx%d != %d devices"
+                         % (pp, dp, ep, sp, tp, n))
+    shape = (pp, dp, ep, sp, tp)
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError):
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array,
+                (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS))
+
+
+def data_sharding(mesh):
+    """Batch-dim sharding over dp (and sp if present)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, P())
+
+
+def topology_valid_power_of_two(n_hosts):
+    """Default TPU validity: host counts must be powers of two (sub-slices
+    of a pod slice). Replace per deployment topology. Used by the cluster
+    generator's validity hook (SURVEY.md §7 'hard parts')."""
+    return n_hosts > 0 and (n_hosts & (n_hosts - 1)) == 0
+
+
+def largest_valid_world(n_hosts):
+    if n_hosts <= 0:
+        return 0
+    return 2 ** int(math.floor(math.log2(n_hosts)))

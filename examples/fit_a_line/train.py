@@ -1,0 +1,71 @@
+"""fit_a_line: the minimum end-to-end elastic training slice.
+
+Run standalone:           python examples/fit_a_line/train.py
+Run under the launcher:   python -m edl_tpu.controller.launch ... train.py
+
+Reference parity: example/fit_a_line/train_ft.py — a tiny regression proving
+the whole stack: launcher → barrier → trainer → per-epoch checkpoint →
+kill/resize → resume from checkpoint (SURVEY.md §7 step 3).
+"""
+
+import argparse
+import json
+import sys
+
+import optax
+
+from edl_tpu.controller import train_status as ts
+from edl_tpu.runtime.trainer import ElasticTrainer, maybe_init_distributed
+
+
+def main(argv=None):
+    # must precede ANY jax computation (including model init)
+    maybe_init_distributed()
+    from edl_tpu.models import linear
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--steps_per_epoch", type=int, default=25)
+    p.add_argument("--total_batch_size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--step_sleep", type=float, default=0.0,
+                   help="artificial per-step delay (elasticity tests)")
+    args = p.parse_args(argv)
+
+    trainer = ElasticTrainer(
+        linear.loss_fn, linear.init_params(), optax.sgd(args.lr),
+        total_batch_size=args.total_batch_size)
+    env = trainer.env
+    resumed = trainer.resume()
+    start_epoch = trainer.state.next_epoch() if resumed else 0
+    print("fit_a_line: rank=%d world=%d start_epoch=%d resumed=%s"
+          % (env.global_rank, trainer.world_size, start_epoch, resumed),
+          flush=True)
+
+    loss = None
+    for epoch in range(start_epoch, args.epochs):
+        if epoch == args.epochs - 1:
+            trainer.report_status(ts.TrainStatus.NEARTHEEND)
+        trainer.begin_epoch(epoch)
+        for step in range(args.steps_per_epoch):
+            seed = epoch * 10000 + step
+            full = linear.synthetic_batch(args.total_batch_size, seed=seed)
+            lo = env.global_rank * trainer.per_host_batch
+            host_batch = {k: v[lo:lo + trainer.per_host_batch]
+                          for k, v in full.items()}
+            loss = float(trainer.train_step(host_batch))
+            if args.step_sleep:
+                import time
+                time.sleep(args.step_sleep)
+        trainer.end_epoch(save=True)
+        print("epoch %d done: loss=%.5f step=%d" % (epoch, loss,
+                                                    trainer.global_step),
+              flush=True)
+
+    trainer.report_status(ts.TrainStatus.SUCCEED)
+    print(json.dumps({"final_loss": loss, "steps": trainer.global_step,
+                      "world": trainer.world_size}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,12 +8,21 @@ strategy (SURVEY.md §4).
 
 import os
 
-# must run before jax is imported anywhere
+# must run before jax backends initialize
 os.environ["JAX_PLATFORMS"] = "cpu"
+# children spawned by integration tests must not register the TPU plugin
+# (its sitecustomize force-selects the axon platform)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU plugin's sitecustomize sets jax_platforms="axon,cpu" at
+# interpreter start, overriding $JAX_PLATFORMS — force CPU back for tests
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

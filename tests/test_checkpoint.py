@@ -1,0 +1,91 @@
+"""Checkpoint manager tests: versioned commit, corruption fallback, dtype
+fidelity (incl. bfloat16), structured restore."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.runtime.checkpoint import CheckpointManager
+
+
+def _tree(seed):
+    rng = np.random.RandomState(seed)
+    return {
+        "params": {
+            "dense": {"w": rng.randn(4, 3).astype(np.float32),
+                      "b": np.zeros(3, np.float32)},
+            "emb": rng.randn(10, 4).astype(np.float32),
+        },
+        "step": np.int32(seed),
+        "bf16": jnp.ones((2, 2), jnp.bfloat16) * seed,
+    }
+
+
+def _assert_trees_equal(a, b):
+    assert np.array_equal(np.asarray(a["step"]), np.asarray(b["step"]))
+    np.testing.assert_array_equal(a["params"]["dense"]["w"],
+                                  b["params"]["dense"]["w"])
+    np.testing.assert_array_equal(np.asarray(a["bf16"], np.float32),
+                                  np.asarray(b["bf16"], np.float32))
+    assert np.asarray(b["bf16"]).dtype == np.asarray(a["bf16"]).dtype
+
+
+def test_save_restore_roundtrip(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=3)
+    tree = _tree(7)
+    cm.save(7, tree, meta={"epoch": 1})
+    version, restored, meta = cm.restore_latest(target=tree)
+    assert version == 7 and meta == {"epoch": 1}
+    _assert_trees_equal(tree, restored)
+
+
+def test_keep_gc_and_latest(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=2)
+    for v in (1, 2, 3, 4):
+        cm.save(v, _tree(v))
+    assert cm.versions() == [3, 4]
+    version, restored, _ = cm.restore_latest(target=_tree(0))
+    assert version == 4
+    assert int(restored["step"]) == 4
+
+
+def test_corrupt_latest_falls_back(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=3)
+    cm.save(1, _tree(1))
+    cm.save(2, _tree(2))
+    # corrupt v2's payload after commit
+    with open(str(tmp_path / "v_00000002" / "arrays.npz"), "wb") as f:
+        f.write(b"garbage")
+    version, restored, _ = cm.restore_latest(target=_tree(0))
+    assert version == 1
+    assert int(restored["step"]) == 1
+
+
+def test_uncommitted_version_invisible(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=3)
+    cm.save(1, _tree(1))
+    # a half-written version: files but no MANIFEST
+    vdir = tmp_path / "v_00000009"
+    vdir.mkdir()
+    (vdir / "arrays.npz").write_bytes(b"partial")
+    assert cm.versions() == [1]
+    version, _, _ = cm.restore_latest(target=_tree(0))
+    assert version == 1
+
+
+def test_missing_key_detected(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=3)
+    cm.save(1, {"a": np.zeros(2)})
+    try:
+        cm.restore(1, target={"a": np.zeros(2), "b": np.zeros(2)})
+        raise AssertionError("expected IOError")
+    except IOError as e:
+        assert "missing keys" in str(e)
+
+
+def test_manifest_contents(tmp_path):
+    cm = CheckpointManager(str(tmp_path), keep=3)
+    cm.save(5, _tree(5))
+    manifest = json.loads((tmp_path / "v_00000005" / "MANIFEST").read_text())
+    assert manifest["version"] == 5 and manifest["nbytes"] > 0
