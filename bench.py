@@ -1,0 +1,101 @@
+"""Benchmark: ResNet50_vd training throughput (img/s) on local devices.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference's headline number — ResNet50_vd pure collective
+training at 1828 img/s on 8x V100 (README.md:83, BASELINE.md), i.e.
+228.5 img/s per accelerator. This bench runs on whatever chips are visible
+(one v5e chip under the driver), so vs_baseline is normalized PER CHIP:
+vs_baseline = (img/s per local chip) / 228.5.
+"""
+
+import json
+import sys
+import time
+
+BASELINE_IMGS_PER_SEC_PER_CHIP = 1828.0 / 8.0
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run(batch_per_chip=128, image_size=224, warmup=3, iters=20):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from edl_tpu.models import resnet
+    from edl_tpu.runtime.mesh import DATA_AXIS, make_mesh
+    from edl_tpu.runtime.trainer import make_train_state, make_train_step
+
+    n_chips = jax.local_device_count()
+    batch = batch_per_chip * n_chips
+    log("bench: %d chip(s) (%s), global batch %d"
+        % (n_chips, jax.devices()[0].platform, batch))
+
+    model, params, extra, loss_fn = resnet.create_model_and_loss(
+        depth=50, num_classes=1000, vd=True, image_size=image_size,
+        dtype=jnp.bfloat16)
+    mesh = make_mesh()
+    repl = NamedSharding(mesh, P())
+    data_sh = NamedSharding(mesh, P(DATA_AXIS))
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    # the SAME step the product trainer runs (trainer.make_train_step)
+    state = jax.device_put(make_train_state(params, tx, extra), repl)
+    step = make_train_step(loss_fn, tx, has_aux=True)
+    jit_step = jax.jit(step,
+                       in_shardings=(repl, data_sh, repl),
+                       out_shardings=(repl, repl),
+                       donate_argnums=(0,))
+
+    # synthetic data staged on device once: measures compute, not host IO
+    key = jax.random.PRNGKey(0)
+    images = jax.device_put(
+        jax.random.normal(key, (batch, image_size, image_size, 3),
+                          jnp.bfloat16), data_sh)
+    labels = jax.device_put(
+        jax.random.randint(key, (batch,), 0, 1000, jnp.int32), data_sh)
+
+    rng = jax.device_put(jax.random.PRNGKey(0), repl)
+    batch_arrs = {"image": images, "label": labels}
+    log("compiling + warmup (%d steps)..." % warmup)
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        state, loss = jit_step(state, batch_arrs, rng)
+    jax.block_until_ready(loss)
+    log("warmup done in %.1fs (loss=%.3f)" % (time.perf_counter() - t0,
+                                              float(loss)))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = jit_step(state, batch_arrs, rng)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    per_chip = imgs_per_sec / n_chips
+    log("throughput: %.1f img/s total, %.1f img/s per chip (%.1f ms/step)"
+        % (imgs_per_sec, per_chip, 1000 * dt / iters))
+    return {
+        "metric": "resnet50_vd_train_imgs_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "img/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMGS_PER_SEC_PER_CHIP, 3),
+    }
+
+
+def main():
+    try:
+        result = run()
+    except Exception as e:  # noqa: BLE001
+        log("full-size bench failed (%r); falling back to small config" % e)
+        result = run(batch_per_chip=8, image_size=64, warmup=2, iters=5)
+        result["metric"] += "_smallcfg"
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -32,6 +33,48 @@ from edl_tpu.runtime.mesh import DATA_AXIS, make_mesh
 from edl_tpu.utils.logger import logger
 
 _distributed_initialized = False
+
+
+def make_train_state(params, tx, extra_state=None):
+    """The canonical train-state pytree shared by ElasticTrainer, bench.py
+    and the driver dry-run."""
+    return {
+        "params": params,
+        "opt_state": tx.init(params),
+        "step": jnp.zeros((), jnp.int32),
+        "extra": extra_state if extra_state is not None else {},
+    }
+
+
+def make_train_step(loss_fn, tx, has_aux=False):
+    """Build the canonical SGD step over a make_train_state pytree.
+
+    loss_fn: (params, batch, rng) -> loss, or with has_aux
+    (params, extra, batch, rng) -> (loss, new_extra). Returns
+    step(train_state, batch, rng) -> (train_state, loss), jit-ready."""
+
+    def step(train_state, batch, rng):
+        if has_aux:
+            def compute(params):
+                return loss_fn(params, train_state["extra"], batch, rng)
+            (loss, extra), grads = jax.value_and_grad(
+                compute, has_aux=True)(train_state["params"])
+        else:
+            def compute(params):
+                return loss_fn(params, batch, rng)
+            loss, grads = jax.value_and_grad(compute)(train_state["params"])
+            extra = train_state["extra"]
+        updates, opt_state = tx.update(grads, train_state["opt_state"],
+                                       train_state["params"])
+        params = optax.apply_updates(train_state["params"], updates)
+        return {
+            "params": params,
+            "opt_state": opt_state,
+            "step": train_state["step"] + 1,
+            "extra": extra,
+        }, loss
+
+    return step
 
 
 def maybe_init_distributed(env=None):
@@ -55,7 +98,10 @@ class ElasticTrainer(object):
     """Data-parallel elastic trainer.
 
     Args:
-      loss_fn: (params, batch, rng) -> scalar loss (jit-traceable).
+      loss_fn: (params, batch, rng) -> scalar loss, or with has_aux=True
+        (params, extra, batch, rng) -> (loss, new_extra) where ``extra`` is
+        non-differentiated model state updated each step (e.g. BatchNorm
+        running stats) — kept inside the donated train_state.
       params: initial parameter pytree.
       tx: an optax GradientTransformation.
       total_batch_size: GLOBAL batch size; kept constant across resizes
@@ -67,7 +113,7 @@ class ElasticTrainer(object):
 
     def __init__(self, loss_fn, params, tx, total_batch_size,
                  checkpoint_dir=None, mesh=None, env=None, coord=None,
-                 keep_checkpoints=3, extra_state=None):
+                 keep_checkpoints=3, extra_state=None, has_aux=False):
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -85,13 +131,19 @@ class ElasticTrainer(object):
 
         self._loss_fn = loss_fn
         self._tx = tx
-        self.train_state = {
-            "params": params,
-            "opt_state": tx.init(params),
-            "step": jnp.zeros((), jnp.int32),
-        }
+        self._has_aux = has_aux
+        if extra_state is not None:
+            for leaf in jax.tree_util.tree_leaves(extra_state):
+                dt = np.asarray(leaf).dtype  # host dtype, pre-canonicalize
+                if dt.kind in "iuf" and dt.itemsize == 8 \
+                        and not jax.config.jax_enable_x64:
+                    raise ValueError(
+                        "extra_state leaf has 64-bit dtype %s which JAX "
+                        "would silently truncate to 32-bit on device; keep "
+                        "host-side metadata (file offsets, loader positions) "
+                        "in trainer.state.user_defined instead" % dt)
+        self.train_state = make_train_state(params, tx, extra_state)
         self.state = state_mod.State(total_batch_size=total_batch_size)
-        self._extra_state = extra_state
 
         self._repl = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
@@ -114,22 +166,7 @@ class ElasticTrainer(object):
     # -- the compiled step ---------------------------------------------------
 
     def _build_step(self):
-        loss_fn = self._loss_fn
-        tx = self._tx
-
-        def step(train_state, batch, rng):
-            def compute(params):
-                return loss_fn(params, batch, rng)
-            loss, grads = jax.value_and_grad(compute)(train_state["params"])
-            updates, opt_state = tx.update(grads, train_state["opt_state"],
-                                           train_state["params"])
-            params = optax.apply_updates(train_state["params"], updates)
-            return {
-                "params": params,
-                "opt_state": opt_state,
-                "step": train_state["step"] + 1,
-            }, loss
-
+        step = make_train_step(self._loss_fn, self._tx, self._has_aux)
         return jax.jit(
             step,
             in_shardings=(self._repl, self._batch_sharding, self._repl),
@@ -188,18 +225,16 @@ class ElasticTrainer(object):
 
     # -- checkpoint / resume -------------------------------------------------
 
-    def _ckpt_tree(self):
-        tree = dict(self.train_state)
-        if self._extra_state is not None:
-            tree["extra"] = self._extra_state
-        return tree
+    @property
+    def extra_state(self):
+        return self.train_state["extra"]
 
     def save(self):
         """Rank-0 writes the versioned checkpoint + State (reference:
         rank0 fleet.save_check_point per epoch, train_with_fleet.py:562)."""
         if self._ckpt is None or self.env.global_rank != 0:
             return
-        tree = jax.device_get(self._ckpt_tree())
+        tree = jax.device_get(dict(self.train_state))
         self._ckpt.save(self.global_step, tree,
                         meta={"state": self.state.to_dict()})
         if self.coord is not None:
@@ -210,23 +245,22 @@ class ElasticTrainer(object):
         the world size changed. Returns True if something was restored."""
         if self._ckpt is None:
             return False
-        # restore the core train state first; 'extra' is optional so a
-        # checkpoint written without it must still restore cleanly
-        core_target = jax.device_get(dict(self.train_state))
-        restored = self._ckpt.restore_latest(target=core_target)
+        # try the full state first; fall back to core-only for checkpoints
+        # written without this trainer's extra state (single read each way)
+        host_state = jax.device_get(dict(self.train_state))
+        restored = self._ckpt.restore_latest(target=host_state)
+        if restored is None and jax.tree_util.tree_leaves(
+                host_state["extra"]):
+            extra_target = host_state.pop("extra")
+            restored = self._ckpt.restore_latest(target=host_state)
+            if restored is not None:
+                logger.info("checkpoint has no extra state; keeping the "
+                            "initial one")
+                restored[1]["extra"] = extra_target
         if restored is None:
             return False
         version, tree, meta = restored
         self.train_state = jax.device_put(tree, self._repl)
-        if self._extra_state is not None:
-            try:
-                _, extra_tree, _ = self._ckpt.restore(
-                    version,
-                    target={"extra": jax.device_get(self._extra_state)})
-                self._extra_state = extra_tree["extra"]
-            except (IOError, OSError):
-                logger.info("checkpoint v%d has no extra state; keeping "
-                            "the initial one", version)
         if meta.get("state"):
             hooks = self.state._adjust_fns  # survive the state swap
             self.state = state_mod.State().from_dict(meta["state"])

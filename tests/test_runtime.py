@@ -70,7 +70,7 @@ def test_state_roundtrip_and_adjust(coord):
     assert loaded.data_checkpoint.is_processed("a.txt", 10)
 
 
-def _linreg_trainer(tmp_path, total_batch=64):
+def _linreg_trainer(tmp_path, total_batch=64, **kw):
     w_true = np.arange(1, 5, dtype=np.float32)
 
     def loss_fn(params, batch, rng):
@@ -80,7 +80,7 @@ def _linreg_trainer(tmp_path, total_batch=64):
     params = {"w": jnp.zeros(4), "b": jnp.zeros(())}
     trainer = ElasticTrainer(
         loss_fn, params, optax.sgd(0.1), total_batch_size=total_batch,
-        checkpoint_dir=str(tmp_path / "ckpt"))
+        checkpoint_dir=str(tmp_path / "ckpt"), **kw)
 
     def make_batch(seed):
         rng = np.random.RandomState(seed)
@@ -122,16 +122,20 @@ def test_resume_preserves_adjust_hooks_and_extra_state(tmp_path):
     # restart WITH a new extra_state the checkpoint doesn't have: core must
     # still restore, extra kept as the fresh initial value
     def make2():
-        t2, mb, _ = _linreg_trainer(tmp_path)
-        t2._extra_state = {"loader_pos": np.int64(123)}
+        t2, mb, _ = _linreg_trainer(
+            tmp_path, extra_state={"loader_pos": np.int32(123)})
         return t2
+
+    # 64-bit extra leaves are rejected loudly (device_put would truncate)
+    with pytest.raises(ValueError, match="64-bit"):
+        _linreg_trainer(tmp_path, extra_state={"pos": np.int64(1 << 40)})
 
     t2 = make2()
     calls = []
     t2.state.register_adjust_function(lambda s, w: calls.append(w))
     assert t2.resume()
     assert t2.global_step == 1
-    assert int(t2._extra_state["loader_pos"]) == 123
+    assert int(t2.extra_state["loader_pos"]) == 123
     # hooks survived the state swap: simulate a world change record
     t2.state.epochs[str(t2.state.epoch_no)]["world_size"] = 4
     t2.state.adjust(t2.world_size)
@@ -143,7 +147,7 @@ def test_resume_preserves_adjust_hooks_and_extra_state(tmp_path):
     t2.end_epoch(save=True)
     t3 = make2()
     assert t3.resume()
-    assert int(t3._extra_state["loader_pos"]) == 123
+    assert int(t3.extra_state["loader_pos"]) == 123
 
 
 def test_trainer_batch_sharded_over_dp(tmp_path):
