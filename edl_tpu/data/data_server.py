@@ -1,0 +1,188 @@
+"""Elastic data plane: leader-side balancer + per-trainer batch server.
+
+Reference parity: the DataServer protocol (protos/data_server.proto;
+edl/utils/data_server.py — PodsData round-robin file split :118-133,
+barrier-and-average rebalance :171-224, steal-from-others :145-169;
+DataServerServicer :250-372). The reference implementation was never green
+(SURVEY.md §2.2) — this is built to the protocol design:
+
+- the LEADER (one per job) slices the file list round-robin across readers,
+  tracks produced-but-unconsumed batch ids per reader, hands out balanced
+  assignments, and steals batches from rich producers for starved consumers;
+- every TRAINER runs a small BatchServer exposing its locally produced
+  batches, so a stolen assignment is fetched straight from the producer
+  (data never flows through the leader).
+
+All RPCs ride the in-tree framed-msgpack substrate.
+"""
+
+import threading
+from collections import OrderedDict, deque
+
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+END = "__END__"
+
+
+class LeaderDataService(object):
+    """Lives on one process per job (the leader pod's rank-0 trainer or the
+    launcher); coordinates readers of one named reader group."""
+
+    def __init__(self, file_list):
+        self._files = list(file_list)
+        self._lock = threading.Lock()
+        self._readers = {}        # pod_id -> {"endpoint": str, "done": bool}
+        self._file_cursor = 0
+        # batch availability: pod_id -> deque of batch_id
+        self._avail = {}
+        # batch_id -> producer endpoint
+        self._producer = {}
+        self._consumed = set()
+
+    # -- registration / files -------------------------------------------------
+
+    def register_reader(self, pod_id, endpoint):
+        with self._lock:
+            self._readers[pod_id] = {"endpoint": endpoint, "done": False}
+            self._avail.setdefault(pod_id, deque())
+            return True
+
+    def get_file_list(self, pod_id):
+        """Round-robin file slices, handed out incrementally so late joiners
+        get the remaining work (elastic twist on the static split)."""
+        with self._lock:
+            if self._file_cursor >= len(self._files):
+                return []
+            out = [(self._file_cursor, self._files[self._file_cursor])]
+            self._file_cursor += 1
+            return out
+
+    # -- production reports ---------------------------------------------------
+
+    def report_batches(self, pod_id, batch_ids, endpoint):
+        with self._lock:
+            q = self._avail.setdefault(pod_id, deque())
+            for b in batch_ids:
+                if b not in self._consumed and b not in self._producer:
+                    q.append(b)
+                    self._producer[b] = endpoint
+            return True
+
+    def reach_data_end(self, pod_id):
+        with self._lock:
+            if pod_id in self._readers:
+                self._readers[pod_id]["done"] = True
+            return True
+
+    # -- consumption -----------------------------------------------------------
+
+    def get_assignment(self, pod_id, n=1):
+        """Balanced batch assignments for ``pod_id``: its own production
+        first, then stolen from the richest producer. Returns a list of
+        {batch_id, endpoint}; [END] when all data is consumed; [] means
+        'retry later' (production still in flight)."""
+        with self._lock:
+            out = []
+            own = self._avail.get(pod_id)
+            while own and len(out) < n:
+                out.append(self._take(pod_id))
+            while len(out) < n:
+                richest = max(self._avail,
+                              key=lambda p: len(self._avail[p]),
+                              default=None)
+                if richest is None or not self._avail[richest]:
+                    break
+                out.append(self._take(richest))
+            if out:
+                return out
+            all_done = (self._file_cursor >= len(self._files)
+                        and self._readers
+                        and all(r["done"] for r in self._readers.values()))
+            return [END] if all_done else []
+
+    def _take(self, pod_id):
+        batch_id = self._avail[pod_id].popleft()
+        self._consumed.add(batch_id)
+        return {"batch_id": batch_id,
+                "endpoint": self._producer.pop(batch_id)}
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            return {
+                "files_handed": self._file_cursor,
+                "files_total": len(self._files),
+                "pending": {p: len(q) for p, q in self._avail.items()},
+                "consumed": len(self._consumed),
+                "readers": {p: r["done"] for p, r in self._readers.items()},
+            }
+
+
+class BatchCache(object):
+    """Producer-side batch store with back-pressure (bounded size)."""
+
+    def __init__(self, capacity=64):
+        self._cap = capacity
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self._data = OrderedDict()  # batch_id -> payload
+
+    def put(self, batch_id, payload, timeout=600):
+        with self._not_full:
+            if not self._not_full.wait_for(
+                    lambda: len(self._data) < self._cap, timeout=timeout):
+                raise errors.DataAccessError("batch cache full")
+            self._data[batch_id] = payload
+
+    def get(self, batch_id):
+        with self._lock:
+            return self._data.get(batch_id)
+
+    def pop(self, batch_id):
+        with self._not_full:
+            payload = self._data.pop(batch_id, None)
+            self._not_full.notify_all()
+            return payload
+
+    def __len__(self):
+        with self._lock:
+            return len(self._data)
+
+
+class DataPlaneServer(object):
+    """One per trainer process: serves this producer's batches, and — iff
+    this process is the job's data leader — the LeaderDataService too."""
+
+    def __init__(self, cache, leader_service=None, host="0.0.0.0", port=0):
+        self._rpc = RpcServer(host=host, port=port)
+        self._cache = cache
+        self._rpc.register("get_batch", self._get_batch)
+        if leader_service is not None:
+            svc = leader_service
+            self._rpc.register("ds_register_reader", svc.register_reader)
+            self._rpc.register("ds_get_file_list", svc.get_file_list)
+            self._rpc.register("ds_report_batches", svc.report_batches)
+            self._rpc.register("ds_reach_data_end", svc.reach_data_end)
+            self._rpc.register("ds_get_assignment", svc.get_assignment)
+            self._rpc.register("ds_stats", svc.stats)
+
+    def _get_batch(self, batch_id):
+        payload = self._cache.pop(batch_id)
+        if payload is None:
+            raise errors.NotFoundError("batch %s not in cache" % batch_id)
+        return payload
+
+    def start(self):
+        self._rpc.start()
+        return self
+
+    @property
+    def endpoint(self):
+        return self._rpc.endpoint
+
+    def stop(self):
+        self._rpc.stop()
+        logger.debug("data plane server stopped")

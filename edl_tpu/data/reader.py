@@ -1,0 +1,186 @@
+"""Elastic reader: each trainer produces batches from its assigned file
+slices and consumes a balanced stream that may include other pods' batches.
+
+Reference parity: edl/collective/distribute_reader.py (DataGenerator /
+DataAccesser design, SURVEY.md §3.4) rebuilt on threads + the in-tree RPC
+substrate; and edl/utils/reader.py (ReaderMeta registration under the
+coordination store so trainers can find the data leader).
+"""
+
+import threading
+import time
+
+from edl_tpu.controller import constants
+from edl_tpu.data.data_server import (END, BatchCache, DataPlaneServer,
+                                      LeaderDataService)
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+def register_data_leader(coord, reader_name, endpoint):
+    coord.set_server_permanent(constants.SERVICE_READER, reader_name,
+                               endpoint)
+
+
+def lookup_data_leader(coord, reader_name, timeout=60):
+    @errors.handle_errors_until_timeout
+    def _get():
+        ep = coord.get_value(constants.SERVICE_READER, reader_name)
+        if ep is None:
+            raise errors.NotFoundError("data leader %s not registered"
+                                       % reader_name)
+        return ep
+    return _get(timeout=timeout)
+
+
+class ElasticReader(object):
+    """Iterate balanced batches of records.
+
+    Args:
+      pod_id: this consumer's identity.
+      splitter: a FileSplitter.
+      batch_size: records per batch.
+      file_list: full job file list — only used by the elected data leader.
+      is_leader: host the LeaderDataService in this process.
+      leader_endpoint: where the leader lives (None + coord ⇒ discover).
+      coord/reader_name: coordination-store discovery (optional in tests).
+      skip_record: optional (file, idx) -> bool predicate for data-aware
+        resume (reference DataCheckpoint semantics).
+    """
+
+    def __init__(self, pod_id, splitter, batch_size, file_list=(),
+                 is_leader=False, leader_endpoint=None, coord=None,
+                 reader_name="reader", cache_capacity=64, skip_record=None,
+                 fetch_ahead=2):
+        self._pod_id = pod_id
+        self._splitter = splitter
+        self._batch_size = batch_size
+        self._skip = skip_record
+        self._fetch_ahead = max(1, fetch_ahead)
+
+        self._cache = BatchCache(capacity=cache_capacity)
+        leader_service = LeaderDataService(file_list) if is_leader else None
+        self._server = DataPlaneServer(self._cache,
+                                       leader_service=leader_service).start()
+        if is_leader and coord is not None:
+            register_data_leader(coord, reader_name, self._server.endpoint)
+            leader_endpoint = self._server.endpoint
+        if leader_endpoint is None:
+            if coord is None:
+                raise ValueError("need leader_endpoint or coord")
+            leader_endpoint = lookup_data_leader(coord, reader_name)
+        self._leader = RpcClient(leader_endpoint, timeout=30)
+        self._leader_gen = RpcClient(leader_endpoint, timeout=30)
+
+        self._stop = threading.Event()
+        self._gen_done = threading.Event()
+        self._gen_error = []
+        self._leader.call("ds_register_reader", pod_id,
+                          self._server.endpoint)
+        self._gen_thread = threading.Thread(target=self._generate,
+                                            daemon=True,
+                                            name="reader-gen-%s" % pod_id)
+        self._gen_thread.start()
+
+    # -- producer side ---------------------------------------------------------
+
+    def _generate(self):
+        try:
+            while not self._stop.is_set():
+                files = self._leader_gen.call("ds_get_file_list",
+                                              self._pod_id)
+                if not files:
+                    return
+                for file_idx, path in files:
+                    self._produce_file(file_idx, path)
+        except Exception as e:  # noqa: BLE001 — any producer failure
+            if not self._stop.is_set():
+                logger.error("reader generator failed: %r", e)
+                self._gen_error.append(e)
+        finally:
+            # ALWAYS tell the leader we are done producing — a crashed
+            # producer must not leave every consumer in the job spinning
+            # on an all_done check that can never become true
+            try:
+                self._leader_gen.call("ds_reach_data_end", self._pod_id)
+            except errors.EdlError:
+                pass
+            self._gen_done.set()
+
+    def _produce_file(self, file_idx, path):
+        records, first_idx = [], None
+        n_batch = 0
+
+        def flush():
+            nonlocal records, first_idx, n_batch
+            if not records:
+                return
+            batch_id = "f%d_b%d" % (file_idx, n_batch)
+            payload = {
+                "batch_id": batch_id,
+                "file": path,
+                "range": [first_idx, first_idx + len(records) - 1],
+                "records": records,
+            }
+            self._cache.put(batch_id, payload)
+            self._leader_gen.call("ds_report_batches", self._pod_id,
+                                  [batch_id], self._server.endpoint)
+            n_batch += 1
+            records, first_idx = [], None
+
+        for idx, record in self._splitter.split(path):
+            if self._stop.is_set():
+                return
+            if self._skip is not None and self._skip(path, idx):
+                continue
+            if first_idx is None:
+                first_idx = idx
+            records.append(record)
+            if len(records) >= self._batch_size:
+                flush()
+        flush()
+
+    # -- consumer side ---------------------------------------------------------
+
+    def __iter__(self):
+        while not self._stop.is_set():
+            if self._gen_error:
+                raise self._gen_error[0]
+            assignment = self._leader.call("ds_get_assignment", self._pod_id,
+                                           self._fetch_ahead)
+            if assignment == [END]:
+                return
+            if not assignment:
+                time.sleep(0.05)
+                continue
+            for item in assignment:
+                payload = self._fetch(item)
+                if payload is not None:
+                    yield payload
+
+    def _fetch(self, item):
+        batch_id, endpoint = item["batch_id"], item["endpoint"]
+        if endpoint == self._server.endpoint:
+            payload = self._cache.pop(batch_id)
+            if payload is not None:
+                return payload
+        try:
+            client = RpcClient(endpoint, timeout=30)
+            try:
+                return client.call("get_batch", batch_id)
+            finally:
+                client.close()
+        except errors.EdlError as e:
+            # producer died (resize) — the batch is lost; training continues
+            # and a restart re-reads it via the data checkpoint
+            logger.warning("batch %s from %s lost: %r", batch_id, endpoint,
+                           e)
+            return None
+
+    def stop(self):
+        self._stop.set()
+        self._gen_thread.join(timeout=10)
+        self._leader.close()
+        self._leader_gen.close()
+        self._server.stop()

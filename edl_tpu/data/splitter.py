@@ -1,0 +1,48 @@
+"""Record-level file splitting — the data sharding contract.
+
+Reference parity: edl/collective/dataset.py:16-44 (FileSplitter interface
+yielding (idx, record) and TxtFileSplitter). Splitters are pluggable so any
+record format (lines, TFRecord, images) rides the same elastic reader.
+"""
+
+
+class FileSplitter(object):
+    """Yield (record_idx, record) pairs for one file."""
+
+    def split(self, path):
+        raise NotImplementedError
+
+    def count(self, path):
+        """Number of records (used for balanced assignment); default scans."""
+        return sum(1 for _ in self.split(path))
+
+
+class TxtFileSplitter(FileSplitter):
+    """One record per non-empty line."""
+
+    def split(self, path):
+        idx = 0
+        with open(path, "r") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                yield idx, line
+                idx += 1
+
+
+class BytesChunkSplitter(FileSplitter):
+    """Fixed-size binary records (e.g. pre-packed numpy batches)."""
+
+    def __init__(self, record_bytes):
+        self._n = record_bytes
+
+    def split(self, path):
+        idx = 0
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(self._n)
+                if not chunk:
+                    return
+                yield idx, chunk
+                idx += 1

@@ -1,0 +1,170 @@
+"""Client/teacher matchmaking: the connection-capped greedy balancer.
+
+Reference parity: edl/distill/balance_table.py Service.rebalance (:139-338)
+— invariants preserved:
+- per-server connection cap  = ceil-ish (clients + servers - 1) // servers
+- per-client server cap      = max(1, servers // clients), bounded by the
+  client's require_num
+- greedy unlink of over-cap links, then greedy link of under-served clients
+  to least-loaded servers; any change bumps the affected client's version so
+  its next heartbeat ships the new list.
+"""
+
+import threading
+
+from edl_tpu.utils.logger import logger
+
+
+class _Client(object):
+    __slots__ = ("id", "require", "servers", "version")
+
+    def __init__(self, cid, require):
+        self.id = cid
+        self.require = max(1, require)
+        self.servers = set()
+        self.version = 0
+
+
+class Service(object):
+    """One distill service: a set of teacher servers and student clients."""
+
+    def __init__(self, name):
+        self.name = name
+        self._lock = threading.Lock()
+        self._servers = {}   # endpoint -> set(client_id)
+        self._clients = {}   # client_id -> _Client
+
+    # -- membership ------------------------------------------------------------
+
+    def set_servers(self, endpoints):
+        with self._lock:
+            endpoints = set(endpoints)
+            for ep in list(self._servers):
+                if ep not in endpoints:
+                    for cid in self._servers.pop(ep):
+                        c = self._clients.get(cid)
+                        if c is not None:
+                            c.servers.discard(ep)
+                            c.version += 1
+            for ep in endpoints:
+                self._servers.setdefault(ep, set())
+            self._rebalance()
+
+    def register_client(self, client_id, require_num):
+        with self._lock:
+            if client_id not in self._clients:
+                self._clients[client_id] = _Client(client_id, require_num)
+                self._rebalance()
+            c = self._clients[client_id]
+            return {"version": c.version, "servers": sorted(c.servers)}
+
+    def unregister_client(self, client_id):
+        with self._lock:
+            c = self._clients.pop(client_id, None)
+            if c is None:
+                return False
+            for ep in c.servers:
+                self._servers.get(ep, set()).discard(client_id)
+            self._rebalance()
+            return True
+
+    def heartbeat(self, client_id, version):
+        """Returns {"version", "servers"} — servers only when the client's
+        view is stale (reference: versioned heartbeat, discovery_client)."""
+        with self._lock:
+            c = self._clients.get(client_id)
+            if c is None:
+                return None
+            if c.version == version:
+                return {"version": version}
+            return {"version": c.version, "servers": sorted(c.servers)}
+
+    # -- the balancing core (callers hold the lock) -----------------------------
+
+    def _caps(self):
+        n_servers = len(self._servers)
+        n_clients = len(self._clients)
+        if n_servers == 0 or n_clients == 0:
+            return 0, 0
+        per_server = (n_clients + n_servers - 1) // n_servers
+        per_client = max(1, n_servers // n_clients)
+        return per_server, per_client
+
+    def _rebalance(self):
+        per_server, per_client = self._caps()
+        if per_server == 0:
+            for c in self._clients.values():
+                if c.servers:
+                    c.servers.clear()
+                    c.version += 1
+            for ep in self._servers:
+                self._servers[ep].clear()
+            return
+
+        # 1. unlink: servers over cap / clients over their allowance
+        for ep, linked in self._servers.items():
+            while len(linked) > per_server:
+                cid = max(linked,
+                          key=lambda i: len(self._clients[i].servers))
+                linked.discard(cid)
+                self._clients[cid].servers.discard(ep)
+                self._clients[cid].version += 1
+        for c in self._clients.values():
+            allowance = min(per_client, c.require)
+            while len(c.servers) > allowance:
+                ep = max(c.servers, key=lambda e: len(self._servers[e]))
+                c.servers.discard(ep)
+                self._servers[ep].discard(c.id)
+                c.version += 1
+
+        # 2. link: starved clients to least-loaded servers
+        for c in self._clients.values():
+            allowance = min(per_client, c.require)
+            while len(c.servers) < allowance:
+                candidates = [ep for ep, linked in self._servers.items()
+                              if ep not in c.servers
+                              and len(linked) < per_server]
+                if not candidates:
+                    break
+                ep = min(candidates, key=lambda e: len(self._servers[e]))
+                c.servers.add(ep)
+                self._servers[ep].add(c.id)
+                c.version += 1
+        # 3. every client gets at least one server if any exist
+        for c in self._clients.values():
+            if not c.servers and self._servers:
+                ep = min(self._servers,
+                         key=lambda e: len(self._servers[e]))
+                c.servers.add(ep)
+                self._servers[ep].add(c.id)
+                c.version += 1
+
+    def stats(self):
+        with self._lock:
+            return {
+                "servers": {ep: len(v) for ep, v in self._servers.items()},
+                "clients": {c.id: sorted(c.servers)
+                            for c in self._clients.values()},
+            }
+
+
+class BalanceTable(object):
+    """All services known to one discovery server (reference
+    balance_table.py BalanceTable :359-689; consistent-hash sharding across
+    discovery servers lives in discovery_server)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._services = {}
+
+    def service(self, name):
+        with self._lock:
+            svc = self._services.get(name)
+            if svc is None:
+                svc = self._services[name] = Service(name)
+                logger.info("balance table: new service %s", name)
+            return svc
+
+    def names(self):
+        with self._lock:
+            return sorted(self._services)

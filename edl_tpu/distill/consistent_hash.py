@@ -1,0 +1,73 @@
+"""Consistent hash ring with virtual nodes and copy-on-write updates.
+
+Reference parity: edl/discovery/consistent_hash.py:105-141 (300 virtual
+nodes, MD5 ring, version counter, copy-on-write thread safety). Shards
+service names across discovery servers.
+"""
+
+import bisect
+import hashlib
+import threading
+
+
+def _hash(key):
+    return int(hashlib.md5(key.encode("utf-8")).hexdigest(), 16)
+
+
+class ConsistentHash(object):
+    VIRTUAL_NODES = 300
+
+    def __init__(self, nodes=()):
+        self._lock = threading.Lock()
+        self._version = 0
+        self._nodes = set()
+        self._ring = []          # sorted [(hash, node)]
+        if nodes:
+            self.update(nodes)
+
+    def update(self, nodes):
+        """Replace the node set (copy-on-write: readers see old or new)."""
+        nodes = set(nodes)
+        ring = []
+        for node in nodes:
+            for i in range(self.VIRTUAL_NODES):
+                ring.append((_hash("%s#%d" % (node, i)), node))
+        ring.sort()
+        with self._lock:
+            self._nodes = nodes
+            self._ring = ring
+            self._version += 1
+            return self._version
+
+    def add_node(self, node):
+        with self._lock:
+            nodes = set(self._nodes)
+        nodes.add(node)
+        return self.update(nodes)
+
+    def remove_node(self, node):
+        with self._lock:
+            nodes = set(self._nodes)
+        nodes.discard(node)
+        return self.update(nodes)
+
+    def get_node(self, key):
+        """(node, version) owning ``key``; (None, version) on empty ring."""
+        with self._lock:
+            ring = self._ring
+            version = self._version
+        if not ring:
+            return None, version
+        idx = bisect.bisect(ring, (_hash(key), chr(0x10FFFF)))
+        if idx >= len(ring):
+            idx = 0
+        return ring[idx][1], version
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    def nodes(self):
+        with self._lock:
+            return set(self._nodes)

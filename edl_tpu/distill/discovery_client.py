@@ -1,0 +1,145 @@
+"""Student-side discovery client: register, heartbeat, follow redirects,
+surface the current teacher list.
+
+Reference parity: edl/distill/discovery_client.py (response-code dispatch
+:70-80, 2s versioned heartbeat :169-182, redirect reconnect :115-131,
+client uuid :184).
+"""
+
+import os
+import threading
+import time
+import uuid
+
+from edl_tpu.distill import discovery_server as ds
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+def _gen_client_id():
+    return "%s-%d-%s" % (os.uname().nodename, os.getpid(),
+                         uuid.uuid4().hex[:8])
+
+
+class DiscoveryClient(object):
+    def __init__(self, endpoint, service_name, require_num=1,
+                 heartbeat_interval=2.0):
+        self._endpoint = endpoint
+        self._service = service_name
+        self._require = require_num
+        self._interval = heartbeat_interval
+        self.client_id = _gen_client_id()
+        self._rpc = None
+        self._version = -1
+        self._servers = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- wire helpers -----------------------------------------------------------
+
+    def _connect(self, endpoint):
+        if self._rpc is not None:
+            self._rpc.close()
+        self._rpc = RpcClient(endpoint, timeout=10)
+
+    def _register(self):
+        """Register, following redirects to the shard owner."""
+        endpoint = self._endpoint
+        for _ in range(8):
+            self._connect(endpoint)
+            resp = self._rpc.call("register_client", self.client_id,
+                                  self._service, self._require)
+            code = resp.get("code")
+            if code == ds.CODE_REDIRECT:
+                endpoint = resp["endpoint"]
+                continue
+            if code in (ds.CODE_OK, ds.CODE_NO_READY):
+                with self._lock:
+                    self._version = resp["version"]
+                    self._servers = list(resp.get("servers", []))
+                return
+            raise errors.RpcError("register failed: %r" % resp)
+        raise errors.RpcError("too many discovery redirects")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self._register()
+        self._thread = threading.Thread(target=self._heartbeat_loop,
+                                        daemon=True,
+                                        name="discovery-heartbeat")
+        self._thread.start()
+        return self
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                resp = self._rpc.call("heartbeat", self.client_id,
+                                      self._service, self._version)
+                code = resp.get("code")
+                if code == ds.CODE_REDIRECT:
+                    self._connect(resp["endpoint"])
+                    self._register()
+                    continue
+                if code == ds.CODE_UNREGISTERED:
+                    logger.info("discovery dropped us; re-registering")
+                    self._register()
+                    continue
+                if "servers" in resp:
+                    with self._lock:
+                        self._version = resp["version"]
+                        self._servers = list(resp["servers"])
+            except errors.EdlError as e:
+                logger.warning("discovery heartbeat error: %r", e)
+                try:
+                    self._register()
+                except errors.EdlError:
+                    time.sleep(self._interval)
+
+    def get_servers(self):
+        with self._lock:
+            return list(self._servers)
+
+    def wait_for_servers(self, timeout=60):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            servers = self.get_servers()
+            if servers:
+                return servers
+            time.sleep(0.2)
+        raise errors.TimeoutError_("no teachers discovered within %ss"
+                                   % timeout)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self._interval * 2 + 1)
+        if self._rpc is not None:
+            try:
+                self._rpc.call("unregister_client", self.client_id,
+                               self._service)
+            except errors.EdlError:
+                pass
+            self._rpc.close()
+
+
+class FixedDiscover(object):
+    """A static teacher list (reference FixedServiceDiscover,
+    distill_reader.py:38-45)."""
+
+    def __init__(self, endpoints):
+        self._endpoints = list(endpoints)
+
+    def start(self):
+        return self
+
+    def get_servers(self):
+        return list(self._endpoints)
+
+    def wait_for_servers(self, timeout=0):
+        return list(self._endpoints)
+
+    def stop(self):
+        pass

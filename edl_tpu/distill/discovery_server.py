@@ -1,0 +1,166 @@
+"""Discovery server: matches student clients to teacher servers.
+
+Reference parity: edl/distill/discovery_server.py + the BalanceTable
+consistent-hash sharding (balance_table.py:359-689): multiple discovery
+servers self-register under a ``__balance__`` service; each service name is
+owned by one discovery server on the hash ring; requests for a service
+owned elsewhere get a REDIRECT with the owner's endpoint
+(discovery_client.py handles reconnects).
+
+Teacher membership comes from the coordination store (the registry module's
+TTL leases) via a prefix watch per service.
+"""
+
+import argparse
+import signal
+import threading
+
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.distill import registry
+from edl_tpu.distill.balance import BalanceTable
+from edl_tpu.distill.consistent_hash import ConsistentHash
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils.logger import logger
+
+BALANCE_SERVICE = "__balance__"
+
+CODE_OK = "OK"
+CODE_REDIRECT = "REDIRECT"
+CODE_UNREGISTERED = "UNREGISTERED"
+CODE_NO_READY = "NO_READY"
+
+
+class DiscoveryServer(object):
+    def __init__(self, coord, host="0.0.0.0", port=0, ttl=10):
+        self._coord = coord
+        self._table = BalanceTable()
+        self._hash = ConsistentHash()
+        self._watchers = {}
+        self._lock = threading.Lock()
+        self._ttl = ttl
+        self._lease = None
+        self._refresher = None
+        self._stop = threading.Event()
+        self._peer_watcher = None
+
+        self._rpc = RpcServer(host=host, port=port)
+        self._rpc.register("register_client", self.register_client)
+        self._rpc.register("heartbeat", self.heartbeat)
+        self._rpc.register("unregister_client", self.unregister_client)
+        self._rpc.register("stats", self.stats)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        self._rpc.start()
+        self._lease = self._coord.set_server_with_lease(
+            BALANCE_SERVICE, self.endpoint, "", self._ttl)
+        self._refresher = threading.Thread(target=self._refresh_loop,
+                                           daemon=True)
+        self._refresher.start()
+        self._peer_watcher = self._coord.watch_service(
+            BALANCE_SERVICE, self._on_peers, poll_timeout=1.0)
+        logger.info("discovery server on %s", self.endpoint)
+        return self
+
+    def _refresh_loop(self):
+        while not self._stop.wait(self._ttl / 3.0):
+            try:
+                self._coord.refresh_server(BALANCE_SERVICE, self.endpoint,
+                                           self._lease)
+            except Exception:
+                logger.exception("discovery self-registration lost")
+
+    def _on_peers(self, added, removed, all_servers):
+        self._hash.update(all_servers.keys())
+        logger.info("discovery peers now %s", sorted(all_servers))
+
+    @property
+    def endpoint(self):
+        return self._rpc.endpoint
+
+    def stop(self):
+        self._stop.set()
+        if self._peer_watcher:
+            self._peer_watcher.stop()
+        with self._lock:
+            for w in self._watchers.values():
+                w.stop()
+            self._watchers.clear()
+        if self._lease is not None:
+            try:
+                self._coord.lease_revoke(self._lease)
+            except Exception:
+                pass
+        self._rpc.stop()
+
+    # -- sharding ------------------------------------------------------------
+
+    def _owner(self, service_name):
+        node, _ = self._hash.get_node(service_name)
+        return node
+
+    def _ensure_service(self, service_name):
+        """Start watching this service's teachers on first touch."""
+        with self._lock:
+            if service_name in self._watchers:
+                return
+            svc = self._table.service(service_name)
+
+            def on_change(added, removed, all_servers, _svc=svc):
+                _svc.set_servers(all_servers.keys())
+
+            self._watchers[service_name] = self._coord.watch_service(
+                registry.teacher_service(service_name), on_change,
+                poll_timeout=1.0)
+
+    # -- RPC surface ----------------------------------------------------------
+
+    def register_client(self, client_id, service_name, require_num):
+        owner = self._owner(service_name)
+        if owner is not None and owner != self.endpoint:
+            return {"code": CODE_REDIRECT, "endpoint": owner}
+        self._ensure_service(service_name)
+        out = self._table.service(service_name).register_client(
+            client_id, require_num)
+        code = CODE_OK if out["servers"] else CODE_NO_READY
+        return {"code": code, "version": out["version"],
+                "servers": out["servers"]}
+
+    def heartbeat(self, client_id, service_name, version):
+        owner = self._owner(service_name)
+        if owner is not None and owner != self.endpoint:
+            return {"code": CODE_REDIRECT, "endpoint": owner}
+        out = self._table.service(service_name).heartbeat(client_id, version)
+        if out is None:
+            return {"code": CODE_UNREGISTERED}
+        out["code"] = CODE_OK
+        return out
+
+    def unregister_client(self, client_id, service_name):
+        self._table.service(service_name).unregister_client(client_id)
+        return {"code": CODE_OK}
+
+    def stats(self):
+        return {name: self._table.service(name).stats()
+                for name in self._table.names()}
+
+
+def main():
+    p = argparse.ArgumentParser("edl_tpu distill discovery server")
+    p.add_argument("--store_endpoints", default="127.0.0.1:2379")
+    p.add_argument("--root", default="distill_jobs")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+    coord = CoordClient(args.store_endpoints, root=args.root)
+    server = DiscoveryServer(coord, port=args.port).start()
+    print("DISCOVERY_ENDPOINT=%s" % server.endpoint, flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,300 @@
+"""DistillReader: wrap a student's data generator so every batch is
+augmented with teacher-model predictions fetched from an elastic fleet of
+TPU inference servers.
+
+Reference parity: edl/distill/distill_reader.py + distill_worker.py —
+the same observable protocol, re-implemented with threads instead of forked
+processes (the heavy lifting is remote TPU inference + msgpack IO, which
+threads overlap fine):
+
+- user data is framed into ordered tasks; a bounded semaphore provides
+  ordering back-pressure (reference task_semaphore, distill_worker.py:599);
+- one predict worker per teacher connection; the manage loop diffs the
+  discovered teacher set, starts workers for new teachers and stops workers
+  for dropped ones (reference predict_manage_worker :58-171);
+- a failed task is re-queued and its worker retires the connection; the
+  epoch completes only when every fed task has a result — the accounting
+  the reference implemented with poison pills + feed/predict counters
+  (:435-506) is expressed here with per-epoch fed/done counters;
+- results are re-ordered by task id so the student sees its batches in the
+  original order (reference fetch_out :720-769).
+"""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from edl_tpu.distill.discovery_client import DiscoveryClient, FixedDiscover
+from edl_tpu.rpc import ndarray as nd
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class _TeacherConn(object):
+    """One connection to one teacher; splits oversized batches to the
+    teacher's compiled max_batch."""
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self._rpc = RpcClient(endpoint, timeout=60)
+        spec = self._rpc.call("get_feed_fetch")
+        self.max_batch = spec.get("max_batch", 64)
+        self.fetch_names = list(spec.get("fetch", {}))
+
+    def predict(self, feed):
+        n = len(next(iter(feed.values())))
+        outs = []
+        for lo in range(0, n, self.max_batch):
+            chunk = {k: v[lo:lo + self.max_batch] for k, v in feed.items()}
+            outs.append(nd.decode_tree(
+                self._rpc.call("predict", nd.encode_tree(chunk))))
+        return {k: np.concatenate([o[k] for o in outs], axis=0)
+                for k in outs[0]}
+
+    def close(self):
+        self._rpc.close()
+
+
+class DistillReader(object):
+    def __init__(self, ins, predicts, max_in_flight=8,
+                 teacher_backoff=5.0):
+        self._ins = list(ins)
+        self._predicts = list(predicts)
+        self._max_in_flight = max_in_flight
+        self._backoff = teacher_backoff
+
+        self._gen = None
+        self._gen_kind = None
+        self._discover = None
+
+        self._in_q = queue.Queue()
+        self._results = {}
+        self._results_cond = threading.Condition()
+        self._stop = threading.Event()
+        self._workers = {}          # endpoint -> (thread, stop_event)
+        self._recent_failures = {}  # endpoint -> timestamp
+        self._inflight = {}         # endpoint -> task currently being predicted
+        self._inflight_lock = threading.Lock()
+        self._manager = None
+        self._started = False
+        self._epoch = 0             # generation token fencing epochs
+        self.stall_timeout = 300.0  # no-progress watchdog for the consumer
+
+    # -- configuration (reference setter surface) ------------------------------
+
+    def set_sample_generator(self, gen, batch_size):
+        """gen yields one sample tuple; batched here to ``batch_size``."""
+        self._gen, self._gen_kind = gen, ("sample", batch_size)
+        return self
+
+    def set_sample_list_generator(self, gen):
+        """gen yields a list of sample tuples (one student batch)."""
+        self._gen, self._gen_kind = gen, ("sample_list", None)
+        return self
+
+    def set_batch_generator(self, gen):
+        """gen yields a tuple/list of batched arrays matching ``ins``."""
+        self._gen, self._gen_kind = gen, ("batch", None)
+        return self
+
+    def set_fixed_teacher(self, endpoints):
+        self._discover = FixedDiscover(endpoints).start()
+        return self
+
+    def set_dynamic_teacher(self, discovery_endpoint, service_name,
+                            require_num=1):
+        self._discover = DiscoveryClient(
+            discovery_endpoint, service_name, require_num).start()
+        return self
+
+    # -- worker management -------------------------------------------------------
+
+    def _ensure_started(self):
+        if self._started:
+            return
+        if self._gen is None or self._discover is None:
+            raise errors.StatusError(
+                "DistillReader needs a generator and a teacher source")
+        self._manager = threading.Thread(target=self._manage_loop,
+                                         daemon=True,
+                                         name="distill-manager")
+        self._manager.start()
+        self._started = True
+
+    def _manage_loop(self):
+        while not self._stop.wait(1.0):
+            self._sync_workers()
+
+    def _sync_workers(self):
+        want = set(self._discover.get_servers())
+        now = time.monotonic()
+        # drop workers whose teacher disappeared; requeue anything a dead
+        # worker was still holding so no task is ever lost
+        for ep in list(self._workers):
+            thread, stop_ev = self._workers[ep]
+            if ep not in want:
+                stop_ev.set()
+            if not thread.is_alive():
+                del self._workers[ep]
+                with self._inflight_lock:
+                    orphan = self._inflight.pop(ep, None)
+                if orphan is not None:
+                    logger.warning("requeueing task %d orphaned by dead "
+                                   "worker %s", orphan[1], ep)
+                    self._in_q.put(orphan)
+        # start workers for new teachers (respecting failure backoff)
+        for ep in want:
+            if ep in self._workers:
+                continue
+            if now - self._recent_failures.get(ep, -1e9) < self._backoff:
+                continue
+            stop_ev = threading.Event()
+            thread = threading.Thread(
+                target=self._predict_loop, args=(ep, stop_ev), daemon=True,
+                name="distill-predict-%s" % ep)
+            thread.start()
+            self._workers[ep] = (thread, stop_ev)
+
+    def _predict_loop(self, endpoint, stop_ev):
+        try:
+            conn = _TeacherConn(endpoint)
+        except errors.EdlError as e:
+            logger.warning("teacher %s unreachable: %r", endpoint, e)
+            self._recent_failures[endpoint] = time.monotonic()
+            return
+        logger.info("distill worker up for teacher %s", endpoint)
+        while not (stop_ev.is_set() or self._stop.is_set()):
+            try:
+                task = self._in_q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            epoch, task_id, feed, payload = task
+            if epoch != self._epoch:  # stale task from an abandoned epoch
+                continue
+            with self._inflight_lock:
+                self._inflight[endpoint] = task
+            try:
+                preds = conn.predict(feed)
+            except Exception as e:  # noqa: BLE001 — ANY failure requeues
+                with self._inflight_lock:
+                    self._inflight.pop(endpoint, None)
+                logger.warning("teacher %s failed task %d (%r); requeueing",
+                               endpoint, task_id, e)
+                self._in_q.put(task)
+                self._recent_failures[endpoint] = time.monotonic()
+                break
+            with self._inflight_lock:
+                self._inflight.pop(endpoint, None)
+            with self._results_cond:
+                self._results[(epoch, task_id)] = (payload, preds)
+                self._results_cond.notify_all()
+        conn.close()
+        logger.info("distill worker for %s retired", endpoint)
+
+    # -- epoch iteration -----------------------------------------------------------
+
+    def _frame_tasks(self):
+        """Yield (feed_dict, payload) per student batch."""
+        kind, batch_size = self._gen_kind
+        if kind == "batch":
+            for arrays in self._gen():
+                arrays = [np.asarray(a) for a in arrays]
+                feed = dict(zip(self._ins, arrays))
+                yield feed, arrays
+        else:
+            def batches():
+                if kind == "sample_list":
+                    yield from self._gen()
+                else:
+                    buf = []
+                    for sample in self._gen():
+                        buf.append(sample)
+                        if len(buf) >= batch_size:
+                            yield buf
+                            buf = []
+                    if buf:
+                        yield buf
+            for samples in batches():
+                cols = list(zip(*samples))
+                arrays = [np.asarray(np.stack(c)) for c in cols]
+                feed = dict(zip(self._ins, arrays[:len(self._ins)]))
+                yield feed, samples
+
+    def __call__(self):
+        """One pass over the student data, each batch augmented with the
+        teacher predictions, in the original order."""
+        self._ensure_started()
+        # bump the epoch token: workers drop tasks/results from abandoned
+        # epochs, and any feeder thread from a previous epoch exits
+        self._epoch += 1
+        epoch = self._epoch
+        while True:
+            try:
+                self._in_q.get_nowait()
+            except queue.Empty:
+                break
+        with self._results_cond:
+            self._results.clear()
+        sem = threading.Semaphore(self._max_in_flight)
+        fed = {"n": 0, "done_feeding": False}
+
+        def feeder():
+            try:
+                for task_id, (feed, payload) in enumerate(
+                        self._frame_tasks()):
+                    if self._stop.is_set() or self._epoch != epoch:
+                        return
+                    sem.acquire()
+                    fed["n"] = task_id + 1
+                    self._in_q.put((epoch, task_id, feed, payload))
+            finally:
+                fed["done_feeding"] = True
+                with self._results_cond:
+                    self._results_cond.notify_all()
+
+        feeder_thread = threading.Thread(target=feeder, daemon=True,
+                                         name="distill-feeder")
+        feeder_thread.start()
+
+        next_id = 0
+        last_progress = time.monotonic()
+        while True:
+            with self._results_cond:
+                while (epoch, next_id) not in self._results:
+                    if (fed["done_feeding"] and next_id >= fed["n"]):
+                        feeder_thread.join(timeout=5)
+                        return
+                    self._results_cond.wait(timeout=0.5)
+                    if self._stop.is_set():
+                        return
+                    if (time.monotonic() - last_progress
+                            > self.stall_timeout):
+                        raise errors.DataAccessError(
+                            "distill pipeline stalled %.0fs waiting for "
+                            "task %d (workers=%s, queued=%d)"
+                            % (self.stall_timeout, next_id,
+                               sorted(self._workers), self._in_q.qsize()))
+                payload, preds = self._results.pop((epoch, next_id))
+            sem.release()
+            last_progress = time.monotonic()
+            yield self._assemble(payload, preds)
+            next_id += 1
+
+    def _assemble(self, payload, preds):
+        pred_arrays = [preds[name] for name in self._predicts]
+        if self._gen_kind[0] == "batch":
+            return tuple(payload) + tuple(pred_arrays)
+        out = []
+        for i, sample in enumerate(payload):
+            out.append(tuple(sample) + tuple(a[i] for a in pred_arrays))
+        return out
+
+    def stop(self):
+        self._stop.set()
+        for _, stop_ev in self._workers.values():
+            stop_ev.set()
+        if self._discover is not None:
+            self._discover.stop()

@@ -1,0 +1,106 @@
+"""Teacher registration daemon: advertise a live inference server into the
+coordination store while its port answers TCP.
+
+Reference parity: edl/discovery/register.py (TTL registration gated on a
+TCP alive probe :40-74; CLI __main__:99) and the redis flavor
+(edl/distill/redis/server_register.py). One store, one code path here.
+"""
+
+import argparse
+import json
+import signal
+import threading
+import time
+
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+from edl_tpu.utils.network import is_server_alive
+
+TEACHER_SERVICE_PREFIX = "distill"
+
+
+def teacher_service(service_name):
+    return "%s/%s" % (TEACHER_SERVICE_PREFIX, service_name)
+
+
+class TeacherRegister(object):
+    """Register ``endpoint`` under distill/<service_name> with a TTL lease,
+    refreshing while the server answers TCP; deregisters when it dies."""
+
+    def __init__(self, coord, service_name, endpoint, info=None, ttl=10):
+        self._coord = coord
+        self._service = teacher_service(service_name)
+        self._endpoint = endpoint
+        self._info = json.dumps(info or {})
+        self._ttl = ttl
+        self._lease = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="teacher-register")
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.is_set():
+            alive = is_server_alive(self._endpoint, timeout=2)
+            try:
+                if alive and self._lease is None:
+                    self._lease = self._coord.set_server_with_lease(
+                        self._service, self._endpoint, self._info, self._ttl)
+                    logger.info("teacher %s registered in %s",
+                                self._endpoint, self._service)
+                elif alive:
+                    self._coord.refresh_server(self._service, self._endpoint,
+                                               self._lease)
+                elif self._lease is not None:
+                    logger.warning("teacher %s dead; deregistering",
+                                   self._endpoint)
+                    self._coord.lease_revoke(self._lease)
+                    self._lease = None
+            except errors.EdlError as e:
+                logger.warning("teacher register error: %r", e)
+                self._lease = None
+            self._stop.wait(self._ttl / 3.0)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=self._ttl)
+        if self._lease is not None:
+            try:
+                self._coord.lease_revoke(self._lease)
+            except errors.EdlError:
+                pass
+
+
+def list_teachers(coord, service_name):
+    """endpoint -> info for every live teacher of a service."""
+    return dict(coord.get_service(teacher_service(service_name)))
+
+
+def main():
+    p = argparse.ArgumentParser("edl_tpu teacher register")
+    p.add_argument("--store_endpoints", default="127.0.0.1:2379")
+    p.add_argument("--root", default="distill_jobs")
+    p.add_argument("--service_name", required=True)
+    p.add_argument("--server", required=True, help="teacher host:port")
+    p.add_argument("--ttl", type=int, default=10)
+    args = p.parse_args()
+    coord = CoordClient(args.store_endpoints, root=args.root)
+    # wait for the server to come up before daemonizing the heartbeat
+    deadline = time.time() + 60
+    while not is_server_alive(args.server) and time.time() < deadline:
+        time.sleep(1)
+    reg = TeacherRegister(coord, args.service_name, args.server,
+                          ttl=args.ttl).start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    reg.stop()
+
+
+if __name__ == "__main__":
+    main()

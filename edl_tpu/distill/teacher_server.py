@@ -1,0 +1,171 @@
+"""TPU teacher inference server — the in-tree replacement for the Paddle
+Serving GPU servers the reference's distill plane called into
+(SURVEY.md §2.6; client usage distill_worker.py:197-321).
+
+Serves a jitted model function over the framed-RPC substrate:
+- ``get_feed_fetch()`` — feed/fetch name+shape introspection (the contract
+  the reference client discovered from serving conf files);
+- ``predict(feed)`` — feed dict of ndarrays → fetch dict of ndarrays.
+  Inputs are padded to a fixed batch size so XLA compiles once.
+
+A teacher registers itself into the coordination store via
+edl_tpu.distill.registry and is matched to students by the discovery/
+balance layer.
+"""
+
+import argparse
+import signal
+import threading
+
+import numpy as np
+
+from edl_tpu.rpc import ndarray as nd
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class TeacherServer(object):
+    """Wrap ``predict_fn(feed: dict[str, np.ndarray]) -> dict`` behind RPC.
+
+    ``feed_specs``/``fetch_specs``: {name: (shape_without_batch, dtype_str)}.
+    ``max_batch``: server-side compiled batch size; requests are padded up
+    and sliced back, so any client batch <= max_batch reuses one program.
+    """
+
+    def __init__(self, predict_fn, feed_specs, fetch_specs, max_batch=128,
+                 host="0.0.0.0", port=0):
+        self._fn = predict_fn
+        self._feed_specs = {k: (list(s), d) for k, (s, d)
+                            in feed_specs.items()}
+        self._fetch_specs = {k: (list(s), d) for k, (s, d)
+                             in fetch_specs.items()}
+        self._max_batch = max_batch
+        self._lock = threading.Lock()  # serialize device access
+        self._rpc = RpcServer(host=host, port=port)
+        self._rpc.register("get_feed_fetch", self.get_feed_fetch)
+        self._rpc.register("predict", self._predict_rpc)
+
+    def get_feed_fetch(self):
+        return {"feed": self._feed_specs, "fetch": self._fetch_specs,
+                "max_batch": self._max_batch}
+
+    def _predict_rpc(self, feed_encoded):
+        feed = nd.decode_tree(feed_encoded)
+        missing = set(self._feed_specs) - set(feed)
+        if missing:
+            raise errors.DataAccessError("missing feeds: %s"
+                                         % sorted(missing))
+        n = None
+        for name, arr in feed.items():
+            if n is None:
+                n = len(arr)
+            elif len(arr) != n:
+                raise errors.DataAccessError("feed batch mismatch")
+        if n == 0:
+            raise errors.DataAccessError("empty batch")
+        if n > self._max_batch:
+            raise errors.DataAccessError(
+                "batch %d exceeds max_batch %d" % (n, self._max_batch))
+        padded = {}
+        for name, arr in feed.items():
+            arr = np.asarray(arr)
+            if n < self._max_batch:
+                pad = np.zeros((self._max_batch - n,) + arr.shape[1:],
+                               arr.dtype)
+                arr = np.concatenate([arr, pad], axis=0)
+            padded[name] = arr
+        with self._lock:
+            out = self._fn(padded)
+        return nd.encode_tree({k: np.asarray(v)[:n] for k, v in out.items()})
+
+    def start(self):
+        self._rpc.start()
+        logger.info("teacher serving on %s (max_batch=%d)",
+                    self._rpc.endpoint, self._max_batch)
+        return self
+
+    @property
+    def endpoint(self):
+        return self._rpc.endpoint
+
+    @property
+    def port(self):
+        return self._rpc.port
+
+    def stop(self):
+        self._rpc.stop()
+
+
+def nop_teacher(fetch_specs, max_batch=128, host="0.0.0.0", port=0,
+                feed_specs=None):
+    """A fake teacher returning zeros — the test backend (reference parity:
+    _TestNopPaddlePredictServer, distill_worker.py:324-333)."""
+    feed_specs = feed_specs or {"ins": ([1], "<f4")}
+
+    def predict(feed):
+        n = max_batch
+        return {name: np.zeros((n,) + tuple(shape), np.dtype(dtype))
+                for name, (shape, dtype) in fetch_specs.items()}
+
+    return TeacherServer(predict, feed_specs, fetch_specs,
+                         max_batch=max_batch, host=host, port=port)
+
+
+def resnet_teacher(depth=50, num_classes=1000, image_size=224,
+                   max_batch=64, host="0.0.0.0", port=0):
+    """A real TPU teacher: ResNet(depth) logits + softmax."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import resnet
+
+    model = resnet.ResNet(depth=depth, num_classes=num_classes, vd=True,
+                          dtype=jnp.bfloat16)
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
+
+    @jax.jit
+    def infer(image):
+        logits = model.apply(variables, image, train=False)
+        return logits, jax.nn.softmax(logits)
+
+    def predict(feed):
+        logits, probs = infer(jnp.asarray(feed["image"]))
+        return {"logits": np.asarray(logits), "probs": np.asarray(probs)}
+
+    return TeacherServer(
+        predict,
+        feed_specs={"image": ([image_size, image_size, 3], "<f4")},
+        fetch_specs={"logits": ([num_classes], "<f4"),
+                     "probs": ([num_classes], "<f4")},
+        max_batch=max_batch, host=host, port=port)
+
+
+def main():
+    p = argparse.ArgumentParser("edl_tpu teacher server")
+    p.add_argument("--model", default="nop", choices=["nop", "resnet"])
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--num_classes", type=int, default=1000)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--max_batch", type=int, default=64)
+    args = p.parse_args()
+    if args.model == "resnet":
+        server = resnet_teacher(args.depth, args.num_classes,
+                                args.image_size, args.max_batch,
+                                port=args.port)
+    else:
+        server = nop_teacher({"logits": ([args.num_classes], "<f4")},
+                             max_batch=args.max_batch, port=args.port)
+    server.start()
+    print("TEACHER_ENDPOINT=%s" % server.endpoint, flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

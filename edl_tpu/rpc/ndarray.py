@@ -1,0 +1,35 @@
+"""Numpy arrays over the msgpack wire: tag-encode ndarrays inside pytrees.
+
+Used by the distill plane to ship feature batches and teacher predictions
+(the role paddle-serving's protobuf tensors played in the reference).
+"""
+
+import numpy as np
+
+_TAG = "__nd__"
+
+
+def encode_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return {_TAG: True, "dtype": obj.dtype.str,
+                "shape": list(obj.shape),
+                "data": obj.tobytes()}
+    if isinstance(obj, (np.generic,)):
+        return encode_tree(np.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: encode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_tree(v) for v in obj]
+    return obj
+
+
+def decode_tree(obj):
+    if isinstance(obj, dict):
+        if obj.get(_TAG):
+            return np.frombuffer(
+                obj["data"], dtype=np.dtype(obj["dtype"])
+            ).reshape(obj["shape"]).copy()
+        return {k: decode_tree(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_tree(v) for v in obj]
+    return obj

@@ -134,7 +134,11 @@ class ElasticTrainer(object):
         self._has_aux = has_aux
         if extra_state is not None:
             for leaf in jax.tree_util.tree_leaves(extra_state):
-                dt = np.asarray(leaf).dtype  # host dtype, pre-canonicalize
+                # only explicit numpy 64-bit leaves are dangerous; Python
+                # scalars are weak-typed to 32-bit with no real truncation
+                if not isinstance(leaf, (np.ndarray, np.generic)):
+                    continue
+                dt = leaf.dtype
                 if dt.kind in "iuf" and dt.itemsize == 8 \
                         and not jax.config.jax_enable_x64:
                     raise ValueError(
@@ -245,18 +249,30 @@ class ElasticTrainer(object):
         the world size changed. Returns True if something was restored."""
         if self._ckpt is None:
             return False
-        # try the full state first; fall back to core-only for checkpoints
-        # written without this trainer's extra state (single read each way)
+        # newest-first: per version, try the full state; when only the extra
+        # keys are missing (legacy checkpoint), retry THAT version core-only
+        # rather than falling back to an older checkpoint
         host_state = jax.device_get(dict(self.train_state))
-        restored = self._ckpt.restore_latest(target=host_state)
-        if restored is None and jax.tree_util.tree_leaves(
-                host_state["extra"]):
-            extra_target = host_state.pop("extra")
-            restored = self._ckpt.restore_latest(target=host_state)
-            if restored is not None:
-                logger.info("checkpoint has no extra state; keeping the "
-                            "initial one")
-                restored[1]["extra"] = extra_target
+        restored = None
+        for version in reversed(self._ckpt.versions()):
+            try:
+                restored = self._ckpt.restore(version, target=host_state)
+                break
+            except Exception as e:  # noqa: BLE001
+                if "missing keys" in str(e) and jax.tree_util.tree_leaves(
+                        host_state["extra"]):
+                    core = dict(host_state)
+                    extra_target = core.pop("extra")
+                    try:
+                        restored = self._ckpt.restore(version, target=core)
+                        logger.info("checkpoint v%d has no extra state; "
+                                    "keeping the initial one", version)
+                        restored[1]["extra"] = extra_target
+                        break
+                    except Exception as e2:  # noqa: BLE001
+                        e = e2
+                logger.warning("checkpoint v%d unusable (%r); trying older",
+                               version, e)
         if restored is None:
             return False
         version, tree, meta = restored

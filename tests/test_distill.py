@@ -1,0 +1,216 @@
+"""Distill plane tests: hash ring, balancer invariants, teacher server,
+discovery, and the full DistillReader pipeline with teacher failure
+mid-epoch (reference shape: distill_reader_test.py + NOP backend)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.distill.balance import Service
+from edl_tpu.distill.consistent_hash import ConsistentHash
+from edl_tpu.distill.discovery_client import DiscoveryClient
+from edl_tpu.distill.discovery_server import DiscoveryServer
+from edl_tpu.distill.distill_reader import DistillReader
+from edl_tpu.distill.registry import TeacherRegister, list_teachers
+from edl_tpu.distill.teacher_server import TeacherServer, nop_teacher
+from edl_tpu.rpc import ndarray as nd
+
+
+def test_ndarray_codec():
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "nested": [np.array([1, 2], np.int64), "text", 7]}
+    out = nd.decode_tree(nd.encode_tree(tree))
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    np.testing.assert_array_equal(out["nested"][0], tree["nested"][0])
+    assert out["nested"][1:] == ["text", 7]
+
+
+def test_consistent_hash_stability():
+    ring = ConsistentHash(["s1", "s2", "s3"])
+    owners = {k: ring.get_node("svc%d" % k)[0] for k in range(50)}
+    v0 = ring.version
+    ring.remove_node("s2")
+    assert ring.version > v0
+    moved = sum(1 for k in range(50)
+                if owners[k] != ring.get_node("svc%d" % k)[0])
+    # only keys owned by the removed node move
+    assert moved == sum(1 for k in range(50) if owners[k] == "s2")
+    assert all(ring.get_node("svc%d" % k)[0] in ("s1", "s3")
+               for k in range(50))
+
+
+def test_balance_invariants():
+    svc = Service("s")
+    svc.set_servers(["t1", "t2", "t3"])
+    for i in range(6):
+        svc.register_client("c%d" % i, require_num=2)
+    stats = svc.stats()
+    # per-server cap = (6+3-1)//3 = 2; per-client = max(1, 3//6) = 1
+    assert all(n <= 3 for n in stats["servers"].values())
+    assert all(len(s) >= 1 for s in stats["clients"].values())
+    # teacher dies → its clients rebalanced
+    v_before = {c: svc.heartbeat(c, -1)["version"]
+                for c in list(stats["clients"])}
+    svc.set_servers(["t1", "t3"])
+    stats2 = svc.stats()
+    assert "t2" not in stats2["servers"]
+    assert all(len(s) >= 1 for s in stats2["clients"].values())
+    # affected clients got a version bump
+    changed = [c for c in v_before
+               if svc.heartbeat(c, v_before[c]) is not None
+               and "servers" in svc.heartbeat(c, v_before[c])]
+    assert changed
+
+
+def test_teacher_server_pad_and_slice():
+    def fn(feed):
+        return {"out": feed["x"] * 2.0}
+    server = TeacherServer(fn, {"x": ([3], "<f4")}, {"out": ([3], "<f4")},
+                           max_batch=8, host="127.0.0.1").start()
+    try:
+        from edl_tpu.distill.distill_reader import _TeacherConn
+        conn = _TeacherConn(server.endpoint)
+        assert conn.max_batch == 8
+        x = np.arange(30, dtype=np.float32).reshape(10, 3)  # > max_batch
+        out = conn.predict({"x": x})
+        np.testing.assert_allclose(out["out"], x * 2.0)
+        conn.close()
+    finally:
+        server.stop()
+
+
+def test_registry_and_discovery(coord):
+    teacher = nop_teacher({"logits": ([4], "<f4")}, max_batch=4,
+                          host="127.0.0.1").start()
+    reg = TeacherRegister(coord, "svc_a", teacher.endpoint, ttl=2).start()
+    disc = DiscoveryServer(coord, host="127.0.0.1").start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if list_teachers(coord, "svc_a"):
+                break
+            time.sleep(0.2)
+        client = DiscoveryClient(disc.endpoint, "svc_a",
+                                 require_num=1).start()
+        servers = client.wait_for_servers(timeout=20)
+        assert servers == [teacher.endpoint]
+        # teacher dies → TTL expiry → discovery pushes the removal
+        teacher.stop()
+        reg.stop()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if not client.get_servers():
+                break
+            time.sleep(0.3)
+        assert client.get_servers() == []
+        client.stop()
+    finally:
+        disc.stop()
+
+
+def _echo_teacher(scale, port=0):
+    def fn(feed):
+        return {"soft_label": feed["img"] * scale}
+    return TeacherServer(fn, {"img": ([2], "<f4")},
+                         {"soft_label": ([2], "<f4")},
+                         max_batch=16, host="127.0.0.1", port=port).start()
+
+
+def test_distill_reader_fixed_teacher_ordering():
+    teacher = _echo_teacher(2.0)
+
+    def gen():
+        for i in range(20):
+            img = np.full((4, 2), i, np.float32)
+            label = np.full((4, 1), i, np.int64)
+            yield img, label
+
+    dr = DistillReader(ins=["img"], predicts=["soft_label"],
+                       max_in_flight=4)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([teacher.endpoint])
+    try:
+        seen = []
+        for img, label, soft in dr():
+            np.testing.assert_allclose(soft, img * 2.0)
+            seen.append(int(img[0, 0]))
+        assert seen == list(range(20))  # original order preserved
+        # second epoch works on the same reader
+        assert sum(1 for _ in dr()) == 20
+    finally:
+        dr.stop()
+        teacher.stop()
+
+
+def test_distill_reader_sample_list_and_teacher_failure():
+    t1 = _echo_teacher(3.0)
+    t2 = _echo_teacher(3.0)
+
+    def gen():
+        for i in range(30):
+            yield [(np.full(2, i + j, np.float32),) for j in range(3)]
+
+    dr = DistillReader(ins=["img"], predicts=["soft_label"],
+                       max_in_flight=4, teacher_backoff=60)
+    dr.set_sample_list_generator(gen)
+    dr.set_fixed_teacher([t1.endpoint, t2.endpoint])
+
+    killed = threading.Event()
+    out_batches = []
+    try:
+        for i, samples in enumerate(dr()):
+            out_batches.append(samples)
+            for img, soft in samples:
+                np.testing.assert_allclose(soft, img * 3.0)
+            if i == 5 and not killed.is_set():
+                t1.stop()  # kill a teacher mid-epoch; tasks must be retried
+                killed.set()
+        assert len(out_batches) == 30  # nothing lost despite the failure
+    finally:
+        dr.stop()
+        t2.stop()
+
+
+def test_distill_reader_abandoned_epoch_is_fenced():
+    """Breaking out of an epoch mid-iteration must not leak stale batches
+    into the next epoch (epoch generation token)."""
+    teacher = _echo_teacher(1.0)
+
+    def gen():
+        for i in range(20):
+            yield (np.full((2, 2), i, np.float32),)
+
+    dr = DistillReader(ins=["img"], predicts=["soft_label"],
+                       max_in_flight=4)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([teacher.endpoint])
+    try:
+        for i, (img, soft) in enumerate(dr()):
+            if i == 2:
+                break  # abandon the epoch with tasks still in flight
+        time.sleep(0.3)
+        seen = [int(img[0, 0]) for img, _ in dr()]
+        assert seen == list(range(20))  # fresh epoch, correct order
+    finally:
+        dr.stop()
+        teacher.stop()
+
+
+def test_distill_reader_sample_generator_batching():
+    teacher = _echo_teacher(1.0)
+
+    def gen():
+        for i in range(10):
+            yield (np.full(2, i, np.float32),)
+
+    dr = DistillReader(ins=["img"], predicts=["soft_label"])
+    dr.set_sample_generator(gen, batch_size=4)
+    dr.set_fixed_teacher([teacher.endpoint])
+    try:
+        sizes = [len(s) for s in dr()]
+        assert sizes == [4, 4, 2]
+    finally:
+        dr.stop()
+        teacher.stop()
