@@ -1,0 +1,169 @@
+"""BERT encoder family in flax.linen, TP/SP-ready.
+
+Reference parity: the reference's NLP scope was ERNIE/BERT distillation
+(example/distill/nlp, doc/ROADMAP.md 0.3.0) with no model parallelism.
+This implementation is TPU-first and goes further by design (a stated goal
+of the rebuild): Megatron-style tensor-parallel partition rules for the
+attention/MLP projections, and an optional ring-attention path so long
+sequences shard over the ``sp`` mesh axis.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+
+class BertSelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    use_ring: bool = False
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        q = dense((self.num_heads, head_dim), "query")(x)
+        k = dense((self.num_heads, head_dim), "key")(x)
+        v = dense((self.num_heads, head_dim), "value")(x)
+        if self.use_ring:
+            from edl_tpu.parallel.ring_attention import ring_attention
+            ctx = ring_attention(q, k, v, self.mesh, causal=False)
+        else:
+            scale = head_dim ** -0.5
+            scores = jnp.einsum("bqhd,bkhd->bhqk",
+                                (q * scale).astype(jnp.float32),
+                                k.astype(jnp.float32))
+            if mask is not None:
+                scores = jnp.where(mask[:, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                             v.astype(jnp.float32)).astype(self.dtype)
+        out = nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
+                              param_dtype=jnp.float32, name="out")(ctx)
+        return out
+
+
+class BertLayer(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+    use_ring: bool = False
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        attn = BertSelfAttention(self.num_heads, self.dtype, self.use_ring,
+                                 self.mesh, name="attention")(x, mask)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_attn")(x + attn)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_down")(h)
+        return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                            name="ln_mlp")(x + h)
+
+
+class Bert(nn.Module):
+    """BERT encoder; bert-base = defaults (12 layers, 768 hidden, 12 heads).
+    """
+    vocab_size: int = 30522
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 512
+    num_classes: Optional[int] = 2
+    dtype: Any = jnp.bfloat16
+    use_ring: bool = False
+    mesh: Any = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        b, s = input_ids.shape
+        word = nn.Embed(self.vocab_size, self.d_model,
+                        param_dtype=jnp.float32, dtype=self.dtype,
+                        name="word_embed")(input_ids)
+        pos = nn.Embed(self.max_len, self.d_model,
+                       param_dtype=jnp.float32, dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(s)[None, :])
+        x = word + pos
+        if token_type_ids is not None:
+            x = x + nn.Embed(2, self.d_model, param_dtype=jnp.float32,
+                             dtype=self.dtype,
+                             name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_embed")(x)
+        for i in range(self.num_layers):
+            x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
+                          self.use_ring, self.mesh,
+                          name="layer_%d" % i)(x, attention_mask)
+        pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32,
+                                   param_dtype=jnp.float32,
+                                   name="pooler")(x[:, 0]))
+        if self.num_classes is None:
+            return x, pooled
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="classifier")(pooled)
+
+
+def bert_base(**kw):
+    return Bert(**kw)
+
+
+def bert_tiny(**kw):
+    """4-layer test-size config."""
+    kw.setdefault("num_layers", 4)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("mlp_dim", 128)
+    kw.setdefault("vocab_size", 1000)
+    kw.setdefault("max_len", 128)
+    return Bert(**kw)
+
+
+def bert_partition_rules():
+    """Megatron-style TP rules: column-shard up-projections, row-shard
+    down-projections, vocab-shard embeddings; everything else replicated."""
+    return [
+        (r"attention/(query|key|value)/kernel", P(None, "tp", None)),
+        (r"attention/out/kernel", P("tp", None, None)),
+        (r"mlp_up/kernel", P(None, "tp")),
+        (r"mlp_down/kernel", P("tp", None)),
+        (r"word_embed/embedding", P("tp", None)),
+    ]
+
+
+def create_model_and_loss(model=None, **kw):
+    """(model, params, loss_fn) for ElasticTrainer (classification)."""
+    model = model or bert_tiny(**kw)
+    dummy = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["input_ids"],
+                             batch.get("attention_mask"))
+        one_hot = jax.nn.one_hot(batch["label"], model.num_classes)
+        return optax.softmax_cross_entropy(logits, one_hot).mean()
+
+    return model, params, loss_fn
+
+
+def synthetic_text_batch(batch_size, seq_len=64, vocab_size=1000,
+                         num_classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "input_ids": rng.randint(0, vocab_size,
+                                 (batch_size, seq_len)).astype(np.int32),
+        "label": rng.randint(0, num_classes,
+                             (batch_size,)).astype(np.int32),
+    }

@@ -1,0 +1,55 @@
+"""Bag-of-words sentiment classifier — the distillation student.
+
+Reference parity: example/distill/nlp — the ERNIE→BOW sentiment
+distillation student (BASELINE.md ChnSentiCorp row). Here the teacher is a
+TPU-served BERT; distillation mixes hard-label CE with soft-label KL.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class BOW(nn.Module):
+    vocab_size: int = 30522
+    embed_dim: int = 128
+    hidden: int = 128
+    num_classes: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids):
+        emb = nn.Embed(self.vocab_size, self.embed_dim,
+                       param_dtype=jnp.float32, dtype=self.dtype,
+                       name="embed")(input_ids)
+        x = jnp.tanh(emb.sum(axis=1))
+        x = jnp.tanh(nn.Dense(self.hidden, dtype=self.dtype,
+                              param_dtype=jnp.float32, name="fc1")(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="fc2")(x)
+
+
+def create_model_and_loss(vocab_size=1000, num_classes=2,
+                          distill_weight=0.5, temperature=1.0):
+    """Loss = (1-w)·CE(hard) + w·KL(teacher soft labels) — the standard
+    distill objective the reference's student used (soft_label input)."""
+    model = BOW(vocab_size=vocab_size, num_classes=num_classes)
+    dummy = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["input_ids"])
+        one_hot = jax.nn.one_hot(batch["label"], num_classes)
+        hard = optax.softmax_cross_entropy(logits, one_hot).mean()
+        if "soft_label" not in batch:
+            return hard
+        t = temperature
+        teacher_probs = jax.nn.softmax(
+            batch["soft_label"].astype(jnp.float32) / t, axis=-1)
+        soft = optax.softmax_cross_entropy(logits / t, teacher_probs).mean()
+        return (1.0 - distill_weight) * hard + distill_weight * soft * t * t
+
+    return model, params, loss_fn

@@ -1,0 +1,78 @@
+"""DeepFM for CTR prediction.
+
+Reference parity: the CTR example (example/ctr, BASELINE.json configs[3]).
+The reference ran it parameter-server style; per BASELINE.md the TPU
+mapping is data-parallel — embeddings live replicated (or vocab-sharded via
+partition rules for huge tables) and gradients ride the dp all-reduce.
+"""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class DeepFM(nn.Module):
+    field_vocab_sizes: Sequence[int]   # one vocab per categorical field
+    embed_dim: int = 8
+    mlp_dims: Sequence[int] = (128, 64)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, fields):
+        """fields: int32 [batch, num_fields] of per-field category ids."""
+        n_fields = len(self.field_vocab_sizes)
+        # first-order weights and k-dim factors per field
+        linear_terms, factors = [], []
+        for i, vocab in enumerate(self.field_vocab_sizes):
+            ids = fields[:, i]
+            w = nn.Embed(vocab, 1, param_dtype=jnp.float32,
+                         dtype=self.dtype, name="linear_%d" % i)(ids)
+            v = nn.Embed(vocab, self.embed_dim, param_dtype=jnp.float32,
+                         dtype=self.dtype, name="factor_%d" % i)(ids)
+            linear_terms.append(w[:, 0])
+            factors.append(v)
+        vs = jnp.stack(factors, axis=1)          # [b, fields, k]
+        first_order = sum(linear_terms)
+        # FM second order: 0.5 * ((Σv)² − Σv²)
+        sum_sq = jnp.square(vs.sum(axis=1))
+        sq_sum = jnp.square(vs).sum(axis=1)
+        second_order = 0.5 * (sum_sq - sq_sum).sum(axis=-1)
+        # deep part over concatenated embeddings
+        h = vs.reshape(vs.shape[0], n_fields * self.embed_dim)
+        for j, dim in enumerate(self.mlp_dims):
+            h = nn.relu(nn.Dense(dim, dtype=self.dtype,
+                                 param_dtype=jnp.float32,
+                                 name="deep_%d" % j)(h))
+        deep = nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="deep_out")(h)[:, 0]
+        bias = self.param("bias", nn.initializers.zeros, ())
+        return first_order + second_order + deep + bias  # logit
+
+
+def create_model_and_loss(field_vocab_sizes=(100,) * 10, embed_dim=8,
+                          mlp_dims=(64, 32)):
+    model = DeepFM(field_vocab_sizes, embed_dim, mlp_dims)
+    dummy = jnp.zeros((1, len(field_vocab_sizes)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+
+    def loss_fn(params, batch, rng):
+        logit = model.apply({"params": params}, batch["fields"])
+        return optax.sigmoid_binary_cross_entropy(
+            logit, batch["label"].astype(jnp.float32)).mean()
+
+    return model, params, loss_fn
+
+
+def synthetic_ctr_batch(batch_size, field_vocab_sizes=(100,) * 10, seed=0):
+    """Clicks correlated with field 0 so learning is observable."""
+    rng = np.random.RandomState(seed)
+    n = len(field_vocab_sizes)
+    fields = np.stack([rng.randint(0, v, batch_size)
+                       for v in field_vocab_sizes], axis=1).astype(np.int32)
+    prob = (fields[:, 0] % 10) / 10.0
+    label = (rng.rand(batch_size) < prob).astype(np.int32)
+    return {"fields": fields, "label": label}

@@ -1,0 +1,47 @@
+"""Partition-rule matching: map parameter path regexes to PartitionSpecs.
+
+The standard idiom for sharding big models under pjit (cf. public JAX LLM
+codebases): author a table of (path_regex, PartitionSpec), apply it over the
+param pytree, and let XLA insert the collectives. Net-new vs the reference
+(which had no model parallelism — SURVEY.md §2.7); this is the TP/SP entry
+point of the framework.
+"""
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _path_str(path):
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def match_partition_rules(rules, params):
+    """Return a pytree of PartitionSpec matching ``params``.
+
+    rules: ordered [(regex, PartitionSpec)]; first match wins; scalars and
+    size-1 leaves are always replicated.
+    """
+    def spec_for(path, leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()
+        name = _path_str(path)
+        for regex, spec in rules:
+            if re.search(regex, name):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_params(params, mesh, rules):
+    """device_put ``params`` with shardings from ``rules`` over ``mesh``."""
+    specs = match_partition_rules(rules, params)
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+    return jax.device_put(params, shardings), shardings

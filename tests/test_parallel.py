@@ -1,0 +1,97 @@
+"""Parallelism tests on the 8-device CPU mesh: ring attention vs dense,
+causal masking, gradients through the ring, and partition-rule matching."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from edl_tpu.parallel.ring_attention import dense_attention, ring_attention
+from edl_tpu.parallel.sharding import match_partition_rules, shard_params
+from edl_tpu.runtime import mesh as mesh_mod
+
+
+def _qkv(b=2, s=32, h=2, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)) * 0.3
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sp", [4, 8])
+def test_ring_attention_matches_dense(causal, sp):
+    mesh = mesh_mod.make_mesh(dp=8 // sp, sp=sp)
+    q, k, v = _qkv()
+    want = dense_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # output stays sequence-sharded
+    assert len(got.sharding.device_set) == 8
+
+
+def test_ring_attention_grads_match_dense():
+    mesh = mesh_mod.make_mesh(dp=2, sp=4)
+    q, k, v = _qkv(s=16)
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, mesh, causal=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_attention(q, k, v, causal=True) ** 2).sum()
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gr, gd in zip(g_ring, g_dense):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gd),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_long_sequence_sharded_memory():
+    """Each device only ever holds its seq shard of q/k/v."""
+    mesh = mesh_mod.make_mesh(dp=1, sp=8)
+    q, k, v = _qkv(b=1, s=64, h=1, d=4)
+    out = ring_attention(q, k, v, mesh, causal=False)
+    shard = out.addressable_shards[0]
+    assert shard.data.shape == (1, 8, 1, 4)  # 64/8 sequence rows
+
+
+def test_match_partition_rules():
+    params = {
+        "encoder": {
+            "layer_0": {
+                "attn": {"qkv": {"kernel": np.zeros((16, 48)),
+                                 "bias": np.zeros(48)},
+                         "out": {"kernel": np.zeros((48, 16))}},
+                "mlp": {"up": {"kernel": np.zeros((16, 64))},
+                        "down": {"kernel": np.zeros((64, 16))}},
+            }},
+        "embed": {"word": {"embedding": np.zeros((100, 16))}},
+        "scalar": np.zeros(()),
+    }
+    rules = [
+        (r"attn/qkv/kernel", P(None, "tp")),
+        (r"attn/out/kernel", P("tp", None)),
+        (r"mlp/up/kernel", P(None, "tp")),
+        (r"mlp/down/kernel", P("tp", None)),
+        (r"embedding", P("tp", None)),
+    ]
+    specs = match_partition_rules(rules, params)
+    lyr = specs["encoder"]["layer_0"]
+    assert lyr["attn"]["qkv"]["kernel"] == P(None, "tp")
+    assert lyr["attn"]["qkv"]["bias"] == P()      # no rule → replicated
+    assert lyr["mlp"]["down"]["kernel"] == P("tp", None)
+    assert specs["embed"]["word"]["embedding"] == P("tp", None)
+    assert specs["scalar"] == P()
+
+
+def test_shard_params_places_on_mesh():
+    mesh = mesh_mod.make_mesh(dp=4, tp=2)
+    params = {"w": np.ones((8, 6), np.float32), "b": np.ones(6, np.float32)}
+    sharded, shardings = shard_params(params, mesh,
+                                      [(r"^w$", P(None, "tp"))])
+    assert sharded["w"].sharding.spec == P(None, "tp")
+    # tp=2 → each device holds half the columns
+    assert sharded["w"].addressable_shards[0].data.shape == (8, 3)
+    assert sharded["b"].sharding.spec == P()
