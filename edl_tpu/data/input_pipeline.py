@@ -1,0 +1,88 @@
+"""Host-side input pipeline — the DALI replacement.
+
+Reference parity: example/collective/resnet50/dali.py (GPU-decode pipeline)
+and the cv2 fallback reader (train_with_fleet.py:463-475, epoch-seeded).
+On TPU the host CPU feeds the chips, so this is a tf.data pipeline:
+parallel JPEG decode, random-resized-crop + flip for train, central crop
+for eval, epoch-seeded shuffling, per-host sharding by global rank, and
+prefetch — returning numpy batches ready for ElasticTrainer.shard_batch.
+"""
+
+import os
+
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32) * 255
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32) * 255
+
+
+def list_image_files(root):
+    """(path, label) pairs from a class-per-subdirectory tree."""
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    out = []
+    for label, cls in enumerate(classes):
+        d = os.path.join(root, cls)
+        for name in sorted(os.listdir(d)):
+            if name.lower().endswith((".jpg", ".jpeg", ".png")):
+                out.append((os.path.join(d, name), label))
+    return out, classes
+
+
+def image_folder_pipeline(root, batch_size, image_size=224, train=True,
+                          epoch_seed=0, shard_index=0, shard_count=1,
+                          prefetch=4):
+    """Yield {"image", "label"} numpy batches from an image-folder tree.
+
+    shard_index/shard_count give each host a disjoint slice (reference: the
+    per-trainer file split); epoch_seed reshuffles per epoch (reference:
+    reader seeded by pass_id).
+    """
+    import tensorflow as tf
+    tf.config.set_visible_devices([], "GPU")  # host CPU only
+
+    files, _ = list_image_files(root)
+    if not files:
+        raise ValueError("no images under %s" % root)
+    paths = [p for p, _ in files]
+    labels = [l for _, l in files]
+    ds = tf.data.Dataset.from_tensor_slices((paths, labels))
+    ds = ds.shard(shard_count, shard_index)
+    if train:
+        ds = ds.shuffle(min(len(files), 10000), seed=epoch_seed,
+                        reshuffle_each_iteration=False)
+
+    def load(path, label):
+        raw = tf.io.read_file(path)
+        img = tf.io.decode_image(raw, channels=3, expand_animations=False)
+        img = tf.cast(img, tf.float32)
+        if train:
+            img = tf.image.resize(img, (int(image_size * 1.15),) * 2)
+            img = tf.image.random_crop(img, (image_size, image_size, 3))
+            img = tf.image.random_flip_left_right(img)
+        else:
+            img = tf.image.resize(img, (image_size, image_size))
+        img = (img - IMAGENET_MEAN) / IMAGENET_STD
+        return img, label
+
+    ds = ds.map(load, num_parallel_calls=tf.data.AUTOTUNE)
+    ds = ds.batch(batch_size, drop_remainder=train)
+    ds = ds.prefetch(prefetch)
+    for img, label in ds.as_numpy_iterator():
+        yield {"image": np.asarray(img, np.float32),
+               "label": np.asarray(label, np.int32)}
+
+
+def synthetic_pipeline(batch_size, image_size=224, num_classes=1000,
+                       steps=None, seed=0):
+    """Deterministic synthetic image stream (benchmark / smoke mode)."""
+    step = 0
+    while steps is None or step < steps:
+        rng = np.random.RandomState(seed * 100003 + step)
+        yield {
+            "image": rng.randn(batch_size, image_size, image_size, 3)
+                        .astype(np.float32),
+            "label": rng.randint(0, num_classes,
+                                 (batch_size,)).astype(np.int32),
+        }
+        step += 1

@@ -29,7 +29,7 @@ import numpy as np
 from edl_tpu.distill.discovery_client import DiscoveryClient, FixedDiscover
 from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.client import RpcClient
-from edl_tpu.utils import errors
+from edl_tpu.utils import errors, timeline
 from edl_tpu.utils.logger import logger
 
 
@@ -166,6 +166,7 @@ class DistillReader(object):
             self._recent_failures[endpoint] = time.monotonic()
             return
         logger.info("distill worker up for teacher %s", endpoint)
+        tl = timeline.get_timeline()
         while not (stop_ev.is_set() or self._stop.is_set()):
             try:
                 task = self._in_q.get(timeout=0.2)
@@ -177,7 +178,8 @@ class DistillReader(object):
             with self._inflight_lock:
                 self._inflight[endpoint] = task
             try:
-                preds = conn.predict(feed)
+                with tl.span("predict@%s" % endpoint):
+                    preds = conn.predict(feed)
             except Exception as e:  # noqa: BLE001 — ANY failure requeues
                 with self._inflight_lock:
                     self._inflight.pop(endpoint, None)

@@ -1,0 +1,155 @@
+"""Minimal elastic layer: the liveft-style alternative to the full launcher.
+
+Reference parity: edl/liveft/elastic.py (the 2021 design later upstreamed
+to Paddle): store keys <job>/liveft/{nodes,np,endpoints}; node
+self-registration with watch-based re-registration (:147-159); a watched
+``np`` (world size) key as the scale signal (:172-178); wait() until the
+registered host count equals np (:263); watch() returning COMPLETED /
+RESTART / HOLD / ERROR (:284-307); rank reassignment that preserves
+surviving hosts' order (:238-261); exit code 101 = "restart me"
+(:25). Useful when an external supervisor (k8s) owns the processes and
+only membership/rank agreement is needed.
+"""
+
+import threading
+import time
+
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+ELASTIC_EXIT_CODE = 101  # ask the outer supervisor to restart us
+
+SERVICE_NODES = "liveft_nodes"
+SERVICE_CONF = "liveft_conf"
+NP_KEY = "np"
+
+COMPLETED = "COMPLETED"
+RESTART = "RESTART"
+HOLD = "HOLD"
+ERROR = "ERROR"
+
+
+class ElasticManager(object):
+    def __init__(self, coord, host, np_target, ttl=10):
+        self._coord = coord
+        self._host = host
+        self._np = int(np_target)
+        self._ttl = ttl
+        self._lease = None
+        self._stop = threading.Event()
+        self._hosts_changed = threading.Event()
+        self._np_changed = threading.Event()
+        self._completed = threading.Event()
+        self._registered = threading.Event()
+        self._keeper = None
+        self._watcher = None
+        self._np_watcher = None
+        self._last_hosts = []
+
+        if self._coord.get_value(SERVICE_CONF, NP_KEY) is None:
+            self._coord.set_server_permanent(SERVICE_CONF, NP_KEY,
+                                             str(self._np))
+
+    # -- registration with self-healing ------------------------------------
+
+    def start(self):
+        self._register()
+        self._keeper = threading.Thread(target=self._keep_registered,
+                                        daemon=True, name="liveft-keeper")
+        self._keeper.start()
+        self._watcher = self._coord.watch_service(SERVICE_NODES,
+                                                  self._on_nodes)
+        self._np_watcher = self._coord.watch_service(SERVICE_CONF,
+                                                     self._on_conf)
+        return self
+
+    def _register(self):
+        self._lease = self._coord.set_server_with_lease(
+            SERVICE_NODES, self._host, str(time.time()), self._ttl)
+        self._registered.set()
+        logger.info("liveft: %s registered", self._host)
+
+    def _keep_registered(self):
+        """Refresh; on lease loss, re-register (reference watch-based
+        re-registration, elastic.py:147-159)."""
+        while not self._stop.wait(self._ttl / 3.0):
+            try:
+                self._coord.refresh_server(SERVICE_NODES, self._host,
+                                           self._lease)
+            except errors.EdlError:
+                logger.warning("liveft: registration lost; re-registering")
+                try:
+                    self._register()
+                except errors.EdlError:
+                    # fell out AND could not get back in → watch() = ERROR
+                    self._registered.clear()
+
+    def _on_nodes(self, added, removed, all_servers):
+        self._last_hosts = sorted(all_servers)
+        if added or removed:
+            self._hosts_changed.set()
+
+    def _on_conf(self, added, removed, all_servers):
+        np_val = all_servers.get(NP_KEY)
+        if np_val is not None and int(np_val) != self._np:
+            self._np = int(np_val)
+            self._np_changed.set()
+
+    # -- the public protocol ----------------------------------------------
+
+    def hosts(self):
+        return sorted(h for h, _ in
+                      self._coord.get_service(SERVICE_NODES))
+
+    def wait(self, timeout=600):
+        """Block until the registered host count equals np; returns ranked
+        host list (this host's rank = index)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            hosts = self.hosts()
+            if len(hosts) == self._np:
+                self._hosts_changed.clear()
+                return hosts
+            time.sleep(0.5)
+        raise errors.TimeoutError_("liveft: %d/%d hosts after %ss"
+                                   % (len(self.hosts()), self._np, timeout))
+
+    def set_np(self, np_target):
+        """Scale signal: update the shared world-size target."""
+        self._coord.set_server_permanent(SERVICE_CONF, NP_KEY,
+                                         str(int(np_target)))
+
+    def complete(self):
+        self._completed.set()
+
+    def watch(self, poll=1.0):
+        """One supervision tick: COMPLETED | RESTART (membership or np
+        changed) | HOLD (keep running) | ERROR (we fell out and could not
+        re-register)."""
+        if self._completed.is_set():
+            return COMPLETED
+        if not self._registered.is_set():
+            return ERROR
+        if self._np_changed.is_set() or self._hosts_changed.is_set():
+            hosts = self.hosts()
+            if len(hosts) == self._np and self._host in hosts:
+                self._np_changed.clear()
+                self._hosts_changed.clear()
+                return RESTART
+        time.sleep(poll)
+        return HOLD
+
+    def rank(self):
+        hosts = self.hosts()
+        return hosts.index(self._host) if self._host in hosts else -1
+
+    def stop(self):
+        self._stop.set()
+        for w in (self._watcher, self._np_watcher):
+            if w is not None:
+                w.stop()
+        if self._lease is not None:
+            try:
+                self._coord.lease_revoke(self._lease)
+            except errors.EdlError:
+                pass

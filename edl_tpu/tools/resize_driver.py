@@ -1,0 +1,159 @@
+"""Resize mutation driver: force pod-set changes against a running job and
+measure recovery time.
+
+Reference parity: the missing `paddle_edl.demo.collective.job_server_demo`
+(SURVEY.md §2.6) whose --time_interval_to_change drove resize injection
+(README.md:126-131). This driver owns the launcher processes on one host:
+it walks a schedule of target pod counts (e.g. 8,4,8), SIGKILLs surplus
+launchers (simulated preemption) or spawns new ones, and records how long
+the surviving cluster takes to agree on a new stage — the recovery-time
+metric of the north star.
+
+Usage:
+    python -m edl_tpu.tools.resize_driver \
+        --store_endpoints 127.0.0.1:2379 --job_id myjob \
+        --schedule 2,1,2 --interval 15 --nodes_range 1:4 \
+        -- python examples/fit_a_line/train.py --epochs 100
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import status
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.utils.logger import logger
+
+
+class ResizeDriver(object):
+    def __init__(self, store_endpoints, job_id, nodes_range, script_argv,
+                 log_dir="./resize_driver_logs", env_extra=None):
+        self._store_endpoints = store_endpoints
+        self._job_id = job_id
+        self._nodes_range = nodes_range
+        self._script_argv = list(script_argv)
+        self._log_dir = log_dir
+        self._env_extra = env_extra or {}
+        self._coord = CoordClient(store_endpoints, root=job_id)
+        self._pods = []  # list of Popen
+        self._counter = 0
+        self.events = []
+
+    def _spawn_launcher(self):
+        self._counter += 1
+        os.makedirs(self._log_dir, exist_ok=True)
+        name = "pod%d" % self._counter
+        env = dict(os.environ)
+        env.update(self._env_extra)
+        log = open(os.path.join(self._log_dir, name + ".log"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
+             "--job_id", self._job_id,
+             "--store_endpoints", self._store_endpoints,
+             "--nodes_range", self._nodes_range,
+             "--log_dir", os.path.join(self._log_dir, name + "_trainers")]
+            + self._script_argv,
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            preexec_fn=os.setsid)
+        log.close()
+        logger.info("resize driver: spawned launcher %s (pid %d)", name,
+                    proc.pid)
+        return proc
+
+    def _kill_launcher(self, proc):
+        logger.info("resize driver: SIGKILL launcher pid %d (simulated "
+                    "preemption)", proc.pid)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+
+    def _alive_pods(self):
+        self._pods = [p for p in self._pods if p.poll() is None]
+        return self._pods
+
+    def set_target(self, n):
+        """Adjust the live launcher count to ``n``; kills newest first."""
+        alive = self._alive_pods()
+        while len(alive) > n:
+            victim = alive.pop()
+            self._kill_launcher(victim)
+        while len(alive) < n:
+            alive.append(self._spawn_launcher())
+        self._pods = alive
+
+    def wait_cluster(self, n, prev_stage=None, timeout=300):
+        """Block until the agreed cluster has ``n`` pods (and a new stage if
+        prev_stage given). Returns (cluster, seconds_waited)."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            c = cluster_mod.load_from_store(self._coord)
+            if (c is not None and len(c.pods) == n
+                    and (prev_stage is None or c.stage != prev_stage)):
+                return c, time.monotonic() - t0
+            if status.load_job_status(self._coord) == status.Status.FAILED:
+                raise RuntimeError("job FAILED during resize")
+            time.sleep(0.2)
+        raise TimeoutError("cluster never reached %d pods" % n)
+
+    def run_schedule(self, schedule, interval):
+        """Walk the pod-count schedule; returns recovery-time events."""
+        prev_stage = None
+        for target in schedule:
+            t0 = time.time()
+            self.set_target(target)
+            cluster, waited = self.wait_cluster(target,
+                                                prev_stage=prev_stage)
+            prev_stage = cluster.stage
+            event = {"target": target, "recovery_s": round(waited, 2),
+                     "stage": cluster.stage, "ts": round(t0, 1)}
+            self.events.append(event)
+            logger.info("resize driver: reached %d pods in %.2fs", target,
+                        waited)
+            time.sleep(interval)
+        return self.events
+
+    def shutdown(self, kill=True):
+        for p in self._alive_pods():
+            if kill:
+                self._kill_launcher(p)
+        self._pods = []
+
+
+def main():
+    parser = argparse.ArgumentParser("edl_tpu resize driver")
+    parser.add_argument("--store_endpoints", default="127.0.0.1:2379")
+    parser.add_argument("--job_id", required=True)
+    parser.add_argument("--schedule", required=True,
+                        help="comma list of pod counts, e.g. 8,4,8")
+    parser.add_argument("--interval", type=float, default=15.0,
+                        help="seconds to hold each target")
+    parser.add_argument("--nodes_range", default="1:16")
+    parser.add_argument("--log_dir", default="./resize_driver_logs")
+    parser.add_argument("script_argv", nargs=argparse.REMAINDER,
+                        help="-- training script and args")
+    args = parser.parse_args()
+    argv = args.script_argv
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    schedule = [int(x) for x in args.schedule.split(",")]
+    driver = ResizeDriver(args.store_endpoints, args.job_id,
+                          args.nodes_range, argv, log_dir=args.log_dir)
+    try:
+        events = driver.run_schedule(schedule, args.interval)
+    except BaseException:
+        # on failure, do NOT orphan the detached launcher groups
+        driver.shutdown(kill=True)
+        raise
+    print(json.dumps({"schedule": schedule, "events": events}), flush=True)
+    # success: leave the final pod set running to finish the job
+    driver.shutdown(kill=False)
+
+
+if __name__ == "__main__":
+    main()

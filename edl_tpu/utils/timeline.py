@@ -1,0 +1,68 @@
+"""Env-gated stopwatch profiling + jax.profiler trace helper.
+
+Reference parity: edl/distill/timeline.py:20-46 — a Nop/Real stopwatch pair
+switched by an env var, recording per-pid op latencies to stderr. Here the
+switch is EDL_TPU_PROFILE=1 (and the distill plane also accepts the
+reference's DISTILL_READER_PROFILE=1). jax_trace() adds the TPU-native
+path: a jax.profiler trace context writing TensorBoard-readable dumps.
+"""
+
+import contextlib
+import os
+import sys
+import time
+
+
+class _NopTimeLine(object):
+    def record(self, op):
+        pass
+
+    @contextlib.contextmanager
+    def span(self, op):
+        yield
+
+
+class _RealTimeLine(object):
+    def __init__(self, out=None):
+        self._pid = os.getpid()
+        self._last = time.monotonic()
+        self._out = out or sys.stderr
+
+    def record(self, op):
+        now = time.monotonic()
+        self._out.write("[timeline] pid=%d op=%s ms=%.3f\n"
+                        % (self._pid, op, (now - self._last) * 1000))
+        self._last = now
+
+    @contextlib.contextmanager
+    def span(self, op):
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self._out.write("[timeline] pid=%d op=%s ms=%.3f\n"
+                            % (self._pid, op,
+                               (time.monotonic() - t0) * 1000))
+
+
+def enabled():
+    return (os.environ.get("EDL_TPU_PROFILE") == "1"
+            or os.environ.get("DISTILL_READER_PROFILE") == "1")
+
+
+def get_timeline(out=None):
+    return _RealTimeLine(out) if enabled() else _NopTimeLine()
+
+
+@contextlib.contextmanager
+def jax_trace(logdir=None):
+    """jax.profiler trace context, active iff EDL_TPU_PROFILE_DIR (or the
+    ``logdir`` arg) is set — the TPU-native replacement for the reference's
+    Paddle profiler window (train_with_fleet.py:521-530)."""
+    logdir = logdir or os.environ.get("EDL_TPU_PROFILE_DIR")
+    if not logdir:
+        yield
+        return
+    import jax
+    with jax.profiler.trace(logdir):
+        yield

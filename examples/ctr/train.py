@@ -1,0 +1,69 @@
+"""DeepFM CTR training (elastic data-parallel).
+
+Reference parity: example/ctr — the reference deployed this parameter-
+server style on k8s; per BASELINE.md the TPU mapping is data-parallel
+(embeddings replicated, gradients on the dp all-reduce). Runs standalone
+or under the launcher with checkpoint resume.
+"""
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None):
+    from edl_tpu.runtime.trainer import maybe_init_distributed
+    maybe_init_distributed()
+
+    import optax
+
+    from edl_tpu.controller import train_status as ts
+    from edl_tpu.models import deepfm
+    from edl_tpu.runtime.trainer import ElasticTrainer
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--steps_per_epoch", type=int, default=50)
+    p.add_argument("--total_batch_size", type=int, default=256)
+    p.add_argument("--num_fields", type=int, default=10)
+    p.add_argument("--vocab_per_field", type=int, default=1000)
+    p.add_argument("--embed_dim", type=int, default=8)
+    p.add_argument("--lr", type=float, default=1e-2)
+    args = p.parse_args(argv)
+
+    vocabs = (args.vocab_per_field,) * args.num_fields
+    model, params, loss_fn = deepfm.create_model_and_loss(
+        field_vocab_sizes=vocabs, embed_dim=args.embed_dim)
+    trainer = ElasticTrainer(loss_fn, params, optax.adam(args.lr),
+                             total_batch_size=args.total_batch_size)
+    env = trainer.env
+    resumed = trainer.resume()
+    start_epoch = trainer.state.next_epoch() if resumed else 0
+    print("deepfm: rank=%d world=%d start_epoch=%d resumed=%s"
+          % (env.global_rank, trainer.world_size, start_epoch, resumed),
+          flush=True)
+
+    loss = None
+    for epoch in range(start_epoch, args.epochs):
+        if epoch == args.epochs - 1:
+            trainer.report_status(ts.TrainStatus.NEARTHEEND)
+        trainer.begin_epoch(epoch)
+        for step in range(args.steps_per_epoch):
+            full = deepfm.synthetic_ctr_batch(
+                args.total_batch_size, vocabs,
+                seed=epoch * 100000 + step)
+            lo = env.global_rank * trainer.per_host_batch
+            host_batch = {k: v[lo:lo + trainer.per_host_batch]
+                          for k, v in full.items()}
+            loss = float(trainer.train_step(host_batch))
+        trainer.end_epoch(save=True)
+        print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+
+    trainer.report_status(ts.TrainStatus.SUCCEED)
+    print(json.dumps({"final_loss": loss, "steps": trainer.global_step,
+                      "world": trainer.world_size}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

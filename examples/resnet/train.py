@@ -1,0 +1,110 @@
+"""ResNet-vd elastic collective training.
+
+Reference parity: example/collective/resnet50/train_with_fleet.py — the
+headline config (SURVEY.md §3.2): bf16 ResNet50_vd, warmup + cosine/
+piecewise LR with the batch-scaling rule, per-epoch rank-0 checkpoints,
+throughput logging every ``fetch_steps`` and a final benchmark-log JSON
+(reference :532-548,642-658). Runs standalone or under the launcher;
+synthetic data by default (the input-pipeline module supplies real data).
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    from edl_tpu.runtime.trainer import maybe_init_distributed
+    maybe_init_distributed()
+
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.controller import train_status as ts
+    from edl_tpu.models import resnet
+    from edl_tpu.runtime import lr_schedules
+    from edl_tpu.runtime.trainer import ElasticTrainer
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--steps_per_epoch", type=int, default=10)
+    p.add_argument("--total_batch_size", type=int, default=32)
+    p.add_argument("--image_size", type=int, default=64)
+    p.add_argument("--num_classes", type=int, default=100)
+    p.add_argument("--base_lr", type=float, default=0.1)
+    p.add_argument("--warmup_epochs", type=int, default=1)
+    p.add_argument("--lr_schedule", choices=["cosine", "piecewise"],
+                   default="cosine")
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="f32")
+    p.add_argument("--fetch_steps", type=int, default=10)
+    args = p.parse_args(argv)
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    total_steps = args.epochs * args.steps_per_epoch
+    lr = lr_schedules.scale_lr_for_batch(args.base_lr,
+                                         args.total_batch_size)
+    if args.lr_schedule == "cosine":
+        base = lr_schedules.cosine_decay(lr, total_steps)
+    else:
+        bounds = [total_steps // 3, 2 * total_steps // 3]
+        base = lr_schedules.piecewise_decay(lr, bounds)
+    schedule = lr_schedules.linear_warmup(
+        base, args.warmup_epochs * args.steps_per_epoch)
+
+    model, params, extra, loss_fn = resnet.create_model_and_loss(
+        depth=args.depth, num_classes=args.num_classes,
+        image_size=args.image_size, dtype=dtype)
+    trainer = ElasticTrainer(
+        loss_fn, params, optax.sgd(schedule, momentum=0.9),
+        total_batch_size=args.total_batch_size, extra_state=extra,
+        has_aux=True)
+    env = trainer.env
+    resumed = trainer.resume()
+    start_epoch = trainer.state.next_epoch() if resumed else 0
+    print("resnet%d_vd: rank=%d world=%d start_epoch=%d resumed=%s"
+          % (args.depth, env.global_rank, trainer.world_size, start_epoch,
+             resumed), flush=True)
+
+    loss = None
+    imgs_seen = 0
+    t_start = time.perf_counter()
+    for epoch in range(start_epoch, args.epochs):
+        if epoch == args.epochs - 1:
+            trainer.report_status(ts.TrainStatus.NEARTHEEND)
+        trainer.begin_epoch(epoch)
+        t_epoch = time.perf_counter()
+        for step in range(args.steps_per_epoch):
+            full = resnet.synthetic_image_batch(
+                args.total_batch_size, image_size=args.image_size,
+                num_classes=args.num_classes,
+                seed=epoch * 100000 + step)
+            lo = env.global_rank * trainer.per_host_batch
+            host_batch = {k: v[lo:lo + trainer.per_host_batch]
+                          for k, v in full.items()}
+            loss = float(trainer.train_step(host_batch))
+            imgs_seen += args.total_batch_size
+            if (step + 1) % args.fetch_steps == 0:
+                dt = time.perf_counter() - t_epoch
+                print("epoch %d step %d loss %.4f  %.1f img/s"
+                      % (epoch, step + 1, loss,
+                         args.total_batch_size * (step + 1) / dt),
+                      flush=True)
+        trainer.end_epoch(save=True)
+
+    trainer.report_status(ts.TrainStatus.SUCCEED)
+    wall = time.perf_counter() - t_start
+    # benchmark-log emission (reference train_with_fleet.py:642-658)
+    print(json.dumps({
+        "model": "ResNet%d_vd" % args.depth,
+        "final_loss": loss,
+        "steps": trainer.global_step,
+        "world": trainer.world_size,
+        "imgs_per_sec": round(imgs_seen / wall, 1),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,13 +129,13 @@ def test_below_min_nodes_fails_job(store, tmp_path):
     job = "launch_below_min"
     coord = store.client(root=job)
     p1 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod1",
-                         trainer_args=("30", "0"))
+                         trainer_args=("60", "0"))
     p2 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod2",
-                         trainer_args=("30", "0"))
+                         trainer_args=("60", "0"))
     try:
-        _wait_cluster_size(coord, 2)
+        _wait_cluster_size(coord, 2, timeout=60)
         _kill_group(p2)
-        r1 = p1.wait(timeout=120)
+        r1 = p1.wait(timeout=180)
         assert r1 == 1, _dump_logs(tmp_path)
         assert status.load_job_status(coord) == Status.FAILED
     finally:
