@@ -1,0 +1,73 @@
+"""Locate/build/launch the native (C++) coordination store server.
+
+native/store_server.cc implements the identical wire protocol and store
+semantics as the Python StoreServer; CoordClient works against either. The
+native binary is the production deployment (one static binary per cluster,
+replacing the external etcd of the reference — SURVEY.md §2.6).
+"""
+
+import os
+import subprocess
+import time
+
+from edl_tpu.utils.logger import logger
+from edl_tpu.utils.network import find_free_port, is_server_alive
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO, "native")
+BINARY = os.path.join(NATIVE_DIR, "build", "edl_tpu_store")
+
+
+def ensure_binary():
+    """Return the binary path, (re)building via make — a no-op when the
+    build is already up to date with the sources."""
+    result = subprocess.run(["make"], cwd=NATIVE_DIR, check=True,
+                            capture_output=True, text=True)
+    if "up to date" not in result.stdout:
+        logger.info("built native store server in %s", NATIVE_DIR)
+    return BINARY
+
+
+class NativeStoreServer(object):
+    """Run the C++ store as a subprocess; context-manager friendly."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._host = host
+        self._port = port or find_free_port()
+        self._proc = None
+
+    def start(self, wait_s=10):
+        binary = ensure_binary()
+        self._proc = subprocess.Popen(
+            [binary, "--host", self._host, "--port", str(self._port)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            if is_server_alive(self.endpoint, timeout=0.5):
+                return self
+            if self._proc.poll() is not None:
+                raise RuntimeError("native store exited with %d"
+                                   % self._proc.returncode)
+            time.sleep(0.05)
+        raise RuntimeError("native store did not come up on %s"
+                           % self.endpoint)
+
+    @property
+    def endpoint(self):
+        return "%s:%d" % (self._host, self._port)
+
+    def stop(self):
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,288 @@
+// Minimal msgpack codec for the edl_tpu RPC wire format.
+//
+// Covers the subset the coordination protocol uses: nil, bool, ints,
+// floats, str, bin, array, map (string keys and value keys both appear).
+// Mirrors edl_tpu/rpc/framing.py (msgpack with use_bin_type=True, raw=False).
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace msgpack_lite {
+
+struct Value;
+using Array = std::vector<Value>;
+using Map = std::vector<std::pair<Value, Value>>;  // preserves order
+
+struct Value {
+  enum class Type { Nil, Bool, Int, Uint, Double, Str, Bin, Arr, MapT };
+  Type type = Type::Nil;
+  bool b = false;
+  int64_t i = 0;
+  uint64_t u = 0;
+  double d = 0;
+  std::string s;  // str or bin payload
+  std::shared_ptr<Array> arr;
+  std::shared_ptr<Map> map;
+
+  static Value nil() { return Value{}; }
+  static Value boolean(bool v) {
+    Value x; x.type = Type::Bool; x.b = v; return x;
+  }
+  static Value integer(int64_t v) {
+    Value x; x.type = Type::Int; x.i = v; return x;
+  }
+  static Value real(double v) {
+    Value x; x.type = Type::Double; x.d = v; return x;
+  }
+  static Value str(std::string v) {
+    Value x; x.type = Type::Str; x.s = std::move(v); return x;
+  }
+  static Value bin(std::string v) {
+    Value x; x.type = Type::Bin; x.s = std::move(v); return x;
+  }
+  static Value array(Array v = {}) {
+    Value x; x.type = Type::Arr;
+    x.arr = std::make_shared<Array>(std::move(v)); return x;
+  }
+  static Value mapv(Map v = {}) {
+    Value x; x.type = Type::MapT;
+    x.map = std::make_shared<Map>(std::move(v)); return x;
+  }
+
+  bool is_nil() const { return type == Type::Nil; }
+  int64_t as_int() const {
+    if (type == Type::Int) return i;
+    if (type == Type::Uint) return static_cast<int64_t>(u);
+    if (type == Type::Double) return static_cast<int64_t>(d);
+    throw std::runtime_error("msgpack: not an int");
+  }
+  double as_double() const {
+    if (type == Type::Double) return d;
+    return static_cast<double>(as_int());
+  }
+  const std::string& as_str() const {
+    if (type != Type::Str && type != Type::Bin)
+      throw std::runtime_error("msgpack: not a str");
+    return s;
+  }
+  const Array& as_array() const {
+    if (type != Type::Arr) throw std::runtime_error("msgpack: not an array");
+    return *arr;
+  }
+  const Map& as_map() const {
+    if (type != Type::MapT) throw std::runtime_error("msgpack: not a map");
+    return *map;
+  }
+  const Value* get(const std::string& key) const {
+    if (type != Type::MapT) return nullptr;
+    for (auto& kv : *map)
+      if ((kv.first.type == Type::Str || kv.first.type == Type::Bin) &&
+          kv.first.s == key)
+        return &kv.second;
+    return nullptr;
+  }
+};
+
+// ---- encoding -------------------------------------------------------------
+
+inline void put_be(std::string& out, uint64_t v, int bytes) {
+  for (int k = bytes - 1; k >= 0; --k)
+    out.push_back(static_cast<char>((v >> (8 * k)) & 0xff));
+}
+
+inline void encode(const Value& v, std::string& out) {
+  switch (v.type) {
+    case Value::Type::Nil: out.push_back('\xc0'); break;
+    case Value::Type::Bool: out.push_back(v.b ? '\xc3' : '\xc2'); break;
+    case Value::Type::Uint: {
+      Value t = Value::integer(static_cast<int64_t>(v.u));
+      encode(t, out); break;
+    }
+    case Value::Type::Int: {
+      int64_t x = v.i;
+      if (x >= 0 && x <= 127) {
+        out.push_back(static_cast<char>(x));
+      } else if (x < 0 && x >= -32) {
+        out.push_back(static_cast<char>(0xe0 | (x + 32)));
+      } else if (x >= 0) {
+        out.push_back('\xcf');
+        put_be(out, static_cast<uint64_t>(x), 8);
+      } else {
+        out.push_back('\xd3');
+        put_be(out, static_cast<uint64_t>(x), 8);
+      }
+      break;
+    }
+    case Value::Type::Double: {
+      out.push_back('\xcb');
+      uint64_t bits;
+      std::memcpy(&bits, &v.d, 8);
+      put_be(out, bits, 8);
+      break;
+    }
+    case Value::Type::Str: {
+      size_t n = v.s.size();
+      if (n <= 31) {
+        out.push_back(static_cast<char>(0xa0 | n));
+      } else if (n <= 0xff) {
+        out.push_back('\xd9'); put_be(out, n, 1);
+      } else if (n <= 0xffff) {
+        out.push_back('\xda'); put_be(out, n, 2);
+      } else {
+        out.push_back('\xdb'); put_be(out, n, 4);
+      }
+      out += v.s;
+      break;
+    }
+    case Value::Type::Bin: {
+      size_t n = v.s.size();
+      if (n <= 0xff) { out.push_back('\xc4'); put_be(out, n, 1); }
+      else if (n <= 0xffff) { out.push_back('\xc5'); put_be(out, n, 2); }
+      else { out.push_back('\xc6'); put_be(out, n, 4); }
+      out += v.s;
+      break;
+    }
+    case Value::Type::Arr: {
+      size_t n = v.arr->size();
+      if (n <= 15) out.push_back(static_cast<char>(0x90 | n));
+      else if (n <= 0xffff) { out.push_back('\xdc'); put_be(out, n, 2); }
+      else { out.push_back('\xdd'); put_be(out, n, 4); }
+      for (auto& e : *v.arr) encode(e, out);
+      break;
+    }
+    case Value::Type::MapT: {
+      size_t n = v.map->size();
+      if (n <= 15) out.push_back(static_cast<char>(0x80 | n));
+      else if (n <= 0xffff) { out.push_back('\xde'); put_be(out, n, 2); }
+      else { out.push_back('\xdf'); put_be(out, n, 4); }
+      for (auto& kv : *v.map) {
+        encode(kv.first, out);
+        encode(kv.second, out);
+      }
+      break;
+    }
+  }
+}
+
+inline std::string pack(const Value& v) {
+  std::string out;
+  encode(v, out);
+  return out;
+}
+
+// ---- decoding -------------------------------------------------------------
+
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t pos = 0;
+
+  uint8_t byte() {
+    if (pos >= n) throw std::runtime_error("msgpack: truncated");
+    return p[pos++];
+  }
+  uint64_t be(int bytes) {
+    uint64_t v = 0;
+    for (int k = 0; k < bytes; ++k) v = (v << 8) | byte();
+    return v;
+  }
+  std::string bytes(size_t len) {
+    if (pos + len > n) throw std::runtime_error("msgpack: truncated str");
+    std::string out(reinterpret_cast<const char*>(p + pos), len);
+    pos += len;
+    return out;
+  }
+};
+
+inline Value decode(Reader& r) {
+  uint8_t c = r.byte();
+  if (c <= 0x7f) return Value::integer(c);
+  if (c >= 0xe0) return Value::integer(static_cast<int8_t>(c));
+  if ((c & 0xf0) == 0x80) {  // fixmap
+    Map m;
+    for (int k = 0; k < (c & 0x0f); ++k) {
+      Value key = decode(r); m.emplace_back(std::move(key), decode(r));
+    }
+    return Value::mapv(std::move(m));
+  }
+  if ((c & 0xf0) == 0x90) {  // fixarray
+    Array a;
+    for (int k = 0; k < (c & 0x0f); ++k) a.push_back(decode(r));
+    return Value::array(std::move(a));
+  }
+  if ((c & 0xe0) == 0xa0) return Value::str(r.bytes(c & 0x1f));  // fixstr
+  switch (c) {
+    case 0xc0: return Value::nil();
+    case 0xc2: return Value::boolean(false);
+    case 0xc3: return Value::boolean(true);
+    case 0xc4: return Value::bin(r.bytes(r.be(1)));
+    case 0xc5: return Value::bin(r.bytes(r.be(2)));
+    case 0xc6: return Value::bin(r.bytes(r.be(4)));
+    case 0xca: {
+      uint32_t bits = static_cast<uint32_t>(r.be(4));
+      float f;
+      std::memcpy(&f, &bits, 4);
+      return Value::real(f);
+    }
+    case 0xcb: {
+      uint64_t bits = r.be(8);
+      double d;
+      std::memcpy(&d, &bits, 8);
+      return Value::real(d);
+    }
+    case 0xcc: return Value::integer(r.be(1));
+    case 0xcd: return Value::integer(r.be(2));
+    case 0xce: return Value::integer(r.be(4));
+    case 0xcf: return Value::integer(static_cast<int64_t>(r.be(8)));
+    case 0xd0: return Value::integer(static_cast<int8_t>(r.be(1)));
+    case 0xd1: return Value::integer(static_cast<int16_t>(r.be(2)));
+    case 0xd2: return Value::integer(static_cast<int32_t>(r.be(4)));
+    case 0xd3: return Value::integer(static_cast<int64_t>(r.be(8)));
+    case 0xd9: return Value::str(r.bytes(r.be(1)));
+    case 0xda: return Value::str(r.bytes(r.be(2)));
+    case 0xdb: return Value::str(r.bytes(r.be(4)));
+    case 0xdc: {
+      size_t len = r.be(2);
+      Array a;
+      for (size_t k = 0; k < len; ++k) a.push_back(decode(r));
+      return Value::array(std::move(a));
+    }
+    case 0xdd: {
+      size_t len = r.be(4);
+      Array a;
+      for (size_t k = 0; k < len; ++k) a.push_back(decode(r));
+      return Value::array(std::move(a));
+    }
+    case 0xde: {
+      size_t len = r.be(2);
+      Map m;
+      for (size_t k = 0; k < len; ++k) {
+        Value key = decode(r); m.emplace_back(std::move(key), decode(r));
+      }
+      return Value::mapv(std::move(m));
+    }
+    case 0xdf: {
+      size_t len = r.be(4);
+      Map m;
+      for (size_t k = 0; k < len; ++k) {
+        Value key = decode(r); m.emplace_back(std::move(key), decode(r));
+      }
+      return Value::mapv(std::move(m));
+    }
+  }
+  throw std::runtime_error("msgpack: unsupported type byte");
+}
+
+inline Value unpack(const std::string& buf) {
+  Reader r{reinterpret_cast<const uint8_t*>(buf.data()), buf.size()};
+  return decode(r);
+}
+
+}  // namespace msgpack_lite

@@ -1,0 +1,539 @@
+// edl_tpu native coordination store server.
+//
+// The C++ implementation of the in-tree etcd replacement: the SAME wire
+// protocol and store semantics as edl_tpu/coordination/{store,server}.py
+// (framed msgpack RPC, revisioned KV, TTL leases, put-if-absent election,
+// guarded transactions, long-poll prefix watch with reset-on-truncation),
+// so CoordClient works against either backend unchanged. Thread-per-
+// connection with one shared store mutex + condition_variable — the control
+// plane's write rates are heartbeats, not data.
+//
+// Build: native/Makefile → build/edl_tpu_store.
+// Run:   edl_tpu_store --host 0.0.0.0 --port 2379
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <iostream>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "msgpack_lite.h"
+
+namespace mp = msgpack_lite;
+using Clock = std::chrono::steady_clock;
+
+static const char kMagic[4] = {'\xed', '\x17', '\x00', '\x01'};
+static const size_t kMaxFrame = 1ull << 30;
+static const size_t kEventHistory = 10000;
+
+// ---- store ----------------------------------------------------------------
+
+struct KeyValue {
+  std::string value;
+  bool value_is_bin = false;  // preserve msgpack bin vs str round-trip
+  int64_t lease_id = 0;       // 0 = none
+  int64_t create_rev = 0;
+  int64_t mod_rev = 0;
+};
+
+struct Lease {
+  double ttl = 0;
+  Clock::time_point deadline;
+  std::set<std::string> keys;
+};
+
+struct Event {
+  std::string type;  // "put" | "delete"
+  std::string key;
+  std::string value;
+  bool has_value = false;
+  bool value_is_bin = false;
+  int64_t rev = 0;
+};
+
+class Store {
+ public:
+  Store() : sweeper_([this] { SweepLoop(); }) {}
+
+  ~Store() {
+    stop_.store(true);
+    cond_.notify_all();
+    sweeper_.join();
+  }
+
+  int64_t LeaseGrant(double ttl) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int64_t id = next_lease_++;
+    Lease l;
+    l.ttl = ttl;
+    l.deadline = Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                                    std::chrono::duration<double>(ttl));
+    leases_[id] = std::move(l);
+    return id;
+  }
+
+  bool LeaseRefresh(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = leases_.find(id);
+    if (it == leases_.end()) return false;
+    it->second.deadline =
+        Clock::now() + std::chrono::duration_cast<Clock::duration>(
+                           std::chrono::duration<double>(it->second.ttl));
+    return true;
+  }
+
+  bool LeaseRevoke(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = leases_.find(id);
+    if (it == leases_.end()) return false;
+    auto keys = it->second.keys;
+    leases_.erase(it);
+    for (auto& k : keys) DeleteLocked(k);
+    return true;
+  }
+
+  int64_t Put(const std::string& key, const std::string& value,
+              bool is_bin, int64_t lease_id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return PutLocked(key, value, is_bin, lease_id);
+  }
+
+  std::pair<bool, int64_t> PutIfAbsent(const std::string& key,
+                                       const std::string& value,
+                                       bool is_bin, int64_t lease_id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = kv_.find(key);
+    if (it != kv_.end()) return {false, it->second.mod_rev};
+    return {true, PutLocked(key, value, is_bin, lease_id)};
+  }
+
+  bool Get(const std::string& key, KeyValue* out) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = kv_.find(key);
+    if (it == kv_.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  std::pair<std::vector<std::pair<std::string, KeyValue>>, int64_t>
+  GetPrefix(const std::string& prefix) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::pair<std::string, KeyValue>> out;
+    for (auto it = kv_.lower_bound(prefix);
+         it != kv_.end() && it->first.compare(0, prefix.size(), prefix) == 0;
+         ++it)
+      out.emplace_back(it->first, it->second);
+    return {out, rev_};
+  }
+
+  bool Delete(const std::string& key) {
+    std::lock_guard<std::mutex> lk(mu_);
+    return DeleteLocked(key);
+  }
+
+  int64_t DeletePrefix(const std::string& prefix) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<std::string> keys;
+    for (auto it = kv_.lower_bound(prefix);
+         it != kv_.end() && it->first.compare(0, prefix.size(), prefix) == 0;
+         ++it)
+      keys.push_back(it->first);
+    for (auto& k : keys) DeleteLocked(k);
+    return static_cast<int64_t>(keys.size());
+  }
+
+  int64_t Revision() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return rev_;
+  }
+
+  // compares: (key, op, expected); actions: ("put", key, value[, lease]) or
+  // ("delete", key) — identical semantics to store.py txn().
+  std::pair<bool, int64_t> Txn(const mp::Array& compares,
+                               const mp::Array& on_success,
+                               const mp::Array& on_failure) {
+    std::lock_guard<std::mutex> lk(mu_);
+    bool ok = true;
+    for (auto& c : compares) {
+      const auto& t = c.as_array();
+      const std::string& key = t.at(0).as_str();
+      const std::string& op = t.at(1).as_str();
+      auto it = kv_.find(key);
+      if (op == "value_eq")
+        ok = it != kv_.end() && !t.at(2).is_nil() &&
+             it->second.value == t.at(2).as_str();
+      else if (op == "exists")
+        ok = it != kv_.end();
+      else if (op == "not_exists")
+        ok = it == kv_.end();
+      else if (op == "mod_rev_eq")
+        ok = it != kv_.end() && it->second.mod_rev == t.at(2).as_int();
+      else
+        throw std::runtime_error("bad compare op: " + op);
+      if (!ok) break;
+    }
+    const mp::Array& actions = ok ? on_success : on_failure;
+    for (auto& a : actions) {
+      const auto& t = a.as_array();
+      const std::string& kind = t.at(0).as_str();
+      if (kind == "put") {
+        int64_t lease = 0;
+        if (t.size() > 3 && !t.at(3).is_nil()) lease = t.at(3).as_int();
+        PutLocked(t.at(1).as_str(), t.at(2).as_str(),
+                  t.at(2).type == mp::Value::Type::Bin, lease);
+      } else if (kind == "delete") {
+        DeleteLocked(t.at(1).as_str());
+      } else {
+        throw std::runtime_error("bad txn action: " + kind);
+      }
+    }
+    return {ok, rev_};
+  }
+
+  // Long-poll: events with rev > since_rev under prefix, or [] on timeout;
+  // a single {"type":"reset"} event when history was truncated past the
+  // watcher's position (store.py wait_events parity).
+  std::pair<std::vector<Event>, int64_t> WaitEvents(const std::string& prefix,
+                                                    int64_t since_rev,
+                                                    double timeout) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = Clock::now() +
+                    std::chrono::duration_cast<Clock::duration>(
+                        std::chrono::duration<double>(timeout));
+    while (true) {
+      if (rev_ > since_rev && !events_.empty() &&
+          events_.front().rev > since_rev + 1) {
+        Event reset;
+        reset.type = "reset";
+        reset.key = prefix;
+        reset.rev = rev_;
+        return {{reset}, rev_};
+      }
+      std::vector<Event> out;
+      for (auto& e : events_)
+        if (e.rev > since_rev &&
+            e.key.compare(0, prefix.size(), prefix) == 0)
+          out.push_back(e);
+      if (!out.empty()) return {out, rev_};
+      if (Clock::now() >= deadline || stop_.load()) return {{}, rev_};
+      cond_.wait_until(lk, deadline);
+    }
+  }
+
+ private:
+  int64_t PutLocked(const std::string& key, const std::string& value,
+                    bool is_bin, int64_t lease_id) {
+    auto it = kv_.find(key);
+    if (it != kv_.end() && it->second.lease_id &&
+        it->second.lease_id != lease_id) {
+      auto lit = leases_.find(it->second.lease_id);
+      if (lit != leases_.end()) lit->second.keys.erase(key);
+    }
+    int64_t create_rev = (it != kv_.end()) ? it->second.create_rev : rev_ + 1;
+    int64_t rev = Emit("put", key, value, true, is_bin);
+    KeyValue kv;
+    kv.value = value;
+    kv.value_is_bin = is_bin;
+    kv.lease_id = lease_id;
+    kv.create_rev = create_rev;
+    kv.mod_rev = rev;
+    kv_[key] = std::move(kv);
+    if (lease_id) {
+      auto lit = leases_.find(lease_id);
+      if (lit == leases_.end())
+        throw std::runtime_error("lease not found");
+      lit->second.keys.insert(key);
+    }
+    return rev;
+  }
+
+  bool DeleteLocked(const std::string& key) {
+    auto it = kv_.find(key);
+    if (it == kv_.end()) return false;
+    if (it->second.lease_id) {
+      auto lit = leases_.find(it->second.lease_id);
+      if (lit != leases_.end()) lit->second.keys.erase(key);
+    }
+    kv_.erase(it);
+    Emit("delete", key, "", false, false);
+    return true;
+  }
+
+  int64_t Emit(const std::string& type, const std::string& key,
+               const std::string& value, bool has_value, bool is_bin) {
+    ++rev_;
+    Event e;
+    e.type = type;
+    e.key = key;
+    e.value = value;
+    e.has_value = has_value;
+    e.value_is_bin = is_bin;
+    e.rev = rev_;
+    events_.push_back(std::move(e));
+    while (events_.size() > kEventHistory) events_.pop_front();
+    cond_.notify_all();
+    return rev_;
+  }
+
+  void SweepLoop() {
+    while (!stop_.load()) {
+      std::this_thread::sleep_for(std::chrono::milliseconds(200));
+      std::lock_guard<std::mutex> lk(mu_);
+      auto now = Clock::now();
+      std::vector<int64_t> dead;
+      for (auto& kv : leases_)
+        if (kv.second.deadline <= now) dead.push_back(kv.first);
+      for (int64_t id : dead) {
+        auto keys = leases_[id].keys;
+        leases_.erase(id);
+        for (auto& k : keys) DeleteLocked(k);
+      }
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cond_;
+  std::map<std::string, KeyValue> kv_;
+  std::map<int64_t, Lease> leases_;
+  std::deque<Event> events_;
+  int64_t rev_ = 0;
+  int64_t next_lease_ = 1;
+  std::atomic<bool> stop_{false};
+  std::thread sweeper_;
+};
+
+// ---- RPC plumbing ---------------------------------------------------------
+
+static bool RecvExact(int fd, char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = recv(fd, buf + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static bool SendAll(int fd, const char* buf, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = send(fd, buf + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+static mp::Value KvToMap(const std::string& key, const KeyValue& kv) {
+  mp::Map m;
+  m.emplace_back(mp::Value::str("key"), mp::Value::str(key));
+  m.emplace_back(mp::Value::str("value"),
+                 kv.value_is_bin ? mp::Value::bin(kv.value)
+                                 : mp::Value::str(kv.value));
+  m.emplace_back(mp::Value::str("mod_rev"), mp::Value::integer(kv.mod_rev));
+  m.emplace_back(mp::Value::str("create_rev"),
+                 mp::Value::integer(kv.create_rev));
+  m.emplace_back(mp::Value::str("lease_id"),
+                 kv.lease_id ? mp::Value::integer(kv.lease_id)
+                             : mp::Value::nil());
+  return mp::Value::mapv(std::move(m));
+}
+
+static mp::Value EventToMap(const Event& e) {
+  mp::Map m;
+  m.emplace_back(mp::Value::str("type"), mp::Value::str(e.type));
+  m.emplace_back(mp::Value::str("key"), mp::Value::str(e.key));
+  m.emplace_back(mp::Value::str("value"),
+                 !e.has_value ? mp::Value::nil()
+                 : e.value_is_bin ? mp::Value::bin(e.value)
+                                  : mp::Value::str(e.value));
+  m.emplace_back(mp::Value::str("rev"), mp::Value::integer(e.rev));
+  return mp::Value::mapv(std::move(m));
+}
+
+static int64_t ArgLease(const mp::Array& args, size_t idx) {
+  if (args.size() <= idx || args[idx].is_nil()) return 0;
+  return args[idx].as_int();
+}
+
+static mp::Value Dispatch(Store& store, const std::string& method,
+                          const mp::Array& args) {
+  if (method == "store_put") {
+    return mp::Value::integer(
+        store.Put(args.at(0).as_str(), args.at(1).as_str(),
+                  args.at(1).type == mp::Value::Type::Bin,
+                  ArgLease(args, 2)));
+  }
+  if (method == "store_put_if_absent") {
+    auto r = store.PutIfAbsent(args.at(0).as_str(), args.at(1).as_str(),
+                               args.at(1).type == mp::Value::Type::Bin,
+                               ArgLease(args, 2));
+    mp::Array a;
+    a.push_back(mp::Value::boolean(r.first));
+    a.push_back(mp::Value::integer(r.second));
+    return mp::Value::array(std::move(a));
+  }
+  if (method == "store_get") {
+    KeyValue kv;
+    if (!store.Get(args.at(0).as_str(), &kv)) return mp::Value::nil();
+    return KvToMap(args.at(0).as_str(), kv);
+  }
+  if (method == "store_get_prefix") {
+    auto r = store.GetPrefix(args.at(0).as_str());
+    mp::Array list;
+    for (auto& kv : r.first) list.push_back(KvToMap(kv.first, kv.second));
+    mp::Array out;
+    out.push_back(mp::Value::array(std::move(list)));
+    out.push_back(mp::Value::integer(r.second));
+    return mp::Value::array(std::move(out));
+  }
+  if (method == "store_delete")
+    return mp::Value::boolean(store.Delete(args.at(0).as_str()));
+  if (method == "store_delete_prefix")
+    return mp::Value::integer(store.DeletePrefix(args.at(0).as_str()));
+  if (method == "store_txn") {
+    static const mp::Array kEmpty;
+    const mp::Array& fail =
+        args.size() > 2 && !args.at(2).is_nil() ? args.at(2).as_array()
+                                                : kEmpty;
+    auto r = store.Txn(args.at(0).as_array(), args.at(1).as_array(), fail);
+    mp::Array out;
+    out.push_back(mp::Value::boolean(r.first));
+    out.push_back(mp::Value::integer(r.second));
+    return mp::Value::array(std::move(out));
+  }
+  if (method == "store_wait_events") {
+    auto r = store.WaitEvents(args.at(0).as_str(), args.at(1).as_int(),
+                              args.at(2).as_double());
+    mp::Array evs;
+    for (auto& e : r.first) evs.push_back(EventToMap(e));
+    mp::Array out;
+    out.push_back(mp::Value::array(std::move(evs)));
+    out.push_back(mp::Value::integer(r.second));
+    return mp::Value::array(std::move(out));
+  }
+  if (method == "store_lease_grant")
+    return mp::Value::integer(store.LeaseGrant(args.at(0).as_double()));
+  if (method == "store_lease_refresh")
+    return mp::Value::boolean(store.LeaseRefresh(args.at(0).as_int()));
+  if (method == "store_lease_revoke")
+    return mp::Value::boolean(store.LeaseRevoke(args.at(0).as_int()));
+  if (method == "store_revision")
+    return mp::Value::integer(store.Revision());
+  throw std::runtime_error("no such method: " + method);
+}
+
+static void ServeConnection(Store* store, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+  while (true) {
+    char header[8];
+    if (!RecvExact(fd, header, 8)) break;
+    if (std::memcmp(header, kMagic, 4) != 0) break;
+    uint32_t len;
+    std::memcpy(&len, header + 4, 4);
+    len = ntohl(len);
+    if (len > kMaxFrame) break;
+    std::string body(len, '\0');
+    if (!RecvExact(fd, body.data(), len)) break;
+
+    mp::Value resp_id = mp::Value::nil();
+    mp::Map resp;
+    try {
+      mp::Value req = mp::unpack(body);
+      if (const mp::Value* idv = req.get("id")) resp_id = *idv;
+      const mp::Value* methodv = req.get("method");
+      if (methodv == nullptr)
+        throw std::runtime_error("request missing 'method'");
+      const mp::Value* kwargsv = req.get("kwargs");
+      if (kwargsv != nullptr && !kwargsv->is_nil() &&
+          !kwargsv->as_map().empty())
+        throw std::runtime_error(
+            "native store takes positional args only (got kwargs)");
+      const mp::Value* argsv = req.get("args");
+      static const mp::Array kNoArgs;
+      const mp::Array& args =
+          (argsv && !argsv->is_nil()) ? argsv->as_array() : kNoArgs;
+      mp::Value result = Dispatch(*store, methodv->as_str(), args);
+      resp.emplace_back(mp::Value::str("id"), resp_id);
+      resp.emplace_back(mp::Value::str("ok"), mp::Value::boolean(true));
+      resp.emplace_back(mp::Value::str("result"), std::move(result));
+    } catch (const std::exception& e) {
+      resp.clear();
+      resp.emplace_back(mp::Value::str("id"), resp_id);
+      resp.emplace_back(mp::Value::str("ok"), mp::Value::boolean(false));
+      mp::Map err;
+      err.emplace_back(mp::Value::str("name"), mp::Value::str("RpcError"));
+      err.emplace_back(mp::Value::str("detail"),
+                       mp::Value::str(e.what()));
+      resp.emplace_back(mp::Value::str("error"),
+                        mp::Value::mapv(std::move(err)));
+    }
+    std::string payload = mp::pack(mp::Value::mapv(std::move(resp)));
+    char out_header[8];
+    std::memcpy(out_header, kMagic, 4);
+    uint32_t out_len = htonl(static_cast<uint32_t>(payload.size()));
+    std::memcpy(out_header + 4, &out_len, 4);
+    if (!SendAll(fd, out_header, 8)) break;
+    if (!SendAll(fd, payload.data(), payload.size())) break;
+  }
+  close(fd);
+}
+
+int main(int argc, char** argv) {
+  std::string host = "0.0.0.0";
+  int port = 2379;
+  for (int i = 1; i < argc - 1; ++i) {
+    if (std::string(argv[i]) == "--host") host = argv[i + 1];
+    if (std::string(argv[i]) == "--port") port = std::atoi(argv[i + 1]);
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (host == "localhost") host = "127.0.0.1";
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    std::cerr << "bad --host '" << host << "' (need a numeric IPv4 address)"
+              << std::endl;
+    return 1;
+  }
+  if (bind(srv, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (listen(srv, 128) != 0) {
+    perror("listen");
+    return 1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(srv, reinterpret_cast<sockaddr*>(&addr), &alen);
+  std::cerr << "edl_tpu_store (C++) serving on " << host << ":"
+            << ntohs(addr.sin_port) << std::endl;
+
+  Store store;
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(ServeConnection, &store, fd).detach();
+  }
+}

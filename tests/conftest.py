@@ -38,9 +38,25 @@ def store():
         yield s
 
 
-@pytest.fixture()
-def coord(store):
-    """A CoordClient on an isolated root namespace."""
-    client = store.client(root="test_job")
-    yield client
-    client.clean_root()
+@pytest.fixture(params=["py", "native"])
+def coord(request):
+    """A CoordClient on an isolated root namespace, parametrized over both
+    store backends: the Python StoreServer and the C++ edl_tpu_store binary
+    (identical wire protocol)."""
+    if request.param == "py":
+        with EmbeddedStore() as s:
+            set_global_endpoints(s.endpoint)
+            client = s.client(root="test_job")
+            yield client
+            client.clean_root()
+    else:
+        from edl_tpu.coordination.client import CoordClient
+        from edl_tpu.coordination.native import (NativeStoreServer,
+                                                 ensure_binary)
+        try:
+            ensure_binary()
+        except Exception as e:  # no C++ toolchain → skip, don't error
+            pytest.skip("native store unavailable: %r" % e)
+        with NativeStoreServer() as s:
+            set_global_endpoints(s.endpoint)
+            yield CoordClient([s.endpoint], root="test_job")
