@@ -1,0 +1,174 @@
+"""TrainingJob operator: reconcile TrainingJob CRs into elastic launcher
+pods and arbitrate node counts between jobs.
+
+Reference parity: the external Go controller/autoscaler (cmd/edl,
+pkg/autoscaler.go — source absent from the reference snapshot; behavior per
+doc/usage.md:104-130: TrainingJob TPR with min/max instances, autoscaler
+grows/shrinks jobs under cluster pressure). Re-created in Python against
+the kubernetes API:
+
+- each TrainingJob becomes a StatefulSet of launcher pods running
+  ``edl-tpu-run`` with the job's min:max range; the in-cluster elasticity
+  (leader election, barrier, stop-resume) is the launcher's job — the
+  operator only decides HOW MANY launcher pods exist;
+- the autoscaler distributes ``capacity_nodes`` across jobs by priority:
+  every job gets its min, remaining nodes go to higher-priority jobs first
+  (the reference's training-vs-serving arbitration generalized);
+- status (phase, currentNodes) reflects the StatefulSet's ready replicas;
+  created StatefulSets carry an ownerReference so deleting a TrainingJob
+  cascades to its pods.
+
+The pure decision logic (``plan_allocations``) is dependency-free and unit
+tested; the reconcile loop requires the ``kubernetes`` package at runtime.
+"""
+
+import time
+
+from edl_tpu.utils.logger import logger
+
+
+def plan_allocations(jobs, capacity_nodes):
+    """Distribute ``capacity_nodes`` across jobs.
+
+    jobs: [{"name", "min", "max", "priority"}]. Returns {name: nodes}.
+    Every job gets its min (jobs are admitted in priority order until
+    capacity runs out); leftover capacity tops up jobs by priority toward
+    their max. Jobs that cannot get min are allocated 0 (pending).
+    """
+    ordered = sorted(jobs, key=lambda j: (-int(j.get("priority", 0)),
+                                          j["name"]))
+    alloc = {j["name"]: 0 for j in jobs}
+    remaining = int(capacity_nodes)
+    admitted = []
+    for j in ordered:
+        lo = max(1, int(j["min"]))
+        hi = max(lo, int(j["max"]))  # clamp invalid min>max specs
+        if remaining >= lo:
+            alloc[j["name"]] = lo
+            remaining -= lo
+            admitted.append((j, hi))
+    for j, hi in admitted:
+        if remaining <= 0:
+            break
+        take = min(hi - alloc[j["name"]], remaining)
+        alloc[j["name"]] += take
+        remaining -= take
+    return alloc
+
+
+def launcher_pod_command(spec):
+    """The container command for one launcher pod of a TrainingJob."""
+    cmd = ["edl-tpu-run",
+           "--job_id", spec["jobId"],
+           "--store_endpoints", spec.get("storeEndpoints",
+                                         "edl-tpu-store:2379"),
+           "--nodes_range", "%d:%d" % (spec.get("minNodes", 1),
+                                       spec.get("maxNodes", 1))]
+    if spec.get("checkpointPath"):
+        cmd += ["--checkpoint_path", spec["checkpointPath"]]
+    cmd.append(spec["script"])
+    cmd += list(spec.get("scriptArgs", []))
+    return cmd
+
+
+class Operator(object):
+    GROUP, VERSION, PLURAL = "edl-tpu.dev", "v1", "trainingjobs"
+
+    def __init__(self, namespace="default", capacity_nodes=16,
+                 interval=10.0):
+        try:
+            from kubernetes import client, config
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(
+                "the k8s operator needs the 'kubernetes' package in the "
+                "operator image (pip install kubernetes)") from e
+        try:
+            config.load_incluster_config()
+        except Exception:
+            config.load_kube_config()
+        self._crd = client.CustomObjectsApi()
+        self._apps = client.AppsV1Api()
+        self._ns = namespace
+        self._capacity = capacity_nodes
+        self._interval = interval
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile_once(self):
+        jobs = self._crd.list_namespaced_custom_object(
+            self.GROUP, self.VERSION, self._ns, self.PLURAL)["items"]
+        plan = plan_allocations(
+            [{"name": j["metadata"]["name"],
+              "min": j["spec"].get("minNodes", 1),
+              "max": j["spec"].get("maxNodes", 1),
+              "priority": j["spec"].get("priority", 0)} for j in jobs],
+            self._capacity)
+        for j in jobs:
+            self._apply(j, plan[j["metadata"]["name"]])
+
+    def _apply(self, job, nodes):
+        from kubernetes import client
+        from kubernetes.client.rest import ApiException
+        name = "edl-tpu-" + job["metadata"]["name"]
+        spec = job["spec"]
+        container = client.V1Container(
+            name="launcher", image=spec["image"],
+            command=launcher_pod_command(spec))
+        template = client.V1PodTemplateSpec(
+            metadata=client.V1ObjectMeta(labels={"edl-tpu-job": name}),
+            spec=client.V1PodSpec(containers=[container],
+                                  restart_policy="Always"))
+        owner = client.V1OwnerReference(
+            api_version="%s/%s" % (self.GROUP, self.VERSION),
+            kind="TrainingJob", name=job["metadata"]["name"],
+            uid=job["metadata"]["uid"], controller=True,
+            block_owner_deletion=True)
+        sts_spec = client.V1StatefulSetSpec(
+            replicas=nodes, service_name=name,
+            selector=client.V1LabelSelector(
+                match_labels={"edl-tpu-job": name}),
+            template=template)
+        body = client.V1StatefulSet(
+            metadata=client.V1ObjectMeta(name=name,
+                                         owner_references=[owner]),
+            spec=sts_spec)
+        ready = 0
+        try:
+            existing = self._apps.read_namespaced_stateful_set(name,
+                                                               self._ns)
+            # replace the whole spec so image/command edits roll out too
+            if (existing.spec.replicas != nodes
+                    or existing.spec.template != template):
+                logger.info("operator: updating %s (replicas %s -> %d)",
+                            name, existing.spec.replicas, nodes)
+                self._apps.patch_namespaced_stateful_set(
+                    name, self._ns, body)
+            ready = (existing.status.ready_replicas or 0
+                     if existing.status else 0)
+        except ApiException as e:
+            if e.status != 404:
+                raise
+            logger.info("operator: creating %s with %d nodes", name, nodes)
+            self._apps.create_namespaced_stateful_set(self._ns, body)
+        phase = ("Running" if ready > 0
+                 else "Starting" if nodes > 0 else "Pending")
+        self._crd.patch_namespaced_custom_object_status(
+            self.GROUP, self.VERSION, self._ns, self.PLURAL,
+            job["metadata"]["name"],
+            {"status": {"phase": phase, "currentNodes": ready}})
+
+    def run_forever(self):
+        while True:
+            try:
+                self.reconcile_once()
+            except Exception:
+                logger.exception("operator reconcile failed")
+            time.sleep(self._interval)
+
+
+def main():  # pragma: no cover
+    Operator().run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="edl_tpu",
+    version="0.1.0",
+    description=("TPU-native elastic deep learning: elastic collective "
+                 "training and a distillation service plane on JAX/XLA"),
+    packages=find_packages(include=["edl_tpu", "edl_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax", "flax", "optax", "numpy", "msgpack", "psutil",
+    ],
+    entry_points={
+        "console_scripts": [
+            # reference parity: `edlrun` (setup.py.in:85)
+            "edl-tpu-run=edl_tpu.controller.launch:main",
+            "edl-tpu-store=edl_tpu.coordination.server:main",
+            "edl-tpu-teacher=edl_tpu.distill.teacher_server:main",
+            "edl-tpu-discovery=edl_tpu.distill.discovery_server:main",
+            "edl-tpu-register=edl_tpu.distill.registry:main",
+            "edl-tpu-resize-driver=edl_tpu.tools.resize_driver:main",
+        ],
+    },
+)

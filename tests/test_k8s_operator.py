@@ -1,0 +1,42 @@
+"""Operator decision-logic tests (no cluster needed)."""
+
+from edl_tpu.tools.k8s_operator import launcher_pod_command, plan_allocations
+
+
+def test_plan_min_then_priority_topup():
+    jobs = [
+        {"name": "a", "min": 2, "max": 8, "priority": 1},
+        {"name": "b", "min": 2, "max": 4, "priority": 5},
+    ]
+    # mins first (both fit), then top-up by priority: b to max, rest to a
+    alloc = plan_allocations(jobs, capacity_nodes=8)
+    assert alloc == {"b": 4, "a": 4}
+
+
+def test_plan_admission_under_pressure():
+    jobs = [
+        {"name": "low", "min": 4, "max": 8, "priority": 0},
+        {"name": "high", "min": 4, "max": 8, "priority": 9},
+    ]
+    alloc = plan_allocations(jobs, capacity_nodes=6)
+    # only the high-priority job is admitted; it gets its min + leftovers
+    assert alloc == {"high": 6, "low": 0}
+
+
+def test_plan_exact_capacity():
+    jobs = [{"name": "x", "min": 3, "max": 5, "priority": 0}]
+    assert plan_allocations(jobs, 3) == {"x": 3}
+    assert plan_allocations(jobs, 10) == {"x": 5}
+    assert plan_allocations(jobs, 2) == {"x": 0}
+
+
+def test_launcher_pod_command():
+    cmd = launcher_pod_command({
+        "jobId": "j1", "script": "/app/train.py",
+        "scriptArgs": ["--epochs", "90"], "minNodes": 4, "maxNodes": 8,
+        "checkpointPath": "gs://b/ckpt",
+    })
+    assert cmd[0] == "edl-tpu-run"
+    assert "--nodes_range" in cmd and "4:8" in cmd
+    assert "--checkpoint_path" in cmd and "gs://b/ckpt" in cmd
+    assert cmd[-3:] == ["/app/train.py", "--epochs", "90"]
