@@ -22,6 +22,7 @@ class BertSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
+    use_flash: bool = False
     mesh: Any = None
 
     @nn.compact
@@ -36,6 +37,14 @@ class BertSelfAttention(nn.Module):
         if self.use_ring:
             from edl_tpu.parallel.ring_attention import ring_attention
             ctx = ring_attention(q, k, v, self.mesh, causal=False)
+        elif self.use_flash:
+            if mask is not None:
+                raise ValueError(
+                    "use_flash does not support attention_mask yet; drop "
+                    "the mask (fixed-length batches) or use the dense path")
+            from edl_tpu.ops.flash_attention import mha
+            ctx = mha(q, k, v, causal=False,
+                      interpret=jax.default_backend() != "tpu")
         else:
             scale = head_dim ** -0.5
             scores = jnp.einsum("bqhd,bkhd->bhqk",
@@ -56,12 +65,14 @@ class BertLayer(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
+    use_flash: bool = False
     mesh: Any = None
 
     @nn.compact
     def __call__(self, x, mask=None):
         attn = BertSelfAttention(self.num_heads, self.dtype, self.use_ring,
-                                 self.mesh, name="attention")(x, mask)
+                                 self.use_flash, self.mesh,
+                                 name="attention")(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x + attn)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype,
@@ -85,6 +96,7 @@ class Bert(nn.Module):
     num_classes: Optional[int] = 2
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
+    use_flash: bool = False
     mesh: Any = None
 
     @nn.compact
@@ -105,7 +117,7 @@ class Bert(nn.Module):
                          name="ln_embed")(x)
         for i in range(self.num_layers):
             x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
-                          self.use_ring, self.mesh,
+                          self.use_ring, self.use_flash, self.mesh,
                           name="layer_%d" % i)(x, attention_mask)
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32,
                                    param_dtype=jnp.float32,

@@ -1,0 +1,263 @@
+"""Pallas flash attention for TPU: blockwise online-softmax forward kernel
+with a memory-efficient blockwise-recompute backward.
+
+The hot op of the transformer models (edl_tpu/models/bert.py) and of the
+teacher inference servers. Never materializes the [seq, seq] score matrix:
+
+- forward: a Pallas kernel gridded over (batch*heads, q_blocks); each
+  program streams kv blocks from VMEM with fp32 online-softmax
+  accumulation on the MXU (q/k/v blocks sized to the 128-lane tiling);
+- backward: custom_vjp that recomputes per-block attention under
+  `lax.scan` (flash-style recompute — O(seq) memory, XLA-fused), so the
+  kernel composes with jit/grad and with the ring-attention sp layer
+  (edl_tpu/parallel/ring_attention.py) which shards the sequence BEFORE
+  attention is applied per shard.
+
+Layout: q, k, v are [batch, heads, seq, head_dim].
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                block_k, seq_len, causal, sm_scale, q_block):
+    """One (bh, q_block, k_block) grid step. kv blocks stream through VMEM
+    via the third grid dimension (fastest-varying, revisiting the same out
+    block), so VMEM holds only tiles regardless of sequence length."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: blocks strictly right of the diagonal contribute nothing
+    diag_ok = (ki * block_k <= qi * q_block + q_block - 1) if causal \
+        else True
+
+    @pl.when(diag_ok)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [TQ, d]
+        tq = q.shape[0]
+        k_blk = k_ref[0].astype(jnp.float32)             # [TK, d]
+        v_blk = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(                    # [TQ, TK]
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (tq, 1), 0)
+        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32,
+                                                    (1, block_k), 1)
+        mask = k_pos < seq_len                           # ragged last block
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        scores = jnp.where(mask, scores, _NEG_INF)
+        m_prev = m_ref[:]
+        m_new = jnp.maximum(m_prev, scores.max(axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(mask, p, 0.0)
+        correction = jnp.exp(m_prev - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * correction + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:]
+                    / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _fwd_kernel_resident(q_ref, k_ref, v_ref, o_ref, *, block_k, seq_len,
+                         causal, sm_scale, q_block):
+    """Fast path for kv that fits VMEM: fori_loop over kv blocks so causal
+    masking skips the loads AND compute right of the diagonal."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale        # [TQ, d]
+    tq, d = q.shape
+    q_pos = qi * q_block + lax.broadcasted_iota(jnp.int32, (tq, 1), 0)
+
+    def body(ki, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        v_blk = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(
+            jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            mask = q_pos >= k_pos
+            scores = jnp.where(mask, scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((tq, d), jnp.float32)
+    m = jnp.full((tq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((tq, 1), jnp.float32)
+    if causal:
+        last = lax.div(qi * q_block + (tq - 1), block_k) + 1
+    else:
+        last = seq_len // block_k
+    acc, m, l = lax.fori_loop(0, last, body, (acc, m, l))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+# kv (k + v) resident in VMEM up to this many bytes; beyond it, stream
+_RESIDENT_KV_BYTES = 4 << 20
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    bh = b * h
+    qf = q.reshape(bh, s, d)
+    kf = k.reshape(bh, sk, d)
+    vf = v.reshape(bh, sk, d)
+    block_q = min(block_q, s)
+    block_k = min(block_k, sk)
+    n_q = pl.cdiv(s, block_q)
+    n_k = pl.cdiv(sk, block_k)
+
+    kv_bytes = 2 * sk * d * k.dtype.itemsize
+    if kv_bytes <= _RESIDENT_KV_BYTES and sk % block_k == 0:
+        out = pl.pallas_call(
+            functools.partial(_fwd_kernel_resident, block_k=block_k,
+                              seq_len=sk, causal=causal, sm_scale=sm_scale,
+                              q_block=block_q),
+            grid=(bh, n_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda i, j: (i, j, 0)),
+            out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            interpret=interpret,
+        )(qf, kf, vf)
+        return out.reshape(b, h, s, d)
+
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, seq_len=sk,
+                          causal=causal, sm_scale=sm_scale,
+                          q_block=block_q),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, s, d)
+
+
+def _blockwise_reference(q, k, v, causal, sm_scale, block_k=512):
+    """O(seq)-memory attention via lax.scan over kv blocks — used for the
+    recompute backward (grad of this == grad of the pallas forward)."""
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    q32 = q.astype(jnp.float32) * sm_scale
+    n_blocks = (sk + block_k - 1) // block_k
+    pad = n_blocks * block_k - sk
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = kp.reshape(b, h, n_blocks, block_k, d).astype(jnp.float32)
+    vb = vp.reshape(b, h, n_blocks, block_k, d).astype(jnp.float32)
+    q_pos = jnp.arange(s)[:, None]
+
+    def body(carry, blk):
+        acc, m, l = carry
+        k_blk, v_blk, ki = blk
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk)
+        k_pos = ki * block_k + jnp.arange(block_k)[None, :]
+        mask = k_pos < sk
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(-1))
+        p = jnp.exp(scores - m_new[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk)
+        return (acc_new, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    (acc, m, l), _ = lax.scan(
+        body, (acc0, m0, l0),
+        (kb.transpose(2, 0, 1, 3, 4), vb.transpose(2, 0, 1, 3, 4),
+         jnp.arange(n_blocks)))
+    return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
+                    block_k=128, interpret=False):
+    """Blockwise exact attention; q/k/v/out are [batch, heads, seq, dim]."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+                      interpret)
+
+
+def _vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    out = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+
+    def ref(q, k, v):
+        return _blockwise_reference(q, k, v, causal, sm_scale)
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def mha(q, k, v, causal=False, sm_scale=None, **kw):
+    """Convenience wrapper for [batch, seq, heads, dim] layouts (the model
+    code's layout): transposes in/out around flash_attention."""
+    out = flash_attention(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                          v.transpose(0, 2, 1, 3), causal, sm_scale, **kw)
+    return out.transpose(0, 2, 1, 3)

@@ -74,8 +74,14 @@ def launcher_pod_command(spec):
 class Operator(object):
     GROUP, VERSION, PLURAL = "edl-tpu.dev", "v1", "trainingjobs"
 
-    def __init__(self, namespace="default", capacity_nodes=16,
-                 interval=10.0):
+    def __init__(self, namespace=None, capacity_nodes=None, interval=None):
+        import os
+        namespace = namespace or os.environ.get("EDL_TPU_K8S_NAMESPACE",
+                                                "default")
+        capacity_nodes = int(capacity_nodes or os.environ.get(
+            "EDL_TPU_K8S_CAPACITY_NODES", "16"))
+        interval = float(interval or os.environ.get(
+            "EDL_TPU_K8S_RECONCILE_INTERVAL", "10"))
         try:
             from kubernetes import client, config
         except ImportError as e:  # pragma: no cover
@@ -104,7 +110,12 @@ class Operator(object):
               "priority": j["spec"].get("priority", 0)} for j in jobs],
             self._capacity)
         for j in jobs:
-            self._apply(j, plan[j["metadata"]["name"]])
+            try:
+                self._apply(j, plan[j["metadata"]["name"]])
+            except Exception:
+                # one broken/racing job must not starve the others
+                logger.exception("operator: reconcile of %s failed",
+                                 j["metadata"]["name"])
 
     def _apply(self, job, nodes):
         from kubernetes import client
@@ -136,9 +147,13 @@ class Operator(object):
         try:
             existing = self._apps.read_namespaced_stateful_set(name,
                                                                self._ns)
-            # replace the whole spec so image/command edits roll out too
-            if (existing.spec.replicas != nodes
-                    or existing.spec.template != template):
+            # compare only the fields we own (the server adds defaults the
+            # local template leaves unset, so whole-template != is useless)
+            cur = existing.spec.template.spec.containers[0]
+            changed = (existing.spec.replicas != nodes
+                       or cur.image != container.image
+                       or cur.command != container.command)
+            if changed:
                 logger.info("operator: updating %s (replicas %s -> %d)",
                             name, existing.spec.replicas, nodes)
                 self._apps.patch_namespaced_stateful_set(
