@@ -6,6 +6,7 @@ failure marks the register stopped so the launcher notices and exits.
 """
 
 import threading
+import time
 
 from edl_tpu.controller import constants
 from edl_tpu.utils import errors
@@ -18,6 +19,7 @@ class Register(object):
         self._coord = coord
         self._service = service
         self._server = server
+        self._value = value
         self._ttl = ttl
         self._lease_id = coord.set_server_with_lease(service, server, value,
                                                      ttl)
@@ -34,10 +36,29 @@ class Register(object):
                 self._coord.refresh_server(self._service, self._server,
                                            self._lease_id)
             except errors.EdlError as e:
-                logger.error("registration %s/%s lost: %r", self._service,
-                             self._server, e)
-                self._broken.set()
-                return
+                # lease lost (expiry race or a store crash/restart) — keep
+                # trying to re-register for a grace window so a store
+                # restart does not take the whole cluster down with it
+                if not self._reregister(e):
+                    self._broken.set()
+                    return
+
+    def _reregister(self, cause, grace_factor=3):
+        deadline = time.monotonic() + self._ttl * grace_factor
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                self._lease_id = self._coord.set_server_with_lease(
+                    self._service, self._server, self._value, self._ttl)
+                logger.warning("registration %s/%s re-established after %r",
+                               self._service, self._server, cause)
+                return True
+            except errors.EdlError:
+                self._stop.wait(self._ttl / 3.0)
+        if self._stop.is_set():
+            return True  # ordinary requested shutdown, not a loss
+        logger.error("registration %s/%s lost for good: %r", self._service,
+                     self._server, cause)
+        return False
 
     def is_broken(self):
         return self._broken.is_set()

@@ -119,7 +119,9 @@ class CoordClient(object):
 
     def _call(self, method, *args, **kwargs):
         last = None
-        for _ in range(len(self._endpoints)):
+        # +1: a stale cached connection (severed by a server restart) costs
+        # one attempt; the fresh reconnect deserves its own
+        for _ in range(len(self._endpoints) + 1):
             rpc = getattr(self._local, "rpc", None)
             if rpc is None:
                 with self._ep_lock:

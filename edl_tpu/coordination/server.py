@@ -14,8 +14,8 @@ from edl_tpu.utils.logger import logger
 
 
 class StoreServer(object):
-    def __init__(self, host="0.0.0.0", port=0):
-        self.store = Store()
+    def __init__(self, host="0.0.0.0", port=0, wal_path=None):
+        self.store = Store(wal_path=wal_path)
         self._rpc = RpcServer(host=host, port=port)
         s = self.store
         for name in ("put", "put_if_absent", "get", "get_prefix", "delete",
@@ -44,8 +44,17 @@ def main():
     parser = argparse.ArgumentParser("edl_tpu coordination store server")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=2379)
+    parser.add_argument("--data_dir", default=None,
+                        help="directory for the durability WAL (permanent "
+                             "keys survive restarts)")
     args = parser.parse_args()
-    server = StoreServer(host=args.host, port=args.port).start()
+    wal = None
+    if args.data_dir:
+        import os
+        os.makedirs(args.data_dir, exist_ok=True)
+        wal = os.path.join(args.data_dir, "store.wal")
+    server = StoreServer(host=args.host, port=args.port,
+                         wal_path=wal).start()
     logger.info("coordination store serving on %s", server.endpoint)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

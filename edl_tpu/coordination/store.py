@@ -12,9 +12,14 @@ Concurrency model: one big lock + a condition variable; watchers long-poll via
 the control plane writes are tiny and infrequent (heartbeats every ttl/2).
 """
 
+import base64
+import json
+import os
 import threading
 import time
 from collections import deque
+
+from edl_tpu.utils.logger import logger
 
 
 class KeyValue(object):
@@ -28,22 +33,92 @@ class KeyValue(object):
         self.mod_rev = mod_rev
 
 
+def _wal_put(key, value):
+    if isinstance(value, bytes):
+        return {"op": "put", "k": key, "b": 1,
+                "v": base64.b64encode(value).decode("ascii")}
+    return {"op": "put", "k": key, "v": value}
+
+
 class Store(object):
     # retain this many recent events for watch catch-up
     EVENT_HISTORY = 10000
 
-    def __init__(self):
+    def __init__(self, wal_path=None):
+        """``wal_path``: append-only log making PERMANENT keys durable
+        across restarts (cluster maps, job statuses, state). Leased keys
+        are deliberately ephemeral — their owners re-register within a TTL
+        (etcd-restart semantics; cf. register.py's re-register-on-loss)."""
         self._kv = {}            # key -> KeyValue
         self._leases = {}        # lease_id -> (ttl, deadline, set(keys))
-        self._rev = 0
+        # revisions are seeded by wall-clock millis so they NEVER regress
+        # across restarts: every watcher from a previous incarnation holds
+        # since_rev < this incarnation's floor and is told to re-list
+        self._rev = int(time.time() * 1000)
         self._next_lease = 1
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._events = deque(maxlen=self.EVENT_HISTORY)
         self._stop = threading.Event()
+        self._wal = None
+        self._wal_watermark = 0  # last rev watermarked into the WAL
+        if wal_path:
+            self._replay_wal(wal_path)
+            with self._lock:
+                self._events.clear()
+                # the watermark bounds the previous incarnation's rev up to
+                # one sweep period of unlogged (lease) ops — the margin
+                # covers those plus any backwards wall-clock step
+                self._rev = max(int(time.time() * 1000),
+                                self._rev + (1 << 20))
+            # compact: rewrite the log as a snapshot of surviving keys
+            tmp = wal_path + ".tmp"
+            with open(tmp, "w") as f:
+                with self._lock:
+                    f.write(json.dumps({"op": "rev", "r": self._rev}) + "\n")
+                    for key, kv in sorted(self._kv.items()):
+                        f.write(json.dumps(_wal_put(key, kv.value)) + "\n")
+            os.replace(tmp, wal_path)
+            self._wal = open(wal_path, "a", buffering=1)
+        self._floor_rev = self._rev  # below this = previous incarnation
         self._sweeper = threading.Thread(
             target=self._sweep_loop, daemon=True, name="store-sweeper")
         self._sweeper.start()
+
+    # -- durability ---------------------------------------------------------
+
+    def _replay_wal(self, path):
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            lines = f.read().splitlines()
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if i == len(lines) - 1:
+                    logger.warning("WAL torn tail at line %d; ignored", i)
+                else:
+                    logger.error(
+                        "WAL corrupt at line %d of %d; DISCARDING %d "
+                        "later records", i, len(lines), len(lines) - i - 1)
+                break
+            with self._lock:
+                if rec["op"] == "put":
+                    value = rec["v"]
+                    if rec.get("b"):
+                        value = base64.b64decode(value)
+                    self._put_locked(rec["k"], value, None)
+                elif rec["op"] == "del":
+                    self._delete_locked(rec["k"])
+                elif rec["op"] == "rev":
+                    self._rev = max(self._rev, int(rec["r"]))
+
+    def _log(self, rec):
+        if self._wal is not None:
+            self._wal.write(json.dumps(rec) + "\n")
 
     # -- internal helpers (hold self._lock) --------------------------------
 
@@ -59,6 +134,17 @@ class Store(object):
         return rev
 
     def _put_locked(self, key, value, lease_id):
+        if not isinstance(value, (str, bytes)):
+            # the native C++ backend only stores str/bin — reject here too
+            raise TypeError("store values must be str or bytes, got %s"
+                            % type(value).__name__)
+        prev = self._kv.get(key)
+        if lease_id is None:
+            self._log(_wal_put(key, value))
+        elif prev is not None and prev.lease_id is None:
+            # a permanent value is being shadowed by an ephemeral one: the
+            # WAL must forget it or a restart would resurrect it
+            self._log({"op": "del", "k": key})
         old = self._kv.get(key)
         if old is not None and old.lease_id and old.lease_id != lease_id:
             lease = self._leases.get(old.lease_id)
@@ -75,9 +161,14 @@ class Store(object):
         return rev
 
     def _delete_locked(self, key):
-        old = self._kv.pop(key, None)
+        old = self._kv.get(key)
         if old is None:
             return None
+        if old.lease_id is None:
+            # log BEFORE mutating so a failed append cannot leave a deleted
+            # key resurrectable from the WAL
+            self._log({"op": "del", "k": key})
+        self._kv.pop(key, None)
         if old.lease_id:
             lease = self._leases.get(old.lease_id)
             if lease:
@@ -94,11 +185,20 @@ class Store(object):
                     _, _, keys = self._leases.pop(lid)
                     for k in list(keys):
                         self._delete_locked(k)
+                # watermark the current revision so a restart can seed
+                # above it even when recent ops were unlogged lease traffic
+                if self._wal is not None and self._rev > self._wal_watermark:
+                    self._log({"op": "rev", "r": self._rev})
+                    self._wal_watermark = self._rev
 
     # -- public API --------------------------------------------------------
 
     def close(self):
         self._stop.set()
+        with self._lock:  # in-flight handlers mutate/_log under this lock
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
 
     def revision(self):
         with self._lock:
@@ -215,9 +315,11 @@ class Store(object):
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:
-                # history truncated past the watcher's position → tell it to
-                # re-list instead of silently dropping events
-                if (self._rev > since_rev and self._events
+                # re-list triggers: (a) the watcher predates this store
+                # incarnation (leased keys died silently with the old
+                # process), (b) history truncated past its position
+                if since_rev < self._floor_rev or (
+                        self._rev > since_rev and self._events
                         and self._events[0]["rev"] > since_rev + 1):
                     return ([{"type": "reset", "key": prefix, "value": None,
                               "rev": self._rev}], self._rev)

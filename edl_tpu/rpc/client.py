@@ -33,13 +33,16 @@ class RpcClient(object):
                 raise errors.ConnectError(
                     "connect %s:%s failed: %s" % (*self._addr, e))
 
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
     def close(self):
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            self._close_locked()
 
     def call(self, method, *args, timeout=None, **kwargs):
         """Invoke ``method`` remotely; one in-flight request per client."""
@@ -52,7 +55,8 @@ class RpcClient(object):
                 framing.write_frame(self._sock, req)
                 resp = framing.read_frame(self._sock)
             except (OSError, ConnectionError, framing.FramingError) as e:
-                self.close()
+                # already holding self._lock — must NOT re-enter close()
+                self._close_locked()
                 raise errors.ConnectError(
                     "rpc %s to %s failed: %s" % (method, self.endpoint, e))
             if resp.get("ok"):

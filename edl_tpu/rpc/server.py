@@ -17,6 +17,12 @@ from edl_tpu.utils.logger import logger
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    def setup(self):
+        self.server.connections.add(self.request)
+
+    def finish(self):
+        self.server.connections.discard(self.request)
+
     def handle(self):
         framing.set_keepalive(self.request)
         while True:
@@ -58,6 +64,10 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
     allow_reuse_address = True
     request_queue_size = 128
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.connections = set()
 
 
 class RpcServer(object):
@@ -110,5 +120,12 @@ class RpcServer(object):
     def stop(self):
         if self._server is not None:
             self._server.shutdown()
+            # sever live connections so a stop behaves like a real process
+            # death — clients must reconnect, not keep talking to a zombie
+            for sock in list(self._server.connections):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
             self._server.server_close()
             self._server = None

@@ -16,6 +16,7 @@ Design (TPU-first):
   adjust hooks re-tune hyperparameters for the new world size.
 """
 
+import os
 import time
 
 import jax
@@ -77,11 +78,24 @@ def make_train_step(loss_fn, tx, has_aux=False):
     return step
 
 
+def enable_compilation_cache():
+    """Persistent XLA compilation cache, keyed by program (incl. mesh
+    shape). Cuts stop-resume resize recovery to O(restart) when the new
+    world size was seen before (SURVEY.md §7 'resize vs XLA reality') —
+    set EDL_TPU_COMPILE_CACHE to a shared directory to activate."""
+    cache_dir = os.environ.get("EDL_TPU_COMPILE_CACHE")
+    if cache_dir:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        logger.info("compilation cache at %s", cache_dir)
+
+
 def maybe_init_distributed(env=None):
     """Initialize jax.distributed from the launcher env contract (no-op for
     single-process runs)."""
     global _distributed_initialized
     env = env or TrainerEnv()
+    enable_compilation_cache()
     if _distributed_initialized or env.world_size <= 1:
         return env
     jax.distributed.initialize(

@@ -68,7 +68,15 @@ struct Event {
 
 class Store {
  public:
-  Store() : sweeper_([this] { SweepLoop(); }) {}
+  // Revisions are seeded by wall-clock millis so they never regress across
+  // restarts; watchers from a previous incarnation fall below floor_rev_
+  // and are told to re-list (parity: coordination/store.py).
+  Store()
+      : rev_(std::chrono::duration_cast<std::chrono::milliseconds>(
+                 std::chrono::system_clock::now().time_since_epoch())
+                 .count()),
+        floor_rev_(rev_),
+        sweeper_([this] { SweepLoop(); }) {}
 
   ~Store() {
     stop_.store(true);
@@ -216,8 +224,9 @@ class Store {
                     std::chrono::duration_cast<Clock::duration>(
                         std::chrono::duration<double>(timeout));
     while (true) {
-      if (rev_ > since_rev && !events_.empty() &&
-          events_.front().rev > since_rev + 1) {
+      if (since_rev < floor_rev_ ||
+          (rev_ > since_rev && !events_.empty() &&
+           events_.front().rev > since_rev + 1)) {
         Event reset;
         reset.type = "reset";
         reset.key = prefix;
@@ -311,7 +320,8 @@ class Store {
   std::map<std::string, KeyValue> kv_;
   std::map<int64_t, Lease> leases_;
   std::deque<Event> events_;
-  int64_t rev_ = 0;
+  int64_t rev_;
+  int64_t floor_rev_;
   int64_t next_lease_ = 1;
   std::atomic<bool> stop_{false};
   std::thread sweeper_;

@@ -1,0 +1,147 @@
+"""Store durability + registration self-healing across store restarts."""
+
+import time
+
+from edl_tpu.controller.register import Register
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.coordination.server import StoreServer
+from edl_tpu.utils.network import find_free_port
+
+
+def test_wal_persists_permanent_keys(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    s1 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    c1 = CoordClient([s1.endpoint], root="jobd")
+    c1.set_server_permanent("cluster", "cluster", '{"pods": []}')
+    c1.set_server_permanent("job_status", "job_status", "RUNNING")
+    c1.set_server_with_lease("resource", "podA", "x", ttl=30)  # ephemeral
+    c1.remove_server("job_status", "job_status")
+    s1.stop()
+
+    s2 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    try:
+        c2 = CoordClient([s2.endpoint], root="jobd")
+        # permanent keys survive; deleted and leased keys do not
+        assert c2.get_value("cluster", "cluster") == '{"pods": []}'
+        assert c2.get_value("job_status", "job_status") is None
+        assert c2.get_value("resource", "podA") is None
+    finally:
+        s2.stop()
+
+
+def test_wal_torn_tail_is_ignored(tmp_path):
+    wal = str(tmp_path / "store.wal")
+    s1 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    c1 = CoordClient([s1.endpoint], root="jobd")
+    c1.set_server_permanent("svc", "a", "v1")
+    s1.stop()
+    with open(wal, "a") as f:
+        f.write('{"op": "put", "k": "/jobd/svc/nodes/b", "v": "tr')  # torn
+    s2 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    try:
+        c2 = CoordClient([s2.endpoint], root="jobd")
+        assert c2.get_value("svc", "a") == "v1"
+        assert c2.get_value("svc", "b") is None
+    finally:
+        s2.stop()
+
+
+def test_revisions_and_watchers_survive_restart(tmp_path):
+    """Revisions never regress across a restart, and a watcher from the
+    previous incarnation is forced to re-list (reset) so it sees both new
+    keys and leased keys that died with the old process."""
+    port = find_free_port()
+    wal = str(tmp_path / "store.wal")
+    s1 = StoreServer(host="127.0.0.1", port=port, wal_path=wal).start()
+    coord = CoordClient(["127.0.0.1:%d" % port], root="jw")
+    coord.set_server_permanent("svc", "keep", "v")
+    coord.set_server_with_lease("svc", "ephemeral", "x", ttl=60)
+    rev_before = coord.revision()
+
+    views = []
+    watcher = coord.watch_service("svc", lambda a, r, alls: views.append(
+        dict(alls)), poll_timeout=0.5)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not views:
+        time.sleep(0.1)
+    assert views and set(views[-1]) == {"keep", "ephemeral"}
+
+    s1.stop()
+    s2 = StoreServer(host="127.0.0.1", port=port, wal_path=wal).start()
+    try:
+        assert coord.revision() >= rev_before  # no regression
+        coord.set_server_permanent("svc", "new", "n")
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if views and set(views[-1]) == {"keep", "new"}:
+                break
+            time.sleep(0.2)
+        # the watcher re-listed: ephemeral gone, new key visible
+        assert set(views[-1]) == {"keep", "new"}, views[-1]
+    finally:
+        watcher.stop()
+        s2.stop()
+
+
+def test_permanent_value_shadowed_by_lease_not_resurrected(tmp_path):
+    """A permanent key later overwritten by a leased registration must NOT
+    come back from the WAL after a restart."""
+    wal = str(tmp_path / "store.wal")
+    s1 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    c1 = CoordClient([s1.endpoint], root="js")
+    c1.set_server_permanent("svc", "k", "permanent")
+    c1.set_server_with_lease("svc", "k", "ephemeral", ttl=60)
+    s1.stop()
+    s2 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    try:
+        assert CoordClient([s2.endpoint],
+                           root="js").get_value("svc", "k") is None
+    finally:
+        s2.stop()
+
+
+def test_non_string_values_rejected(tmp_path):
+    s = StoreServer(host="127.0.0.1",
+                    wal_path=str(tmp_path / "w.wal")).start()
+    try:
+        c = CoordClient([s.endpoint], root="jt")
+        try:
+            c.put("/jt/k", 123)
+            raise AssertionError("expected a type error")
+        except Exception as e:
+            assert "str or bytes" in str(e)
+        c.put("/jt/raw", b"\x00\xff")  # bytes are fine and durable
+    finally:
+        s.stop()
+    s2 = StoreServer(host="127.0.0.1",
+                     wal_path=str(tmp_path / "w.wal")).start()
+    try:
+        c2 = CoordClient([s2.endpoint], root="jt")
+        assert c2.get_key("/jt/raw")["value"] == b"\x00\xff"
+    finally:
+        s2.stop()
+
+
+def test_register_survives_store_restart(tmp_path):
+    """A store crash/restart must not kill registered components: the
+    register re-establishes its lease on the new store instance."""
+    port = find_free_port()
+    wal = str(tmp_path / "store.wal")
+    s1 = StoreServer(host="127.0.0.1", port=port, wal_path=wal).start()
+    coord = CoordClient(["127.0.0.1:%d" % port], root="jobr")
+    reg = Register(coord, "resource", "podA", "payload", ttl=2)
+    try:
+        assert coord.get_value("resource", "podA") == "payload"
+        s1.stop()
+        time.sleep(1.0)
+        s2 = StoreServer(host="127.0.0.1", port=port, wal_path=wal).start()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if coord.get_value("resource", "podA") == "payload":
+                break
+            time.sleep(0.3)
+        assert coord.get_value("resource", "podA") == "payload"
+        assert not reg.is_broken()
+        s2.stop()
+    finally:
+        reg.stop()
