@@ -1,0 +1,53 @@
+"""Evaluation helpers: top-k accuracy and a jitted eval loop.
+
+Reference parity: the rank-0 test loop + acc1/acc5 reporting of the
+collective example (train_with_fleet.py:573-610, the acc numbers in
+README.md:83-85 / BASELINE.md).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def top_k_accuracies(logits, labels, ks=(1, 5)):
+    """{k: fraction of rows whose label is in the top-k logits}."""
+    logits = jnp.asarray(logits)
+    labels = jnp.asarray(labels)
+    max_k = min(max(ks), logits.shape[-1])
+    _, top = jax.lax.top_k(logits, max_k)          # [batch, max_k]
+    hits = top == labels[:, None]
+    return {k: jnp.mean(jnp.any(hits[:, :min(k, max_k)], axis=1))
+            for k in ks}
+
+
+class Evaluator(object):
+    """Jitted accuracy evaluation over a batch stream.
+
+    apply_fn(params, extra, batch) -> logits. ``extra`` carries frozen
+    model state (BatchNorm running stats) in eval mode.
+    """
+
+    def __init__(self, apply_fn, ks=(1, 5)):
+        self._ks = tuple(ks)
+
+        def step(params, extra, batch):
+            logits = apply_fn(params, extra, batch)
+            accs = top_k_accuracies(logits, batch["label"], self._ks)
+            return jnp.stack([accs[k] for k in self._ks]), logits.shape[0]
+
+        self._step = jax.jit(step)
+
+    def evaluate(self, params, extra, batches):
+        """Weighted-average top-k accuracies over ``batches``; returns
+        {"acc1": ..., "acc5": ...}-style dict."""
+        totals = np.zeros(len(self._ks))
+        n = 0
+        for batch in batches:
+            accs, bs = self._step(params, extra, batch)
+            totals += np.asarray(accs) * int(bs)
+            n += int(bs)
+        if n == 0:
+            return {}
+        return {"acc%d" % k: round(float(t / n), 4)
+                for k, t in zip(self._ks, totals)}

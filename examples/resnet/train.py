@@ -39,6 +39,8 @@ def main(argv=None):
                    default="cosine")
     p.add_argument("--dtype", choices=["bf16", "f32"], default="f32")
     p.add_argument("--fetch_steps", type=int, default=10)
+    p.add_argument("--eval_steps", type=int, default=0,
+                   help="eval batches per epoch on rank 0 (0 = off)")
     args = p.parse_args(argv)
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
@@ -67,6 +69,16 @@ def main(argv=None):
           % (args.depth, env.global_rank, trainer.world_size, start_epoch,
              resumed), flush=True)
 
+    evaluator = None
+    if args.eval_steps and env.global_rank == 0:
+        from edl_tpu.runtime.evaluation import Evaluator
+
+        def eval_apply(params, extra, batch):
+            return model.apply(
+                {"params": params, "batch_stats": extra["batch_stats"]},
+                batch["image"], train=False)
+        evaluator = Evaluator(eval_apply)
+
     loss = None
     imgs_seen = 0
     t_start = time.perf_counter()
@@ -92,6 +104,23 @@ def main(argv=None):
                          args.total_batch_size * (step + 1) / dt),
                       flush=True)
         trainer.end_epoch(save=True)
+        if evaluator is not None:
+            # rank-0 eval, reference parity: train_with_fleet.py:573-610.
+            # device_get first: the train state is sharded over the GLOBAL
+            # mesh and a single-rank jit over it would touch devices this
+            # process cannot address in multi-host runs
+            import jax as _jax
+            host_params = _jax.device_get(trainer.train_state["params"])
+            host_extra = _jax.device_get(trainer.extra_state)
+            accs = evaluator.evaluate(
+                host_params, host_extra,
+                # negative-offset seed stream: disjoint from training's
+                # epoch*100000 + step for any epoch count
+                (resnet.synthetic_image_batch(
+                    args.total_batch_size, image_size=args.image_size,
+                    num_classes=args.num_classes, seed=2**31 - 1 - i)
+                 for i in range(args.eval_steps)))
+            print("epoch %d eval: %s" % (epoch, accs), flush=True)
 
     trainer.report_status(ts.TrainStatus.SUCCEED)
     wall = time.perf_counter() - t_start

@@ -1,0 +1,38 @@
+"""Evaluation helper tests."""
+
+import numpy as np
+import pytest
+
+from edl_tpu.runtime.evaluation import Evaluator, top_k_accuracies
+
+
+def test_top_k_accuracies():
+    logits = np.array([
+        [9.0, 1.0, 0.0, 0.0],   # top1 = 0
+        [1.0, 9.0, 8.0, 0.0],   # top1 = 1, top2 incl 2
+        [0.0, 1.0, 2.0, 3.0],   # top1 = 3
+    ], np.float32)
+    labels = np.array([0, 2, 0])
+    accs = top_k_accuracies(logits, labels, ks=(1, 2, 4))
+    assert float(accs[1]) == pytest.approx(1 / 3)   # only row 0
+    assert float(accs[2]) == pytest.approx(2 / 3)   # rows 0 and 1
+    assert float(accs[4]) == 1.0
+
+
+def test_evaluator_weighted_average():
+    import jax.numpy as jnp
+
+    def apply_fn(params, extra, batch):
+        # "model": predicts the label perfectly when params["good"] else 0
+        return jnp.eye(4, dtype=jnp.float32)[batch["label"]] * params["good"]
+
+    ev = Evaluator(apply_fn, ks=(1,))
+    batches = [
+        {"label": np.array([1, 2, 3])},
+        {"label": np.array([0])},
+    ]
+    out = ev.evaluate({"good": np.float32(1.0)}, {}, iter(batches))
+    assert out == {"acc1": 1.0}
+    # all-zero logits → top-1 picks class 0 → only the [0] batch scores
+    out0 = ev.evaluate({"good": np.float32(0.0)}, {}, iter(batches))
+    assert out0 == {"acc1": 0.25}
