@@ -1,0 +1,125 @@
+"""Expert parallelism: a top-1-routed mixture-of-experts FFN with experts
+sharded over the ``ep`` mesh axis and token exchange via all_to_all.
+
+Net-new vs the reference (no EP anywhere in its tree, SURVEY.md §2.7).
+Switch-style routing: each token goes to its argmax expert, bounded by a
+per-expert capacity; overflow tokens pass through unchanged. Inside
+shard_map, tokens are exchanged with `lax.all_to_all` over ep (ICI), each
+slice runs only its local experts' FFNs, and results return the same way.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.runtime.mesh import EXPERT_AXIS
+
+
+def init_moe_params(rng, num_experts, d_model, d_ff):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale = d_model ** -0.5
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts)) * scale,
+        "w_in": jax.random.normal(k2, (num_experts, d_model, d_ff)) * scale,
+        "w_out": jax.random.normal(k3, (num_experts, d_ff, d_model))
+                 * (d_ff ** -0.5),
+    }
+
+
+def moe_ffn_dense(params, x):
+    """Reference implementation: every expert computed densely, combined by
+    the top-1 routing mask (capacity ignored)."""
+    logits = x @ params["router"]                    # [n, E]
+    choice = jnp.argmax(logits, axis=-1)             # [n]
+    h = jnp.einsum("nd,edf->enf", x, params["w_in"])
+    h = jax.nn.relu(h)
+    y = jnp.einsum("enf,efd->end", h, params["w_out"])
+    mask = jax.nn.one_hot(choice, logits.shape[-1]).T[..., None]  # [E,n,1]
+    return (y * mask).sum(axis=0)
+
+
+def _moe_shard(params, x, *, axis_name, num_experts, capacity):
+    """One ep slice: local tokens [n, d], local experts [E/ep, d, ...]."""
+    ep = lax.psum(1, axis_name)
+    experts_local = num_experts // ep
+    n, d = x.shape
+
+    logits = x @ params["router"]                    # router is replicated
+    choice = jnp.argmax(logits, axis=-1)             # [n] global expert id
+
+    # per-destination-slice capacity buffers: [ep, capacity, d]
+    dest_slice = choice // experts_local
+    # position of each token within its destination buffer
+    one_hot_dest = jax.nn.one_hot(dest_slice, ep, dtype=jnp.int32)
+    pos = jnp.cumsum(one_hot_dest, axis=0) - 1       # [n, ep]
+    my_pos = jnp.take_along_axis(pos, dest_slice[:, None], axis=1)[:, 0]
+    keep = my_pos < capacity
+
+    send = jnp.zeros((ep, capacity, d), x.dtype)
+    send_expert = jnp.zeros((ep, capacity), jnp.int32)
+    # overflow tokens scatter OUT OF BOUNDS and are dropped — clipping
+    # them into slot capacity-1 would clobber the token that owns it
+    drop_row = jnp.where(keep, dest_slice, ep)
+    send = send.at[(drop_row, my_pos)].set(x, mode="drop")
+    send_expert = send_expert.at[(drop_row, my_pos)].set(
+        choice % experts_local, mode="drop")
+    idx = (dest_slice, jnp.clip(my_pos, 0, capacity - 1))  # gather-safe
+
+    # exchange: recv[i] = what slice i sent to us
+    recv = lax.all_to_all(send, axis_name, 0, 0, tiled=False)
+    recv_expert = lax.all_to_all(send_expert, axis_name, 0, 0,
+                                 tiled=False)
+    recv_flat = recv.reshape(ep * capacity, d)
+    recv_expert_flat = recv_expert.reshape(ep * capacity)
+
+    # run every LOCAL expert on the received tokens, select by assignment
+    h = jnp.einsum("nd,edf->enf", recv_flat, params["w_in"])
+    h = jax.nn.relu(h)
+    y_all = jnp.einsum("enf,efd->end", h, params["w_out"])
+    sel = jax.nn.one_hot(recv_expert_flat, experts_local).T[..., None]
+    y = (y_all * sel).sum(axis=0).reshape(ep, capacity, d)
+
+    # send results home and scatter back into token order
+    back = lax.all_to_all(y, axis_name, 0, 0, tiled=False)
+    gathered = back[idx]                              # [n, d]
+    return jnp.where(keep[:, None], gathered, x)      # overflow: identity
+
+
+def moe_ffn(params, x, mesh, capacity_factor=2.0, ep_axis=EXPERT_AXIS):
+    """Expert-parallel MoE FFN; x: [tokens, d_model] sharded over (dp, ep)
+    — the standard EP layout: every slice routes only its own tokens, so
+    there is no redundant routing compute or duplicated all_to_all rows.
+
+    params['w_in']/['w_out'] have a leading expert axis sharded over ep;
+    the router is replicated. Per-destination capacity =
+    ceil(tokens_per_slice * capacity_factor / ep).
+    """
+    ep = mesh.shape[ep_axis]
+    dp = mesh.shape["dp"]
+    num_experts = params["w_in"].shape[0]
+    if num_experts % ep != 0:
+        raise ValueError("num_experts %d not divisible by ep %d"
+                         % (num_experts, ep))
+    if x.shape[0] % (dp * ep) != 0:
+        raise ValueError("tokens %d not divisible by dp*ep=%d"
+                         % (x.shape[0], dp * ep))
+    n_local = x.shape[0] // (dp * ep)
+    capacity = int(max(1, -(-n_local * capacity_factor // ep)))
+
+    param_specs = {
+        "router": P(),
+        "w_in": P(ep_axis),
+        "w_out": P(ep_axis),
+    }
+    fn = shard_map(
+        functools.partial(_moe_shard, axis_name=ep_axis,
+                          num_experts=num_experts, capacity=capacity),
+        mesh=mesh,
+        in_specs=(param_specs, P(("dp", ep_axis))),
+        out_specs=P(("dp", ep_axis)),
+        check_vma=False)
+    return fn(params, x)

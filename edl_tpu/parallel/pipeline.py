@@ -1,0 +1,97 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over the ``pp``
+mesh axis with shard_map + ppermute activation transfer.
+
+Net-new vs the reference (model parallelism was only a roadmap bullet,
+SURVEY.md §2.7) — completes the framework's mesh axes (dp/tp/sp/pp/ep).
+Each pipeline stage's parameters live only on its pp slice; activations hop
+stage-to-stage over ICI via `lax.ppermute` on the classic GPipe schedule
+(M microbatches over P stages in M + P - 1 ticks). Differentiable: the
+loop has static bounds and ppermute transposes to the reverse hop, so
+jax.grad runs the reverse schedule automatically.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from edl_tpu.runtime.mesh import PIPE_AXIS
+
+
+def _pipeline_shard(stage_params, microbatches, *, stage_fn, num_stages,
+                    num_micro, axis_name):
+    """Runs on one pp slice. stage_params: this stage's params (leading
+    stage axis of size 1); microbatches: [M, mb, ...] (replicated in)."""
+    idx = lax.axis_index(axis_name)
+    params = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+    mb_shape = microbatches.shape[1:]
+    out0 = jnp.zeros((num_micro,) + mb_shape, microbatches.dtype)
+    carry0 = jnp.zeros(mb_shape, microbatches.dtype)
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def tick(t, state):
+        carry, outs = state
+        mb_idx = t - idx                       # which microbatch this stage
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < num_micro)
+        fresh = microbatches[jnp.clip(t, 0, num_micro - 1)]
+        x_in = jnp.where(idx == 0, fresh, carry)
+        y = stage_fn(params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        # the last stage records its finished microbatch
+        write = jnp.logical_and(active, idx == num_stages - 1)
+        outs = lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(write, y, outs[jnp.clip(mb_idx, 0, num_micro - 1)]),
+            jnp.clip(mb_idx, 0, num_micro - 1), 0)
+        carry = lax.ppermute(y, axis_name, perm)
+        return carry, outs
+
+    _, outs = lax.fori_loop(0, num_micro + num_stages - 1, tick,
+                            (carry0, out0))
+    # only the last stage holds real outputs; psum replicates them
+    return lax.psum(outs, axis_name)
+
+
+def pipeline_apply(stage_params, x, stage_fn, mesh, num_micro=None,
+                   pipe_axis=PIPE_AXIS):
+    """Apply ``num_stages`` sequential stages to ``x`` with the stages
+    sharded over the pp mesh axis.
+
+    stage_params: pytree with a leading stage axis [P, ...] (shard it over
+    pp before calling, or pass host arrays and let shard_map split them).
+    x: [batch, ...]; batch must divide into ``num_micro`` microbatches.
+    Returns stage_{P-1}(...stage_0(x)), replicated.
+    """
+    num_stages = mesh.shape[pipe_axis]
+    batch = x.shape[0]
+    num_micro = num_micro or num_stages
+    if batch % num_micro != 0:
+        raise ValueError("batch %d not divisible by %d microbatches"
+                         % (batch, num_micro))
+    mb = batch // num_micro
+    microbatches = x.reshape((num_micro, mb) + x.shape[1:])
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(pipe_axis), stage_params)
+    fn = shard_map(
+        functools.partial(_pipeline_shard, stage_fn=stage_fn,
+                          num_stages=num_stages, num_micro=num_micro,
+                          axis_name=pipe_axis),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_vma=False)
+    out = fn(stage_params, microbatches)
+    return out.reshape((batch,) + out.shape[2:])
+
+
+def sequential_apply(stage_params, x, stage_fn):
+    """Reference implementation: apply stages one after another."""
+    num_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+    for s in range(num_stages):
+        params = jax.tree_util.tree_map(lambda p: p[s], stage_params)
+        x = stage_fn(params, x)
+    return x
