@@ -46,7 +46,10 @@ class ElasticReader(object):
       leader_endpoint: where the leader lives (None + coord ⇒ discover).
       coord/reader_name: coordination-store discovery (optional in tests).
       skip_record: optional (file, idx) -> bool predicate for data-aware
-        resume (reference DataCheckpoint semantics).
+        resume (reference DataCheckpoint semantics). Pass
+        ``state.data_checkpoint.is_processed`` to resume where a previous
+        incarnation stopped; pair with ``mark_consumed`` on the consume
+        side to record progress.
     """
 
     def __init__(self, pod_id, splitter, batch_size, file_list=(),
@@ -177,6 +180,15 @@ class ElasticReader(object):
             logger.warning("batch %s from %s lost: %r", batch_id, endpoint,
                            e)
             return None
+
+    @staticmethod
+    def mark_consumed(state, batch):
+        """Record a consumed batch in the elastic State's data checkpoint
+        (reference DataCheckpoint :25-31); call after training on it, then
+        persist the State with the epoch checkpoint so a restart resumes
+        behind the consumed ranges via ``skip_record``."""
+        lo, hi = batch["range"]
+        state.data_checkpoint.mark_processed(batch["file"], lo, hi)
 
     def stop(self):
         self._stop.set()
