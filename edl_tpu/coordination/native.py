@@ -32,16 +32,20 @@ def ensure_binary():
 class NativeStoreServer(object):
     """Run the C++ store as a subprocess; context-manager friendly."""
 
-    def __init__(self, host="127.0.0.1", port=0):
+    def __init__(self, host="127.0.0.1", port=0, data_dir=None):
         self._host = host
         self._port = port or find_free_port()
+        self._data_dir = data_dir
         self._proc = None
 
     def start(self, wait_s=10):
         binary = ensure_binary()
+        cmd = [binary, "--host", self._host, "--port", str(self._port)]
+        if self._data_dir:
+            os.makedirs(self._data_dir, exist_ok=True)
+            cmd += ["--data-dir", self._data_dir]
         self._proc = subprocess.Popen(
-            [binary, "--host", self._host, "--port", str(self._port)],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         deadline = time.monotonic() + wait_s
         while time.monotonic() < deadline:
             if is_server_alive(self.endpoint, timeout=0.5):

@@ -20,6 +20,7 @@
 
 #include <atomic>
 #include <chrono>
+#include <fstream>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -70,18 +71,34 @@ class Store {
  public:
   // Revisions are seeded by wall-clock millis so they never regress across
   // restarts; watchers from a previous incarnation fall below floor_rev_
-  // and are told to re-list (parity: coordination/store.py).
-  Store()
-      : rev_(std::chrono::duration_cast<std::chrono::milliseconds>(
-                 std::chrono::system_clock::now().time_since_epoch())
-                 .count()),
-        floor_rev_(rev_),
-        sweeper_([this] { SweepLoop(); }) {}
+  // and are told to re-list (parity: coordination/store.py). When
+  // wal_path is non-empty, PERMANENT keys are durable across restarts
+  // via a length-prefixed msgpack WAL with startup compaction (leased
+  // keys stay ephemeral: their owners re-register within a TTL).
+  explicit Store(const std::string& wal_path = "")
+      : rev_(NowMs()), wal_path_(wal_path) {
+    if (!wal_path_.empty()) {
+      int64_t replayed = ReplayWal();
+      rev_ = std::max(NowMs(), replayed + (int64_t{1} << 20));
+      Compact();
+      wal_.open(wal_path_, std::ios::binary | std::ios::app);
+    }
+    floor_rev_ = rev_;
+    sweeper_ = std::thread([this] { SweepLoop(); });
+  }
+
+  static int64_t NowMs() {
+    return std::chrono::duration_cast<std::chrono::milliseconds>(
+               std::chrono::system_clock::now().time_since_epoch())
+        .count();
+  }
 
   ~Store() {
     stop_.store(true);
     cond_.notify_all();
-    sweeper_.join();
+    if (sweeper_.joinable()) sweeper_.join();
+    std::lock_guard<std::mutex> lk(mu_);
+    if (wal_.is_open()) wal_.close();
   }
 
   int64_t LeaseGrant(double ttl) {
@@ -245,9 +262,104 @@ class Store {
   }
 
  private:
+  // ---- WAL (callers hold mu_) ----------------------------------------
+
+  void WalWrite(const mp::Value& rec) {
+    if (!wal_.is_open()) return;
+    std::string body = mp::pack(rec);
+    uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+    wal_.write(reinterpret_cast<const char*>(&len), 4);
+    wal_.write(body.data(), static_cast<std::streamsize>(body.size()));
+    wal_.flush();
+  }
+
+  static mp::Value WalPutRec(const std::string& key, const std::string& v,
+                             bool is_bin) {
+    mp::Map m;
+    m.emplace_back(mp::Value::str("op"), mp::Value::str("put"));
+    m.emplace_back(mp::Value::str("k"), mp::Value::str(key));
+    m.emplace_back(mp::Value::str("v"),
+                   is_bin ? mp::Value::bin(v) : mp::Value::str(v));
+    return mp::Value::mapv(std::move(m));
+  }
+
+  static mp::Value WalDelRec(const std::string& key) {
+    mp::Map m;
+    m.emplace_back(mp::Value::str("op"), mp::Value::str("del"));
+    m.emplace_back(mp::Value::str("k"), mp::Value::str(key));
+    return mp::Value::mapv(std::move(m));
+  }
+
+  // returns the max watermarked revision found
+  int64_t ReplayWal() {
+    std::ifstream in(wal_path_, std::ios::binary);
+    int64_t watermark = 0;
+    if (!in.is_open()) return watermark;
+    size_t n_records = 0;
+    while (true) {
+      uint32_t len_be;
+      if (!in.read(reinterpret_cast<char*>(&len_be), 4)) break;
+      uint32_t len = ntohl(len_be);
+      std::string body(len, '\0');
+      if (!in.read(body.data(), len)) {
+        std::cerr << "WAL torn tail after " << n_records << " records"
+                  << std::endl;
+        break;
+      }
+      try {
+        mp::Value rec = mp::unpack(body);
+        const std::string& op = rec.get("op")->as_str();
+        if (op == "put") {
+          const mp::Value* v = rec.get("v");
+          PutLocked(rec.get("k")->as_str(), v->as_str(),
+                    v->type == mp::Value::Type::Bin, 0);
+        } else if (op == "del") {
+          DeleteLocked(rec.get("k")->as_str());
+        } else if (op == "rev") {
+          watermark = std::max(watermark, rec.get("r")->as_int());
+        }
+      } catch (const std::exception& e) {
+        std::cerr << "WAL corrupt after " << n_records
+                  << " records; discarding the rest (" << e.what() << ")"
+                  << std::endl;
+        break;
+      }
+      ++n_records;
+    }
+    return std::max(watermark, rev_);
+  }
+
+  void Compact() {
+    std::string tmp = wal_path_ + ".tmp";
+    {
+      std::ofstream out(tmp, std::ios::binary | std::ios::trunc);
+      mp::Map m;
+      m.emplace_back(mp::Value::str("op"), mp::Value::str("rev"));
+      m.emplace_back(mp::Value::str("r"), mp::Value::integer(rev_));
+      std::string body = mp::pack(mp::Value::mapv(std::move(m)));
+      uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+      out.write(reinterpret_cast<const char*>(&len), 4);
+      out.write(body.data(), static_cast<std::streamsize>(body.size()));
+      for (auto& kv : kv_) {
+        body = mp::pack(WalPutRec(kv.first, kv.second.value,
+                                  kv.second.value_is_bin));
+        len = htonl(static_cast<uint32_t>(body.size()));
+        out.write(reinterpret_cast<const char*>(&len), 4);
+        out.write(body.data(), static_cast<std::streamsize>(body.size()));
+      }
+    }
+    ::rename(tmp.c_str(), wal_path_.c_str());
+  }
+
   int64_t PutLocked(const std::string& key, const std::string& value,
                     bool is_bin, int64_t lease_id) {
     auto it = kv_.find(key);
+    if (lease_id == 0) {
+      WalWrite(WalPutRec(key, value, is_bin));
+    } else if (it != kv_.end() && it->second.lease_id == 0) {
+      // permanent value shadowed by an ephemeral one: WAL must forget it
+      WalWrite(WalDelRec(key));
+    }
     if (it != kv_.end() && it->second.lease_id &&
         it->second.lease_id != lease_id) {
       auto lit = leases_.find(it->second.lease_id);
@@ -274,6 +386,7 @@ class Store {
   bool DeleteLocked(const std::string& key) {
     auto it = kv_.find(key);
     if (it == kv_.end()) return false;
+    if (it->second.lease_id == 0) WalWrite(WalDelRec(key));
     if (it->second.lease_id) {
       auto lit = leases_.find(it->second.lease_id);
       if (lit != leases_.end()) lit->second.keys.erase(key);
@@ -312,6 +425,13 @@ class Store {
         leases_.erase(id);
         for (auto& k : keys) DeleteLocked(k);
       }
+      if (wal_.is_open() && rev_ > wal_watermark_) {
+        mp::Map m;
+        m.emplace_back(mp::Value::str("op"), mp::Value::str("rev"));
+        m.emplace_back(mp::Value::str("r"), mp::Value::integer(rev_));
+        WalWrite(mp::Value::mapv(std::move(m)));
+        wal_watermark_ = rev_;
+      }
     }
   }
 
@@ -321,9 +441,12 @@ class Store {
   std::map<int64_t, Lease> leases_;
   std::deque<Event> events_;
   int64_t rev_;
-  int64_t floor_rev_;
+  int64_t floor_rev_ = 0;
   int64_t next_lease_ = 1;
   std::atomic<bool> stop_{false};
+  std::string wal_path_;
+  std::ofstream wal_;
+  int64_t wal_watermark_ = 0;
   std::thread sweeper_;
 };
 
@@ -508,10 +631,14 @@ static void ServeConnection(Store* store, int fd) {
 
 int main(int argc, char** argv) {
   std::string host = "0.0.0.0";
+  std::string data_dir;
   int port = 2379;
   for (int i = 1; i < argc - 1; ++i) {
     if (std::string(argv[i]) == "--host") host = argv[i + 1];
     if (std::string(argv[i]) == "--port") port = std::atoi(argv[i + 1]);
+    if (std::string(argv[i]) == "--data-dir" ||
+        std::string(argv[i]) == "--data_dir")
+      data_dir = argv[i + 1];
   }
   signal(SIGPIPE, SIG_IGN);
 
@@ -540,7 +667,7 @@ int main(int argc, char** argv) {
   std::cerr << "edl_tpu_store (C++) serving on " << host << ":"
             << ntohs(addr.sin_port) << std::endl;
 
-  Store store;
+  Store store(data_dir.empty() ? "" : data_dir + "/store.wal");
   while (true) {
     int fd = accept(srv, nullptr, nullptr);
     if (fd < 0) continue;

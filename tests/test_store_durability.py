@@ -122,6 +122,43 @@ def test_non_string_values_rejected(tmp_path):
         s2.stop()
 
 
+def test_native_store_wal_durability(tmp_path):
+    """The C++ store's WAL: permanent keys survive a SIGKILL restart,
+    leased and shadowed values do not (parity with the Python backend)."""
+    import signal
+
+    from edl_tpu.coordination.native import NativeStoreServer, ensure_binary
+    try:
+        ensure_binary()
+    except Exception as e:
+        import pytest
+        pytest.skip("native store unavailable: %r" % e)
+    port_dir = str(tmp_path / "data")
+    s1 = NativeStoreServer(data_dir=port_dir)
+    s1.start()
+    c1 = CoordClient([s1.endpoint], root="jn")
+    c1.set_server_permanent("cluster", "cluster", '{"stage": "s1"}')
+    c1.put("/jn/raw", b"\x00\xff")
+    c1.set_server_permanent("svc", "shadow", "perm")
+    c1.set_server_with_lease("svc", "shadow", "eph", ttl=60)
+    c1.set_server_with_lease("resource", "pod", "x", ttl=60)
+    rev1 = c1.revision()
+    s1._proc.send_signal(signal.SIGKILL)  # hard crash
+    s1._proc.wait()
+
+    s2 = NativeStoreServer(port=s1._port, data_dir=port_dir)
+    s2.start()
+    try:
+        c2 = CoordClient([s2.endpoint], root="jn")
+        assert c2.get_value("cluster", "cluster") == '{"stage": "s1"}'
+        assert c2.get_key("/jn/raw")["value"] == b"\x00\xff"
+        assert c2.get_value("svc", "shadow") is None   # shadowed → gone
+        assert c2.get_value("resource", "pod") is None  # leased → gone
+        assert c2.revision() > rev1                     # no regression
+    finally:
+        s2.stop()
+
+
 def test_register_survives_store_restart(tmp_path):
     """A store crash/restart must not kill registered components: the
     register re-establishes its lease on the new store instance."""
