@@ -48,13 +48,37 @@ class BarrierServicer(object):
 
 class PodServer(object):
     """Per-pod RPC server hosting the barrier servicer (and, on the leader,
-    answering every pod's barrier calls)."""
+    answering every pod's barrier calls). Also exposes ``pod_stats`` — a
+    scrapeable observability endpoint (net-new; the reference had no
+    metrics surface, SURVEY.md §5.5)."""
 
-    def __init__(self, coord, pod):
+    def __init__(self, coord, pod, stats_fn=None):
         self._rpc = RpcServer(host="0.0.0.0", port=0)
         self._servicer = BarrierServicer(coord)
         self._rpc.register("barrier", self._servicer.barrier)
+        self._rpc.register("pod_stats", self._pod_stats)
+        self._coord = coord
+        self._stats_fn = stats_fn
         self._pod = pod
+
+    def _pod_stats(self):
+        try:  # a store hiccup must not fail the locally-known fields
+            cluster = cluster_mod.load_from_store(self._coord)
+        except Exception:
+            cluster = None
+        out = {
+            "pod_id": self._pod.id,
+            "pod_rank": self._pod.rank,
+            "cluster_stage": cluster.stage if cluster else None,
+            "cluster_size": len(cluster.pods) if cluster else 0,
+            "world_size": cluster.world_size() if cluster else 0,
+        }
+        if self._stats_fn is not None:
+            try:
+                out.update(self._stats_fn())
+            except Exception:  # stats must never break the barrier server
+                pass
+        return out
 
     def start(self):
         self._rpc.start()

@@ -44,8 +44,17 @@ class Launcher(object):
     def init(self):
         status.save_pod_status(self._coord, self._pod.id,
                                status.Status.INITIAL)
-        self._pod_server = barrier_mod.PodServer(self._coord,
-                                                 self._pod).start()
+
+        def stats():
+            return {
+                "trainers": [
+                    {"rank": tp.trainer.global_rank, "pid": tp.proc.pid,
+                     "alive": tp.proc.poll() is None}
+                    for tp in self._procs],
+            }
+
+        self._pod_server = barrier_mod.PodServer(
+            self._coord, self._pod, stats_fn=stats).start()
         logger.info("pod %s serving barrier on port %d", self._pod.id,
                     self._pod.port)
         return self

@@ -17,6 +17,7 @@ Design (TPU-first):
 """
 
 import os
+import threading
 import time
 
 import jax
@@ -127,7 +128,8 @@ class ElasticTrainer(object):
 
     def __init__(self, loss_fn, params, tx, total_batch_size,
                  checkpoint_dir=None, mesh=None, env=None, coord=None,
-                 keep_checkpoints=3, extra_state=None, has_aux=False):
+                 keep_checkpoints=3, extra_state=None, has_aux=False,
+                 async_save=False):
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -180,6 +182,8 @@ class ElasticTrainer(object):
         # host-side mirror of the step counter: seeds default rngs without
         # forcing a device sync on the donated step array every step
         self._host_step = 0
+        self._async_save = async_save
+        self._save_thread = None
 
     # -- the compiled step ---------------------------------------------------
 
@@ -249,14 +253,57 @@ class ElasticTrainer(object):
 
     def save(self):
         """Rank-0 writes the versioned checkpoint + State (reference:
-        rank0 fleet.save_check_point per epoch, train_with_fleet.py:562)."""
+        rank0 fleet.save_check_point per epoch, train_with_fleet.py:562).
+
+        With ``async_save=True`` the write overlaps training: the state is
+        copied ON DEVICE first (so later steps may donate the originals),
+        then a background thread fetches and writes it; the manifest-last
+        commit keeps partial writes invisible."""
         if self._ckpt is None or self.env.global_rank != 0:
             return
-        tree = jax.device_get(dict(self.train_state))
-        self._ckpt.save(self.global_step, tree,
-                        meta={"state": self.state.to_dict()})
+        self.wait_for_save()
+        version = self.global_step
+        # deep-snapshot the control-plane state NOW — the background writer
+        # must not see the live State's nested dicts mutating under it
+        import json
+        state_snapshot = json.loads(self.state.to_json())
+        meta = {"state": state_snapshot}
+        if not self._async_save:
+            tree = jax.device_get(dict(self.train_state))
+            self._ckpt.save(version, tree, meta=meta)
+            self._save_state_to_store(state_snapshot)
+            return
+        # immutable device-side snapshot, independent of donated buffers
+        snapshot = jax.tree_util.tree_map(jnp.copy, dict(self.train_state))
+
+        def _write():
+            try:
+                self._ckpt.save(version, jax.device_get(snapshot),
+                                meta=meta)
+                self._save_state_to_store(state_snapshot)
+            except Exception:
+                logger.exception("async checkpoint v%d failed", version)
+
+        # non-daemon + atexit join: process exit must not lose the final
+        # checkpoint mid-write (manifest-last keeps partials invisible,
+        # but losing the last epoch silently is still a regression)
+        self._save_thread = threading.Thread(
+            target=_write, daemon=False, name="ckpt-save-%d" % version)
+        self._save_thread.start()
+        import atexit
+        atexit.register(self.wait_for_save)
+
+    def wait_for_save(self):
+        """Block until any in-flight async checkpoint write finishes."""
+        if self._save_thread is not None:
+            self._save_thread.join()
+            self._save_thread = None
+
+    def _save_state_to_store(self, state_dict):
         if self.coord is not None:
-            state_mod.save_to_store(self.coord, self.state)
+            snap = state_mod.State()
+            snap.from_dict(dict(state_dict))
+            state_mod.save_to_store(self.coord, snap)
 
     def resume(self):
         """Restore the newest valid checkpoint; apply resize adjust hooks if

@@ -264,13 +264,33 @@ class Store {
  private:
   // ---- WAL (callers hold mu_) ----------------------------------------
 
-  void WalWrite(const mp::Value& rec) {
-    if (!wal_.is_open()) return;
+  static void WriteFramed(std::ostream& out, const mp::Value& rec) {
     std::string body = mp::pack(rec);
     uint32_t len = htonl(static_cast<uint32_t>(body.size()));
-    wal_.write(reinterpret_cast<const char*>(&len), 4);
-    wal_.write(body.data(), static_cast<std::streamsize>(body.size()));
+    out.write(reinterpret_cast<const char*>(&len), 4);
+    out.write(body.data(), static_cast<std::streamsize>(body.size()));
+  }
+
+  void WalWrite(const mp::Value& rec) {
+    if (!wal_.is_open()) return;
+    WriteFramed(wal_, rec);
     wal_.flush();
+  }
+
+  static mp::Value WalRevRec(int64_t rev) {
+    mp::Map m;
+    m.emplace_back(mp::Value::str("op"), mp::Value::str("rev"));
+    m.emplace_back(mp::Value::str("r"), mp::Value::integer(rev));
+    return mp::Value::mapv(std::move(m));
+  }
+
+  // null-safe field access for replayed records (corrupt bytes can decode
+  // as ANY valid msgpack — a missing key must be an exception, not UB)
+  static const mp::Value& Field(const mp::Value& rec, const char* key) {
+    const mp::Value* v = rec.get(key);
+    if (v == nullptr) throw std::runtime_error(
+        std::string("WAL record missing field ") + key);
+    return *v;
   }
 
   static mp::Value WalPutRec(const std::string& key, const std::string& v,
@@ -296,10 +316,16 @@ class Store {
     int64_t watermark = 0;
     if (!in.is_open()) return watermark;
     size_t n_records = 0;
+    static const uint32_t kMaxWalRecord = 64u << 20;  // 64 MB sanity cap
     while (true) {
       uint32_t len_be;
       if (!in.read(reinterpret_cast<char*>(&len_be), 4)) break;
       uint32_t len = ntohl(len_be);
+      if (len > kMaxWalRecord) {
+        std::cerr << "WAL torn/garbage length after " << n_records
+                  << " records" << std::endl;
+        break;
+      }
       std::string body(len, '\0');
       if (!in.read(body.data(), len)) {
         std::cerr << "WAL torn tail after " << n_records << " records"
@@ -308,15 +334,15 @@ class Store {
       }
       try {
         mp::Value rec = mp::unpack(body);
-        const std::string& op = rec.get("op")->as_str();
+        const std::string& op = Field(rec, "op").as_str();
         if (op == "put") {
-          const mp::Value* v = rec.get("v");
-          PutLocked(rec.get("k")->as_str(), v->as_str(),
-                    v->type == mp::Value::Type::Bin, 0);
+          const mp::Value& v = Field(rec, "v");
+          PutLocked(Field(rec, "k").as_str(), v.as_str(),
+                    v.type == mp::Value::Type::Bin, 0);
         } else if (op == "del") {
-          DeleteLocked(rec.get("k")->as_str());
+          DeleteLocked(Field(rec, "k").as_str());
         } else if (op == "rev") {
-          watermark = std::max(watermark, rec.get("r")->as_int());
+          watermark = std::max(watermark, Field(rec, "r").as_int());
         }
       } catch (const std::exception& e) {
         std::cerr << "WAL corrupt after " << n_records
@@ -331,28 +357,30 @@ class Store {
 
   void Compact() {
     std::string tmp = wal_path_ + ".tmp";
+    bool ok;
     {
       std::ofstream out(tmp, std::ios::binary | std::ios::trunc);
-      mp::Map m;
-      m.emplace_back(mp::Value::str("op"), mp::Value::str("rev"));
-      m.emplace_back(mp::Value::str("r"), mp::Value::integer(rev_));
-      std::string body = mp::pack(mp::Value::mapv(std::move(m)));
-      uint32_t len = htonl(static_cast<uint32_t>(body.size()));
-      out.write(reinterpret_cast<const char*>(&len), 4);
-      out.write(body.data(), static_cast<std::streamsize>(body.size()));
-      for (auto& kv : kv_) {
-        body = mp::pack(WalPutRec(kv.first, kv.second.value,
-                                  kv.second.value_is_bin));
-        len = htonl(static_cast<uint32_t>(body.size()));
-        out.write(reinterpret_cast<const char*>(&len), 4);
-        out.write(body.data(), static_cast<std::streamsize>(body.size()));
-      }
+      WriteFramed(out, WalRevRec(rev_));
+      for (auto& kv : kv_)
+        WriteFramed(out, WalPutRec(kv.first, kv.second.value,
+                                   kv.second.value_is_bin));
+      out.flush();
+      ok = out.good();
     }
-    ::rename(tmp.c_str(), wal_path_.c_str());
+    if (ok) {
+      ::rename(tmp.c_str(), wal_path_.c_str());
+    } else {
+      // never clobber a good WAL with a failed rewrite (ENOSPC etc.)
+      std::cerr << "WAL compaction write failed; keeping the original"
+                << std::endl;
+      ::remove(tmp.c_str());
+    }
   }
 
   int64_t PutLocked(const std::string& key, const std::string& value,
                     bool is_bin, int64_t lease_id) {
+    if (lease_id && leases_.find(lease_id) == leases_.end())
+      throw std::runtime_error("lease not found");
     auto it = kv_.find(key);
     if (lease_id == 0) {
       WalWrite(WalPutRec(key, value, is_bin));
@@ -426,10 +454,7 @@ class Store {
         for (auto& k : keys) DeleteLocked(k);
       }
       if (wal_.is_open() && rev_ > wal_watermark_) {
-        mp::Map m;
-        m.emplace_back(mp::Value::str("op"), mp::Value::str("rev"));
-        m.emplace_back(mp::Value::str("r"), mp::Value::integer(rev_));
-        WalWrite(mp::Value::mapv(std::move(m)));
+        WalWrite(WalRevRec(rev_));
         wal_watermark_ = rev_;
       }
     }

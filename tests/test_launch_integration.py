@@ -125,6 +125,39 @@ def test_elastic_resize_survives_pod_kill(store, tmp_path):
 
 
 @pytest.mark.integration
+def test_pod_stats_endpoint(store, tmp_path):
+    """The pod server's observability endpoint reports cluster + trainer
+    state while the job runs."""
+    from edl_tpu.controller.resource_pods import load_resource_pods
+    from edl_tpu.rpc.client import RpcClient
+
+    job = "launch_stats"
+    coord = store.client(root=job)
+    p1 = _spawn_launcher(store.endpoint, job, "1:1", tmp_path, "pod1",
+                         trainer_args=("15", "0"))
+    try:
+        c = _wait_cluster_size(coord, 1)
+        pods = load_resource_pods(coord)
+        pod = pods[c.pods[0].id]
+        deadline = time.monotonic() + 30
+        stats = None
+        while time.monotonic() < deadline:
+            client = RpcClient(pod.endpoint, timeout=5)
+            try:
+                stats = client.call("pod_stats")
+            finally:
+                client.close()
+            if stats.get("trainers"):
+                break
+            time.sleep(0.5)
+        assert stats["pod_id"] == c.pods[0].id
+        assert stats["cluster_size"] == 1 and stats["world_size"] == 1
+        assert stats["trainers"] and stats["trainers"][0]["alive"]
+    finally:
+        _kill_group(p1)
+
+
+@pytest.mark.integration
 def test_below_min_nodes_fails_job(store, tmp_path):
     job = "launch_below_min"
     coord = store.client(root=job)

@@ -150,6 +150,26 @@ def test_resume_preserves_adjust_hooks_and_extra_state(tmp_path):
     assert int(t3.extra_state["loader_pos"]) == 123
 
 
+def test_async_save_overlaps_donation(tmp_path):
+    """Async save snapshots on device, so continuing to train (which
+    donates the original buffers) cannot corrupt the checkpoint."""
+    trainer, make_batch, _ = _linreg_trainer(tmp_path, async_save=True)
+    for i in range(5):
+        trainer.train_step(make_batch(i))
+    trainer.begin_epoch(0)
+    trainer.end_epoch(save=True)  # async write of step-5 state
+    # keep training immediately — donates the buffers save() snapshotted
+    for i in range(5, 10):
+        trainer.train_step(make_batch(i))
+    trainer.wait_for_save()
+
+    trainer2, make_batch2, _ = _linreg_trainer(tmp_path)
+    assert trainer2.resume()
+    assert trainer2.global_step == 5  # the snapshot, not the later state
+    loss = float(trainer2.train_step(make_batch2(50)))
+    assert np.isfinite(loss)
+
+
 def test_trainer_batch_sharded_over_dp(tmp_path):
     trainer, make_batch, _ = _linreg_trainer(tmp_path)
     batch = trainer.shard_batch(make_batch(0))
