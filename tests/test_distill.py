@@ -110,6 +110,55 @@ def test_registry_and_discovery(coord):
         disc.stop()
 
 
+def test_multi_discovery_sharding_and_redirect(coord):
+    """Three discovery servers shard service names over the hash ring;
+    clients landing on a non-owner follow REDIRECTs to the owner, and all
+    clients of one service agree on the same teacher set."""
+    teachers = [nop_teacher({"logits": ([2], "<f4")}, max_batch=4,
+                            host="127.0.0.1").start() for _ in range(2)]
+    regs = [TeacherRegister(coord, "svc_m", t.endpoint, ttl=5).start()
+            for t in teachers]
+    servers = [DiscoveryServer(coord, host="127.0.0.1").start()
+               for _ in range(3)]
+    clients = []
+    try:
+        # wait until every discovery server sees all three peers
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if all(len(s._hash.nodes()) == 3 for s in servers):
+                break
+            time.sleep(0.2)
+        assert all(len(s._hash.nodes()) == 3 for s in servers)
+
+        # clients register against EVERY server; non-owners must redirect
+        for entry in servers:
+            c = DiscoveryClient(entry.endpoint, "svc_m",
+                                require_num=2).start()
+            clients.append(c)
+        views = [set(c.wait_for_servers(timeout=30)) for c in clients]
+        want = {t.endpoint for t in teachers}
+        assert all(v <= want and v for v in views), views
+        # exactly one discovery server owns the service
+        owners = {s._owner("svc_m") for s in servers}
+        assert len(owners) == 1
+        owner_ep = owners.pop()
+        stats = [s.stats() for s in servers]
+        with_clients = [st for st in stats if st.get("svc_m", {})
+                        .get("clients")]
+        assert len(with_clients) == 1  # only the owner holds the table
+        owner_idx = stats.index(with_clients[0])
+        assert servers[owner_idx].endpoint == owner_ep
+    finally:
+        for c in clients:
+            c.stop()
+        for s in servers:
+            s.stop()
+        for r in regs:
+            r.stop()
+        for t in teachers:
+            t.stop()
+
+
 def _echo_teacher(scale, port=0):
     def fn(feed):
         return {"soft_label": feed["img"] * scale}
