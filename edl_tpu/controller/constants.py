@@ -14,6 +14,7 @@ SERVICE_TRAIN_STATUS = "train_status"
 SERVICE_READER = "reader"
 SERVICE_STATE = "state"
 SERVICE_JOB_FLAG = "job_flag"
+SERVICE_METRICS = "metrics"
 
 LEADER_SERVER = "0"          # the single leader key
 CLUSTER_SERVER = "cluster"   # the single cluster-map key

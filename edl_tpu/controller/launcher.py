@@ -8,6 +8,7 @@ local chips; gradient communication is XLA collectives inside the trainer,
 so the launcher only manages membership + barrier + processes.
 """
 
+import json
 import time
 
 from edl_tpu.controller import barrier as barrier_mod
@@ -167,6 +168,7 @@ class Launcher(object):
         this pod was evicted by the new cluster map."""
         logger.info("membership changed; stop-resume resize on pod %s",
                     self._pod.id)
+        t0 = time.monotonic()
         train_process.terminate_trainers(self._procs)
         self._procs = []
         self._watcher.stop()
@@ -184,9 +186,31 @@ class Launcher(object):
         self._procs = train_process.start_trainers(
             self._job_env, self._pod, self._cluster, self._script,
             self._script_args, self._job_env.log_dir)
-        logger.info("resize complete: world=%d stage=%s",
-                    self._cluster.world_size(), self._cluster.stage)
+        recovery_s = time.monotonic() - t0
+        logger.info("resize complete: world=%d stage=%s (%.2fs)",
+                    self._cluster.world_size(), self._cluster.stage,
+                    recovery_s)
+        self._record_resize_metric(recovery_s)
         return True
+
+    def _record_resize_metric(self, recovery_s):
+        """Per-pod resize history under the metrics service, scrapeable by
+        drivers/operators (per-pod keys, so no cross-pod write races)."""
+        try:
+            raw = self._coord.get_value(constants.SERVICE_METRICS,
+                                        self._pod.id) or "[]"
+            history = json.loads(raw)[-19:]
+            history.append({
+                "stage": self._cluster.stage,
+                "world": self._cluster.world_size(),
+                "recovery_s": round(recovery_s, 2),
+                "ts": round(time.time(), 1),
+            })
+            self._coord.set_server_permanent(constants.SERVICE_METRICS,
+                                             self._pod.id,
+                                             json.dumps(history))
+        except Exception:
+            logger.exception("resize metric write failed")
 
     def _exit(self, ok):
         """Write the pod flag; the leader aggregates all flags into the job

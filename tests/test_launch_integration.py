@@ -119,6 +119,12 @@ def test_elastic_resize_survives_pod_kill(store, tmp_path):
         stages = {line.split("stage=")[1].split()[0]
                   for line in worker_log.splitlines() if "stage=" in line}
         assert len(stages) >= 2, worker_log
+        # resize metrics were recorded by the survivor
+        from edl_tpu.controller import constants
+        metrics = dict(coord.get_service(constants.SERVICE_METRICS))
+        assert metrics, "no resize metrics recorded"
+        history = json.loads(list(metrics.values())[0])
+        assert history and all(h["recovery_s"] >= 0 for h in history)
     finally:
         _kill_group(p1)
         _kill_group(p2)
@@ -174,6 +180,40 @@ def test_below_min_nodes_fails_job(store, tmp_path):
     finally:
         _kill_group(p1)
         _kill_group(p2)
+
+
+@pytest.mark.integration
+def test_two_pod_launch_on_native_store(tmp_path):
+    """The full elastic launch flow (election, generator, barrier,
+    supervision, flags) against the C++ coordination store binary."""
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.coordination.native import NativeStoreServer, ensure_binary
+    try:
+        ensure_binary()
+    except Exception as e:
+        pytest.skip("native store unavailable: %r" % e)
+    job = "launch_native"
+    with NativeStoreServer(data_dir=str(tmp_path / "wal")) as s:
+        coord = CoordClient([s.endpoint], root=job)
+        p1 = _spawn_launcher(s.endpoint, job, "2:2", tmp_path, "pod1")
+        p2 = _spawn_launcher(s.endpoint, job, "2:2", tmp_path, "pod2")
+        try:
+            assert (p1.wait(timeout=120), p2.wait(timeout=120)) == (0, 0), \
+                _dump_logs(tmp_path)
+            assert status.load_job_status(coord) == Status.SUCCEED
+            # and the verdict survived a WAL'd store restart
+            s.stop()
+            s2 = NativeStoreServer(port=s._port,
+                                   data_dir=str(tmp_path / "wal")).start()
+            try:
+                c2 = CoordClient([s2.endpoint], root=job)
+                assert status.load_job_status(c2) == Status.SUCCEED
+                assert cluster_mod.load_from_store(c2) is not None
+            finally:
+                s2.stop()
+        finally:
+            _kill_group(p1)
+            _kill_group(p2)
 
 
 @pytest.mark.integration
