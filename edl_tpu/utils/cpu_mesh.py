@@ -1,0 +1,22 @@
+"""Force a virtual n-device CPU platform for hermetic multi-chip tests.
+
+The axon TPU plugin's sitecustomize force-selects platform "axon" when
+PALLAS_AXON_POOL_IPS is set, overriding $JAX_PLATFORMS; and XLA only
+honours --xla_force_host_platform_device_count before backends
+initialize.  Both tests/conftest.py and the driver-facing
+``__graft_entry__.dryrun_multichip`` need the same recipe, so it lives
+here (no jax import — callers must apply it before jax initializes).
+"""
+
+
+def force_cpu_env(env, n_devices):
+    """Mutate ``env`` (a dict, e.g. os.environ or a subprocess env copy)
+    so that a fresh Python process sees ``n_devices`` virtual CPU devices
+    and never registers the axon TPU plugin. Returns ``env``."""
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=%d" % n_devices)
+    env["XLA_FLAGS"] = " ".join(flags)
+    return env

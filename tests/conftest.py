@@ -7,16 +7,15 @@ strategy (SURVEY.md §4).
 """
 
 import os
+import sys
 
-# must run before jax backends initialize
-os.environ["JAX_PLATFORMS"] = "cpu"
-# children spawned by integration tests must not register the TPU plugin
-# (its sitecustomize force-selects the axon platform)
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from edl_tpu.utils.cpu_mesh import force_cpu_env  # noqa: E402
+
+# must run before jax backends initialize; also scrubs the TPU plugin's
+# sitecustomize trigger so children spawned by integration tests stay on CPU
+force_cpu_env(os.environ, 8)
 
 import jax  # noqa: E402
 
