@@ -68,8 +68,14 @@ class LeaderElector(object):
         self._thread.join(timeout=self._ttl)
         if self._is_leader.is_set():
             try:
-                self._coord.remove_server(constants.SERVICE_LEADER,
-                                          constants.LEADER_SERVER)
+                # guarded: only delete the key if WE still hold it — if the
+                # lease silently expired (e.g. a pause longer than the TTL)
+                # and a successor already seized leadership, an unguarded
+                # delete would evict the successor and churn the election
+                key = self._coord.server_key(constants.SERVICE_LEADER,
+                                             constants.LEADER_SERVER)
+                self._coord.txn([(key, "value_eq", self._pod_id)],
+                                [("delete", key)])
             except errors.EdlError:
                 pass
             self._is_leader.clear()

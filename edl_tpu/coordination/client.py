@@ -108,8 +108,12 @@ class CoordClient(object):
     def service_prefix(self, service):
         return "/%s/%s/nodes/" % (self._root, service)
 
-    def _key(self, service, server):
+    def server_key(self, service, server):
+        """The raw store key for a (service, server) pair — for callers
+        composing guarded txns over service keys (e.g. leader stop)."""
         return self.service_prefix(service) + server
+
+    _key = server_key
 
     @property
     def root(self):

@@ -33,6 +33,14 @@ class KeyValue(object):
         self.mod_rev = mod_rev
 
 
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 def _wal_put(key, value):
     if isinstance(value, bytes):
         return {"op": "put", "k": key, "b": 1,
@@ -61,6 +69,7 @@ class Store(object):
         self._events = deque(maxlen=self.EVENT_HISTORY)
         self._stop = threading.Event()
         self._wal = None
+        self._wal_dirty = False
         self._wal_watermark = 0  # last rev watermarked into the WAL
         if wal_path:
             self._replay_wal(wal_path)
@@ -78,7 +87,10 @@ class Store(object):
                     f.write(json.dumps({"op": "rev", "r": self._rev}) + "\n")
                     for key, kv in sorted(self._kv.items()):
                         f.write(json.dumps(_wal_put(key, kv.value)) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, wal_path)
+            _fsync_dir(os.path.dirname(os.path.abspath(wal_path)))
             self._wal = open(wal_path, "a", buffering=1)
         self._floor_rev = self._rev  # below this = previous incarnation
         self._sweeper = threading.Thread(
@@ -119,6 +131,15 @@ class Store(object):
     def _log(self, rec):
         if self._wal is not None:
             self._wal.write(json.dumps(rec) + "\n")
+            self._wal_dirty = True
+
+    def _sync_locked(self):
+        """Group-commit: fsync the WAL once per public mutating op, before
+        the op is acknowledged (etcd fsyncs its WAL before acking)."""
+        if self._wal is not None and self._wal_dirty:
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            self._wal_dirty = False
 
     # -- internal helpers (hold self._lock) --------------------------------
 
@@ -190,6 +211,7 @@ class Store(object):
                 if self._wal is not None and self._rev > self._wal_watermark:
                     self._log({"op": "rev", "r": self._rev})
                     self._wal_watermark = self._rev
+                self._sync_locked()
 
     # -- public API --------------------------------------------------------
 
@@ -227,18 +249,23 @@ class Store(object):
                 return False
             for k in list(lease[2]):
                 self._delete_locked(k)
+            self._sync_locked()
             return True
 
     def put(self, key, value, lease_id=None):
         with self._lock:
-            return self._put_locked(key, value, lease_id)
+            rev = self._put_locked(key, value, lease_id)
+            self._sync_locked()
+            return rev
 
     def put_if_absent(self, key, value, lease_id=None):
         """The election primitive: returns (True, rev) only if key was free."""
         with self._lock:
             if key in self._kv:
                 return False, self._kv[key].mod_rev
-            return True, self._put_locked(key, value, lease_id)
+            rev = self._put_locked(key, value, lease_id)
+            self._sync_locked()
+            return True, rev
 
     def get(self, key):
         with self._lock:
@@ -259,13 +286,16 @@ class Store(object):
 
     def delete(self, key):
         with self._lock:
-            return self._delete_locked(key) is not None
+            rev = self._delete_locked(key)
+            self._sync_locked()
+            return rev is not None
 
     def delete_prefix(self, prefix):
         with self._lock:
             keys = [k for k in self._kv if k.startswith(prefix)]
             for k in keys:
                 self._delete_locked(k)
+            self._sync_locked()
             return len(keys)
 
     def txn(self, compares, on_success, on_failure=()):
@@ -303,6 +333,7 @@ class Store(object):
                     self._delete_locked(action[1])
                 else:
                     raise ValueError("bad txn action %r" % (action,))
+            self._sync_locked()
             return ok, self._rev
 
     def wait_events(self, prefix, since_rev, timeout):

@@ -11,33 +11,60 @@ Reference parity: edl/distill/balance_table.py Service.rebalance (:139-338)
 """
 
 import threading
+import time
 
 from edl_tpu.utils.logger import logger
 
+# heartbeats arrive every 2s (discovery_client.py); a client silent for
+# 5 intervals is gone — elastic resizes restart trainers with fresh pids,
+# so crashed students would otherwise accumulate as ghost clients forever,
+# inflating the per-server cap and pinning teachers to dead students
+# (reference: balance_table liveness cleanup)
+CLIENT_TTL = 10.0
+
 
 class _Client(object):
-    __slots__ = ("id", "require", "servers", "version")
+    __slots__ = ("id", "require", "servers", "version", "last_seen")
 
-    def __init__(self, cid, require):
+    def __init__(self, cid, require, now):
         self.id = cid
         self.require = max(1, require)
         self.servers = set()
         self.version = 0
+        self.last_seen = now
 
 
 class Service(object):
     """One distill service: a set of teacher servers and student clients."""
 
-    def __init__(self, name):
+    def __init__(self, name, client_ttl=CLIENT_TTL, clock=time.monotonic):
         self.name = name
         self._lock = threading.Lock()
         self._servers = {}   # endpoint -> set(client_id)
         self._clients = {}   # client_id -> _Client
+        self._client_ttl = client_ttl
+        self._clock = clock
+
+    def _evict_stale_locked(self):
+        """Drop clients whose last heartbeat is older than the TTL, then
+        rebalance so their capacity returns to live clients."""
+        cutoff = self._clock() - self._client_ttl
+        stale = [cid for cid, c in self._clients.items()
+                 if c.last_seen < cutoff]
+        for cid in stale:
+            c = self._clients.pop(cid)
+            for ep in c.servers:
+                self._servers.get(ep, set()).discard(cid)
+            logger.info("balance: evicted stale client %s (service %s)",
+                        cid, self.name)
+        if stale:
+            self._rebalance()
 
     # -- membership ------------------------------------------------------------
 
     def set_servers(self, endpoints):
         with self._lock:
+            self._evict_stale_locked()
             endpoints = set(endpoints)
             for ep in list(self._servers):
                 if ep not in endpoints:
@@ -52,10 +79,13 @@ class Service(object):
 
     def register_client(self, client_id, require_num):
         with self._lock:
+            self._evict_stale_locked()
             if client_id not in self._clients:
-                self._clients[client_id] = _Client(client_id, require_num)
+                self._clients[client_id] = _Client(
+                    client_id, require_num, self._clock())
                 self._rebalance()
             c = self._clients[client_id]
+            c.last_seen = self._clock()
             return {"version": c.version, "servers": sorted(c.servers)}
 
     def unregister_client(self, client_id):
@@ -72,9 +102,11 @@ class Service(object):
         """Returns {"version", "servers"} — servers only when the client's
         view is stale (reference: versioned heartbeat, discovery_client)."""
         with self._lock:
+            self._evict_stale_locked()
             c = self._clients.get(client_id)
             if c is None:
                 return None
+            c.last_seen = self._clock()
             if c.version == version:
                 return {"version": version}
             return {"version": c.version, "servers": sorted(c.servers)}

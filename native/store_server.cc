@@ -12,13 +12,16 @@
 // Run:   edl_tpu_store --host 0.0.0.0 --port 2379
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <fstream>
 #include <condition_variable>
@@ -81,7 +84,14 @@ class Store {
       int64_t replayed = ReplayWal();
       rev_ = std::max(NowMs(), replayed + (int64_t{1} << 20));
       Compact();
-      wal_.open(wal_path_, std::ios::binary | std::ios::app);
+      // replayed puts sit below floor_rev_ and are never delivered, but
+      // would consume the bounded event history and shrink the watch
+      // catch-up window after a restart with a large WAL (store.py parity)
+      events_.clear();
+      wal_fd_ = ::open(wal_path_.c_str(),
+                       O_WRONLY | O_APPEND | O_CREAT, 0644);
+      if (wal_fd_ < 0)
+        std::cerr << "WAL open failed: " << strerror(errno) << std::endl;
     }
     floor_rev_ = rev_;
     sweeper_ = std::thread([this] { SweepLoop(); });
@@ -98,7 +108,11 @@ class Store {
     cond_.notify_all();
     if (sweeper_.joinable()) sweeper_.join();
     std::lock_guard<std::mutex> lk(mu_);
-    if (wal_.is_open()) wal_.close();
+    if (wal_fd_ >= 0) {
+      WalSync();
+      ::close(wal_fd_);
+      wal_fd_ = -1;
+    }
   }
 
   int64_t LeaseGrant(double ttl) {
@@ -129,13 +143,16 @@ class Store {
     auto keys = it->second.keys;
     leases_.erase(it);
     for (auto& k : keys) DeleteLocked(k);
+    WalSync();
     return true;
   }
 
   int64_t Put(const std::string& key, const std::string& value,
               bool is_bin, int64_t lease_id) {
     std::lock_guard<std::mutex> lk(mu_);
-    return PutLocked(key, value, is_bin, lease_id);
+    int64_t rev = PutLocked(key, value, is_bin, lease_id);
+    WalSync();
+    return rev;
   }
 
   std::pair<bool, int64_t> PutIfAbsent(const std::string& key,
@@ -144,7 +161,9 @@ class Store {
     std::lock_guard<std::mutex> lk(mu_);
     auto it = kv_.find(key);
     if (it != kv_.end()) return {false, it->second.mod_rev};
-    return {true, PutLocked(key, value, is_bin, lease_id)};
+    int64_t rev = PutLocked(key, value, is_bin, lease_id);
+    WalSync();
+    return {true, rev};
   }
 
   bool Get(const std::string& key, KeyValue* out) {
@@ -168,7 +187,9 @@ class Store {
 
   bool Delete(const std::string& key) {
     std::lock_guard<std::mutex> lk(mu_);
-    return DeleteLocked(key);
+    bool ok = DeleteLocked(key);
+    WalSync();
+    return ok;
   }
 
   int64_t DeletePrefix(const std::string& prefix) {
@@ -179,6 +200,7 @@ class Store {
          ++it)
       keys.push_back(it->first);
     for (auto& k : keys) DeleteLocked(k);
+    WalSync();
     return static_cast<int64_t>(keys.size());
   }
 
@@ -227,6 +249,7 @@ class Store {
         throw std::runtime_error("bad txn action: " + kind);
       }
     }
+    WalSync();
     return {ok, rev_};
   }
 
@@ -264,17 +287,54 @@ class Store {
  private:
   // ---- WAL (callers hold mu_) ----------------------------------------
 
-  static void WriteFramed(std::ostream& out, const mp::Value& rec) {
+  static void AppendFramed(std::string* out, const mp::Value& rec) {
     std::string body = mp::pack(rec);
     uint32_t len = htonl(static_cast<uint32_t>(body.size()));
-    out.write(reinterpret_cast<const char*>(&len), 4);
-    out.write(body.data(), static_cast<std::streamsize>(body.size()));
+    out->append(reinterpret_cast<const char*>(&len), 4);
+    out->append(body);
+  }
+
+  static bool WriteAll(int fd, const std::string& buf) {
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t w = ::write(fd, buf.data() + off, buf.size() - off);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      off += static_cast<size_t>(w);
+    }
+    return true;
   }
 
   void WalWrite(const mp::Value& rec) {
-    if (!wal_.is_open()) return;
-    WriteFramed(wal_, rec);
-    wal_.flush();
+    if (wal_fd_ < 0) return;
+    std::string frame;
+    AppendFramed(&frame, rec);
+    if (!WriteAll(wal_fd_, frame))
+      std::cerr << "WAL append failed: " << strerror(errno) << std::endl;
+    wal_dirty_ = true;
+  }
+
+  // Group-commit: fdatasync once per public mutating op, before the op is
+  // acknowledged (etcd fsyncs its WAL before acking). Callers hold mu_.
+  void WalSync() {
+    if (wal_fd_ >= 0 && wal_dirty_) {
+      if (::fdatasync(wal_fd_) != 0)
+        std::cerr << "WAL fdatasync failed: " << strerror(errno) << std::endl;
+      wal_dirty_ = false;
+    }
+  }
+
+  static void FsyncDirOf(const std::string& file_path) {
+    std::string dir = ".";
+    size_t slash = file_path.find_last_of('/');
+    if (slash != std::string::npos) dir = file_path.substr(0, slash);
+    int dfd = ::open(dir.c_str(), O_RDONLY);
+    if (dfd >= 0) {
+      ::fsync(dfd);
+      ::close(dfd);
+    }
   }
 
   static mp::Value WalRevRec(int64_t rev) {
@@ -357,18 +417,22 @@ class Store {
 
   void Compact() {
     std::string tmp = wal_path_ + ".tmp";
-    bool ok;
-    {
-      std::ofstream out(tmp, std::ios::binary | std::ios::trunc);
-      WriteFramed(out, WalRevRec(rev_));
-      for (auto& kv : kv_)
-        WriteFramed(out, WalPutRec(kv.first, kv.second.value,
-                                   kv.second.value_is_bin));
-      out.flush();
-      ok = out.good();
+    std::string snapshot;
+    AppendFramed(&snapshot, WalRevRec(rev_));
+    for (auto& kv : kv_)
+      AppendFramed(&snapshot, WalPutRec(kv.first, kv.second.value,
+                                        kv.second.value_is_bin));
+    bool ok = false;
+    int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd >= 0) {
+      // the snapshot must be on disk BEFORE the rename makes it the WAL,
+      // or a host crash could leave a truncated file under the real name
+      ok = WriteAll(fd, snapshot) && ::fsync(fd) == 0;
+      ::close(fd);
     }
     if (ok) {
       ::rename(tmp.c_str(), wal_path_.c_str());
+      FsyncDirOf(wal_path_);
     } else {
       // never clobber a good WAL with a failed rewrite (ENOSPC etc.)
       std::cerr << "WAL compaction write failed; keeping the original"
@@ -453,10 +517,11 @@ class Store {
         leases_.erase(id);
         for (auto& k : keys) DeleteLocked(k);
       }
-      if (wal_.is_open() && rev_ > wal_watermark_) {
+      if (wal_fd_ >= 0 && rev_ > wal_watermark_) {
         WalWrite(WalRevRec(rev_));
         wal_watermark_ = rev_;
       }
+      WalSync();
     }
   }
 
@@ -470,7 +535,8 @@ class Store {
   int64_t next_lease_ = 1;
   std::atomic<bool> stop_{false};
   std::string wal_path_;
-  std::ofstream wal_;
+  int wal_fd_ = -1;
+  bool wal_dirty_ = false;
   int64_t wal_watermark_ = 0;
   std::thread sweeper_;
 };

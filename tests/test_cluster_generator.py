@@ -102,6 +102,20 @@ def test_leader_elector_failover(coord):
     assert events[0] == "e1+" and "e2+" in events
 
 
+def test_leader_stop_does_not_evict_successor(coord):
+    """stop() on a stale leader must not delete a successor's key: the
+    delete is guarded on the key still holding OUR pod id (ADVICE r1)."""
+    e1 = LeaderElector(coord, "pod_1").start()
+    _wait(lambda: e1.is_leader())
+    # simulate a silent lease expiry + successor seize while e1 still
+    # believes it leads (e.g. a process pause longer than the TTL)
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "pod_2")
+    assert e1.is_leader()
+    e1.stop()
+    assert get_leader_id(coord) == "pod_2"  # successor untouched
+
+
 def test_barrier_all_pods_get_cluster(coord):
     pod_a, pod_b = _pod(), _pod()
     regs = [ResourceRegister(coord, pod_a)]

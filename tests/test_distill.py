@@ -64,6 +64,28 @@ def test_balance_invariants():
     assert changed
 
 
+def test_balance_evicts_stale_clients():
+    """Crashed students (no heartbeat for > TTL) must be evicted so their
+    capacity returns to live clients — elastic resizes restart trainers
+    with fresh pids, so ghosts would otherwise accumulate forever."""
+    now = [0.0]
+    svc = Service("s", client_ttl=10.0, clock=lambda: now[0])
+    svc.set_servers(["t1", "t2"])
+    svc.register_client("ghost", require_num=2)
+    svc.register_client("live", require_num=2)
+    assert set(svc.stats()["clients"]) == {"ghost", "live"}
+
+    # only "live" heartbeats; ghost goes silent past the TTL
+    for t in (4.0, 8.0, 12.0):
+        now[0] = t
+        assert svc.heartbeat("live", -1) is not None
+    stats = svc.stats()
+    assert "ghost" not in stats["clients"]
+    assert svc.heartbeat("ghost", -1) is None  # must re-register
+    # live client now gets the full fleet (per_client = 2//1 = 2)
+    assert len(stats["clients"]["live"]) == 2
+
+
 def test_teacher_server_pad_and_slice():
     def fn(feed):
         return {"out": feed["x"] * 2.0}
