@@ -128,6 +128,107 @@ class Bert(nn.Module):
                         param_dtype=jnp.float32, name="classifier")(pooled)
 
 
+class BertEmbed(nn.Module):
+    """The pipeline ``encode`` end: token ids → activations (stage 0)."""
+    vocab_size: int
+    d_model: int
+    max_len: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, input_ids):
+        s = input_ids.shape[1]
+        word = nn.Embed(self.vocab_size, self.d_model,
+                        param_dtype=jnp.float32, dtype=self.dtype,
+                        name="word_embed")(input_ids)
+        pos = nn.Embed(self.max_len, self.d_model, param_dtype=jnp.float32,
+                       dtype=self.dtype,
+                       name="pos_embed")(jnp.arange(s)[None, :])
+        return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                            name="ln_embed")(word + pos)
+
+
+class BertStage(nn.Module):
+    """One pipeline stage: ``layers_per_stage`` BertLayers, activation →
+    activation (the uniform ring body for pipeline_value_and_grad)."""
+    layers_per_stage: int
+    num_heads: int
+    mlp_dim: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.layers_per_stage):
+            x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
+                          name="layer_%d" % i)(x)
+        return x
+
+
+class BertHead(nn.Module):
+    """The pipeline ``decode`` end: activations → logits (last stage)."""
+    d_model: int
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x):
+        pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32,
+                                   param_dtype=jnp.float32,
+                                   name="pooler")(x[:, 0]))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="classifier")(pooled)
+
+
+def create_bert_pipeline(pp, num_layers=4, d_model=64, num_heads=4,
+                         mlp_dim=128, vocab_size=1000, max_len=128,
+                         num_classes=2, seq_len=16, dtype=jnp.bfloat16,
+                         seed=0):
+    """A BERT classifier factored for pipeline parallelism.
+
+    Returns (params, encode_fn, stage_fn, decode_fn, sequential_loss):
+    params = {"encode", "stages" (leading stage axis [pp, ...]), "decode"}
+    for ``pipeline_value_and_grad``; ``sequential_loss(params, ids,
+    labels)`` is the numerically-identical unpipelined composite for
+    grad-equivalence tests and single-chip runs.
+    """
+    if num_layers % pp != 0:
+        raise ValueError("num_layers %d not divisible by pp %d"
+                         % (num_layers, pp))
+    embed = BertEmbed(vocab_size, d_model, max_len, dtype)
+    stage = BertStage(num_layers // pp, num_heads, mlp_dim, dtype)
+    head = BertHead(d_model, num_classes)
+
+    root = jax.random.PRNGKey(seed)
+    k_embed, k_head, *k_stages = jax.random.split(root, 2 + pp)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    p_enc = embed.init(k_embed, ids)["params"]
+    act = embed.apply({"params": p_enc}, ids)
+    per_stage = [stage.init(k, act)["params"] for k in k_stages]
+    p_stages = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage)
+    p_dec = head.init(k_head, act)["params"]
+    params = {"encode": p_enc, "stages": p_stages, "decode": p_dec}
+
+    def encode_fn(p, batch_x):
+        return embed.apply({"params": p}, batch_x)
+
+    def stage_fn(p, x):
+        return stage.apply({"params": p}, x)
+
+    def decode_fn(p, x, labels):
+        logits = head.apply({"params": p}, x)
+        one_hot = jax.nn.one_hot(labels, num_classes)
+        return optax.softmax_cross_entropy(logits, one_hot).mean()
+
+    def sequential_loss(params, batch_x, labels):
+        x = encode_fn(params["encode"], batch_x)
+        for s in range(pp):
+            p_s = jax.tree_util.tree_map(lambda a: a[s], params["stages"])
+            x = stage_fn(p_s, x)
+        return decode_fn(params["decode"], x, labels)
+
+    return params, encode_fn, stage_fn, decode_fn, sequential_loss
+
+
 def bert_base(**kw):
     return Bert(**kw)
 

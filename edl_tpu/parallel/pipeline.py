@@ -1,13 +1,28 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over the ``pp``
-mesh axis with shard_map + ppermute activation transfer.
+"""Pipeline parallelism over the ``pp`` mesh axis with shard_map + ppermute.
 
 Net-new vs the reference (model parallelism was only a roadmap bullet,
 SURVEY.md §2.7) — completes the framework's mesh axes (dp/tp/sp/pp/ep).
 Each pipeline stage's parameters live only on its pp slice; activations hop
-stage-to-stage over ICI via `lax.ppermute` on the classic GPipe schedule
-(M microbatches over P stages in M + P - 1 ticks). Differentiable: the
-loop has static bounds and ppermute transposes to the reverse hop, so
-jax.grad runs the reverse schedule automatically.
+stage-to-stage over ICI via `lax.ppermute`.
+
+Two schedules:
+
+- ``pipeline_apply``: GPipe forward (M microbatches over P stages in
+  M + P - 1 ticks), differentiable through jax.grad (which replays the
+  reverse schedule but stores every tick's activations — memory O(M)).
+- ``pipeline_value_and_grad``: 1F1B (PipeDream-flush) training schedule.
+  Each stage interleaves one forward with one backward per round trip, so
+  at most P - stage_idx microbatch activations are live per stage
+  (memory O(P), independent of M) — and only the stage INPUT is saved;
+  the stage body is recomputed inside the backward vjp (remat). Gradients
+  for stage params come out pp-sharded, ready for a pp-sharded optimizer.
+
+Shape changes are handled at the pipeline ends: ``encode_fn`` (e.g. token
+embedding: int ids → activations, evaluated on stage 0) and ``decode_fn``
+(activations + labels → scalar loss, evaluated on the last stage). The
+repeated stage body must map the activation pytree to itself — an inherent
+property of an SPMD ring, not a restriction: any network of the form
+encode → uniform-block^N → head fits (BERT/GPT/ViT/ResNet stages).
 """
 
 import functools
@@ -18,7 +33,9 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from edl_tpu.runtime.mesh import PIPE_AXIS
+from edl_tpu.runtime.mesh import DATA_AXIS, PIPE_AXIS
+
+_tmap = jax.tree_util.tree_map
 
 
 def _pipeline_shard(stage_params, microbatches, *, stage_fn, num_stages,
@@ -86,6 +103,189 @@ def pipeline_apply(stage_params, x, stage_fn, mesh, num_micro=None,
         check_vma=False)
     out = fn(stage_params, microbatches)
     return out.reshape((batch,) + out.shape[2:])
+
+
+def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
+                     num_stages, num_micro, axis_name, batch_axes,
+                     n_batch):
+    """1F1B on one pp slice (all stages run this SPMD; ``idx`` picks the
+    role). Schedule (fwd cost == bwd slot): stage s runs forward of
+    microbatch m at tick s + 2m and backward of m at tick 2P-1-s + 2m —
+    opposite parities, so each tick is exactly one of {fwd, bwd, idle},
+    picked with lax.cond (real control flow under shard_map, not select).
+    """
+    nP, M = num_stages, num_micro
+    idx = lax.axis_index(axis_name)
+    p_enc, p_dec = params["encode"], params["decode"]
+    p_stage = _tmap(lambda a: a[0], params["stages"])  # this slice's stage
+
+    mb = xs.shape[0] // M
+    xmb = _tmap(lambda a: a.reshape((M, mb) + a.shape[1:]), xs)
+    ymb = _tmap(lambda a: a.reshape((M, mb) + a.shape[1:]), ys)
+
+    def take(tree, m):
+        return _tmap(lambda a: a[m], tree)
+
+    # activation template: everything the ring carries is act-shaped
+    act = jax.eval_shape(encode_fn, p_enc, take(xmb, 0))
+    out_shape = jax.eval_shape(stage_fn, p_stage, act)
+    if (jax.tree_util.tree_structure(out_shape)
+            != jax.tree_util.tree_structure(act) or
+        any(a.shape != b.shape or a.dtype != b.dtype
+            for a, b in zip(jax.tree_util.tree_leaves(act),
+                            jax.tree_util.tree_leaves(out_shape)))):
+        raise ValueError(
+            "stage_fn must map the activation pytree to itself "
+            "(encode output %s, stage output %s)" % (act, out_shape))
+
+    zeros_act = _tmap(lambda s: jnp.zeros(s.shape, s.dtype), act)
+    fwd_perm = [(i, (i + 1) % nP) for i in range(nP)]
+    bwd_perm = [((i + 1) % nP, i) for i in range(nP)]
+
+    state = dict(
+        fwd_carry=zeros_act,
+        bwd_carry=zeros_act,
+        # ring buffer of saved stage INPUTS: 1F1B holds <= P in flight
+        buf=_tmap(lambda s: jnp.zeros((nP,) + s.shape, s.dtype), act),
+        g_enc=_tmap(jnp.zeros_like, p_enc),
+        g_stage=_tmap(jnp.zeros_like, p_stage),
+        g_dec=_tmap(jnp.zeros_like, p_dec),
+        loss=jnp.zeros((), jnp.float32),
+    )
+
+    def masked_add(acc, new, valid):
+        return _tmap(lambda a, n: a + jnp.where(valid, n, 0).astype(a.dtype),
+                     acc, new)
+
+    def tick(t, state):
+        tf = t - idx                   # forward clock of this stage
+
+        def do_fwd(state):
+            m_f = tf // 2
+            valid = jnp.logical_and(m_f >= 0, m_f < M)
+            m = jnp.clip(m_f, 0, M - 1)
+            x_in = lax.cond(
+                idx == 0,
+                lambda: encode_fn(p_enc, take(xmb, m)),
+                lambda: state["fwd_carry"])
+            y = stage_fn(p_stage, x_in)
+            slot = m % nP
+            buf = _tmap(
+                lambda b, v: jnp.where(
+                    valid, lax.dynamic_update_index_in_dim(b, v, slot, 0), b),
+                state["buf"], x_in)
+            out = dict(state, buf=buf)
+            return out, y, zeros_act
+
+        def do_bwd(state):
+            tb = t - (2 * nP - 1 - idx)    # backward clock
+            m_b = tb // 2
+            valid = jnp.logical_and(tb >= 0, m_b < M)
+            m = jnp.clip(m_b, 0, M - 1)
+            slot = m % nP
+            x_saved = _tmap(lambda b: b[slot], state["buf"])
+
+            def last_stage():
+                # fold the head + loss into the last stage's backward;
+                # seed 1/M so accumulated grads are the microbatch mean
+                def comp(ps, pd, x):
+                    return decode_fn(pd, stage_fn(ps, x), take(ymb, m))
+                loss_m, vjp = jax.vjp(comp, p_stage, p_dec, x_saved)
+                gs, gd, gx = vjp(jnp.float32(1.0 / M))
+                return loss_m, gs, gd, gx
+
+            def mid_stage():
+                _, vjp = jax.vjp(stage_fn, p_stage, x_saved)
+                gs, gx = vjp(state["bwd_carry"])
+                return (jnp.zeros((), jnp.float32), gs,
+                        _tmap(jnp.zeros_like, p_dec), gx)
+
+            loss_m, gs, gd, gx = lax.cond(idx == nP - 1, last_stage,
+                                          mid_stage)
+            ge = lax.cond(
+                idx == 0,
+                lambda: jax.vjp(
+                    lambda p: encode_fn(p, take(xmb, m)), p_enc)[1](gx)[0],
+                lambda: _tmap(jnp.zeros_like, p_enc))
+            out = dict(
+                state,
+                g_stage=masked_add(state["g_stage"], gs, valid),
+                g_dec=masked_add(state["g_dec"], gd, valid),
+                g_enc=masked_add(state["g_enc"], ge, valid),
+                loss=state["loss"]
+                + jnp.where(valid, loss_m, 0).astype(jnp.float32) / M)
+            return out, zeros_act, gx
+
+        state, y_send, g_send = lax.cond(tf % 2 == 0, do_fwd, do_bwd, state)
+        state["fwd_carry"] = _tmap(
+            lambda v: lax.ppermute(v, axis_name, fwd_perm), y_send)
+        state["bwd_carry"] = _tmap(
+            lambda v: lax.ppermute(v, axis_name, bwd_perm), g_send)
+        return state
+
+    state = lax.fori_loop(0, 2 * (nP + M) - 2, tick, state)
+
+    # encode/decode grads + loss live on one stage each → share over pp;
+    # then reduce everything over the batch axes (dp and friends)
+    reduce_axes = (axis_name,) + tuple(batch_axes)
+    g_enc = _tmap(lambda g: lax.psum(g, reduce_axes) / n_batch,
+                  state["g_enc"])
+    g_dec = _tmap(lambda g: lax.psum(g, reduce_axes) / n_batch,
+                  state["g_dec"])
+    loss = lax.psum(state["loss"], reduce_axes) / n_batch
+    g_stage = _tmap(lambda g: g[None], state["g_stage"])
+    if batch_axes:
+        g_stage = _tmap(
+            lambda g: lax.psum(g, tuple(batch_axes)) / n_batch, g_stage)
+    return loss, {"encode": g_enc, "stages": g_stage, "decode": g_dec}
+
+
+def pipeline_value_and_grad(params, x, y, *, encode_fn, stage_fn, decode_fn,
+                            mesh, num_micro=None, pipe_axis=PIPE_AXIS,
+                            batch_axes=None):
+    """(loss, grads) of a pipelined network on the 1F1B schedule.
+
+    params: {"encode": pytree, "stages": pytree with leading stage axis
+    [P, ...] (sharded over pp), "decode": pytree}. The network is
+    ``decode_fn(p_dec, stage^P(encode_fn(p_enc, x)), y)``; loss is the
+    mean over microbatches (decode_fn must return a per-microbatch mean).
+    x/y batch dims are sharded over ``batch_axes`` (defaults to ("dp",)
+    when present in the mesh); grads are psum-reduced over them and
+    returned with "stages" still pp-sharded.
+    """
+    num_stages = mesh.shape[pipe_axis]
+    if batch_axes is None:
+        batch_axes = tuple(
+            ax for ax in (DATA_AXIS,)
+            if ax in mesh.shape and mesh.shape[ax] > 1)
+    num_micro = num_micro or num_stages
+    batch = jax.tree_util.tree_leaves(x)[0].shape[0]
+    shard = 1
+    for ax in batch_axes:
+        shard *= mesh.shape[ax]
+    if (batch // shard) % num_micro != 0:
+        raise ValueError(
+            "per-shard batch %d not divisible by %d microbatches"
+            % (batch // shard, num_micro))
+
+    data_spec = P(tuple(batch_axes) if batch_axes else None)
+    param_specs = {
+        "encode": _tmap(lambda _: P(), params["encode"]),
+        "stages": _tmap(lambda _: P(pipe_axis), params["stages"]),
+        "decode": _tmap(lambda _: P(), params["decode"]),
+    }
+    fn = shard_map(
+        functools.partial(_pipe_1f1b_shard, encode_fn=encode_fn,
+                          stage_fn=stage_fn, decode_fn=decode_fn,
+                          num_stages=num_stages, num_micro=num_micro,
+                          axis_name=pipe_axis, batch_axes=tuple(batch_axes),
+                          n_batch=shard),
+        mesh=mesh,
+        in_specs=(param_specs, data_spec, data_spec),
+        out_specs=(P(), {"encode": P(), "stages": P(pipe_axis),
+                         "decode": P()}),
+        check_vma=False)
+    return fn(params, x, y)
 
 
 def sequential_apply(stage_params, x, stage_fn):
