@@ -98,6 +98,11 @@ class Bert(nn.Module):
     use_ring: bool = False
     use_flash: bool = False
     mesh: Any = None
+    # activation recompute: save only layer-boundary activations and
+    # recompute layer internals (attention scores, MLP hidden) in the
+    # backward pass — the TPU equivalent of the reference's recompute
+    # checkpointing knob (train_with_fleet.py:322-325)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
@@ -115,8 +120,9 @@ class Bert(nn.Module):
                              name="type_embed")(token_type_ids)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_embed")(x)
+        layer_cls = nn.remat(BertLayer) if self.remat else BertLayer
         for i in range(self.num_layers):
-            x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
+            x = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
                           self.use_ring, self.use_flash, self.mesh,
                           name="layer_%d" % i)(x, attention_mask)
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32,
@@ -155,11 +161,13 @@ class BertStage(nn.Module):
     num_heads: int
     mlp_dim: int
     dtype: Any = jnp.bfloat16
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x):
+        layer_cls = nn.remat(BertLayer) if self.remat else BertLayer
         for i in range(self.layers_per_stage):
-            x = BertLayer(self.num_heads, self.mlp_dim, self.dtype,
+            x = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
                           name="layer_%d" % i)(x)
         return x
 

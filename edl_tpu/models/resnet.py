@@ -100,6 +100,10 @@ class ResNet(nn.Module):
     vd: bool = True
     dtype: Any = jnp.bfloat16
     stage_filters: Sequence[int] = (64, 128, 256, 512)
+    # activation recompute per residual block: save only block boundaries,
+    # recompute conv/BN internals in backward (reference knob:
+    # train_with_fleet.py:322-325 fleet recompute checkpointing)
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -122,6 +126,9 @@ class ResNet(nn.Module):
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
         block_cls = BottleneckBlock if bottleneck else BasicBlock
+        if self.remat:
+            # train is a static python bool → static_argnums (0 = self)
+            block_cls = nn.remat(block_cls, static_argnums=(2,))
         for stage, (filters, n_blocks) in enumerate(
                 zip(self.stage_filters, blocks_per_stage)):
             for i in range(n_blocks):
@@ -141,12 +148,13 @@ def ResNet50_vd(**kw):
 
 def create_model_and_loss(depth=50, num_classes=1000, vd=True,
                           image_size=224, label_smoothing=0.1,
-                          dtype=jnp.bfloat16):
+                          dtype=jnp.bfloat16, remat=False):
     """Build (model, params, batch_stats, loss_fn) wired for ElasticTrainer
     with has_aux=True — aux carries the BatchNorm running stats."""
     import jax
 
-    model = ResNet(depth=depth, num_classes=num_classes, vd=vd, dtype=dtype)
+    model = ResNet(depth=depth, num_classes=num_classes, vd=vd, dtype=dtype,
+                   remat=remat)
     dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
     params = variables["params"]

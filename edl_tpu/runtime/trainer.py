@@ -48,20 +48,46 @@ def make_train_state(params, tx, extra_state=None):
     }
 
 
-def make_train_step(loss_fn, tx, has_aux=False):
+# named activation-recompute policies for make_train_step/ElasticTrainer;
+# per-LAYER recompute (the big lever) is the models' own `remat` flag —
+# these whole-loss policies tune what the fwd/bwd boundary may save
+_REMAT_POLICIES = {
+    "full": lambda: None,  # jax.checkpoint default: save nothing
+    "dots": lambda: jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch":
+        lambda: jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+def make_train_step(loss_fn, tx, has_aux=False, remat_policy=None):
     """Build the canonical SGD step over a make_train_state pytree.
 
     loss_fn: (params, batch, rng) -> loss, or with has_aux
     (params, extra, batch, rng) -> (loss, new_extra). Returns
-    step(train_state, batch, rng) -> (train_state, loss), jit-ready."""
+    step(train_state, batch, rng) -> (train_state, loss), jit-ready.
+
+    remat_policy: None or one of "full"|"dots"|"dots_no_batch" — wraps the
+    loss in jax.checkpoint with the named policy (activation recompute;
+    reference knob train_with_fleet.py:322-325). Combine with the models'
+    own per-layer ``remat`` flag for layer-boundary-only memory."""
+    if remat_policy is not None and remat_policy not in _REMAT_POLICIES:
+        raise ValueError("remat_policy %r not in %s"
+                         % (remat_policy, sorted(_REMAT_POLICIES)))
+
+    def _maybe_remat(fn):
+        if remat_policy is None:
+            return fn
+        return jax.checkpoint(fn, policy=_REMAT_POLICIES[remat_policy]())
 
     def step(train_state, batch, rng):
         if has_aux:
+            @_maybe_remat
             def compute(params):
                 return loss_fn(params, train_state["extra"], batch, rng)
             (loss, extra), grads = jax.value_and_grad(
                 compute, has_aux=True)(train_state["params"])
         else:
+            @_maybe_remat
             def compute(params):
                 return loss_fn(params, batch, rng)
             loss, grads = jax.value_and_grad(compute)(train_state["params"])
@@ -129,7 +155,7 @@ class ElasticTrainer(object):
     def __init__(self, loss_fn, params, tx, total_batch_size,
                  checkpoint_dir=None, mesh=None, env=None, coord=None,
                  keep_checkpoints=3, extra_state=None, has_aux=False,
-                 async_save=False):
+                 async_save=False, remat_policy=None):
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -148,6 +174,7 @@ class ElasticTrainer(object):
         self._loss_fn = loss_fn
         self._tx = tx
         self._has_aux = has_aux
+        self._remat_policy = remat_policy
         if extra_state is not None:
             for leaf in jax.tree_util.tree_leaves(extra_state):
                 # only explicit numpy 64-bit leaves are dangerous; Python
@@ -188,7 +215,8 @@ class ElasticTrainer(object):
     # -- the compiled step ---------------------------------------------------
 
     def _build_step(self):
-        step = make_train_step(self._loss_fn, self._tx, self._has_aux)
+        step = make_train_step(self._loss_fn, self._tx, self._has_aux,
+                               remat_policy=self._remat_policy)
         return jax.jit(
             step,
             in_shardings=(self._repl, self._batch_sharding, self._repl),
