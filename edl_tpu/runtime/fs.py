@@ -5,10 +5,20 @@ checkpoints (example/collective/resnet50/train_with_fleet.py:422-424). The
 TPU equivalent targets POSIX (NFS/local) and GCS; GCS has no atomic rename,
 so the checkpoint layer commits via manifest-last writes instead of relying
 on rename (SURVEY.md §7 "hard parts").
+
+GCSFS speaks the GCS JSON API directly over urllib (no google-cloud-storage
+dependency): point it at a real endpoint with auth via a bearer token, or
+at any GCS emulator via STORAGE_EMULATOR_HOST (the in-tree one lives in
+edl_tpu/tools/fake_gcs.py).
 """
 
+import io
+import json
 import os
 import shutil
+import urllib.error
+import urllib.parse
+import urllib.request
 
 
 class FileSystem(object):
@@ -54,18 +64,148 @@ class LocalFS(FileSystem):
         os.replace(src, dst)
 
 
-class GCSFS(FileSystem):
-    """Placeholder for a GCS backend (no egress in this environment).
+def _split_gs(path):
+    """gs://bucket/a/b -> (bucket, "a/b")."""
+    if not str(path).startswith("gs://"):
+        raise ValueError("not a gs:// path: %r" % (path,))
+    rest = str(path)[len("gs://"):]
+    bucket, _, obj = rest.partition("/")
+    return bucket, obj.strip("/")
 
-    The checkpoint layer only needs exists/open/listdir/delete/makedirs —
-    all expressible over the GCS JSON API; commits are already manifest-last
-    so no rename primitive is required.
+
+class _GCSWriter(io.BytesIO):
+    """Buffers locally; uploads the object on close (GCS objects are
+    immutable blobs — there is no partial append)."""
+
+    def __init__(self, fs, bucket, name):
+        super().__init__()
+        self._fs, self._bucket, self._name = fs, bucket, name
+        self._closed_once = False
+
+    def close(self):
+        if not self._closed_once:
+            self._closed_once = True
+            self._fs._upload(self._bucket, self._name, self.getvalue())
+        super().close()
+
+
+class GCSFS(FileSystem):
+    """GCS over the JSON API: flat object namespace, no rename — the
+    checkpoint layer's manifest-last commit is designed for exactly this
+    (a version is valid iff its MANIFEST object exists).
+
+    endpoint: emulator/base URL; defaults to $STORAGE_EMULATOR_HOST or the
+    public GCS endpoint. token: OAuth bearer for real GCS (emulators need
+    none).
     """
 
-    def __init__(self, *a, **k):
+    def __init__(self, endpoint=None, token=None, timeout=30.0):
+        self._base = (endpoint or os.environ.get("STORAGE_EMULATOR_HOST")
+                      or "https://storage.googleapis.com").rstrip("/")
+        self._token = token
+        self._timeout = timeout
+
+    # -- http plumbing ----------------------------------------------------
+
+    def _request(self, method, url, data=None, ctype=None):
+        req = urllib.request.Request(url, data=data, method=method)
+        if ctype:
+            req.add_header("Content-Type", ctype)
+        if self._token:
+            req.add_header("Authorization", "Bearer %s" % self._token)
+        return urllib.request.urlopen(req, timeout=self._timeout)
+
+    def _obj_url(self, bucket, name, **params):
+        url = "%s/storage/v1/b/%s/o/%s" % (
+            self._base, urllib.parse.quote(bucket, safe=""),
+            urllib.parse.quote(name, safe=""))
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        return url
+
+    def _upload(self, bucket, name, data):
+        url = "%s/upload/storage/v1/b/%s/o?%s" % (
+            self._base, urllib.parse.quote(bucket, safe=""),
+            urllib.parse.urlencode({"uploadType": "media", "name": name}))
+        with self._request("POST", url, data=data,
+                           ctype="application/octet-stream") as resp:
+            resp.read()
+
+    def _download(self, bucket, name):
+        with self._request("GET", self._obj_url(bucket, name,
+                                                alt="media")) as resp:
+            return resp.read()
+
+    def _list(self, bucket, prefix, delimiter=None):
+        params = {"prefix": prefix}
+        if delimiter:
+            params["delimiter"] = delimiter
+        url = "%s/storage/v1/b/%s/o?%s" % (
+            self._base, urllib.parse.quote(bucket, safe=""),
+            urllib.parse.urlencode(params))
+        with self._request("GET", url) as resp:
+            out = json.loads(resp.read().decode())
+        return ([it["name"] for it in out.get("items", [])],
+                out.get("prefixes", []))
+
+    # -- FileSystem API ---------------------------------------------------
+
+    def exists(self, path):
+        bucket, obj = _split_gs(path)
+        if not obj:
+            return True
+        try:
+            with self._request("GET", self._obj_url(bucket, obj)) as resp:
+                resp.read()
+            return True
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+        # "directory": any object under the prefix
+        items, prefixes = self._list(bucket, obj + "/", delimiter="/")
+        return bool(items or prefixes)
+
+    def makedirs(self, path):
+        pass  # GCS has no directories
+
+    def open(self, path, mode):
+        bucket, obj = _split_gs(path)
+        if "w" in mode:
+            raw = _GCSWriter(self, bucket, obj)
+            return raw if "b" in mode else io.TextIOWrapper(raw)
+        try:
+            data = self._download(bucket, obj)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(path)
+            raise
+        return (io.BytesIO(data) if "b" in mode
+                else io.StringIO(data.decode()))
+
+    def listdir(self, path):
+        bucket, obj = _split_gs(path)
+        prefix = obj + "/" if obj else ""
+        items, prefixes = self._list(bucket, prefix, delimiter="/")
+        names = [n[len(prefix):] for n in items]
+        names += [p[len(prefix):].rstrip("/") for p in prefixes]
+        return sorted(n for n in names if n)
+
+    def delete_tree(self, path):
+        bucket, obj = _split_gs(path)
+        items, _ = self._list(bucket, obj + "/" if obj else "")
+        for name in items + [obj]:
+            try:
+                with self._request(
+                        "DELETE", self._obj_url(bucket, name)) as resp:
+                    resp.read()
+            except urllib.error.HTTPError as e:
+                if e.code != 404:
+                    raise
+
+    def rename(self, src, dst):
         raise NotImplementedError(
-            "GCS backend requires google-cloud-storage; use LocalFS on a "
-            "shared mount, or add the dependency in your deployment image")
+            "GCS has no atomic rename; the checkpoint layer commits "
+            "manifest-last and never calls rename on object stores")
 
 
 def get_fs(path):

@@ -1,0 +1,169 @@
+"""In-tree fake GCS server: the JSON-API subset GCSFS needs.
+
+Speaks the same wire shapes as a real GCS emulator (upload media, download
+``alt=media``, list with prefix/delimiter, delete), so
+``STORAGE_EMULATOR_HOST=http://host:port`` points GCSFS — and, in a real
+deployment image, google-cloud-storage — at it unchanged. Object store is
+flat (names with slashes), exactly like GCS: no directories, no rename —
+which is why the checkpoint layer commits manifest-last
+(edl_tpu/runtime/checkpoint.py) instead of relying on atomic rename.
+
+Reference role: the shared-storage half of the reference's HDFS/BDFS
+checkpoint wrapper (train_with_fleet.py:422-424).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # objects: {bucket: {name: bytes}} on the server instance
+    def _send(self, code, body=b"", ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def _json(self, code, obj):
+        self._send(code, json.dumps(obj).encode())
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    @property
+    def store(self):
+        return self.server.objects
+
+    @property
+    def lock(self):
+        return self.server.lock
+
+    def do_POST(self):
+        u = urlparse(self.path)
+        q = parse_qs(u.query)
+        parts = u.path.split("/")
+        # /upload/storage/v1/b/<bucket>/o
+        if (len(parts) >= 7 and parts[1] == "upload"
+                and parts[4] == "b" and parts[6] == "o"):
+            bucket = unquote(parts[5])
+            name = q.get("name", [""])[0]
+            n = int(self.headers.get("Content-Length", 0))
+            data = self.rfile.read(n)
+            with self.lock:
+                self.store.setdefault(bucket, {})[name] = data
+            self._json(200, {"name": name, "bucket": bucket,
+                             "size": str(len(data))})
+            return
+        self._json(404, {"error": "bad upload path %s" % u.path})
+
+    def do_GET(self):
+        u = urlparse(self.path)
+        q = parse_qs(u.query)
+        parts = u.path.split("/")
+        # /storage/v1/b/<bucket>/o[/<object>]
+        if len(parts) >= 6 and parts[1] == "storage" and parts[3] == "b":
+            bucket = unquote(parts[4])
+            with self.lock:
+                objs = dict(self.store.get(bucket, {}))
+            if len(parts) >= 7 and parts[5] == "o" and parts[6]:
+                name = unquote("/".join(parts[6:]))
+                if name not in objs:
+                    self._json(404, {"error": "no such object"})
+                    return
+                if q.get("alt", [""])[0] == "media":
+                    self._send(200, objs[name],
+                               ctype="application/octet-stream")
+                else:
+                    self._json(200, {"name": name, "bucket": bucket,
+                                     "size": str(len(objs[name]))})
+                return
+            if len(parts) >= 6 and parts[5] == "o":  # list
+                prefix = q.get("prefix", [""])[0]
+                delim = q.get("delimiter", [""])[0]
+                items, prefixes = [], set()
+                for name in sorted(objs):
+                    if not name.startswith(prefix):
+                        continue
+                    rest = name[len(prefix):]
+                    if delim and delim in rest:
+                        prefixes.add(prefix + rest.split(delim)[0] + delim)
+                    else:
+                        items.append({"name": name,
+                                      "size": str(len(objs[name]))})
+                self._json(200, {"items": items,
+                                 "prefixes": sorted(prefixes)})
+                return
+        self._json(404, {"error": "bad path %s" % u.path})
+
+    def do_DELETE(self):
+        u = urlparse(self.path)
+        parts = u.path.split("/")
+        if (len(parts) >= 7 and parts[1] == "storage" and parts[3] == "b"
+                and parts[5] == "o"):
+            bucket = unquote(parts[4])
+            name = unquote("/".join(parts[6:]))
+            with self.lock:
+                existed = self.store.get(bucket, {}).pop(name, None)
+            if existed is None:
+                self._json(404, {"error": "no such object"})
+            else:
+                self._send(204)
+            return
+        self._json(404, {"error": "bad path %s" % u.path})
+
+
+class FakeGCSServer(object):
+    """``with FakeGCSServer() as s:`` → ``s.endpoint`` for
+    STORAGE_EMULATOR_HOST / GCSFS(endpoint=...)."""
+
+    def __init__(self, host="127.0.0.1", port=0):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.objects = {}
+        self._httpd.lock = threading.Lock()
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="fake-gcs")
+
+    @property
+    def endpoint(self):
+        host, port = self._httpd.server_address[:2]
+        return "http://%s:%d" % (host, port)
+
+    @property
+    def objects(self):
+        return self._httpd.objects
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def main():  # pragma: no cover
+    import argparse
+    ap = argparse.ArgumentParser(description="fake GCS JSON-API server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=4443)
+    args = ap.parse_args()
+    server = FakeGCSServer(args.host, args.port).start()
+    print("fake GCS at %s" % server.endpoint)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()
