@@ -45,6 +45,10 @@ class ElasticManager(object):
         self._watcher = None
         self._np_watcher = None
         self._last_hosts = []
+        # membership agreed at the last wait(); watch events only count as
+        # a change against THIS (the initial registration listing would
+        # otherwise race wait() and fire a spurious RESTART)
+        self._agreed_hosts = None
 
         if self._coord.get_value(SERVICE_CONF, NP_KEY) is None:
             self._coord.set_server_permanent(SERVICE_CONF, NP_KEY,
@@ -86,7 +90,8 @@ class ElasticManager(object):
 
     def _on_nodes(self, added, removed, all_servers):
         self._last_hosts = sorted(all_servers)
-        if added or removed:
+        if self._agreed_hosts is not None \
+                and self._last_hosts != self._agreed_hosts:
             self._hosts_changed.set()
 
     def _on_conf(self, added, removed, all_servers):
@@ -108,6 +113,7 @@ class ElasticManager(object):
         while time.monotonic() < deadline:
             hosts = self.hosts()
             if len(hosts) == self._np:
+                self._agreed_hosts = hosts
                 self._hosts_changed.clear()
                 return hosts
             time.sleep(0.5)

@@ -1,0 +1,170 @@
+"""The liveft launch supervisor: wait → run → watch, exit-101 convention.
+
+Reference parity: edl/liveft/launch.py:24-59 (wait for membership, run the
+trainer through a launcher, watch; RESTART ⇒ exit ELASTIC_EXIT_CODE so the
+outer supervisor restarts the pod) and the LauncherInterface process
+handling in edl/liveft/elastic.py. Two modes:
+
+- ``--exit-on-restart``: exact reference behavior — the process exits 101
+  on a scale event and an external supervisor (k8s) restarts it.
+- default self-supervising loop: on RESTART the trainer is killed and
+  respawned in-process with the new rank assignment (no external
+  supervisor needed — the natural mode on TPU pods).
+
+The trainer contract: env EDL_TPU_LIVEFT_RANK / _HOSTS / _NP; exit 0 ⇒ job
+COMPLETED for the whole fleet; exit 101 ⇒ "restart me" (re-wait + respawn);
+any other exit ⇒ ERROR.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.liveft.elastic import (COMPLETED, ELASTIC_EXIT_CODE, ERROR,
+                                    HOLD, RESTART, ElasticManager)
+from edl_tpu.utils.logger import logger
+
+
+class TrainerLauncher(object):
+    """Spawn/poll/kill one trainer process with the liveft env contract
+    (reference LauncherInterface: spawn, watch via poll, kill-tree stop)."""
+
+    def __init__(self, cmd, host, rank, hosts, log_path=None):
+        self._cmd = list(cmd)
+        env = dict(os.environ)
+        env["EDL_TPU_LIVEFT_RANK"] = str(rank)
+        env["EDL_TPU_LIVEFT_HOSTS"] = ",".join(hosts)
+        env["EDL_TPU_LIVEFT_NP"] = str(len(hosts))
+        env["EDL_TPU_LIVEFT_HOST"] = host
+        self._env = env
+        self._log_path = log_path
+        self._log_f = None
+        self._proc = None
+
+    def start(self):
+        out = None
+        if self._log_path:
+            self._log_f = open(self._log_path, "ab")
+            out = self._log_f
+        self._proc = subprocess.Popen(
+            self._cmd, env=self._env, stdout=out, stderr=out,
+            start_new_session=True)  # own group → killpg reaps children
+        logger.info("liveft: trainer pid %d started (rank %s of %s)",
+                    self._proc.pid, self._env["EDL_TPU_LIVEFT_RANK"],
+                    self._env["EDL_TPU_LIVEFT_NP"])
+        return self
+
+    def poll(self):
+        """None while running, else the exit code."""
+        return self._proc.poll() if self._proc else None
+
+    def stop(self, grace=10.0):
+        if self._proc is None or self._proc.poll() is not None:
+            self._close_log()
+            return
+        try:
+            os.killpg(self._proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and self._proc.poll() is None:
+            time.sleep(0.1)
+        if self._proc.poll() is None:
+            try:
+                os.killpg(self._proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self._proc.wait()
+        self._close_log()
+
+    def _close_log(self):
+        if self._log_f is not None:
+            self._log_f.close()
+            self._log_f = None
+
+
+def launch_loop(coord, host, np_target, cmd, ttl=10, exit_on_restart=False,
+                wait_timeout=600, log_path=None, poll=0.5):
+    """The wait → run → watch supervisor loop. Returns the process exit
+    code (0 completed, 3 error, ELASTIC_EXIT_CODE when --exit-on-restart)."""
+    elastic = ElasticManager(coord, host, np_target, ttl=ttl).start()
+    try:
+        while True:
+            hosts = elastic.wait(timeout=wait_timeout)
+            rank = hosts.index(host)
+            launcher = TrainerLauncher(cmd, host, rank, hosts,
+                                       log_path=log_path).start()
+            verdict = HOLD
+            try:
+                while True:
+                    ret = launcher.poll()
+                    if ret is not None:
+                        if ret == 0:
+                            elastic.complete()
+                            verdict = COMPLETED
+                        elif ret == ELASTIC_EXIT_CODE:
+                            logger.info("liveft: trainer asked for restart")
+                            verdict = RESTART
+                        else:
+                            logger.error("liveft: trainer exited rc=%d", ret)
+                            verdict = ERROR
+                        break
+                    verdict = elastic.watch(poll=poll)
+                    if verdict != HOLD:
+                        break
+            finally:
+                launcher.stop()
+            if verdict == COMPLETED:
+                return 0
+            if verdict == ERROR:
+                return 3
+            # RESTART: membership/np changed or trainer exit-101
+            if exit_on_restart:
+                return ELASTIC_EXIT_CODE
+            logger.info("liveft: restarting under new membership")
+    finally:
+        elastic.stop()
+
+
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="liveft elastic supervisor (wait-run-watch)")
+    ap.add_argument("--store_endpoints", required=True,
+                    help="comma-separated host:port of the coord store")
+    ap.add_argument("--job_id", required=True)
+    ap.add_argument("--host", required=True,
+                    help="this node's identity (host or host:port)")
+    ap.add_argument("--np", type=int, required=True,
+                    help="initial world-size target")
+    ap.add_argument("--ttl", type=int, default=10)
+    ap.add_argument("--exit-on-restart", action="store_true",
+                    help="exit %d on scale events (external supervisor "
+                         "mode, reference behavior)" % ELASTIC_EXIT_CODE)
+    ap.add_argument("--wait_timeout", type=float, default=600)
+    ap.add_argument("--log_path", default=None)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="trainer command (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        ap.error("no trainer command given")
+
+    # die cleanly on supervisor signals: SystemExit unwinds the finally
+    # blocks, so the trainer process group is killed and the lease revoked
+    # (reference: launch.py:31-33 signal_handler registration)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda s, f: sys.exit(128 + s))
+
+    coord = CoordClient(args.store_endpoints.split(","), root=args.job_id)
+    rc = launch_loop(coord, args.host, args.np, cmd, ttl=args.ttl,
+                     exit_on_restart=args.exit_on_restart,
+                     wait_timeout=args.wait_timeout, log_path=args.log_path)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

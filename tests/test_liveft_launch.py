@@ -1,0 +1,128 @@
+"""Liveft launch supervisor e2e: two real supervisor processes against a
+real store; a scale signal (np 2→1 + host loss) must RESTART the
+surviving trainer with a fresh rank assignment; trainer exit 0 completes
+the job (reference flow: edl/liveft/launch.py:24-59)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+TRAINER = """\
+import os, sys, time
+log, done = sys.argv[1], sys.argv[2]
+with open(log, "a") as f:
+    f.write("%s rank=%s np=%s\\n" % (os.environ["EDL_TPU_LIVEFT_HOST"],
+                                     os.environ["EDL_TPU_LIVEFT_RANK"],
+                                     os.environ["EDL_TPU_LIVEFT_NP"]))
+    f.flush()
+while not os.path.exists(done):
+    time.sleep(0.1)
+sys.exit(0)
+"""
+
+
+def _read_lines(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+def _wait_for(pred, timeout=40, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.2)
+    raise AssertionError("timed out waiting for %s" % what)
+
+
+def test_liveft_launch_scale_restart(store, tmp_path):
+    trainer_py = tmp_path / "trainer.py"
+    trainer_py.write_text(TRAINER)
+    log = str(tmp_path / "ranks.log")
+    done = str(tmp_path / "done")
+
+    def supervisor(host):
+        return subprocess.Popen(
+            [sys.executable, "-m", "edl_tpu.liveft.launch",
+             "--store_endpoints", store.endpoint, "--job_id", "lf_job",
+             "--host", host, "--np", "2", "--ttl", "3",
+             "--", sys.executable, str(trainer_py), log, done],
+            env=dict(os.environ, PYTHONPATH=os.getcwd()),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    sup_a, sup_b = supervisor("node_a"), supervisor("node_b")
+    try:
+        # both trainers come up with distinct ranks in a 2-world
+        _wait_for(lambda: len([ln for ln in _read_lines(log)
+                               if "np=2" in ln]) >= 2,
+                  what="both trainers started at np=2")
+        first = [ln for ln in _read_lines(log) if "np=2" in ln]
+        assert {ln.split()[1] for ln in first} == {"rank=0", "rank=1"}
+
+        # scale signal: np -> 1, and node_b disappears (supervisor killed;
+        # its lease expires after the ttl)
+        from edl_tpu.coordination.client import CoordClient
+        from edl_tpu.liveft.elastic import NP_KEY, SERVICE_CONF
+        coord = CoordClient([store.endpoint], root="lf_job")
+        sup_b.send_signal(signal.SIGTERM)
+        sup_b.wait(timeout=20)
+        coord.set_server_permanent(SERVICE_CONF, NP_KEY, "1")
+
+        # the survivor must respawn its trainer as rank 0 of a 1-world
+        _wait_for(lambda: any("node_a rank=0 np=1" == ln
+                              for ln in _read_lines(log)),
+                  what="node_a restarted as rank 0 of np=1")
+
+        # trainer completion (exit 0) completes the supervisor with rc 0
+        with open(done, "w") as f:
+            f.write("x")
+        assert sup_a.wait(timeout=30) == 0
+    finally:
+        for p in (sup_a, sup_b):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def test_liveft_exit_on_restart_mode(store, tmp_path):
+    """Reference behavior: --exit-on-restart exits 101 on the scale event
+    so an external supervisor (k8s) can restart the pod."""
+    trainer_py = tmp_path / "trainer.py"
+    trainer_py.write_text(TRAINER)
+    log = str(tmp_path / "ranks.log")
+    done = str(tmp_path / "done")
+
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "edl_tpu.liveft.launch",
+         "--store_endpoints", store.endpoint, "--job_id", "lf_job2",
+         "--host", "solo", "--np", "1", "--ttl", "3", "--exit-on-restart",
+         "--", sys.executable, str(trainer_py), log, done],
+        env=dict(os.environ, PYTHONPATH=os.getcwd()),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        _wait_for(lambda: len(_read_lines(log)) >= 1,
+                  what="trainer started")
+        # trainer asks for a restart by exiting 101 — simulate via np bump
+        # (a membership-level scale event): np 1 -> ... back to 1 won't
+        # trigger; instead kill the trainer with exit 101 through the done
+        # protocol is exit 0, so use the np key with a second registrant.
+        from edl_tpu.coordination.client import CoordClient
+        from edl_tpu.liveft.elastic import (ELASTIC_EXIT_CODE, NP_KEY,
+                                            SERVICE_CONF, SERVICE_NODES)
+        coord = CoordClient([store.endpoint], root="lf_job2")
+        # a second host joins and np goes to 2 → RESTART verdict
+        lease = coord.set_server_with_lease(SERVICE_NODES, "joiner",
+                                            "t", 30)
+        coord.set_server_permanent(SERVICE_CONF, NP_KEY, "2")
+        assert sup.wait(timeout=30) == ELASTIC_EXIT_CODE
+        coord.lease_revoke(lease)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+            sup.wait()
