@@ -18,13 +18,21 @@ the kubernetes API:
   created StatefulSets carry an ownerReference so deleting a TrainingJob
   cascades to its pods.
 
-The pure decision logic (``plan_allocations``) is dependency-free and unit
-tested; the reconcile loop requires the ``kubernetes`` package at runtime.
+The decision logic (``plan_allocations``) and the reconcile loop are both
+dependency-free: manifests are plain dicts (the kubernetes python client
+accepts them unchanged) and the API clients are injectable, so the loop is
+tested against a fake API (tests/fake_k8s.py); production wires the real
+``kubernetes`` package clients in.
 """
 
 import time
 
 from edl_tpu.utils.logger import logger
+
+
+def _is_not_found(e):
+    """True for a 404 from either the real ApiException or a fake."""
+    return getattr(e, "status", None) == 404
 
 
 def plan_allocations(jobs, capacity_nodes):
@@ -74,7 +82,8 @@ def launcher_pod_command(spec):
 class Operator(object):
     GROUP, VERSION, PLURAL = "edl-tpu.dev", "v1", "trainingjobs"
 
-    def __init__(self, namespace=None, capacity_nodes=None, interval=None):
+    def __init__(self, namespace=None, capacity_nodes=None, interval=None,
+                 crd_api=None, apps_api=None):
         import os
         namespace = namespace or os.environ.get("EDL_TPU_K8S_NAMESPACE",
                                                 "default")
@@ -82,21 +91,30 @@ class Operator(object):
             "EDL_TPU_K8S_CAPACITY_NODES", "16"))
         interval = float(interval or os.environ.get(
             "EDL_TPU_K8S_RECONCILE_INTERVAL", "10"))
-        try:
-            from kubernetes import client, config
-        except ImportError as e:  # pragma: no cover
-            raise RuntimeError(
-                "the k8s operator needs the 'kubernetes' package in the "
-                "operator image (pip install kubernetes)") from e
-        try:
-            config.load_incluster_config()
-        except Exception:
-            config.load_kube_config()
-        self._crd = client.CustomObjectsApi()
-        self._apps = client.AppsV1Api()
+        if crd_api is None or apps_api is None:  # pragma: no cover
+            try:
+                from kubernetes import client, config
+            except ImportError as e:
+                raise RuntimeError(
+                    "the k8s operator needs the 'kubernetes' package in "
+                    "the operator image (pip install kubernetes), or "
+                    "injected crd_api/apps_api clients") from e
+            try:
+                config.load_incluster_config()
+            except Exception:
+                config.load_kube_config()
+            crd_api = crd_api or client.CustomObjectsApi()
+            apps_api = apps_api or client.AppsV1Api()
+        self._crd = crd_api
+        self._apps = apps_api
         self._ns = namespace
         self._capacity = capacity_nodes
         self._interval = interval
+
+    def set_capacity(self, capacity_nodes):
+        """Autoscaler input: total schedulable nodes changed (e.g. a TPU
+        slice reservation grew/shrank); next reconcile re-plans."""
+        self._capacity = int(capacity_nodes)
 
     # -- reconcile ----------------------------------------------------------
 
@@ -117,32 +135,47 @@ class Operator(object):
                 logger.exception("operator: reconcile of %s failed",
                                  j["metadata"]["name"])
 
-    def _apply(self, job, nodes):
-        from kubernetes import client
-        from kubernetes.client.rest import ApiException
+    def statefulset_manifest(self, job, nodes):
+        """The StatefulSet (plain dict — accepted verbatim by the real
+        kubernetes client) owning one TrainingJob's launcher pods."""
         name = "edl-tpu-" + job["metadata"]["name"]
         spec = job["spec"]
-        container = client.V1Container(
-            name="launcher", image=spec["image"],
-            command=launcher_pod_command(spec))
-        template = client.V1PodTemplateSpec(
-            metadata=client.V1ObjectMeta(labels={"edl-tpu-job": name}),
-            spec=client.V1PodSpec(containers=[container],
-                                  restart_policy="Always"))
-        owner = client.V1OwnerReference(
-            api_version="%s/%s" % (self.GROUP, self.VERSION),
-            kind="TrainingJob", name=job["metadata"]["name"],
-            uid=job["metadata"]["uid"], controller=True,
-            block_owner_deletion=True)
-        sts_spec = client.V1StatefulSetSpec(
-            replicas=nodes, service_name=name,
-            selector=client.V1LabelSelector(
-                match_labels={"edl-tpu-job": name}),
-            template=template)
-        body = client.V1StatefulSet(
-            metadata=client.V1ObjectMeta(name=name,
-                                         owner_references=[owner]),
-            spec=sts_spec)
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": name,
+                "ownerReferences": [{
+                    "apiVersion": "%s/%s" % (self.GROUP, self.VERSION),
+                    "kind": "TrainingJob",
+                    "name": job["metadata"]["name"],
+                    "uid": job["metadata"]["uid"],
+                    "controller": True,
+                    "blockOwnerDeletion": True,
+                }],
+            },
+            "spec": {
+                "replicas": nodes,
+                "serviceName": name,
+                "selector": {"matchLabels": {"edl-tpu-job": name}},
+                "template": {
+                    "metadata": {"labels": {"edl-tpu-job": name}},
+                    "spec": {
+                        "restartPolicy": "Always",
+                        "containers": [{
+                            "name": "launcher",
+                            "image": spec["image"],
+                            "command": launcher_pod_command(spec),
+                        }],
+                    },
+                },
+            },
+        }
+
+    def _apply(self, job, nodes):
+        name = "edl-tpu-" + job["metadata"]["name"]
+        body = self.statefulset_manifest(job, nodes)
+        want = body["spec"]["template"]["spec"]["containers"][0]
         ready = 0
         try:
             existing = self._apps.read_namespaced_stateful_set(name,
@@ -151,8 +184,8 @@ class Operator(object):
             # local template leaves unset, so whole-template != is useless)
             cur = existing.spec.template.spec.containers[0]
             changed = (existing.spec.replicas != nodes
-                       or cur.image != container.image
-                       or cur.command != container.command)
+                       or cur.image != want["image"]
+                       or list(cur.command) != want["command"])
             if changed:
                 logger.info("operator: updating %s (replicas %s -> %d)",
                             name, existing.spec.replicas, nodes)
@@ -160,8 +193,8 @@ class Operator(object):
                     name, self._ns, body)
             ready = (existing.status.ready_replicas or 0
                      if existing.status else 0)
-        except ApiException as e:
-            if e.status != 404:
+        except Exception as e:
+            if not _is_not_found(e):
                 raise
             logger.info("operator: creating %s with %d nodes", name, nodes)
             self._apps.create_namespaced_stateful_set(self._ns, body)

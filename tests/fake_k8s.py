@@ -1,0 +1,87 @@
+"""A fake kubernetes API surface for operator reconcile tests.
+
+Implements exactly the calls Operator makes, with the same read-side attr
+shapes the real client exposes (snake_case object attrs) and dict bodies
+on the write side (which the real client also accepts)."""
+
+import copy
+
+
+class FakeApiException(Exception):
+    def __init__(self, status):
+        super().__init__("status %d" % status)
+        self.status = status
+
+
+class _View(object):
+    """Attr view over a StatefulSet manifest dict, shaped like the real
+    client's V1StatefulSet (spec.replicas, spec.template.spec.containers,
+    status.ready_replicas)."""
+
+    class _C(object):
+        def __init__(self, c):
+            self.name = c["name"]
+            self.image = c["image"]
+            self.command = list(c["command"])
+
+    def __init__(self, body, ready):
+        tpl = body["spec"]["template"]["spec"]
+        containers = [self._C(c) for c in tpl["containers"]]
+        self.spec = type("S", (), {})()
+        self.spec.replicas = body["spec"]["replicas"]
+        self.spec.template = type("T", (), {})()
+        self.spec.template.spec = type("TS", (), {})()
+        self.spec.template.spec.containers = containers
+        self.status = type("St", (), {})()
+        self.status.ready_replicas = ready
+        self.metadata = type("M", (), {})()
+        self.metadata.name = body["metadata"]["name"]
+        self.metadata.owner_references = body["metadata"].get(
+            "ownerReferences", [])
+
+
+class FakeAppsV1Api(object):
+    def __init__(self):
+        self.sets = {}    # name -> manifest dict
+        self.ready = {}   # name -> ready replica count
+        self.creates = []
+        self.patches = []
+
+    def read_namespaced_stateful_set(self, name, ns):
+        if name not in self.sets:
+            raise FakeApiException(404)
+        return _View(self.sets[name], self.ready.get(name, 0))
+
+    def create_namespaced_stateful_set(self, ns, body):
+        name = body["metadata"]["name"]
+        if name in self.sets:
+            raise FakeApiException(409)
+        self.sets[name] = copy.deepcopy(body)
+        self.creates.append(name)
+
+    def patch_namespaced_stateful_set(self, name, ns, body):
+        if name not in self.sets:
+            raise FakeApiException(404)
+        self.sets[name] = copy.deepcopy(body)
+        self.patches.append(name)
+
+    # test helper: simulate pods becoming ready
+    def set_ready(self, name, n):
+        self.ready[name] = n
+
+
+class FakeCustomObjectsApi(object):
+    def __init__(self, jobs=()):
+        self.jobs = {j["metadata"]["name"]: copy.deepcopy(j) for j in jobs}
+        self.status_patches = []
+
+    def list_namespaced_custom_object(self, group, version, ns, plural):
+        return {"items": [copy.deepcopy(j) for _, j in
+                          sorted(self.jobs.items())]}
+
+    def patch_namespaced_custom_object_status(self, group, version, ns,
+                                              plural, name, body):
+        if name not in self.jobs:
+            raise FakeApiException(404)
+        self.jobs[name].setdefault("status", {}).update(body["status"])
+        self.status_patches.append((name, copy.deepcopy(body["status"])))

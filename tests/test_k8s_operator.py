@@ -1,6 +1,94 @@
-"""Operator decision-logic tests (no cluster needed)."""
+"""Operator tests: decision logic + the reconcile loop against a fake
+kubernetes API (tests/fake_k8s.py) — CR → StatefulSet create/scale/
+status, owner references, autoscaler re-plan, broken-job isolation."""
 
-from edl_tpu.tools.k8s_operator import launcher_pod_command, plan_allocations
+from fake_k8s import FakeAppsV1Api, FakeCustomObjectsApi
+
+from edl_tpu.tools.k8s_operator import (Operator, launcher_pod_command,
+                                        plan_allocations)
+
+
+def _job(name, uid="u-%s", image="edl-tpu:latest", min_nodes=2, max_nodes=4,
+         priority=0):
+    return {
+        "metadata": {"name": name, "uid": uid % name},
+        "spec": {"jobId": name, "image": image, "script": "/app/train.py",
+                 "minNodes": min_nodes, "maxNodes": max_nodes,
+                 "priority": priority},
+    }
+
+
+def _operator(jobs, capacity=16):
+    crd = FakeCustomObjectsApi(jobs)
+    apps = FakeAppsV1Api()
+    op = Operator(namespace="ns", capacity_nodes=capacity, interval=1,
+                  crd_api=crd, apps_api=apps)
+    return op, crd, apps
+
+
+def test_reconcile_creates_statefulsets_with_owner_refs():
+    op, crd, apps = _operator([_job("alpha"), _job("beta", priority=5)],
+                              capacity=16)
+    op.reconcile_once()
+    assert sorted(apps.creates) == ["edl-tpu-alpha", "edl-tpu-beta"]
+    sts = apps.sets["edl-tpu-beta"]
+    # beta (priority 5) topped up to max; alpha got the rest up to max
+    assert sts["spec"]["replicas"] == 4
+    owner = sts["metadata"]["ownerReferences"][0]
+    assert owner["kind"] == "TrainingJob" and owner["name"] == "beta"
+    assert owner["uid"] == "u-beta" and owner["controller"]
+    cmd = sts["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert cmd[0] == "edl-tpu-run" and "2:4" in cmd
+    # statuses patched: no pods ready yet → Starting
+    assert dict(crd.status_patches)["beta"]["phase"] == "Starting"
+
+
+def test_reconcile_is_idempotent_and_tracks_ready():
+    op, crd, apps = _operator([_job("alpha")], capacity=8)
+    op.reconcile_once()
+    assert apps.creates == ["edl-tpu-alpha"]
+    op.reconcile_once()
+    assert apps.patches == []          # nothing changed → no patch
+    apps.set_ready("edl-tpu-alpha", 3)
+    op.reconcile_once()
+    assert crd.jobs["alpha"]["status"] == {"phase": "Running",
+                                           "currentNodes": 3}
+
+
+def test_reconcile_replans_on_capacity_change():
+    op, crd, apps = _operator([_job("alpha", min_nodes=2, max_nodes=8),
+                               _job("beta", min_nodes=2, max_nodes=8,
+                                    priority=9)], capacity=16)
+    op.reconcile_once()
+    assert apps.sets["edl-tpu-beta"]["spec"]["replicas"] == 8
+    assert apps.sets["edl-tpu-alpha"]["spec"]["replicas"] == 8
+    # the TPU reservation shrinks: high-priority keeps max, alpha squeezed
+    op.set_capacity(10)
+    op.reconcile_once()
+    assert apps.sets["edl-tpu-beta"]["spec"]["replicas"] == 8
+    assert apps.sets["edl-tpu-alpha"]["spec"]["replicas"] == 2
+    assert "edl-tpu-alpha" in apps.patches
+
+
+def test_reconcile_applies_spec_changes():
+    jobs = [_job("alpha")]
+    op, crd, apps = _operator(jobs, capacity=8)
+    op.reconcile_once()
+    crd.jobs["alpha"]["spec"]["image"] = "edl-tpu:v2"
+    op.reconcile_once()
+    assert apps.patches == ["edl-tpu-alpha"]
+    c = apps.sets["edl-tpu-alpha"]["spec"]["template"]["spec"]["containers"]
+    assert c[0]["image"] == "edl-tpu:v2"
+
+
+def test_broken_job_does_not_starve_others():
+    bad = {"metadata": {"name": "bad", "uid": "u-bad"},
+           "spec": {"jobId": "bad", "script": "/x.py",
+                    "minNodes": 1, "maxNodes": 1}}  # no image → KeyError
+    op, crd, apps = _operator([bad, _job("good")], capacity=8)
+    op.reconcile_once()
+    assert "edl-tpu-good" in apps.creates
+    assert "edl-tpu-bad" not in apps.sets
 
 
 def test_plan_min_then_priority_topup():
