@@ -19,6 +19,7 @@ MODEL_AXIS = "tp"
 SEQ_AXIS = "sp"
 PIPE_AXIS = "pp"
 EXPERT_AXIS = "ep"
+DCN_AXIS = "dcn"  # the cross-slice (data-center network) axis
 
 
 def make_mesh(dp=None, tp=1, sp=1, pp=1, ep=1, devices=None):
@@ -49,9 +50,83 @@ def make_mesh(dp=None, tp=1, sp=1, pp=1, ep=1, devices=None):
                 (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS))
 
 
+def _group_slices(devices):
+    """Group devices into slices: by the TPU runtime's slice_index when it
+    discriminates (real multi-slice systems, where one slice spans many
+    host processes), else by owning process (multi-host CPU rigs report a
+    constant slice_index 0)."""
+    sids = {getattr(d, "slice_index", None) for d in devices}
+    key = ((lambda d: d.slice_index) if len(sids) > 1 and None not in sids
+           else (lambda d: d.process_index))
+    groups = {}
+    for d in devices:
+        groups.setdefault(key(d), []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def make_hybrid_mesh(dcn_dp=None, dp=None, tp=1, sp=1, pp=1, ep=1,
+                     devices=None):
+    """Multi-slice mesh: data parallelism over DCN (one row per slice),
+    the other axes within each slice over ICI.
+
+    Axes: (dcn, pp, dp, ep, sp, tp) — shard batches with
+    ``data_sharding(mesh)`` (= P(("dcn", "dp"))); the gradient all-reduce
+    XLA inserts then decomposes into a fast within-slice reduce over ICI
+    plus a small cross-slice reduce over DCN (the hierarchical-allreduce
+    the reference exposed as a fleet knob, train_with_fleet.py:372).
+
+    Slices are discovered from device.slice_index (real multi-slice TPU)
+    or process_index (multi-host CPU test rig). If all devices report ONE
+    slice and ``dcn_dp`` > 1 is requested, the device list is split
+    contiguously into dcn_dp virtual slices — the hermetic single-process
+    test/dryrun mode.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    slices = _group_slices(devices)
+    if len(slices) == 1 and dcn_dp and dcn_dp > 1:
+        n = len(devices)
+        if n % dcn_dp != 0:
+            raise ValueError("devices=%d not divisible into %d virtual "
+                             "slices" % (n, dcn_dp))
+        per = n // dcn_dp
+        slices = [devices[i * per:(i + 1) * per] for i in range(dcn_dp)]
+    if dcn_dp is None:
+        dcn_dp = len(slices)
+    if len(slices) != dcn_dp:
+        raise ValueError("found %d slices, want dcn_dp=%d"
+                         % (len(slices), dcn_dp))
+    sizes = sorted({len(s) for s in slices})
+    if len(sizes) != 1:
+        raise ValueError("unequal slice sizes %s" % sizes)
+    per = sizes[0]
+    fixed = tp * sp * pp * ep
+    if dp is None:
+        if per % fixed != 0:
+            raise ValueError("slice size %d not divisible by tp*sp*pp*ep=%d"
+                             % (per, fixed))
+        dp = per // fixed
+    if dp * fixed != per:
+        raise ValueError("per-slice mesh %dx%dx%dx%dx%d != %d devices"
+                         % (pp, dp, ep, sp, tp, per))
+    shape = (pp, dp, ep, sp, tp)
+    rows = []
+    for s in slices:
+        try:
+            rows.append(mesh_utils.create_device_mesh(shape, devices=s))
+        except (ValueError, AssertionError):
+            rows.append(np.asarray(s).reshape(shape))
+    dev_array = np.stack(rows)  # [dcn, pp, dp, ep, sp, tp]
+    return Mesh(dev_array, (DCN_AXIS, PIPE_AXIS, DATA_AXIS, EXPERT_AXIS,
+                            SEQ_AXIS, MODEL_AXIS))
+
+
 def data_sharding(mesh):
-    """Batch-dim sharding over dp (and sp if present)."""
-    return NamedSharding(mesh, P(DATA_AXIS))
+    """Batch-dim sharding over the data axes present in the mesh: dp, plus
+    dcn for hybrid (multi-slice) meshes."""
+    axes = tuple(a for a in (DCN_AXIS, DATA_AXIS) if a in mesh.shape)
+    if not axes:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, P(axes if len(axes) > 1 else axes[0]))
 
 
 def replicated(mesh):
