@@ -17,6 +17,7 @@ from functools import partial
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -28,6 +29,50 @@ DEPTH_CONFIGS = {
     101: ((3, 4, 23, 3), True),
     152: ((3, 8, 36, 3), True),
 }
+
+
+def space_to_depth(x, block=2):
+    """[B, H, W, C] -> [B, H/b, W/b, b*b*C] (channel = (di*b+dj)*C + c)."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, h // block, w // block, block * block * c)
+
+
+class _S2DStemConv(nn.Module):
+    """The vd stem's 3x3/stride-2 conv on 3 channels, computed on the
+    space-to-depth input instead (MLPerf-style TPU optimization).
+
+    A 3-channel 224x224 conv runs the MXU at K=27 contraction depth —
+    mostly padding. On the 2x2 space-to-depth image it becomes a DENSE
+    stride-1 2x2 conv with K=48: the trained parameter stays the original
+    [3,3,3,F] kernel (checkpoint-compatible either way); it is scattered
+    into the equivalent [2,2,4*3,F] kernel inside the step, which is exact
+    — every (tap, packed-channel) pair maps to one original (u,v,c) weight
+    or to zero where the 4x4 region exceeds the 3x3 window.
+    """
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, y):
+        # y: [B, H/2, W/2, 12] space-to-depth image
+        in_c = 3
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (3, 3, in_c, self.features), jnp.float32)
+        w2 = jnp.zeros((2, 2, 4 * in_c, self.features), w.dtype)
+        for dp in range(2):
+            for dq in range(2):
+                for di in range(2):
+                    for dj in range(2):
+                        u, v = 2 * dp + di, 2 * dq + dj
+                        if u < 3 and v < 3:
+                            ch = (di * 2 + dj) * in_c
+                            w2 = w2.at[dp, dq, ch:ch + in_c].set(w[u, v])
+        return jax.lax.conv_general_dilated(
+            y.astype(self.dtype), w2.astype(self.dtype),
+            window_strides=(1, 1), padding=((0, 1), (0, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 
 class BottleneckBlock(nn.Module):
@@ -104,6 +149,9 @@ class ResNet(nn.Module):
     # recompute conv/BN internals in backward (reference knob:
     # train_with_fleet.py:322-325 fleet recompute checkpointing)
     remat: bool = False
+    # MLPerf-style space-to-depth stem: exact, checkpoint-compatible
+    # re-layout of the thin first conv (vd stems only)
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -114,7 +162,11 @@ class ResNet(nn.Module):
                        param_dtype=jnp.float32)
         x = x.astype(self.dtype)
         if self.vd:
-            x = conv(32, (3, 3), strides=(2, 2), name="stem1")(x)
+            if self.space_to_depth:
+                x = _S2DStemConv(32, self.dtype, name="stem1")(
+                    space_to_depth(x, 2))
+            else:
+                x = conv(32, (3, 3), strides=(2, 2), name="stem1")(x)
             x = nn.relu(norm(name="stem_bn1")(x))
             x = conv(32, (3, 3), name="stem2")(x)
             x = nn.relu(norm(name="stem_bn2")(x))
@@ -148,13 +200,12 @@ def ResNet50_vd(**kw):
 
 def create_model_and_loss(depth=50, num_classes=1000, vd=True,
                           image_size=224, label_smoothing=0.1,
-                          dtype=jnp.bfloat16, remat=False):
+                          dtype=jnp.bfloat16, remat=False,
+                          space_to_depth=False):
     """Build (model, params, batch_stats, loss_fn) wired for ElasticTrainer
     with has_aux=True — aux carries the BatchNorm running stats."""
-    import jax
-
     model = ResNet(depth=depth, num_classes=num_classes, vd=vd, dtype=dtype,
-                   remat=remat)
+                   remat=remat, space_to_depth=space_to_depth)
     dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
     params = variables["params"]

@@ -26,6 +26,31 @@ def test_resnet50_vd_forward_shape():
     assert "downsample" in variables["params"]["stage1_block0"]
 
 
+def test_space_to_depth_stem_exact():
+    """The s2d stem is a pure compute-layout change: identical param tree
+    and bit-nearly-identical outputs for the SAME parameters."""
+    m0 = resnet.ResNet(depth=50, num_classes=10, vd=True, dtype=jnp.float32)
+    m1 = resnet.ResNet(depth=50, num_classes=10, vd=True, dtype=jnp.float32,
+                       space_to_depth=True)
+    x = jnp.asarray(np.random.RandomState(0)
+                    .randn(2, 64, 64, 3).astype(np.float32))
+    v = m0.init(jax.random.PRNGKey(0), x, train=False)
+    v1 = m1.init(jax.random.PRNGKey(0), x, train=False)
+    assert (jax.tree_util.tree_structure(v)
+            == jax.tree_util.tree_structure(v1))
+    y0 = m0.apply(v, x, train=False)
+    y1 = m1.apply(v, x, train=False)  # the s2d model with m0's params
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1),
+                               rtol=1e-5, atol=1e-5)
+    # gradients agree too (the scatter is differentiated through)
+    def loss(variables, model):
+        return (model.apply(variables, x, train=False) ** 2).mean()
+    g0 = jax.grad(loss)(v, m0)["params"]["stem1"]["kernel"]
+    g1 = jax.grad(loss)(v, m1)["params"]["stem1"]["kernel"]
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                               rtol=1e-4, atol=1e-6)
+
+
 @pytest.mark.parametrize("depth", [18, 50])
 def test_resnet_depths(depth):
     model = resnet.ResNet(depth=depth, num_classes=7, vd=False,
