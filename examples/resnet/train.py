@@ -41,6 +41,11 @@ def main(argv=None):
     p.add_argument("--fetch_steps", type=int, default=10)
     p.add_argument("--eval_steps", type=int, default=0,
                    help="eval batches per epoch on rank 0 (0 = off)")
+    p.add_argument("--data_dir", default=None,
+                   help="image-folder dataset root (class subdirs of "
+                        "jpegs); default = synthetic stream")
+    p.add_argument("--eval_dir", default=None,
+                   help="image-folder eval split (with --data_dir)")
     args = p.parse_args(argv)
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
@@ -54,6 +59,11 @@ def main(argv=None):
         base = lr_schedules.piecewise_decay(lr, bounds)
     schedule = lr_schedules.linear_warmup(
         base, args.warmup_epochs * args.steps_per_epoch)
+
+    if args.data_dir:
+        from edl_tpu.data.input_pipeline import list_image_files
+        files, class_names = list_image_files(args.data_dir)
+        args.num_classes = max(args.num_classes, len(class_names))
 
     model, params, extra, loss_fn = resnet.create_model_and_loss(
         depth=args.depth, num_classes=args.num_classes,
@@ -70,7 +80,7 @@ def main(argv=None):
              resumed), flush=True)
 
     evaluator = None
-    if args.eval_steps and env.global_rank == 0:
+    if (args.eval_steps or args.eval_dir) and env.global_rank == 0:
         from edl_tpu.runtime.evaluation import Evaluator
 
         def eval_apply(params, extra, batch):
@@ -79,7 +89,49 @@ def main(argv=None):
                 batch["image"], train=False)
         evaluator = Evaluator(eval_apply)
 
+    def host_batches(epoch):
+        """Per-host batch stream for one epoch (real data when --data_dir,
+        else the deterministic synthetic stream), capped at
+        steps_per_epoch."""
+        if args.data_dir:
+            from edl_tpu.data.input_pipeline import image_folder_pipeline
+            n = 0
+            while n < args.steps_per_epoch:  # cycle the folder if short
+                for b in image_folder_pipeline(
+                        args.data_dir, trainer.per_host_batch,
+                        image_size=args.image_size, train=True,
+                        epoch_seed=epoch * 131 + n,
+                        shard_index=env.global_rank,
+                        shard_count=trainer.world_size):
+                    if len(b["label"]) != trainer.per_host_batch:
+                        continue  # ragged tail
+                    yield b
+                    n += 1
+                    if n >= args.steps_per_epoch:
+                        return
+        else:
+            for step in range(args.steps_per_epoch):
+                full = resnet.synthetic_image_batch(
+                    args.total_batch_size, image_size=args.image_size,
+                    num_classes=args.num_classes,
+                    seed=epoch * 100000 + step)
+                lo = env.global_rank * trainer.per_host_batch
+                yield {k: v[lo:lo + trainer.per_host_batch]
+                       for k, v in full.items()}
+
+    def eval_batches():
+        if args.eval_dir:
+            from edl_tpu.data.input_pipeline import image_folder_pipeline
+            return image_folder_pipeline(
+                args.eval_dir, args.total_batch_size,
+                image_size=args.image_size, train=False)
+        return (resnet.synthetic_image_batch(
+            args.total_batch_size, image_size=args.image_size,
+            num_classes=args.num_classes, seed=2**31 - 1 - i)
+            for i in range(args.eval_steps))
+
     loss = None
+    accs = None
     imgs_seen = 0
     t_start = time.perf_counter()
     for epoch in range(start_epoch, args.epochs):
@@ -87,14 +139,7 @@ def main(argv=None):
             trainer.report_status(ts.TrainStatus.NEARTHEEND)
         trainer.begin_epoch(epoch)
         t_epoch = time.perf_counter()
-        for step in range(args.steps_per_epoch):
-            full = resnet.synthetic_image_batch(
-                args.total_batch_size, image_size=args.image_size,
-                num_classes=args.num_classes,
-                seed=epoch * 100000 + step)
-            lo = env.global_rank * trainer.per_host_batch
-            host_batch = {k: v[lo:lo + trainer.per_host_batch]
-                          for k, v in full.items()}
+        for step, host_batch in enumerate(host_batches(epoch)):
             loss = float(trainer.train_step(host_batch))
             imgs_seen += args.total_batch_size
             if (step + 1) % args.fetch_steps == 0:
@@ -112,26 +157,23 @@ def main(argv=None):
             import jax as _jax
             host_params = _jax.device_get(trainer.train_state["params"])
             host_extra = _jax.device_get(trainer.extra_state)
-            accs = evaluator.evaluate(
-                host_params, host_extra,
-                # negative-offset seed stream: disjoint from training's
-                # epoch*100000 + step for any epoch count
-                (resnet.synthetic_image_batch(
-                    args.total_batch_size, image_size=args.image_size,
-                    num_classes=args.num_classes, seed=2**31 - 1 - i)
-                 for i in range(args.eval_steps)))
+            accs = evaluator.evaluate(host_params, host_extra,
+                                      eval_batches())
             print("epoch %d eval: %s" % (epoch, accs), flush=True)
 
     trainer.report_status(ts.TrainStatus.SUCCEED)
     wall = time.perf_counter() - t_start
     # benchmark-log emission (reference train_with_fleet.py:642-658)
-    print(json.dumps({
+    result = {
         "model": "ResNet%d_vd" % args.depth,
         "final_loss": loss,
         "steps": trainer.global_step,
         "world": trainer.world_size,
         "imgs_per_sec": round(imgs_seen / wall, 1),
-    }), flush=True)
+    }
+    if accs:
+        result.update({"eval_" + k: v for k, v in accs.items()})
+    print(json.dumps(result), flush=True)
     return 0
 
 
