@@ -31,6 +31,15 @@ except ImportError:  # pragma: no cover
 _SEP = "/"
 
 
+class MissingKeysError(IOError):
+    """The checkpoint is valid but lacks keys the restore target needs
+    (e.g. a legacy checkpoint without the model's extra state)."""
+
+    def __init__(self, keys):
+        super().__init__("checkpoint missing keys: %s" % sorted(keys))
+        self.keys = frozenset(keys)
+
+
 def _path_key(path):
     return _SEP.join(str(getattr(p, "key", getattr(p, "idx", p)))
                      for p in path)
@@ -154,7 +163,7 @@ class CheckpointManager(object):
             keys, treedef = _paths(target)
             missing = set(keys) - set(arrays)
             if missing:
-                raise IOError("checkpoint missing keys: %s" % sorted(missing))
+                raise MissingKeysError(missing)
             tree = jax.tree_util.tree_unflatten(treedef,
                                                 [arrays[k] for k in keys])
         return version, tree, meta_blob["meta"]

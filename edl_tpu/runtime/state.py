@@ -90,6 +90,13 @@ class State(Serializable):
         for fn in self._adjust_fns:
             fn(self, new_world_size)
 
+    def carry_hooks_to(self, other):
+        """Transfer registered adjust hooks onto ``other`` (a State
+        deserialized from a checkpoint — hooks are process-local and never
+        serialized). Returns ``other``."""
+        other._adjust_fns = list(self._adjust_fns)
+        return other
+
     # -- serialization (skip private attrs) ----------------------------------
 
     def to_dict(self):

@@ -30,7 +30,7 @@ from edl_tpu.controller import train_status as train_status_mod
 from edl_tpu.controller.env import TrainerEnv
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.runtime import state as state_mod
-from edl_tpu.runtime.checkpoint import CheckpointManager
+from edl_tpu.runtime.checkpoint import CheckpointManager, MissingKeysError
 from edl_tpu.runtime.mesh import DATA_AXIS, make_mesh
 from edl_tpu.utils.logger import logger
 
@@ -348,8 +348,8 @@ class ElasticTrainer(object):
                 restored = self._ckpt.restore(version, target=host_state)
                 break
             except Exception as e:  # noqa: BLE001
-                if "missing keys" in str(e) and jax.tree_util.tree_leaves(
-                        host_state["extra"]):
+                if isinstance(e, MissingKeysError) \
+                        and jax.tree_util.tree_leaves(host_state["extra"]):
                     core = dict(host_state)
                     extra_target = core.pop("extra")
                     try:
@@ -367,10 +367,10 @@ class ElasticTrainer(object):
         version, tree, meta = restored
         self.train_state = jax.device_put(tree, self._repl)
         if meta.get("state"):
-            hooks = self.state._adjust_fns  # survive the state swap
-            self.state = state_mod.State().from_dict(meta["state"])
+            # hooks are process-local: carry them onto the restored state
+            self.state = self.state.carry_hooks_to(
+                state_mod.State().from_dict(meta["state"]))
             self.state.total_batch_size = self.total_batch_size
-            self.state._adjust_fns = hooks
         prev_world = (self.state.epochs.get(str(self.state.epoch_no), {})
                       .get("world_size", self.world_size))
         if prev_world != self.world_size:
