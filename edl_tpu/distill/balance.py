@@ -44,6 +44,8 @@ class Service(object):
         self._clients = {}   # client_id -> _Client
         self._client_ttl = client_ttl
         self._clock = clock
+        self._rebalances = 0
+        self._evicted = 0
 
     def _evict_stale_locked(self):
         """Drop clients whose last heartbeat is older than the TTL, then
@@ -58,6 +60,7 @@ class Service(object):
             logger.info("balance: evicted stale client %s (service %s)",
                         cid, self.name)
         if stale:
+            self._evicted += len(stale)
             self._rebalance()
 
     # -- membership ------------------------------------------------------------
@@ -123,6 +126,7 @@ class Service(object):
         return per_server, per_client
 
     def _rebalance(self):
+        self._rebalances += 1
         per_server, per_client = self._caps()
         if per_server == 0:
             for c in self._clients.values():
@@ -173,10 +177,26 @@ class Service(object):
 
     def stats(self):
         with self._lock:
+            loads = [len(v) for v in self._servers.values()]
+            _, per_client = self._caps()
+            sats = [len(c.servers) / max(1, min(per_client, c.require))
+                    for c in self._clients.values()]
             return {
                 "servers": {ep: len(v) for ep, v in self._servers.items()},
                 "clients": {c.id: sorted(c.servers)
                             for c in self._clients.values()},
+                # fairness: how evenly teachers are loaded and how close
+                # each student is to its entitled teacher count
+                "fairness": {
+                    "load_min": min(loads) if loads else 0,
+                    "load_max": max(loads) if loads else 0,
+                    "load_imbalance": (max(loads) - min(loads)
+                                       if loads else 0),
+                    "satisfaction": (round(sum(sats) / len(sats), 4)
+                                     if sats else 1.0),
+                    "rebalances": self._rebalances,
+                    "evicted": self._evicted,
+                },
             }
 
 

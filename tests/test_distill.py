@@ -64,6 +64,24 @@ def test_balance_invariants():
     assert changed
 
 
+def test_balance_fairness_metrics():
+    svc = Service("s")
+    svc.set_servers(["t1", "t2", "t3"])
+    for i in range(6):
+        svc.register_client("c%d" % i, require_num=2)
+    f = svc.stats()["fairness"]
+    # 6 clients over 3 teachers, per-client allowance 1 → even spread,
+    # everyone fully satisfied
+    assert f["load_imbalance"] <= 1
+    assert f["satisfaction"] == 1.0
+    assert f["rebalances"] > 0 and f["evicted"] == 0
+    # teacher loss → imbalance stays bounded after the rebalance
+    svc.set_servers(["t1", "t2"])
+    f2 = svc.stats()["fairness"]
+    assert f2["load_imbalance"] <= 1
+    assert f2["satisfaction"] == 1.0
+
+
 def test_balance_evicts_stale_clients():
     """Crashed students (no heartbeat for > TTL) must be evicted so their
     capacity returns to live clients — elastic resizes restart trainers
