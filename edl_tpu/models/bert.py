@@ -60,6 +60,48 @@ class BertSelfAttention(nn.Module):
         return out
 
 
+class MoeFFN(nn.Module):
+    """Mixture-of-experts FFN as a flax module: expert-parallel over the
+    mesh's ep axis when a mesh is given, dense fallback otherwise. The
+    Switch load-balancing aux loss is sowed into the "losses" collection
+    (collect with mutable=["losses"] and add to the training loss)."""
+    num_experts: int
+    d_ff: int
+    mesh: Any = None
+    k: int = 1
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from edl_tpu.parallel.moe import moe_ffn, moe_ffn_dense
+        d_model = x.shape[-1]
+        scale_in = nn.initializers.normal(d_model ** -0.5)
+        scale_out = nn.initializers.normal(self.d_ff ** -0.5)
+        params = {
+            "router": self.param("router", scale_in,
+                                 (d_model, self.num_experts), jnp.float32),
+            "w_in": self.param("w_in", scale_in,
+                               (self.num_experts, d_model, self.d_ff),
+                               jnp.float32),
+            "w_out": self.param("w_out", scale_out,
+                                (self.num_experts, self.d_ff, d_model),
+                                jnp.float32),
+        }
+        params = jax.tree_util.tree_map(
+            lambda a: a.astype(self.dtype), params)
+        tokens = x.reshape(-1, d_model).astype(self.dtype)
+        if self.mesh is not None:
+            y, aux = moe_ffn(params, tokens, self.mesh, k=self.k,
+                             capacity_factor=self.capacity_factor,
+                             return_aux=True)
+        else:
+            y, aux = moe_ffn_dense(params, tokens, k=self.k,
+                                   return_aux=True)
+        self.sow("losses", "moe_aux", aux)
+        return y.reshape(x.shape)
+
+
 class BertLayer(nn.Module):
     num_heads: int
     mlp_dim: int
@@ -67,6 +109,10 @@ class BertLayer(nn.Module):
     use_ring: bool = False
     use_flash: bool = False
     mesh: Any = None
+    # mixture-of-experts FFN: replaces the dense MLP with num_experts
+    # expert-parallel FFNs (ep mesh axis) behind a top-k router
+    moe_experts: int = 0
+    moe_k: int = 1
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -75,11 +121,15 @@ class BertLayer(nn.Module):
                                  name="attention")(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x + attn)
-        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlp_up")(x)
-        h = nn.gelu(h)
-        h = nn.Dense(x.shape[-1], dtype=self.dtype,
-                     param_dtype=jnp.float32, name="mlp_down")(h)
+        if self.moe_experts:
+            h = MoeFFN(self.moe_experts, self.mlp_dim, self.mesh,
+                       k=self.moe_k, dtype=self.dtype, name="moe")(x)
+        else:
+            h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_up")(x)
+            h = nn.gelu(h)
+            h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                         param_dtype=jnp.float32, name="mlp_down")(h)
         return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                             name="ln_mlp")(x + h)
 
@@ -103,6 +153,9 @@ class Bert(nn.Module):
     # backward pass — the TPU equivalent of the reference's recompute
     # checkpointing knob (train_with_fleet.py:322-325)
     remat: bool = False
+    # mixture-of-experts FFNs (expert-parallel over ep when mesh given)
+    moe_experts: int = 0
+    moe_k: int = 1
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
@@ -124,6 +177,7 @@ class Bert(nn.Module):
         for i in range(self.num_layers):
             x = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
                           self.use_ring, self.use_flash, self.mesh,
+                          moe_experts=self.moe_experts, moe_k=self.moe_k,
                           name="layer_%d" % i)(x, attention_mask)
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32,
                                    param_dtype=jnp.float32,
