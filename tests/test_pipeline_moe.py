@@ -131,6 +131,43 @@ def test_1f1b_bert_stack_matches_sequential():
             err_msg=jax.tree_util.keystr(path))
 
 
+def test_1f1b_composes_with_remat():
+    """remat'd stages under the 1F1B schedule: same loss/grads (the 1F1B
+    backward already recomputes the stage from its saved input, so remat
+    inside the stage must be a no-op numerically)."""
+    from edl_tpu.models.bert import create_bert_pipeline
+    from edl_tpu.parallel.pipeline import pipeline_value_and_grad
+
+    pp = 4
+    mesh = mesh_mod.make_mesh(dp=2, pp=pp)
+    base = create_bert_pipeline(pp, num_layers=4, d_model=32, num_heads=2,
+                                mlp_dim=64, vocab_size=100, max_len=64,
+                                seq_len=16, dtype=jnp.float32)
+    params, encode, stage, decode, seq_loss = base
+    import flax.linen as nn
+
+    from edl_tpu.models.bert import BertStage
+    remat_stage_mod = nn.remat(BertStage)(1, 2, 64, jnp.float32)
+
+    def remat_stage(p, x):
+        return remat_stage_mod.apply({"params": p}, x)
+
+    rng = np.random.RandomState(13)
+    ids = jnp.asarray(rng.randint(0, 100, (16, 16)).astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, 2, (16,)).astype(np.int32))
+    outs = {}
+    for name, stg in (("plain", stage), ("remat", remat_stage)):
+        loss, g = jax.jit(lambda p, i, l, s=stg: pipeline_value_and_grad(
+            p, i, l, encode_fn=encode, stage_fn=s, decode_fn=decode,
+            mesh=mesh, num_micro=4))(params, ids, labels)
+        outs[name] = (float(loss), g)
+    assert outs["plain"][0] == pytest.approx(outs["remat"][0], rel=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(outs["plain"][1]),
+                    jax.tree_util.tree_leaves(outs["remat"][1])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
 def test_moe_matches_dense_with_ample_capacity():
     mesh = mesh_mod.make_mesh(dp=2, ep=4)
     params = init_moe_params(jax.random.PRNGKey(0), num_experts=8,
