@@ -126,10 +126,16 @@ def main():
         result = run(batch_per_chip=args.batch_per_chip, iters=args.iters,
                      s2d=args.s2d, feed=args.feed)
     except Exception as e:  # noqa: BLE001
-        log("bench config failed (%r); retrying the r1 baseline config" % e)
+        was_r1_cfg = (args.batch_per_chip == 128 and not args.s2d
+                      and args.feed == "device")
         try:
+            if was_r1_cfg:
+                raise  # identical retry cannot succeed; go to smallcfg
+            log("bench config failed (%r); retrying the r1 baseline "
+                "config" % e)
             result = run(batch_per_chip=128, iters=args.iters, s2d=False,
                          feed="device")
+            result["metric"] += "_r1cfg"  # mark the substituted config
         except Exception as e2:  # noqa: BLE001
             log("full-size bench failed (%r); small-config fallback" % e2)
             result = run(batch_per_chip=8, image_size=64, warmup=2,
