@@ -63,16 +63,14 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
 
     if feed == "host":
         from edl_tpu.data.input_pipeline import synthetic_pipeline
-        stream = synthetic_pipeline(batch, image_size=image_size)
+        from edl_tpu.data.prefetch import DevicePrefetcher
 
-        def batches():
-            for host_batch in stream:
-                yield {
-                    "image": jax.device_put(
-                        host_batch["image"].astype(jnp.bfloat16), data_sh),
-                    "label": jax.device_put(host_batch["label"], data_sh),
-                }
-        it = batches()
+        def to_bf16(b):
+            return {"image": b["image"].astype(jnp.bfloat16),
+                    "label": b["label"]}
+        it = DevicePrefetcher(synthetic_pipeline(batch,
+                                                 image_size=image_size),
+                              data_sh, size=2, transform=to_bf16)
         next_batch = lambda: next(it)
     else:
         key = jax.random.PRNGKey(0)

@@ -1,0 +1,88 @@
+"""Host→device prefetch: overlap input-pipeline work and device_put with
+the running step (the role DALI's pipelined feed played for the reference;
+SURVEY.md §7 "hard parts" — host input pipeline keeping the MXU fed).
+
+A background thread pulls host batches, transfers them onto the sharded
+devices, and keeps ``size`` batches in flight; the training loop consumes
+already-resident arrays, so the host transfer happens strictly behind the
+previous step's compute.
+"""
+
+import queue
+import threading
+
+import jax
+
+_END = object()
+
+
+class DevicePrefetcher(object):
+    """Iterate device-resident batches, ``size`` transfers ahead.
+
+    host_iter: yields pytrees of numpy arrays.
+    sharding: a jax.sharding.Sharding (or pytree of them) for device_put.
+    transform: optional host-side fn applied before the transfer (e.g.
+    dtype cast). Stop early with .close(); the thread is a daemon, so an
+    abandoned prefetcher never blocks interpreter exit.
+    """
+
+    def __init__(self, host_iter, sharding, size=2, transform=None):
+        self._q = queue.Queue(maxsize=max(1, size))
+        self._stop = threading.Event()
+        self._err = None
+
+        def pump():
+            try:
+                for batch in host_iter:
+                    if self._stop.is_set():
+                        return
+                    if transform is not None:
+                        batch = transform(batch)
+                    arr = jax.device_put(batch, sharding)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(arr, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except Exception as e:  # noqa: BLE001 — surface on next()
+                self._err = e
+            finally:
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(_END, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=pump, daemon=True,
+                                        name="device-prefetch")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is _END:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+        # drain so the pump's blocked put wakes up
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
