@@ -43,6 +43,29 @@ def test_resnet_example_standalone():
 
 
 @pytest.mark.integration
+def test_bert_pipeline_example_learns():
+    env_flags = "--xla_force_host_platform_device_count=8"
+    import subprocess as sp
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": env_flags})
+    proc = sp.run(
+        [sys.executable, "-u",
+         os.path.join(REPO, "examples", "bert_pipeline", "train.py"),
+         "--pp", "4", "--steps", "60", "--d_model", "32",
+         "--num_heads", "2", "--mlp_dim", "64", "--seq_len", "16",
+         "--vocab_size", "50", "--lr", "5e-3"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert out["model"] == "bert_pipeline_pp4_dp2"
+    # the parity task is learnable: loss must drop toward 0 from ~ln(2)
+    assert out["final_loss"] < out["first_loss"] - 0.2, out
+
+
+@pytest.mark.integration
 def test_ctr_example_learns():
     out = _run_example("examples/ctr/train.py", [
         "--epochs", "2", "--steps_per_epoch", "30",
