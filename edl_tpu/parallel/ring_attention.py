@@ -21,7 +21,7 @@ from jax import lax
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from edl_tpu.runtime.mesh import DATA_AXIS, SEQ_AXIS
+from edl_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 _NEG_INF = -1e30
 
@@ -70,16 +70,26 @@ def _ring_attention_shard(q, k, v, *, axis_name, causal, sm_scale):
 
 
 def ring_attention(q, k, v, mesh, causal=False, sm_scale=None,
-                   batch_axis=DATA_AXIS, seq_axis=SEQ_AXIS):
+                   batch_axis=DATA_AXIS, seq_axis=SEQ_AXIS,
+                   head_axis="auto"):
     """Exact attention with q/k/v sequence-sharded over ``seq_axis``.
 
     Returns [batch, seq, heads, head_dim] with the same sharding as q.
     Differentiable (ppermute has a transpose rule; the backward pass runs
     the ring in reverse).
+
+    head_axis: additionally shard the head dim (tensor parallelism
+    composed with sequence parallelism — heads are independent, so the
+    ring runs per tp shard with no extra communication). "auto" uses the
+    mesh's tp axis when it is >1 and divides num_heads; None disables.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    spec = P(batch_axis, seq_axis, None, None)
+    if head_axis == "auto":
+        tp = mesh.shape.get(MODEL_AXIS, 1)
+        head_axis = (MODEL_AXIS
+                     if tp > 1 and q.shape[2] % tp == 0 else None)
+    spec = P(batch_axis, seq_axis, head_axis, None)
     fn = shard_map(
         functools.partial(_ring_attention_shard, axis_name=seq_axis,
                           causal=causal, sm_scale=sm_scale),
