@@ -45,3 +45,32 @@ def shard_params(params, mesh, rules):
         lambda s: NamedSharding(mesh, s), specs,
         is_leaf=lambda x: isinstance(x, P))
     return jax.device_put(params, shardings), shardings
+
+
+def opt_state_shardings(tx, params, param_shardings, default):
+    """Shardings for ``tx.init(params)``'s state, derived STRUCTURALLY:
+    optax states (momentum/mu/nu/trace) embed the param pytree verbatim,
+    so any opt-state leaf whose trailing path matches a param path gets
+    that param's sharding; everything else (counts, scalars) gets
+    ``default``. (Relying on jit sharding propagation through tx.init is
+    backend-dependent — the CPU backend returns single-device outputs —
+    so the derivation must not depend on it.)
+    """
+    flat = {}
+    for path, sh in jax.tree_util.tree_flatten_with_path(
+            param_shardings)[0]:
+        flat[tuple(str(getattr(p, "key", getattr(p, "idx", p)))
+                   for p in path)] = sh
+
+    opt_shape = jax.eval_shape(tx.init, params)
+
+    def pick(path, leaf):
+        keys = tuple(str(getattr(p, "key", getattr(p, "idx", p)))
+                     for p in path)
+        for start in range(len(keys)):
+            sh = flat.get(keys[start:])
+            if sh is not None:
+                return sh
+        return default
+
+    return jax.tree_util.tree_map_with_path(pick, opt_shape)

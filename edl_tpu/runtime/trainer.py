@@ -31,7 +31,7 @@ from edl_tpu.controller.env import TrainerEnv
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.runtime import state as state_mod
 from edl_tpu.runtime.checkpoint import CheckpointManager, MissingKeysError
-from edl_tpu.runtime.mesh import DATA_AXIS, make_mesh
+from edl_tpu.runtime.mesh import DATA_AXIS, data_sharding, make_mesh
 from edl_tpu.utils.logger import logger
 
 _distributed_initialized = False
@@ -155,7 +155,8 @@ class ElasticTrainer(object):
     def __init__(self, loss_fn, params, tx, total_batch_size,
                  checkpoint_dir=None, mesh=None, env=None, coord=None,
                  keep_checkpoints=3, extra_state=None, has_aux=False,
-                 async_save=False, remat_policy=None):
+                 async_save=False, remat_policy=None,
+                 param_shardings=None):
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -189,12 +190,38 @@ class ElasticTrainer(object):
                         "would silently truncate to 32-bit on device; keep "
                         "host-side metadata (file offsets, loader positions) "
                         "in trainer.state.user_defined instead" % dt)
-        self.train_state = make_train_state(params, tx, extra_state)
         self.state = state_mod.State(total_batch_size=total_batch_size)
-
         self._repl = NamedSharding(self.mesh, P())
-        self._batch_sharding = NamedSharding(self.mesh, P(DATA_AXIS))
-        self.train_state = jax.device_put(self.train_state, self._repl)
+        self._batch_sharding = data_sharding(self.mesh)
+
+        # model parallelism: partition rules (regex, PartitionSpec) or an
+        # explicit sharding pytree for the params; optimizer-state
+        # shardings are derived by running tx.init under jit so moments
+        # inherit their param's layout (net-new vs the reference: elastic
+        # stop-resume composes with tp — SURVEY.md §2.7)
+        if isinstance(param_shardings, (list, tuple)):
+            from edl_tpu.parallel.sharding import shard_params
+            params, param_shardings = shard_params(params, self.mesh,
+                                                   param_shardings)
+        self.train_state = make_train_state(params, tx, extra_state)
+        if param_shardings is None:
+            self._state_shardings = jax.tree_util.tree_map(
+                lambda _: self._repl, self.train_state)
+        else:
+            from edl_tpu.parallel.sharding import opt_state_shardings
+            params = jax.device_put(params, param_shardings)
+            opt_shardings = opt_state_shardings(tx, params,
+                                                param_shardings,
+                                                self._repl)
+            self.train_state["params"] = params
+            self.train_state["opt_state"] = jax.jit(
+                tx.init, out_shardings=opt_shardings)(params)
+            self._state_shardings = jax.tree_util.tree_map(
+                lambda _: self._repl, self.train_state)
+            self._state_shardings["params"] = param_shardings
+            self._state_shardings["opt_state"] = opt_shardings
+        self.train_state = jax.device_put(self.train_state,
+                                          self._state_shardings)
 
         self._ckpt = (CheckpointManager(checkpoint_dir,
                                         keep=keep_checkpoints)
@@ -219,8 +246,9 @@ class ElasticTrainer(object):
                                remat_policy=self._remat_policy)
         return jax.jit(
             step,
-            in_shardings=(self._repl, self._batch_sharding, self._repl),
-            out_shardings=(self._repl, self._repl),
+            in_shardings=(self._state_shardings, self._batch_sharding,
+                          self._repl),
+            out_shardings=(self._state_shardings, self._repl),
             donate_argnums=(0,))
 
     def shard_batch(self, host_batch):
@@ -365,7 +393,7 @@ class ElasticTrainer(object):
         if restored is None:
             return False
         version, tree, meta = restored
-        self.train_state = jax.device_put(tree, self._repl)
+        self.train_state = jax.device_put(tree, self._state_shardings)
         if meta.get("state"):
             # hooks are process-local: carry them onto the restored state
             self.state = self.state.carry_hooks_to(
