@@ -318,10 +318,14 @@ def bert_partition_rules():
     ]
 
 
-def create_model_and_loss(model=None, **kw):
-    """(model, params, loss_fn) for ElasticTrainer (classification)."""
+def create_model_and_loss(model=None, dummy_batch=1, dummy_seq=16, **kw):
+    """(model, params, loss_fn) for ElasticTrainer (classification).
+
+    dummy_batch/dummy_seq size the init trace — sharded models (use_ring
+    over sp, MoE over ep) need init shapes divisible by their mesh axes.
+    """
     model = model or bert_tiny(**kw)
-    dummy = jnp.zeros((1, 16), jnp.int32)
+    dummy = jnp.zeros((dummy_batch, dummy_seq), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), dummy)["params"]
 
     def loss_fn(params, batch, rng):

@@ -1,0 +1,107 @@
+"""Long-context BERT training: sequence parallelism via ring attention.
+
+Net-new vs the reference (no long-context support anywhere in its tree —
+SURVEY.md §5.7; a stated first-class goal of the TPU rebuild). The
+sequence axis is sharded over the ``sp`` mesh axis: each chip holds
+seq/sp tokens, kv blocks rotate around the ring over ICI
+(edl_tpu/parallel/ring_attention.py), and per-layer activation recompute
+(--remat) bounds activation memory, so context length scales with the
+number of chips instead of per-chip HBM.
+
+Hermetic run:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/long_context/train.py --sp 4 --seq_len 512 --steps 5
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.models import bert
+    from edl_tpu.runtime.mesh import data_sharding, make_mesh, replicated
+    from edl_tpu.runtime.trainer import make_train_state, make_train_step
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--sp", type=int, default=4)
+    p.add_argument("--dp", type=int, default=0,
+                   help="0 = all remaining devices")
+    p.add_argument("--seq_len", type=int, default=512)
+    p.add_argument("--batch_per_dp", type=int, default=2)
+    p.add_argument("--num_layers", type=int, default=2)
+    p.add_argument("--d_model", type=int, default=64)
+    p.add_argument("--num_heads", type=int, default=4)
+    p.add_argument("--mlp_dim", type=int, default=128)
+    p.add_argument("--vocab_size", type=int, default=1000)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--remat", action="store_true",
+                   help="per-layer activation recompute")
+    p.add_argument("--dtype", choices=["bf16", "f32"], default="f32")
+    args = p.parse_args(argv)
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    n = jax.device_count()
+    dp = args.dp or max(1, n // args.sp)
+    mesh = make_mesh(dp=dp, sp=args.sp,
+                     devices=jax.devices()[:dp * args.sp])
+    print("mesh: dp=%d sp=%d, seq %d (%d tokens/chip)"
+          % (dp, args.sp, args.seq_len, args.seq_len // args.sp),
+          flush=True)
+
+    model = bert.Bert(
+        num_layers=args.num_layers, d_model=args.d_model,
+        num_heads=args.num_heads, mlp_dim=args.mlp_dim,
+        vocab_size=args.vocab_size, max_len=args.seq_len, dtype=dtype,
+        use_ring=True, mesh=mesh, remat=args.remat)
+    _, params, loss_fn = bert.create_model_and_loss(
+        model=model, dummy_batch=dp * args.batch_per_dp,
+        dummy_seq=args.seq_len)
+    tx = optax.adamw(args.lr)
+    state = jax.device_put(make_train_state(params, tx), replicated(mesh))
+    data_sh = data_sharding(mesh)
+    jit_step = jax.jit(make_train_step(loss_fn, tx),
+                       in_shardings=(replicated(mesh), data_sh,
+                                     replicated(mesh)),
+                       out_shardings=(replicated(mesh), replicated(mesh)),
+                       donate_argnums=(0,))
+
+    rng = np.random.RandomState(0)
+    batch = dp * args.batch_per_dp
+    loss = first_loss = None
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        ids = rng.randint(0, args.vocab_size,
+                          (batch, args.seq_len)).astype(np.int32)
+        # learnable synthetic task: label = parity of the first token
+        host = {"input_ids": ids, "label": (ids[:, 0] % 2).astype(np.int32)}
+        dev = jax.device_put(host, data_sh)
+        state, loss = jit_step(state, dev,
+                               jax.device_put(jax.random.PRNGKey(step),
+                                              replicated(mesh)))
+        if first_loss is None:
+            first_loss = float(loss)
+    jax.block_until_ready(loss)
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "model": "bert_ring_sp%d_dp%d" % (args.sp, dp),
+        "seq_len": args.seq_len,
+        "first_loss": first_loss,
+        "final_loss": float(loss),
+        "steps": args.steps,
+        "tokens_per_sec": round(batch * args.seq_len * args.steps / wall,
+                                1),
+        "remat": bool(args.remat),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
