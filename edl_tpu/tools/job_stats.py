@@ -1,0 +1,112 @@
+"""Job-level observability: aggregate per-pod stats into one summary.
+
+Net-new vs the reference (it had no metrics surface; its design doc only
+called for perf reporting to the scheduler — SURVEY.md §5.5). Scrapes the
+store (cluster map, job/train status, elastic State, per-pod resize
+recovery histories) and every live pod's ``pod_stats`` RPC, and returns
+one JSON document — the thing an operator or autoscaler polls.
+
+CLI:
+  python -m edl_tpu.tools.job_stats --store_endpoints 127.0.0.1:2379 \
+      --job_id myjob
+"""
+
+import argparse
+import json
+import sys
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants, status
+from edl_tpu.controller.resource_pods import load_resource_pods
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.runtime import state as state_mod
+
+
+def collect_job_stats(coord, rpc_timeout=5.0):
+    out = {"job_id": coord.root}
+    try:
+        out["job_status"] = status.load_job_status(coord)  # plain string
+    except Exception:
+        out["job_status"] = None
+
+    cluster = None
+    try:
+        cluster = cluster_mod.load_from_store(coord)
+    except Exception:
+        pass
+    out["cluster"] = ({
+        "stage": cluster.stage,
+        "pods": [p.id for p in cluster.pods],
+        "world_size": cluster.world_size(),
+    } if cluster else None)
+
+    try:
+        state = state_mod.load_from_store(coord)
+    except Exception:
+        state = None
+    if state is not None:
+        epoch = state.epochs.get(str(state.epoch_no), {})
+        out["train"] = {
+            "epoch": state.epoch_no,
+            "global_step": state.global_step,
+            "world_size": epoch.get("world_size"),
+            "avg_step_time_s": epoch.get("avg_step_time"),
+            "total_batch_size": state.total_batch_size,
+        }
+        if epoch.get("avg_step_time") and state.total_batch_size:
+            out["train"]["samples_per_sec"] = round(
+                state.total_batch_size / epoch["avg_step_time"], 1)
+    else:
+        out["train"] = None
+
+    # per-pod resize-recovery histories (written by each launcher)
+    resize = {}
+    try:
+        for pod_id, raw in coord.get_service(constants.SERVICE_METRICS):
+            try:
+                resize[pod_id] = json.loads(raw)
+            except ValueError:
+                continue
+    except Exception:
+        pass
+    out["resize_history"] = resize
+    events = [e for h in resize.values() for e in h
+              if isinstance(e, dict) and "recovery_s" in e]
+    out["resize_count"] = len(events)
+    if events:
+        out["last_recovery_s"] = events[-1]["recovery_s"]
+
+    # live pod_stats scrape
+    pods = {}
+    try:
+        registered = load_resource_pods(coord)
+    except Exception:
+        registered = {}
+    for pod_id, pod in registered.items():
+        if not getattr(pod, "port", None):
+            continue
+        client = RpcClient(pod.endpoint, timeout=rpc_timeout)
+        try:
+            pods[pod_id] = client.call("pod_stats")
+        except Exception as e:  # noqa: BLE001 — dead pod, report as such
+            pods[pod_id] = {"error": repr(e)}
+        finally:
+            client.close()
+    out["pods"] = pods
+    out["pods_alive"] = sum(1 for v in pods.values() if "error" not in v)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="job-level stats scrape")
+    ap.add_argument("--store_endpoints", required=True)
+    ap.add_argument("--job_id", required=True)
+    args = ap.parse_args(argv)
+    coord = CoordClient(args.store_endpoints.split(","), root=args.job_id)
+    print(json.dumps(collect_job_stats(coord), indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -164,6 +164,38 @@ def test_pod_stats_endpoint(store, tmp_path):
 
 
 @pytest.mark.integration
+def test_job_stats_aggregation(store, tmp_path):
+    """The job-level observability scrape: store state + live pod_stats
+    in one document (net-new; reference had no metrics surface)."""
+    from edl_tpu.tools.job_stats import collect_job_stats
+
+    job = "launch_jobstats"
+    coord = store.client(root=job)
+    p1 = _spawn_launcher(store.endpoint, job, "1:1", tmp_path, "pod1",
+                         trainer_args=("20", "0"))
+    try:
+        c = _wait_cluster_size(coord, 1)
+        deadline = time.monotonic() + 30
+        stats = None
+        while time.monotonic() < deadline:
+            stats = collect_job_stats(coord)
+            if stats["pods_alive"] >= 1:
+                break
+            time.sleep(0.5)
+        assert stats["job_id"] == job
+        assert stats["cluster"]["stage"] == c.stage
+        assert stats["cluster"]["world_size"] == 1
+        assert stats["pods_alive"] == 1
+        pod_stat = list(stats["pods"].values())[0]
+        assert pod_stat["cluster_size"] == 1
+        # terminal flag unset while running (written at SUCCEED/FAILED)
+        assert stats["job_status"] in (None, "RUNNING", "INITIAL",
+                                       "PENDING")
+    finally:
+        _kill_group(p1)
+
+
+@pytest.mark.integration
 def test_below_min_nodes_fails_job(store, tmp_path):
     job = "launch_below_min"
     coord = store.client(root=job)
