@@ -50,6 +50,20 @@ def _flatten(tree):
     return {_path_key(p): np.asarray(leaf) for p, leaf in flat}, treedef
 
 
+def to_host_tree(tree):
+    """Fetch a (possibly sharded) device pytree to host numpy, multi-host
+    safe: leaves that are not fully addressable from this process (e.g.
+    tp-sharded across hosts) are all-gathered over jax.distributed first
+    — the shared-FS checkpoint write needs the GLOBAL array (reference
+    role: rank-0 fleet.save_check_point of the full model)."""
+    def fetch(x):
+        if getattr(x, "is_fully_addressable", True):
+            return jax.device_get(x)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return jax.tree_util.tree_map(fetch, tree)
+
+
 def _paths(tree):
     """Flat path keys + treedef without materializing leaves (target may
     hold ShapeDtypeStructs)."""

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from edl_tpu.controller import train_status as train_status_mod
 from edl_tpu.controller.env import TrainerEnv
 from edl_tpu.coordination.client import CoordClient
+from edl_tpu.runtime import checkpoint as checkpoint_mod
 from edl_tpu.runtime import state as state_mod
 from edl_tpu.runtime.checkpoint import CheckpointManager, MissingKeysError
 from edl_tpu.runtime.mesh import DATA_AXIS, data_sharding, make_mesh
@@ -325,16 +326,25 @@ class ElasticTrainer(object):
         state_snapshot = json.loads(self.state.to_json())
         meta = {"state": state_snapshot}
         if not self._async_save:
-            tree = jax.device_get(dict(self.train_state))
+            tree = checkpoint_mod.to_host_tree(dict(self.train_state))
             self._ckpt.save(version, tree, meta=meta)
             self._save_state_to_store(state_snapshot)
             return
         # immutable device-side snapshot, independent of donated buffers
         snapshot = jax.tree_util.tree_map(jnp.copy, dict(self.train_state))
 
+        # multi-host gather must happen ON the main thread (collectives);
+        # only fully-addressable fetches may move to the writer thread
+        addressable = all(
+            getattr(x, "is_fully_addressable", True)
+            for x in jax.tree_util.tree_leaves(snapshot))
+        if not addressable:
+            snapshot = checkpoint_mod.to_host_tree(snapshot)
+
         def _write():
             try:
-                self._ckpt.save(version, jax.device_get(snapshot),
+                self._ckpt.save(version,
+                                checkpoint_mod.to_host_tree(snapshot),
                                 meta=meta)
                 self._save_state_to_store(state_snapshot)
             except Exception:

@@ -57,6 +57,22 @@ loss, grads = jax.jit(
     out_shardings=(NamedSharding(mesh, P()),
                    NamedSharding(mesh, P(None, "tp"))))(w, x)
 gsum = float(jnp.abs(grads).sum())
+
+# cross-process-sharded checkpoint: w's tp shards live on BOTH processes;
+# to_host_tree must all-gather before the rank-0 write, and the restored
+# tree must match the global array
+from edl_tpu.runtime.checkpoint import CheckpointManager, to_host_tree
+import numpy as np
+host_tree = to_host_tree({"w": grads})
+assert host_tree["w"].shape == (16, 8), host_tree["w"].shape
+ckpt_dir = sys.argv[4]
+if rank == 0:
+    cm = CheckpointManager(ckpt_dir)
+    cm.save(1, host_tree)
+    _, restored, _ = cm.restore(1, target=host_tree)
+    assert np.array_equal(restored["w"], host_tree["w"])
+    print("CKPT OK", flush=True)
+
 print("RESULT rank=%d loss=%.10f gsum=%.10f" % (rank, float(loss), gsum),
       flush=True)
 """
@@ -125,8 +141,10 @@ def test_multiprocess_dcn_mesh(tmp_path):
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    ckpt_dir = str(tmp_path / "ckpt")
     procs = [subprocess.Popen(
-        [sys.executable, str(worker_py), coordinator, "2", str(rank)],
+        [sys.executable, str(worker_py), coordinator, "2", str(rank),
+         ckpt_dir],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for rank in range(2)]
     outs = []
@@ -146,3 +164,5 @@ def test_multiprocess_dcn_mesh(tmp_path):
     # reduction really happened and agreed
     f0, f1 = (r.split(" ", 1)[1] for r in results)
     assert f0.split("loss=")[1] == f1.split("loss=")[1], results
+    # the cross-process-sharded checkpoint gathered + round-tripped
+    assert any("CKPT OK" in out for out in outs), outs
