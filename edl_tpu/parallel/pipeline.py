@@ -288,6 +288,234 @@ def pipeline_value_and_grad(params, x, y, *, encode_fn, stage_fn, decode_fn,
     return fn(params, x, y)
 
 
+def _pipe_interleaved_shard(params, xs, ys, tables, *, encode_fn,
+                            stage_fn, decode_fn, sched, axis_name,
+                            batch_axes, n_batch):
+    """Interleaved (circular) schedule on one pp slice: V virtual stages
+    per device, ops driven by the static tables (pipeline_schedule.py).
+    Buffers are sized by the schedule's true high-water marks."""
+    nP = sched["num_stages"]
+    M = sched["num_micro"]
+    V = sched["num_chunks"]
+    T = sched["n_ticks"]
+    idx = lax.axis_index(axis_name)
+    p_enc, p_dec = params["encode"], params["decode"]
+    # local chunks: leading axis V (device-major global layout)
+    p_chunks = params["stages"]
+
+    mb_sz = xs.shape[0] // M
+    xmb = _tmap(lambda a: a.reshape((M, mb_sz) + a.shape[1:]), xs)
+    ymb = _tmap(lambda a: a.reshape((M, mb_sz) + a.shape[1:]), ys)
+
+    def take(tree, m):
+        return _tmap(lambda a: a[m], tree)
+
+    p_chunk0 = _tmap(lambda a: a[0], p_chunks)
+    act = jax.eval_shape(encode_fn, p_enc, take(xmb, 0))
+    out_shape = jax.eval_shape(stage_fn, p_chunk0, act)
+    if jax.tree_util.tree_structure(out_shape) \
+            != jax.tree_util.tree_structure(act):
+        raise ValueError("stage_fn must map the activation pytree to "
+                         "itself")
+    zeros_act = _tmap(lambda s: jnp.zeros(s.shape, s.dtype), act)
+
+    def buf(n):
+        return _tmap(lambda s: jnp.zeros((n,) + s.shape, s.dtype), act)
+
+    fwd_perm = [(i, (i + 1) % nP) for i in range(nP)]
+    bwd_perm = [((i + 1) % nP, i) for i in range(nP)]
+
+    state = dict(
+        fwd_carry=zeros_act, bwd_carry=zeros_act,
+        save=buf(sched["n_save_slots"]),
+        rxf=buf(sched["n_rxf_slots"]),
+        rxb=buf(sched["n_rxb_slots"]),
+        g_enc=_tmap(jnp.zeros_like, p_enc),
+        g_stages=_tmap(jnp.zeros_like, p_chunks),
+        g_dec=_tmap(jnp.zeros_like, p_dec),
+        loss=jnp.zeros((), jnp.float32),
+    )
+
+    def tick(t, state):
+        # phase 1: deposit ring arrivals into the receive buffers
+        rxf = _tmap(
+            lambda b, v: jnp.where(
+                tables["recv_f"][t, idx] > 0,
+                lax.dynamic_update_index_in_dim(
+                    b, v, tables["rxf_w"][t, idx], 0), b),
+            state["rxf"], state["fwd_carry"])
+        rxb = _tmap(
+            lambda b, v: jnp.where(
+                tables["recv_b"][t, idx] > 0,
+                lax.dynamic_update_index_in_dim(
+                    b, v, tables["rxb_w"][t, idx], 0), b),
+            state["rxb"], state["bwd_carry"])
+        state = dict(state, rxf=rxf, rxb=rxb)
+
+        kind = tables["op"][t, idx]
+        v = tables["chunk"][t, idx]
+        m = tables["mb"][t, idx]
+        sigma = v * nP + idx
+        p_v = _tmap(lambda a: a[v], p_chunks)
+
+        def do_idle(state):
+            return state, zeros_act, zeros_act
+
+        def do_fwd(state):
+            x_in = lax.cond(
+                sigma == 0,
+                lambda: encode_fn(p_enc, take(xmb, m)),
+                lambda: _tmap(lambda b: b[tables["rxf_r"][t, idx]],
+                              state["rxf"]))
+            y = stage_fn(p_v, x_in)
+            save = _tmap(
+                lambda b, val: lax.dynamic_update_index_in_dim(
+                    b, val, tables["save_slot"][t, idx], 0),
+                state["save"], x_in)
+            return dict(state, save=save), y, zeros_act
+
+        def do_bwd(state):
+            x_saved = _tmap(lambda b: b[tables["save_slot"][t, idx]],
+                            state["save"])
+
+            def last_stage():
+                def comp(ps, pd, x):
+                    return decode_fn(pd, stage_fn(ps, x), take(ymb, m))
+                loss_m, vjp = jax.vjp(comp, p_v, p_dec, x_saved)
+                gs, gd, gx = vjp(jnp.float32(1.0 / M))
+                return loss_m, gs, gd, gx
+
+            def mid_stage():
+                dy = _tmap(lambda b: b[tables["rxb_r"][t, idx]],
+                           state["rxb"])
+                _, vjp = jax.vjp(stage_fn, p_v, x_saved)
+                gs, gx = vjp(dy)
+                return (jnp.zeros((), jnp.float32), gs,
+                        _tmap(jnp.zeros_like, p_dec), gx)
+
+            loss_m, gs, gd, gx = lax.cond(
+                sigma == nP * V - 1, last_stage, mid_stage)
+            ge = lax.cond(
+                sigma == 0,
+                lambda: jax.vjp(
+                    lambda p: encode_fn(p, take(xmb, m)), p_enc)[1](
+                        gx)[0],
+                lambda: _tmap(jnp.zeros_like, p_enc))
+            g_stages = _tmap(lambda G, g: G.at[v].add(g),
+                             state["g_stages"], gs)
+            out = dict(
+                state, g_stages=g_stages,
+                g_dec=_tmap(lambda a, b: a + b, state["g_dec"], gd),
+                g_enc=_tmap(lambda a, b: a + b, state["g_enc"], ge),
+                loss=state["loss"] + loss_m / M)
+            return out, zeros_act, gx
+
+        state, y_send, g_send = lax.switch(kind, [do_idle, do_fwd, do_bwd],
+                                           state)
+        state["fwd_carry"] = _tmap(
+            lambda val: lax.ppermute(val, axis_name, fwd_perm), y_send)
+        state["bwd_carry"] = _tmap(
+            lambda val: lax.ppermute(val, axis_name, bwd_perm), g_send)
+        return state
+
+    state = lax.fori_loop(0, T, tick, state)
+
+    reduce_axes = (axis_name,) + tuple(batch_axes)
+    g_enc = _tmap(lambda g: lax.psum(g, reduce_axes) / n_batch,
+                  state["g_enc"])
+    g_dec = _tmap(lambda g: lax.psum(g, reduce_axes) / n_batch,
+                  state["g_dec"])
+    loss = lax.psum(state["loss"], reduce_axes) / n_batch
+    g_stages = state["g_stages"]
+    if batch_axes:
+        g_stages = _tmap(
+            lambda g: lax.psum(g, tuple(batch_axes)) / n_batch, g_stages)
+    return loss, {"encode": g_enc, "stages": g_stages, "decode": g_dec}
+
+
+def device_major_stage_params(stage_params, num_stages, num_chunks):
+    """Reorder a [S, ...] virtual-stage-major pytree into the device-major
+    layout the interleaved engine shards over pp: global index
+    j = (σ % P) * V + σ // P, so device s's contiguous block holds its
+    chunks σ = s, s+P, ..., s+(V-1)P in chunk order."""
+    perm = [0] * (num_stages * num_chunks)
+    for sigma in range(num_stages * num_chunks):
+        perm[(sigma % num_stages) * num_chunks + sigma // num_stages] = \
+            sigma
+    order = jnp.asarray(perm)
+    return _tmap(lambda a: a[order], stage_params)
+
+
+def virtual_stage_major_stage_params(stage_params, num_stages,
+                                     num_chunks):
+    """Inverse of device_major_stage_params."""
+    inv = [0] * (num_stages * num_chunks)
+    for sigma in range(num_stages * num_chunks):
+        inv[sigma] = (sigma % num_stages) * num_chunks \
+            + sigma // num_stages
+    order = jnp.asarray(inv)
+    return _tmap(lambda a: a[order], stage_params)
+
+
+def pipeline_value_and_grad_interleaved(params, x, y, *, encode_fn,
+                                        stage_fn, decode_fn, mesh,
+                                        num_chunks, num_micro=None,
+                                        pipe_axis=PIPE_AXIS,
+                                        batch_axes=None):
+    """(loss, grads) on the interleaved (circular) pipeline schedule.
+
+    params["stages"] has leading axis S = P * num_chunks in DEVICE-MAJOR
+    order (device_major_stage_params converts from σ order); each device
+    runs its V chunks per the static tables from
+    pipeline_schedule.build_schedule, shrinking the warmup bubble from
+    O(P) to O(P/V). Grads come back in the same layout, pp-sharded.
+    """
+    from edl_tpu.parallel.pipeline_schedule import build_schedule
+
+    num_stages = mesh.shape[pipe_axis]
+    if batch_axes is None:
+        batch_axes = tuple(
+            ax for ax in (DATA_AXIS,)
+            if ax in mesh.shape and mesh.shape[ax] > 1)
+    num_micro = num_micro or num_stages
+    batch = jax.tree_util.tree_leaves(x)[0].shape[0]
+    shard = 1
+    for ax in batch_axes:
+        shard *= mesh.shape[ax]
+    if (batch // shard) % num_micro != 0:
+        raise ValueError("per-shard batch %d not divisible by %d "
+                         "microbatches" % (batch // shard, num_micro))
+    n_stage_leaves = jax.tree_util.tree_leaves(params["stages"])
+    if n_stage_leaves[0].shape[0] != num_stages * num_chunks:
+        raise ValueError(
+            "stages leading axis %d != P*V = %d"
+            % (n_stage_leaves[0].shape[0], num_stages * num_chunks))
+
+    sched = build_schedule(num_stages, num_micro, num_chunks)
+    tables = {k: jnp.asarray(sched[k])
+              for k in ("op", "chunk", "mb", "recv_f", "recv_b",
+                        "save_slot", "rxf_w", "rxf_r", "rxb_w", "rxb_r")}
+
+    data_spec = P(tuple(batch_axes) if batch_axes else None)
+    param_specs = {
+        "encode": _tmap(lambda _: P(), params["encode"]),
+        "stages": _tmap(lambda _: P(pipe_axis), params["stages"]),
+        "decode": _tmap(lambda _: P(), params["decode"]),
+    }
+    table_specs = _tmap(lambda _: P(), tables)
+    fn = shard_map(
+        functools.partial(_pipe_interleaved_shard, encode_fn=encode_fn,
+                          stage_fn=stage_fn, decode_fn=decode_fn,
+                          sched=sched, axis_name=pipe_axis,
+                          batch_axes=tuple(batch_axes), n_batch=shard),
+        mesh=mesh,
+        in_specs=(param_specs, data_spec, data_spec, table_specs),
+        out_specs=(P(), {"encode": P(), "stages": P(pipe_axis),
+                         "decode": P()}),
+        check_vma=False)
+    return fn(params, x, y, tables)
+
+
 def sequential_apply(stage_params, x, stage_fn):
     """Reference implementation: apply stages one after another."""
     num_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]

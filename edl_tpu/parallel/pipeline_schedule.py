@@ -1,0 +1,302 @@
+"""Static schedule generation for interleaved (circular) pipeline
+parallelism.
+
+With V > 1 virtual stages (chunks) per device, the model is split into
+S = P*V chunks; device(σ) = σ % P, so a microbatch travels the physical
+ring V times. Interleaving shrinks the pipeline bubble from O(P) to
+O(P/V) warmup slots per flush (Megatron-style), at the price of a more
+intricate schedule. Because every shape here is static, the schedule is
+computed AT TRACE TIME by a list scheduler and baked into device-indexed
+tables; the SPMD engine (pipeline.py::pipeline_value_and_grad_interleaved)
+just executes table lookups.
+
+Dependencies modeled (one ring hop per tick, one op per device per tick):
+  F(σ,m) needs F(σ-1,m) at an earlier tick (activation arrives by ring)
+  B(σ,m) needs B(σ+1,m) at an earlier tick, and F(σ,m) already done
+Priority: backward-first (1F1B), then forward in (chunk, microbatch)
+order — reproducing the flush schedule at V=1.
+"""
+
+import numpy as np
+
+IDLE, FWD, BWD = 0, 1, 2
+
+
+def build_schedule(num_stages, num_micro, num_chunks=1, cap_slack=0):
+    """Greedy list schedule with a memory cap; the cap is a heuristic
+    (tightest = Megatron warmup count), so on the rare configs where the
+    greedy order deadlocks under it, retry with a looser cap — an
+    uncapped schedule always closes, so this terminates."""
+    last_err = None
+    for slack in range(cap_slack, cap_slack + 4 * num_stages + 3, 2):
+        try:
+            return _build_schedule(num_stages, num_micro, num_chunks,
+                                   slack)
+        except RuntimeError as e:
+            last_err = e
+    raise last_err
+
+
+def _build_schedule(num_stages, num_micro, num_chunks, cap_slack):
+    """One capped scheduling attempt. Returns a dict of numpy tables:
+
+    op[t, s]     in {IDLE, FWD, BWD}
+    chunk[t, s]  local chunk index v (0 when idle)
+    mb[t, s]     microbatch index (0 when idle)
+    recv_f[t, s] / recv_f_chunk / recv_f_mb: whether the fwd value
+      ARRIVING at device s at tick t (sent at t-1 by s-1) is valid, and
+      which (chunk, mb) it belongs to; likewise recv_b* for backward.
+    n_ticks, max_inflight (per device+chunk saved-input high-water mark).
+    """
+    P, M, V = num_stages, num_micro, num_chunks
+    S = P * V
+    done_f = np.full((S, M), -1, np.int64)   # tick each F completed
+    done_b = np.full((S, M), -1, np.int64)
+    ops = []                                  # per tick: list per device
+
+    # Megatron-style warmup cap: bound each device's outstanding
+    # (forwarded, not-yet-backwarded) chunk-microbatches so saved-input
+    # memory stays O(P*V) instead of O(M*V)
+    cap = [2 * (P - s - 1) + (V - 1) * P + 1 + cap_slack
+           for s in range(P)]
+
+    def device(sigma):
+        return sigma % P
+
+    total = 2 * S * M
+    completed = 0
+    t = 0
+    while completed < total:
+        if t > 16 * (S + M) + 64:            # safety: schedule must close
+            raise RuntimeError("scheduler did not converge")
+        tick_ops = [(IDLE, 0, 0)] * P
+        busy = [False] * P
+        # ready sets at tick t (dependencies completed strictly earlier)
+        for s in range(P):
+            best = None
+            # backward-first: scan chunks from the LAST virtual stage
+            for v in reversed(range(V)):
+                sigma = v * P + s
+                for m in range(M):
+                    if done_b[sigma, m] >= 0:
+                        continue
+                    if done_f[sigma, m] < 0 or done_f[sigma, m] >= t:
+                        continue
+                    if sigma < S - 1 and not (
+                            0 <= done_b[sigma + 1, m] < t):
+                        continue
+                    best = (BWD, v, m, sigma)
+                    break
+                if best:
+                    break
+            if best is None:
+                outstanding = sum(
+                    1 for v in range(V) for m in range(M)
+                    if done_f[v * P + s, m] >= 0
+                    and done_b[v * P + s, m] < 0)
+                if outstanding < cap[s]:
+                    # Megatron order: microbatch groups of size P cycle
+                    # through the chunks (group g: chunk 0 of mbs gP..gP+
+                    # P-1, then chunk 1 of the same group, ...), so deep
+                    # chunks get forwarded early and backwards can start
+                    cand = []
+                    for v in range(V):
+                        sigma = v * P + s
+                        for m in range(M):
+                            if done_f[sigma, m] >= 0:
+                                continue
+                            if sigma > 0 and not (
+                                    0 <= done_f[sigma - 1, m] < t):
+                                continue
+                            cand.append(((m // P, v, m % P), v, m, sigma))
+                    if cand:
+                        _, v, m, sigma = min(cand)
+                        best = (FWD, v, m, sigma)
+            if best is not None:
+                kind, v, m, sigma = best
+                tick_ops[s] = (kind, v, m)
+                busy[s] = True
+                if kind == FWD:
+                    done_f[sigma, m] = t
+                else:
+                    done_b[sigma, m] = t
+                completed += 1
+        ops.append(tick_ops)
+        t += 1
+    T = len(ops)
+
+    op = np.zeros((T, P), np.int32)
+    chunk = np.zeros((T, P), np.int32)
+    mb = np.zeros((T, P), np.int32)
+    for tt, tick_ops in enumerate(ops):
+        for s, (kind, v, m) in enumerate(tick_ops):
+            op[tt, s], chunk[tt, s], mb[tt, s] = kind, v, m
+
+    # arrival tables: what lands on device s at tick t from the ring.
+    # fwd: sender is device s-1 at t-1 doing F(σ,m) with σ < S-1 → the
+    # value belongs to σ+1 = chunk (σ+1)//P on device (σ+1)%P == s.
+    recv_f = np.zeros((T, P), np.int32)
+    recv_f_chunk = np.zeros((T, P), np.int32)
+    recv_f_mb = np.zeros((T, P), np.int32)
+    recv_b = np.zeros((T, P), np.int32)
+    recv_b_chunk = np.zeros((T, P), np.int32)
+    recv_b_mb = np.zeros((T, P), np.int32)
+    for tt in range(1, T):
+        for s in range(P):
+            kind, v, m = ops[tt - 1][(s - 1) % P]
+            if kind == FWD:
+                sigma = v * P + (s - 1) % P
+                if sigma < S - 1 and (sigma + 1) % P == s:
+                    recv_f[tt, s] = 1
+                    recv_f_chunk[tt, s] = (sigma + 1) // P
+                    recv_f_mb[tt, s] = m
+            kind, v, m = ops[tt - 1][(s + 1) % P]
+            if kind == BWD:
+                sigma = v * P + (s + 1) % P
+                if sigma > 0 and (sigma - 1) % P == s:
+                    recv_b[tt, s] = 1
+                    recv_b_chunk[tt, s] = (sigma - 1) // P
+                    recv_b_mb[tt, s] = m
+    # saved-input high-water mark per (device, chunk): F saves, B frees
+    max_inflight = 1
+    for s in range(P):
+        for v in range(V):
+            live = 0
+            peak = 0
+            for tt in range(T):
+                kind, vv, m = ops[tt][s]
+                if vv != v:
+                    continue
+                if kind == FWD:
+                    live += 1
+                    peak = max(peak, live)
+                elif kind == BWD:
+                    live -= 1
+            max_inflight = max(max_inflight, peak)
+    sched = {
+        "op": op, "chunk": chunk, "mb": mb,
+        "recv_f": recv_f, "recv_f_chunk": recv_f_chunk,
+        "recv_f_mb": recv_f_mb,
+        "recv_b": recv_b, "recv_b_chunk": recv_b_chunk,
+        "recv_b_mb": recv_b_mb,
+        "n_ticks": T, "max_inflight": max_inflight,
+        "num_stages": P, "num_micro": M, "num_chunks": V,
+    }
+    _assign_slots(sched, done_f, done_b)
+    return sched
+
+
+def _color_intervals(intervals):
+    """First-fit interval coloring: [(start, end, key)] → ({key: color},
+    n_colors). Optimal for interval graphs (= max overlap colors)."""
+    events = sorted(intervals, key=lambda iv: (iv[0], iv[1]))
+    colors = {}
+    free = []
+    n = 0
+    active = []  # (end, color)
+    for start, end, key in events:
+        active_new = []
+        for e, c in active:
+            if e < start:
+                free.append(c)
+            else:
+                active_new.append((e, c))
+        active = active_new
+        if free:
+            c = free.pop()
+        else:
+            c = n
+            n += 1
+        colors[key] = c
+        active.append((end, c))
+    return colors, max(n, 1)
+
+
+def _assign_slots(sched, done_f, done_b):
+    """Static buffer-slot tables so the engine's saved-input and receive
+    buffers are sized by true high-water marks, not by microbatch count:
+
+    save_slot[t, s]  — slot the tick-t op writes (FWD) or reads (BWD)
+    rxf_w[t, s] / rxf_r[t, s] — fwd receive-buffer slot at the arrival
+      tick / at the consuming FWD tick (likewise rxb_* for backward)
+    """
+    P, M, V = (sched["num_stages"], sched["num_micro"],
+               sched["num_chunks"])
+    S = P * V
+    T = sched["n_ticks"]
+    save_slot = np.zeros((T, P), np.int32)
+    rxf_w = np.zeros((T, P), np.int32)
+    rxf_r = np.zeros((T, P), np.int32)
+    rxb_w = np.zeros((T, P), np.int32)
+    rxb_r = np.zeros((T, P), np.int32)
+    n_save = n_rxf = n_rxb = 1
+    for s in range(P):
+        save_iv, rxf_iv, rxb_iv = [], [], []
+        for v in range(V):
+            sigma = v * P + s
+            for m in range(M):
+                tf, tb = int(done_f[sigma, m]), int(done_b[sigma, m])
+                save_iv.append((tf, tb, (sigma, m)))
+                if sigma > 0:
+                    arr = int(done_f[sigma - 1, m]) + 1
+                    rxf_iv.append((arr, tf, (sigma, m)))
+                if sigma < S - 1:
+                    arr = int(done_b[sigma + 1, m]) + 1
+                    rxb_iv.append((arr, tb, (sigma, m)))
+        sc, k = _color_intervals(save_iv)
+        n_save = max(n_save, k)
+        fc, k = _color_intervals(rxf_iv) if rxf_iv else ({}, 1)
+        n_rxf = max(n_rxf, k)
+        bc, k = _color_intervals(rxb_iv) if rxb_iv else ({}, 1)
+        n_rxb = max(n_rxb, k)
+        for v in range(V):
+            sigma = v * P + s
+            for m in range(M):
+                tf, tb = int(done_f[sigma, m]), int(done_b[sigma, m])
+                save_slot[tf, s] = sc[(sigma, m)]
+                save_slot[tb, s] = sc[(sigma, m)]
+                if sigma > 0:
+                    arr = int(done_f[sigma - 1, m]) + 1
+                    rxf_w[arr, s] = fc[(sigma, m)]
+                    rxf_r[tf, s] = fc[(sigma, m)]
+                if sigma < S - 1:
+                    arr = int(done_b[sigma + 1, m]) + 1
+                    rxb_w[arr, s] = bc[(sigma, m)]
+                    rxb_r[tb, s] = bc[(sigma, m)]
+    sched.update({
+        "save_slot": save_slot, "rxf_w": rxf_w, "rxf_r": rxf_r,
+        "rxb_w": rxb_w, "rxb_r": rxb_r,
+        "n_save_slots": n_save, "n_rxf_slots": n_rxf,
+        "n_rxb_slots": n_rxb,
+    })
+
+
+def validate_schedule(sched):
+    """Sanity obligations every schedule must satisfy (used by tests):
+    each F/B exactly once, dependency ordering, one-op-per-device."""
+    P, M, V = (sched["num_stages"], sched["num_micro"],
+               sched["num_chunks"])
+    S = P * V
+    T = sched["n_ticks"]
+    seen_f = {}
+    seen_b = {}
+    for tt in range(T):
+        for s in range(P):
+            kind = sched["op"][tt, s]
+            v, m = int(sched["chunk"][tt, s]), int(sched["mb"][tt, s])
+            sigma = v * P + s
+            if kind == FWD:
+                assert (sigma, m) not in seen_f
+                seen_f[(sigma, m)] = tt
+            elif kind == BWD:
+                assert (sigma, m) not in seen_b
+                seen_b[(sigma, m)] = tt
+    assert len(seen_f) == S * M and len(seen_b) == S * M
+    for (sigma, m), tt in seen_f.items():
+        if sigma > 0:
+            assert seen_f[(sigma - 1, m)] < tt
+    for (sigma, m), tt in seen_b.items():
+        assert seen_f[(sigma, m)] < tt
+        if sigma < S - 1:
+            assert seen_b[(sigma + 1, m)] < tt
+    return True

@@ -19,6 +19,10 @@ setup(
             "edl-tpu-discovery=edl_tpu.distill.discovery_server:main",
             "edl-tpu-register=edl_tpu.distill.registry:main",
             "edl-tpu-resize-driver=edl_tpu.tools.resize_driver:main",
+            "edl-tpu-liveft=edl_tpu.liveft.launch:main",
+            "edl-tpu-job-stats=edl_tpu.tools.job_stats:main",
+            "edl-tpu-fake-gcs=edl_tpu.tools.fake_gcs:main",
+            "edl-tpu-k8s-operator=edl_tpu.tools.k8s_operator:main",
         ],
     },
 )
