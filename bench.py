@@ -61,6 +61,7 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
                        out_shardings=(repl, repl), donate_argnums=(0,))
     rng = jax.device_put(jax.random.PRNGKey(0), repl)
 
+    prefetcher = None
     if feed == "host":
         from edl_tpu.data.input_pipeline import synthetic_pipeline
         from edl_tpu.data.prefetch import DevicePrefetcher
@@ -68,10 +69,10 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
         def to_bf16(b):
             return {"image": b["image"].astype(jnp.bfloat16),
                     "label": b["label"]}
-        it = DevicePrefetcher(synthetic_pipeline(batch,
-                                                 image_size=image_size),
-                              data_sh, size=2, transform=to_bf16)
-        next_batch = lambda: next(it)
+        prefetcher = DevicePrefetcher(
+            synthetic_pipeline(batch, image_size=image_size),
+            data_sh, size=2, transform=to_bf16)
+        next_batch = lambda: next(prefetcher)
     else:
         key = jax.random.PRNGKey(0)
         staged = {
@@ -84,19 +85,25 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
         }
         next_batch = lambda: staged
 
-    log("compiling + warmup (%d steps)..." % warmup)
-    t0 = time.perf_counter()
-    for _ in range(warmup):
-        state, loss = jit_step(state, next_batch(), rng)
-    jax.block_until_ready(loss)
-    log("warmup done in %.1fs (loss=%.3f)" % (time.perf_counter() - t0,
-                                              float(loss)))
+    try:
+        log("compiling + warmup (%d steps)..." % warmup)
+        t0 = time.perf_counter()
+        for _ in range(warmup):
+            state, loss = jit_step(state, next_batch(), rng)
+        jax.block_until_ready(loss)
+        log("warmup done in %.1fs (loss=%.3f)" % (time.perf_counter() - t0,
+                                                  float(loss)))
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = jit_step(state, next_batch(), rng)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = jit_step(state, next_batch(), rng)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    finally:
+        # a failed run must not leave the prefetch thread holding
+        # full-size device batches while the fallback config runs
+        if prefetcher is not None:
+            prefetcher.close()
 
     imgs_per_sec = batch * iters / dt
     per_chip = imgs_per_sec / n_chips

@@ -30,6 +30,7 @@ class DevicePrefetcher(object):
         self._q = queue.Queue(maxsize=max(1, size))
         self._stop = threading.Event()
         self._err = None
+        self._exhausted = False
 
         def pump():
             try:
@@ -65,8 +66,13 @@ class DevicePrefetcher(object):
         return self
 
     def __next__(self):
+        # iterator contract: keep raising StopIteration after exhaustion
+        # or close() — never park on the empty queue
+        if self._exhausted or self._stop.is_set():
+            raise StopIteration
         item = self._q.get()
         if item is _END:
+            self._exhausted = True
             if self._err is not None:
                 raise self._err
             raise StopIteration

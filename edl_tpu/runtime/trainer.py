@@ -308,15 +308,27 @@ class ElasticTrainer(object):
     def extra_state(self):
         return self.train_state["extra"]
 
+    def _state_fully_addressable(self):
+        return all(getattr(x, "is_fully_addressable", True)
+                   for x in jax.tree_util.tree_leaves(self.train_state))
+
     def save(self):
         """Rank-0 writes the versioned checkpoint + State (reference:
         rank0 fleet.save_check_point per epoch, train_with_fleet.py:562).
+        EVERY process must call this when the state is sharded across
+        hosts — the gather is a collective; only the write is rank-0.
 
         With ``async_save=True`` the write overlaps training: the state is
         copied ON DEVICE first (so later steps may donate the originals),
         then a background thread fetches and writes it; the manifest-last
         commit keeps partial writes invisible."""
-        if self._ckpt is None or self.env.global_rank != 0:
+        if self._ckpt is None:
+            return
+        gathered = None
+        if not self._state_fully_addressable():
+            # collective: all ranks participate, then non-writers return
+            gathered = checkpoint_mod.to_host_tree(dict(self.train_state))
+        if self.env.global_rank != 0:
             return
         self.wait_for_save()
         version = self.global_step
@@ -326,20 +338,17 @@ class ElasticTrainer(object):
         state_snapshot = json.loads(self.state.to_json())
         meta = {"state": state_snapshot}
         if not self._async_save:
-            tree = checkpoint_mod.to_host_tree(dict(self.train_state))
+            tree = (gathered if gathered is not None
+                    else checkpoint_mod.to_host_tree(
+                        dict(self.train_state)))
             self._ckpt.save(version, tree, meta=meta)
             self._save_state_to_store(state_snapshot)
             return
-        # immutable device-side snapshot, independent of donated buffers
-        snapshot = jax.tree_util.tree_map(jnp.copy, dict(self.train_state))
-
-        # multi-host gather must happen ON the main thread (collectives);
-        # only fully-addressable fetches may move to the writer thread
-        addressable = all(
-            getattr(x, "is_fully_addressable", True)
-            for x in jax.tree_util.tree_leaves(snapshot))
-        if not addressable:
-            snapshot = checkpoint_mod.to_host_tree(snapshot)
+        # immutable snapshot, independent of donated buffers: already on
+        # host when gathered; else a device-side copy
+        snapshot = (gathered if gathered is not None else
+                    jax.tree_util.tree_map(jnp.copy,
+                                           dict(self.train_state)))
 
         def _write():
             try:
@@ -379,7 +388,9 @@ class ElasticTrainer(object):
         # newest-first: per version, try the full state; when only the extra
         # keys are missing (legacy checkpoint), retry THAT version core-only
         # rather than falling back to an older checkpoint
-        host_state = jax.device_get(dict(self.train_state))
+        # (to_host_tree: every rank calls resume(), so the cross-host
+        # gather of sharded leaves is a valid collective here)
+        host_state = checkpoint_mod.to_host_tree(dict(self.train_state))
         restored = None
         for version in reversed(self._ckpt.versions()):
             try:

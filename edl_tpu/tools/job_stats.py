@@ -71,8 +71,10 @@ def collect_job_stats(coord, rpc_timeout=5.0):
     except Exception:
         pass
     out["resize_history"] = resize
-    events = [e for h in resize.values() for e in h
-              if isinstance(e, dict) and "recovery_s" in e]
+    events = sorted(
+        (e for h in resize.values() for e in h
+         if isinstance(e, dict) and "recovery_s" in e),
+        key=lambda e: e.get("ts", 0))  # chronological across pods
     out["resize_count"] = len(events)
     if events:
         out["last_recovery_s"] = events[-1]["recovery_s"]

@@ -66,6 +66,22 @@ def test_prefetch_close_unblocks_producer():
     assert len(produced) < 10
 
 
+def test_prefetch_iterator_contract_after_exhaustion_and_close():
+    mesh = make_mesh()
+    sh = data_sharding(mesh)
+    it = DevicePrefetcher(_batches(2), sh)
+    assert len(list(it)) == 2
+    with pytest.raises(StopIteration):
+        next(it)          # repeated next() must keep raising, not hang
+    with pytest.raises(StopIteration):
+        next(it)
+    it2 = DevicePrefetcher(_batches(5), sh)
+    next(it2)
+    it2.close()
+    with pytest.raises(StopIteration):
+        next(it2)         # closed → StopIteration, not a blocked get()
+
+
 def test_prefetch_feeds_training_loop():
     mesh = make_mesh()
     sh = data_sharding(mesh)
