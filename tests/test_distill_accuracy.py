@@ -1,0 +1,116 @@
+"""Distillation accuracy story (the ERNIE→BOW analogue, hermetic):
+a BERT teacher trained on plentiful data distills into a BOW student
+that only has a small labeled set — the distilled student must beat the
+label-only student on held-out data (reference result shape:
+example/distill/nlp README, BOW 0.901 → 0.905/0.915 with distill;
+BASELINE.md row 6)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.models import bert, bow
+
+VOCAB = 100
+SEQ = 17
+
+
+def _data(n, seed):
+    """Clean-margin count task: label = majority of tokens in the low
+    half of the vocab; borderline counts (7..10 of 17) rejected so the
+    decision boundary has margin."""
+    rng = np.random.RandomState(seed)
+    out_ids, out_y = [], []
+    while len(out_ids) < n:
+        ids = rng.randint(0, VOCAB, (4 * n, SEQ)).astype(np.int32)
+        counts = (ids < VOCAB // 2).sum(axis=1)
+        keep = (counts <= 6) | (counts >= 11)
+        out_ids.append(ids[keep])
+        out_y.append((counts[keep] >= 11).astype(np.int32))
+    ids = np.concatenate(out_ids)[:n]
+    labels = np.concatenate(out_y)[:n]
+    return ids, labels
+
+
+def _train(loss_fn, params, batches, lr=3e-3, steps=None):
+    tx = optax.adamw(lr)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(params, opt, batch):
+        loss, g = jax.value_and_grad(loss_fn)(params, batch,
+                                              jax.random.PRNGKey(0))
+        updates, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    for batch in batches:
+        params, opt, loss = step(params, opt, batch)
+    return params, float(loss)
+
+
+def _acc(model, params, ids, labels):
+    logits = jax.jit(
+        lambda p, i: model.apply({"params": p}, i))(params, ids)
+    return float((np.argmax(np.asarray(logits), -1) == labels).mean())
+
+
+@pytest.mark.integration
+def test_distillation_beats_label_only_student():
+    # --- teacher: BERT trained on plentiful labeled data ---------------
+    t_model, t_params, t_loss = bert.create_model_and_loss(
+        model=bert.bert_tiny(dtype=jnp.float32, vocab_size=VOCAB))
+    ids_big, y_big = _data(4096, seed=1)
+
+    def teacher_batches(steps, bs=64):
+        for i in range(steps):
+            lo = (i * bs) % (len(ids_big) - bs)
+            yield {"input_ids": jnp.asarray(ids_big[lo:lo + bs]),
+                   "label": jnp.asarray(y_big[lo:lo + bs])}
+
+    t_params, _ = _train(t_loss, t_params, teacher_batches(220), lr=1e-3)
+    ids_test, y_test = _data(512, seed=9)
+    t_acc = _acc(t_model, t_params, jnp.asarray(ids_test), y_test)
+    assert t_acc > 0.9, t_acc  # the teacher must actually know the task
+
+    @jax.jit
+    def teacher_logits(ids):
+        return t_model.apply({"params": t_params}, ids)
+
+    # --- students: 64 labeled samples only vs + teacher distillation ---
+    ids_small, y_small = _data(64, seed=2)
+    ids_unlab, _ = _data(2048, seed=3)
+
+    s_model, s_params0, s_loss_plain = bow.create_model_and_loss(
+        vocab_size=VOCAB, distill_weight=0.0)
+
+    def small_batches(steps, bs=32):
+        for i in range(steps):
+            lo = (i * bs) % max(1, len(ids_small) - bs)
+            yield {"input_ids": jnp.asarray(ids_small[lo:lo + bs]),
+                   "label": jnp.asarray(y_small[lo:lo + bs])}
+
+    plain_params, _ = _train(s_loss_plain, s_params0, small_batches(300))
+    plain_acc = _acc(s_model, plain_params, jnp.asarray(ids_test), y_test)
+
+    _, s_params1, s_loss_distill = bow.create_model_and_loss(
+        vocab_size=VOCAB, distill_weight=0.7, temperature=2.0)
+
+    def distill_batches(steps, bs=64):
+        for i in range(steps):
+            lo = (i * bs) % (len(ids_unlab) - bs)
+            chunk = jnp.asarray(ids_unlab[lo:lo + bs])
+            soft = teacher_logits(chunk)
+            yield {"input_ids": chunk,
+                   "label": jnp.argmax(soft, -1),  # teacher pseudo-labels
+                   "soft_label": soft}
+
+    dist_params, _ = _train(s_loss_distill, s_params1,
+                            distill_batches(300))
+    dist_acc = _acc(s_model, dist_params, jnp.asarray(ids_test), y_test)
+
+    # the reference's claim, reproduced: distillation closes the gap the
+    # small labeled set leaves open
+    assert dist_acc > plain_acc + 0.03, (plain_acc, dist_acc, t_acc)
+    assert dist_acc > 0.85, (plain_acc, dist_acc, t_acc)
