@@ -1,0 +1,112 @@
+"""Elastic data-plane training: fit_a_line fed by the ElasticReader.
+
+The end-to-end demonstration of the data server path the reference
+designed but never wired green (SURVEY.md §3.4): the rank-0 trainer hosts
+the leader data service over the on-disk file list; every trainer
+consumes balanced batches through its ElasticReader (batch stealing keeps
+slow pods from starving fast ones), records consumed ranges into the
+elastic State (``mark_consumed``), and checkpoints them — a restarted job
+resumes BEHIND the processed ranges (data-aware resume, exactly-once).
+
+Data format: one record per line, "v1 v2 ... v13 y".
+"""
+
+import argparse
+import json
+import sys
+
+import numpy as np
+import optax
+
+from edl_tpu.controller import train_status as ts
+from edl_tpu.data.reader import ElasticReader, lookup_data_leader
+from edl_tpu.data.splitter import TxtFileSplitter
+from edl_tpu.runtime.trainer import ElasticTrainer, maybe_init_distributed
+
+
+def _parse(records):
+    rows = np.asarray([[float(v) for v in r.split()] for r in records],
+                      np.float32)
+    return {"x": rows[:, :-1], "y": rows[:, -1]}
+
+
+def main(argv=None):
+    maybe_init_distributed()
+    from edl_tpu.models import linear
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--data_dir", required=True,
+                   help="directory of .txt record files")
+    p.add_argument("--batch_size", type=int, default=16,
+                   help="records per reader batch (= train batch here)")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--save_every", type=int, default=10)
+    args = p.parse_args(argv)
+
+    import glob
+    import os
+    files = sorted(glob.glob(os.path.join(args.data_dir, "*.txt")))
+    if not files:
+        raise SystemExit("no .txt files under %s" % args.data_dir)
+
+    trainer = ElasticTrainer(
+        linear.loss_fn, linear.init_params(), optax.sgd(args.lr),
+        total_batch_size=args.batch_size)
+    env = trainer.env
+    if trainer.world_size > 1:
+        # reader-paced stepping is per-pod; a multi-process jax world
+        # must step in lockstep — use the input-pipeline sharding path
+        # (examples/resnet --data_dir) for collective multi-host training
+        raise SystemExit("elastic_data demo runs at world_size == 1")
+    resumed = trainer.resume()
+    skip = (trainer.state.data_checkpoint.is_processed if resumed
+            else None)
+    print("elastic_data: rank=%d world=%d resumed=%s" %
+          (env.global_rank, trainer.world_size, resumed), flush=True)
+
+    pod_id = env.pod_id or ("solo_rank%d" % env.global_rank)
+    if env.global_rank == 0:
+        reader = ElasticReader(pod_id, TxtFileSplitter(),
+                               args.batch_size, file_list=files,
+                               is_leader=True, coord=trainer.coord,
+                               reader_name="fit_data", skip_record=skip)
+    else:
+        ep = lookup_data_leader(trainer.coord, "fit_data")
+        reader = ElasticReader(pod_id, TxtFileSplitter(),
+                               args.batch_size, leader_endpoint=ep,
+                               skip_record=skip)
+
+    trainer.begin_epoch(trainer.state.next_epoch() if resumed else 0)
+    trainer.report_status(ts.TrainStatus.RUNNING)
+    loss = None
+    seen = 0
+    try:
+        for batch in reader:
+            if not batch["records"]:
+                continue
+            arrays = _parse(batch["records"])
+            # ragged tails train too: the linear step takes any batch len
+            if len(arrays["y"]) == args.batch_size:
+                loss = float(trainer.train_step(arrays))
+            ElasticReader.mark_consumed(trainer.state, batch)
+            seen += len(batch["records"])
+            if trainer.global_step % args.save_every == 0:
+                trainer.end_epoch(save=True)
+                trainer.begin_epoch(trainer.state.epoch_no)
+    finally:
+        reader.stop()
+    trainer.end_epoch(save=True)
+    trainer.report_status(ts.TrainStatus.SUCCEED)
+
+    print(json.dumps({
+        "records_seen": seen,
+        "steps": trainer.global_step,
+        "final_loss": loss,
+        "world": trainer.world_size,
+        "resumed": resumed,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

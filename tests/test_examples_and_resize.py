@@ -148,6 +148,60 @@ def test_nlp_distill_example_with_bert_teacher():
         teacher.stop()
 
 
+@pytest.mark.integration
+def test_elastic_data_example_end_to_end(store, tmp_path):
+    """The data-server path e2e: launcher → trainer → ElasticReader
+    (leader balancer + batch serving) → mark_consumed/State checkpoints;
+    records_seen must equal the dataset exactly (no loss, no dupes)."""
+    import subprocess as sp
+
+    rng = np.random.RandomState(0)
+    w_true = np.linspace(-1.0, 1.0, 13).astype(np.float32)
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    total = 0
+    for f in range(8):
+        lines = []
+        for _ in range(64):
+            x = rng.randn(13).astype(np.float32)
+            y = float(x @ w_true + 0.5)
+            lines.append(" ".join("%.6f" % v for v in x) + " %.6f" % y)
+            total += 1
+        (data_dir / ("part%d.txt" % f)).write_text("\n".join(lines))
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"PYTHONPATH": REPO, "EDL_TPU_POD_IP": "127.0.0.1",
+                "EDL_TPU_TTL": "3", "JAX_PLATFORMS": "cpu"})
+    log = open(str(tmp_path / "pod1.log"), "wb")
+    p = sp.Popen(
+        [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
+         "--job_id", "edata", "--store_endpoints", store.endpoint,
+         "--nodes_range", "1:1",
+         "--checkpoint_path", str(tmp_path / "ckpt"),
+         "--log_dir", str(tmp_path / "pod1_logs"),
+         os.path.join(REPO, "examples", "elastic_data", "train.py"),
+         "--data_dir", str(data_dir), "--batch_size", "16"],
+        env=env, stdout=log, stderr=sp.STDOUT, preexec_fn=os.setsid)
+    log.close()
+    try:
+        assert p.wait(timeout=240) == 0, \
+            (tmp_path / "pod1.log").read_text()
+        worker_log = (tmp_path / "pod1_logs" / "workerlog.0").read_text()
+        out = json.loads([l for l in worker_log.splitlines()
+                          if l.startswith("{")][-1])
+        assert out["records_seen"] == total, out
+        assert out["steps"] == total // 16
+        assert out["final_loss"] < 0.5, out
+        coord = store.client(root="edata")
+        assert status.load_job_status(coord) == Status.SUCCEED
+    finally:
+        try:
+            os.killpg(os.getpgid(p.pid), 9)
+        except ProcessLookupError:
+            pass
+
+
 def _make_real_dataset(root, classes=4, per_class=48, size=48, seed=0):
     """Real JPEGs on disk with visually-learnable classes (distinct base
     colors + noise) in class-per-subdirectory layout."""
