@@ -13,8 +13,10 @@ Modes:
   --feed host    numpy batches from the input pipeline are sharded onto
                  device every step: the end-to-end rate a real training
                  loop sees (the role DALI played for the reference).
-Variants: --no-s2d disables the space-to-depth stem; --batch_per_chip to
-sweep. The default config is the fastest found on v5e.
+Variants: --s2d enables the space-to-depth stem (exactness-proven;
+throughput on v5e not yet measured — the dev TPU tunnel was down when it
+landed, see NOTES.md gap #1, so the measured r1 config stays the
+default); --batch_per_chip to sweep.
 """
 
 import argparse
@@ -30,7 +32,7 @@ def log(msg):
 
 
 def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
-        s2d=True, feed="device"):
+        s2d=False, feed="device"):
     import jax
     import jax.numpy as jnp
     import optax
@@ -124,7 +126,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch_per_chip", type=int, default=128)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--s2d", dest="s2d", action="store_true")
     ap.add_argument("--no-s2d", dest="s2d", action="store_false")
+    ap.set_defaults(s2d=False)
     ap.add_argument("--feed", choices=("device", "host"), default="device")
     args = ap.parse_args()
     try:
