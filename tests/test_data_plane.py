@@ -3,6 +3,7 @@ iteration with remote fetch — many actors in one process (reference shape:
 test_data_server.py)."""
 
 import threading
+import time
 
 from edl_tpu.data.data_server import (END, BatchCache, DataPlaneServer,
                                       LeaderDataService)
@@ -91,6 +92,11 @@ def test_two_readers_consume_everything(tmp_path, coord):
     def consume(name, reader):
         for batch in reader:
             got[name].extend(batch["records"])
+            # pace consumption so the other reader always gets a share
+            # even when CPU contention delays its start (the balancing
+            # POLICY is unit-tested in test_leader_service_balancing;
+            # this test is about exactly-once across two consumers)
+            time.sleep(0.05)
 
     t1 = threading.Thread(target=consume, args=("podA", r1))
     t2 = threading.Thread(target=consume, args=("podB", r2))
