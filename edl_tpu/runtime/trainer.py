@@ -126,6 +126,13 @@ def maybe_init_distributed(env=None):
     enable_compilation_cache()
     if _distributed_initialized or env.world_size <= 1:
         return env
+    # idempotent with external bootstrap (a test rig or launcher that
+    # already called jax.distributed.initialize)
+    state = getattr(getattr(jax, "_src", None), "distributed", None)
+    if state is not None and getattr(getattr(state, "global_state", None),
+                                     "client", None) is not None:
+        _distributed_initialized = True
+        return env
     jax.distributed.initialize(
         coordinator_address=env.coordinator,
         num_processes=env.world_size,
@@ -165,13 +172,32 @@ class ElasticTrainer(object):
             checkpoint_dir = self.env.checkpoint_path
         self.mesh = mesh if mesh is not None else make_mesh()
         self.total_batch_size = total_batch_size
-        n_dev = self.mesh.devices.size
-        if total_batch_size % n_dev != 0:
-            raise ValueError("total_batch_size %d not divisible by %d devices"
-                             % (total_batch_size, n_dev))
-        self.per_device_batch = total_batch_size // n_dev
-        self.per_host_batch = (total_batch_size
-                               * jax.local_device_count() // n_dev)
+        self._batch_sharding_early = data_sharding(self.mesh)
+        # batch divisibility is over the BATCH-SHARDED axes (dcn, dp) —
+        # with model axes (tp/sp/pp) in the mesh, rows are replicated
+        # across them, not split
+        n_batch_shards = 1
+        spec0 = self._batch_sharding_early.spec[0] \
+            if self._batch_sharding_early.spec else None
+        for ax in ((spec0,) if isinstance(spec0, str)
+                   else tuple(spec0 or ())):
+            n_batch_shards *= self.mesh.shape[ax]
+        if total_batch_size % n_batch_shards != 0:
+            raise ValueError(
+                "total_batch_size %d not divisible by %d batch shards"
+                % (total_batch_size, n_batch_shards))
+        self.per_device_batch = total_batch_size // n_batch_shards
+        # rows THIS process must supply = the union of its devices' batch
+        # spans (with cross-process model axes a process can own every
+        # row; with pure dp it owns a contiguous slice)
+        idx_map = self._batch_sharding_early \
+            .addressable_devices_indices_map((total_batch_size,))
+        spans = sorted({(sl[0].start or 0,
+                         total_batch_size if sl[0].stop is None
+                         else sl[0].stop)
+                        for sl in idx_map.values()})
+        self._host_row_spans = spans
+        self.per_host_batch = sum(b - a for a, b in spans)
 
         self._loss_fn = loss_fn
         self._tx = tx
@@ -193,7 +219,7 @@ class ElasticTrainer(object):
                         "in trainer.state.user_defined instead" % dt)
         self.state = state_mod.State(total_batch_size=total_batch_size)
         self._repl = NamedSharding(self.mesh, P())
-        self._batch_sharding = data_sharding(self.mesh)
+        self._batch_sharding = self._batch_sharding_early
 
         # model parallelism: partition rules (regex, PartitionSpec) or an
         # explicit sharding pytree for the params; optimizer-state
@@ -251,6 +277,15 @@ class ElasticTrainer(object):
                           self._repl),
             out_shardings=(self._state_shardings, self._repl),
             donate_argnums=(0,))
+
+    def local_batch_slice(self, full_batch):
+        """Slice a FULL global batch down to the rows this process must
+        supply (the complement of shard_batch): contiguous lo:hi under
+        pure dp; every row when a model axis (tp/sp) crosses hosts."""
+        def cut(x):
+            return np.concatenate([x[a:b] for a, b in
+                                   self._host_row_spans], axis=0)
+        return jax.tree_util.tree_map(cut, full_batch)
 
     def shard_batch(self, host_batch):
         """Turn per-host numpy arrays into a globally-sharded jax.Array over

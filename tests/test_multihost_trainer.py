@@ -1,0 +1,112 @@
+"""ElasticTrainer across REAL processes with params tp-sharded ACROSS the
+process boundary: train → save (collective gather + rank-0 write) →
+fresh-trainer resume on both ranks. This is the deadlock scenario of the
+multi-host checkpoint path: save() must be called by every rank, gather
+collectively, and only rank 0 writes."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+coordinator, nprocs, rank, ckpt = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4])
+import os
+os.environ["EDL_TPU_GLOBAL_RANK"] = str(rank)
+os.environ["EDL_TPU_WORLD_SIZE"] = str(nprocs)
+jax.distributed.initialize(coordinator_address=coordinator,
+                           num_processes=nprocs, process_id=rank)
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from edl_tpu.models import bert
+from edl_tpu.runtime.trainer import ElasticTrainer
+
+# tp axis SPANS the two processes: column j of the mesh = process j's
+# devices, so every tp pair crosses the host boundary and the params are
+# NOT fully addressable from either process
+devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+mine = [d for d in devs if d.process_index == 0]
+theirs = [d for d in devs if d.process_index == 1]
+mesh = Mesh(np.stack([mine, theirs], axis=1), ("dp", "tp"))
+
+def make_trainer():
+    model, params, loss_fn = bert.create_model_and_loss(
+        model=bert.bert_tiny(dtype=jnp.float32))
+    return ElasticTrainer(
+        loss_fn, params, optax.adamw(1e-3), total_batch_size=16,
+        checkpoint_dir=ckpt, mesh=mesh,
+        param_shardings=bert.bert_partition_rules())
+
+trainer = make_trainer()
+qkv = trainer.train_state["params"]["layer_0"]["attention"]["query"][
+    "kernel"]
+assert not qkv.is_fully_addressable, "tp must cross the process boundary"
+
+full = bert.synthetic_text_batch(16, seq_len=16)
+# tp crosses processes → every process supplies ALL rows
+host_batch = trainer.local_batch_slice(full)
+assert host_batch["label"].shape[0] == 16, host_batch["label"].shape
+for i in range(2):
+    loss = float(trainer.train_step(host_batch))
+trainer.begin_epoch(0)
+trainer.end_epoch(save=True)   # collective gather; rank-0 write
+print("SAVED rank=%d loss=%.6f" % (rank, loss), flush=True)
+
+trainer2 = make_trainer()
+assert trainer2.resume(), "resume failed"
+assert trainer2.global_step == 2
+q2 = trainer2.train_state["params"]["layer_0"]["attention"]["query"][
+    "kernel"]
+assert not q2.is_fully_addressable
+l2 = float(trainer2.train_step(host_batch))
+print("RESUMED rank=%d loss=%.6f" % (rank, l2), flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_multihost_tp_trainer_save_resume(tmp_path):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = "127.0.0.1:%d" % port
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER)
+    ckpt = str(tmp_path / "ckpt")
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker_py), coordinator, "2", str(rank),
+         ckpt],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode("utf-8", "replace"))
+            assert p.returncode == 0, "\n".join(outs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    text = "\n".join(outs)
+    assert text.count("SAVED") == 2, text
+    assert text.count("RESUMED") == 2, text
+    # both ranks agree on the post-resume loss (replicated-consistent)
+    resumed = sorted(ln.split("loss=")[1] for ln in text.splitlines()
+                     if ln.startswith("RESUMED"))
+    assert resumed[0] == resumed[1], resumed
