@@ -49,10 +49,8 @@ def main(argv=None):
         for step in range(args.steps_per_epoch):
             seed = epoch * 10000 + step
             full = linear.synthetic_batch(args.total_batch_size, seed=seed)
-            lo = env.global_rank * trainer.per_host_batch
-            host_batch = {k: v[lo:lo + trainer.per_host_batch]
-                          for k, v in full.items()}
-            loss = float(trainer.train_step(host_batch))
+            loss = float(trainer.train_step(
+                trainer.local_batch_slice(full)))
             if args.step_sleep:
                 import time
                 time.sleep(args.step_sleep)

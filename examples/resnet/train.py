@@ -115,9 +115,7 @@ def main(argv=None):
                     args.total_batch_size, image_size=args.image_size,
                     num_classes=args.num_classes,
                     seed=epoch * 100000 + step)
-                lo = env.global_rank * trainer.per_host_batch
-                yield {k: v[lo:lo + trainer.per_host_batch]
-                       for k, v in full.items()}
+                yield trainer.local_batch_slice(full)
 
     def eval_batches():
         if args.eval_dir:
