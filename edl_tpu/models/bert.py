@@ -24,6 +24,10 @@ class BertSelfAttention(nn.Module):
     use_ring: bool = False
     use_flash: bool = False
     mesh: Any = None
+    # in-shard ring: the module is ALREADY inside a shard_map (e.g. a
+    # pipeline stage) and the named axis carries the sequence sharding —
+    # run the ring body directly instead of opening a nested shard_map
+    ring_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -34,7 +38,14 @@ class BertSelfAttention(nn.Module):
         q = dense((self.num_heads, head_dim), "query")(x)
         k = dense((self.num_heads, head_dim), "key")(x)
         v = dense((self.num_heads, head_dim), "value")(x)
-        if self.use_ring:
+        if self.ring_axis:
+            from edl_tpu.parallel.ring_attention import (
+                _ring_attention_shard)
+            ctx = _ring_attention_shard(q, k, v,
+                                        axis_name=self.ring_axis,
+                                        causal=False,
+                                        sm_scale=head_dim ** -0.5)
+        elif self.use_ring:
             from edl_tpu.parallel.ring_attention import ring_attention
             ctx = ring_attention(q, k, v, self.mesh, causal=False)
         elif self.use_flash:
@@ -109,6 +120,7 @@ class BertLayer(nn.Module):
     use_ring: bool = False
     use_flash: bool = False
     mesh: Any = None
+    ring_axis: Optional[str] = None  # in-shard ring (see BertSelfAttention)
     # mixture-of-experts FFN: replaces the dense MLP with num_experts
     # expert-parallel FFNs (ep mesh axis) behind a top-k router
     moe_experts: int = 0
@@ -118,6 +130,7 @@ class BertLayer(nn.Module):
     def __call__(self, x, mask=None):
         attn = BertSelfAttention(self.num_heads, self.dtype, self.use_ring,
                                  self.use_flash, self.mesh,
+                                 ring_axis=self.ring_axis,
                                  name="attention")(x, mask)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x + attn)
@@ -189,11 +202,14 @@ class Bert(nn.Module):
 
 
 class BertEmbed(nn.Module):
-    """The pipeline ``encode`` end: token ids → activations (stage 0)."""
+    """The pipeline ``encode`` end: token ids → activations (stage 0).
+    With ``seq_axis`` set (in-shard sequence parallelism) each shard
+    embeds its seq SLICE, so positions are offset by the shard index."""
     vocab_size: int
     d_model: int
     max_len: int
     dtype: Any = jnp.bfloat16
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids):
@@ -201,41 +217,58 @@ class BertEmbed(nn.Module):
         word = nn.Embed(self.vocab_size, self.d_model,
                         param_dtype=jnp.float32, dtype=self.dtype,
                         name="word_embed")(input_ids)
+        pos_ids = jnp.arange(s)[None, :]
+        if self.seq_axis:
+            pos_ids = pos_ids + jax.lax.axis_index(self.seq_axis) * s
         pos = nn.Embed(self.max_len, self.d_model, param_dtype=jnp.float32,
-                       dtype=self.dtype,
-                       name="pos_embed")(jnp.arange(s)[None, :])
+                       dtype=self.dtype, name="pos_embed")(pos_ids)
         return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                             name="ln_embed")(word + pos)
 
 
 class BertStage(nn.Module):
     """One pipeline stage: ``layers_per_stage`` BertLayers, activation →
-    activation (the uniform ring body for pipeline_value_and_grad)."""
+    activation (the uniform ring body for pipeline_value_and_grad).
+    ring_axis composes sequence parallelism INTO the pipeline stage: the
+    layers' attention runs the in-shard ring over that mesh axis."""
     layers_per_stage: int
     num_heads: int
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     remat: bool = False
+    ring_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
         layer_cls = nn.remat(BertLayer) if self.remat else BertLayer
         for i in range(self.layers_per_stage):
             x = layer_cls(self.num_heads, self.mlp_dim, self.dtype,
+                          ring_axis=self.ring_axis,
                           name="layer_%d" % i)(x)
         return x
 
 
 class BertHead(nn.Module):
-    """The pipeline ``decode`` end: activations → logits (last stage)."""
+    """The pipeline ``decode`` end: activations → logits (last stage).
+    mean_pool replaces CLS pooling (required under sequence parallelism,
+    where token 0 lives on one shard; seq_axis pmean makes the pooled
+    vector global)."""
     d_model: int
     num_classes: int
+    mean_pool: bool = False
+    seq_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
+        if self.mean_pool:
+            pooled_in = x.mean(axis=1)
+            if self.seq_axis:
+                pooled_in = jax.lax.pmean(pooled_in, self.seq_axis)
+        else:
+            pooled_in = x[:, 0]
         pooled = jnp.tanh(nn.Dense(self.d_model, dtype=jnp.float32,
                                    param_dtype=jnp.float32,
-                                   name="pooler")(x[:, 0]))
+                                   name="pooler")(pooled_in))
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         param_dtype=jnp.float32, name="classifier")(pooled)
 
@@ -243,7 +276,7 @@ class BertHead(nn.Module):
 def create_bert_pipeline(pp, num_layers=4, d_model=64, num_heads=4,
                          mlp_dim=128, vocab_size=1000, max_len=128,
                          num_classes=2, seq_len=16, dtype=jnp.bfloat16,
-                         seed=0):
+                         seed=0, seq_parallel_axis=None):
     """A BERT classifier factored for pipeline parallelism.
 
     Returns (params, encode_fn, stage_fn, decode_fn, sequential_loss):
@@ -251,13 +284,31 @@ def create_bert_pipeline(pp, num_layers=4, d_model=64, num_heads=4,
     for ``pipeline_value_and_grad``; ``sequential_loss(params, ids,
     labels)`` is the numerically-identical unpipelined composite for
     grad-equivalence tests and single-chip runs.
+
+    seq_parallel_axis composes sequence parallelism into the pipeline:
+    the apply fns run on seq SLICES inside the pipeline's shard_map —
+    shard-offset positions, in-shard ring attention, pmean mean-pooling —
+    and decode returns this shard's loss contribution (pass the same
+    axis name as pipeline_value_and_grad's seq_axes). Params are
+    identical either way (attention impl and pooling don't change the
+    tree), so init uses the plain modules.
     """
     if num_layers % pp != 0:
         raise ValueError("num_layers %d not divisible by pp %d"
                          % (num_layers, pp))
+    spa = seq_parallel_axis
+    mean_pool = spa is not None
+    # init twins (no collectives — init runs outside any shard_map)
     embed = BertEmbed(vocab_size, d_model, max_len, dtype)
     stage = BertStage(num_layers // pp, num_heads, mlp_dim, dtype)
-    head = BertHead(d_model, num_classes)
+    head = BertHead(d_model, num_classes, mean_pool=mean_pool)
+    # apply variants (collectives over spa, valid inside shard_map)
+    embed_sp = BertEmbed(vocab_size, d_model, max_len, dtype,
+                         seq_axis=spa)
+    stage_sp = BertStage(num_layers // pp, num_heads, mlp_dim, dtype,
+                         ring_axis=spa)
+    head_sp = BertHead(d_model, num_classes, mean_pool=mean_pool,
+                       seq_axis=spa)
 
     root = jax.random.PRNGKey(seed)
     k_embed, k_head, *k_stages = jax.random.split(root, 2 + pp)
@@ -271,22 +322,29 @@ def create_bert_pipeline(pp, num_layers=4, d_model=64, num_heads=4,
     params = {"encode": p_enc, "stages": p_stages, "decode": p_dec}
 
     def encode_fn(p, batch_x):
-        return embed.apply({"params": p}, batch_x)
+        return embed_sp.apply({"params": p}, batch_x)
 
     def stage_fn(p, x):
-        return stage.apply({"params": p}, x)
+        return stage_sp.apply({"params": p}, x)
 
     def decode_fn(p, x, labels):
-        logits = head.apply({"params": p}, x)
+        logits = head_sp.apply({"params": p}, x)
         one_hot = jax.nn.one_hot(labels, num_classes)
-        return optax.softmax_cross_entropy(logits, one_hot).mean()
+        loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+        if spa:
+            # per-shard CONTRIBUTION: the engine sums over seq_axes
+            loss = loss / jax.lax.psum(1, spa)
+        return loss
 
     def sequential_loss(params, batch_x, labels):
-        x = encode_fn(params["encode"], batch_x)
+        """Unsharded reference: dense attention on the full sequence."""
+        x = embed.apply({"params": params["encode"]}, batch_x)
         for s in range(pp):
             p_s = jax.tree_util.tree_map(lambda a: a[s], params["stages"])
-            x = stage_fn(p_s, x)
-        return decode_fn(params["decode"], x, labels)
+            x = stage.apply({"params": p_s}, x)
+        logits = head.apply({"params": params["decode"]}, x)
+        one_hot = jax.nn.one_hot(labels, num_classes)
+        return optax.softmax_cross_entropy(logits, one_hot).mean()
 
     return params, encode_fn, stage_fn, decode_fn, sequential_loss
 

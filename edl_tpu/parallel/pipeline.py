@@ -107,7 +107,7 @@ def pipeline_apply(stage_params, x, stage_fn, mesh, num_micro=None,
 
 def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
                      num_stages, num_micro, axis_name, batch_axes,
-                     n_batch):
+                     n_batch, seq_axes=()):
     """1F1B on one pp slice (all stages run this SPMD; ``idx`` picks the
     role). Schedule (fwd cost == bwd slot): stage s runs forward of
     microbatch m at tick s + 2m and backward of m at tick 2P-1-s + 2m —
@@ -156,6 +156,73 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
     def masked_add(acc, new, valid):
         return _tmap(lambda a, n: a + jnp.where(valid, n, 0).astype(a.dtype),
                      acc, new)
+
+    def tick_uniform(t, state):
+        """seq-parallel variant: stage_fn/decode_fn contain collectives
+        over seq_axes, and collectives must execute on EVERY device in
+        the same order each tick — different pp stages taking different
+        lax.cond branches would leave subgroup collectives with missing
+        participants. So both the forward and the backward path are
+        computed every tick and the results are mask-selected (the
+        throughput price of composing sp into an SPMD pipeline)."""
+        tf = t - idx
+        is_fwd = (tf % 2 == 0)
+        m_f = jnp.clip(tf // 2, 0, M - 1)
+        f_valid = jnp.logical_and(is_fwd,
+                                  jnp.logical_and(tf // 2 >= 0,
+                                                  tf // 2 < M))
+        tb = t - (2 * nP - 1 - idx)
+        m_b = jnp.clip(tb // 2, 0, M - 1)
+        b_valid = jnp.logical_and(~is_fwd,
+                                  jnp.logical_and(tb >= 0, tb // 2 < M))
+
+        def sel(pred, a, b):
+            return _tmap(lambda u, v: jnp.where(pred, u, v), a, b)
+
+        # ---- forward path (always executed) --------------------------
+        enc_out = encode_fn(p_enc, take(xmb, m_f))
+        x_in = sel(idx == 0, enc_out, state["fwd_carry"])
+        y = stage_fn(p_stage, x_in)
+        slot_f = m_f % nP
+        buf = _tmap(
+            lambda b_, v: jnp.where(
+                f_valid,
+                lax.dynamic_update_index_in_dim(b_, v, slot_f, 0), b_),
+            state["buf"], x_in)
+
+        # ---- backward path (always executed) -------------------------
+        x_saved = _tmap(lambda b_: b_[m_b % nP], buf)
+
+        def comp(ps, pd, x):
+            return decode_fn(pd, stage_fn(ps, x), take(ymb, m_b))
+
+        loss_m, vjp_last = jax.vjp(comp, p_stage, p_dec, x_saved)
+        gs_l, gd_l, gx_l = vjp_last(jnp.float32(1.0 / M))
+        _, vjp_mid = jax.vjp(stage_fn, p_stage, x_saved)
+        gs_m, gx_m = vjp_mid(state["bwd_carry"])
+        is_last = idx == nP - 1
+        gs = sel(is_last, gs_l, gs_m)
+        gx = sel(is_last, gx_l, gx_m)
+        gd = sel(is_last, gd_l, _tmap(jnp.zeros_like, p_dec))
+        _, vjp_enc = jax.vjp(
+            lambda p: encode_fn(p, take(xmb, m_b)), p_enc)
+        ge = sel(idx == 0, vjp_enc(gx)[0], _tmap(jnp.zeros_like, p_enc))
+
+        state = dict(
+            state, buf=buf,
+            g_stage=masked_add(state["g_stage"], gs, b_valid),
+            g_dec=masked_add(state["g_dec"], gd, b_valid),
+            g_enc=masked_add(state["g_enc"], ge, b_valid),
+            loss=state["loss"] + jnp.where(
+                jnp.logical_and(b_valid, is_last), loss_m,
+                0).astype(jnp.float32) / M)
+        state["fwd_carry"] = _tmap(
+            lambda v: lax.ppermute(v, axis_name, fwd_perm),
+            sel(f_valid, y, zeros_act))
+        state["bwd_carry"] = _tmap(
+            lambda v: lax.ppermute(v, axis_name, bwd_perm),
+            sel(b_valid, gx, zeros_act))
+        return state
 
     def tick(t, state):
         tf = t - idx                   # forward clock of this stage
@@ -223,26 +290,29 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
             lambda v: lax.ppermute(v, axis_name, bwd_perm), g_send)
         return state
 
-    state = lax.fori_loop(0, 2 * (nP + M) - 2, tick, state)
+    state = lax.fori_loop(0, 2 * (nP + M) - 2,
+                          tick_uniform if seq_axes else tick, state)
 
     # encode/decode grads + loss live on one stage each → share over pp;
-    # then reduce everything over the batch axes (dp and friends)
-    reduce_axes = (axis_name,) + tuple(batch_axes)
+    # reduce over the batch axes (mean: /n_batch) and the seq axes (sum:
+    # each sp shard computed a PARTIAL contribution from its seq slice)
+    reduce_axes = (axis_name,) + tuple(batch_axes) + tuple(seq_axes)
     g_enc = _tmap(lambda g: lax.psum(g, reduce_axes) / n_batch,
                   state["g_enc"])
     g_dec = _tmap(lambda g: lax.psum(g, reduce_axes) / n_batch,
                   state["g_dec"])
     loss = lax.psum(state["loss"], reduce_axes) / n_batch
     g_stage = _tmap(lambda g: g[None], state["g_stage"])
-    if batch_axes:
+    stage_reduce = tuple(batch_axes) + tuple(seq_axes)
+    if stage_reduce:
         g_stage = _tmap(
-            lambda g: lax.psum(g, tuple(batch_axes)) / n_batch, g_stage)
+            lambda g: lax.psum(g, stage_reduce) / n_batch, g_stage)
     return loss, {"encode": g_enc, "stages": g_stage, "decode": g_dec}
 
 
 def pipeline_value_and_grad(params, x, y, *, encode_fn, stage_fn, decode_fn,
                             mesh, num_micro=None, pipe_axis=PIPE_AXIS,
-                            batch_axes=None):
+                            batch_axes=None, seq_axes=None):
     """(loss, grads) of a pipelined network on the 1F1B schedule.
 
     params: {"encode": pytree, "stages": pytree with leading stage axis
@@ -252,12 +322,21 @@ def pipeline_value_and_grad(params, x, y, *, encode_fn, stage_fn, decode_fn,
     x/y batch dims are sharded over ``batch_axes`` (defaults to ("dp",)
     when present in the mesh); grads are psum-reduced over them and
     returned with "stages" still pp-sharded.
+
+    seq_axes: sequence parallelism COMPOSED with the pipeline — x's dim 1
+    (and the activations) shard over these mesh axes; stage/encode/decode
+    fns run on seq slices and may use lax collectives over the axis names
+    directly (e.g. the in-shard ring attention). decode_fn must return
+    this shard's CONTRIBUTION to the loss (sum of per-shard terms ÷
+    global counts); the engine sums contributions over seq_axes.
     """
     num_stages = mesh.shape[pipe_axis]
     if batch_axes is None:
         batch_axes = tuple(
             ax for ax in (DATA_AXIS,)
             if ax in mesh.shape and mesh.shape[ax] > 1)
+    if seq_axes is None:
+        seq_axes = ()
     num_micro = num_micro or num_stages
     batch = jax.tree_util.tree_leaves(x)[0].shape[0]
     shard = 1
@@ -268,7 +347,9 @@ def pipeline_value_and_grad(params, x, y, *, encode_fn, stage_fn, decode_fn,
             "per-shard batch %d not divisible by %d microbatches"
             % (batch // shard, num_micro))
 
-    data_spec = P(tuple(batch_axes) if batch_axes else None)
+    bspec = tuple(batch_axes) if batch_axes else None
+    x_spec = (P(bspec, tuple(seq_axes)) if seq_axes else P(bspec))
+    y_spec = P(bspec)
     param_specs = {
         "encode": _tmap(lambda _: P(), params["encode"]),
         "stages": _tmap(lambda _: P(pipe_axis), params["stages"]),
@@ -279,9 +360,9 @@ def pipeline_value_and_grad(params, x, y, *, encode_fn, stage_fn, decode_fn,
                           stage_fn=stage_fn, decode_fn=decode_fn,
                           num_stages=num_stages, num_micro=num_micro,
                           axis_name=pipe_axis, batch_axes=tuple(batch_axes),
-                          n_batch=shard),
+                          n_batch=shard, seq_axes=tuple(seq_axes)),
         mesh=mesh,
-        in_specs=(param_specs, data_spec, data_spec),
+        in_specs=(param_specs, x_spec, y_spec),
         out_specs=(P(), {"encode": P(), "stages": P(pipe_axis),
                          "decode": P()}),
         check_vma=False)
