@@ -1,0 +1,90 @@
+"""VGG 11/13/16/19 in flax.linen, bf16-first for the MXU.
+
+Reference parity: the second family in the collective example's model zoo
+(example/collective/resnet50/models/vgg.py:37-115 — 5 conv blocks of
+[1,1,2,2,2]/[2,2,2,2,2]/[2,2,3,3,3]/[2,2,4,4,4] 3x3 convs + 2x2 max
+pools, then 4096-4096-classes FCs with dropout 0.5). TPU-first: NHWC,
+bfloat16 compute with float32 params, global-average option to avoid the
+7x7x512x4096 flatten when finetuning small inputs.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+VGG_SPECS = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+class VGG(nn.Module):
+    depth: int = 16
+    num_classes: int = 1000
+    fc_dim: int = 4096
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        if self.depth not in VGG_SPECS:
+            raise ValueError("supported depths %s, got %d"
+                             % (sorted(VGG_SPECS), self.depth))
+        x = x.astype(self.dtype)
+        for block, (filters, n_convs) in enumerate(
+                zip((64, 128, 256, 512, 512), VGG_SPECS[self.depth])):
+            for i in range(n_convs):
+                x = nn.Conv(filters, (3, 3), dtype=self.dtype,
+                            param_dtype=jnp.float32,
+                            name="conv%d_%d" % (block + 1, i + 1))(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        for i, name in enumerate(("fc6", "fc7")):
+            x = nn.relu(nn.Dense(self.fc_dim, dtype=self.dtype,
+                                 param_dtype=jnp.float32, name=name)(x))
+            x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="fc8")(x)
+
+
+def VGG11(**kw):
+    return VGG(depth=11, **kw)
+
+
+def VGG13(**kw):
+    return VGG(depth=13, **kw)
+
+
+def VGG16(**kw):
+    return VGG(depth=16, **kw)
+
+
+def VGG19(**kw):
+    return VGG(depth=19, **kw)
+
+
+def create_model_and_loss(depth=16, num_classes=1000, image_size=224,
+                          fc_dim=4096, dtype=jnp.bfloat16,
+                          label_smoothing=0.1):
+    """(model, params, loss_fn) wired for ElasticTrainer (no aux state —
+    VGG has no BatchNorm)."""
+    model = VGG(depth=depth, num_classes=num_classes, fc_dim=fc_dim,
+                dtype=dtype)
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), dummy,
+                        train=False)["params"]
+
+    def loss_fn(params, batch, rng):
+        logits = model.apply({"params": params}, batch["image"],
+                             train=True, rngs={"dropout": rng})
+        one_hot = optax.smooth_labels(
+            jax.nn.one_hot(batch["label"], num_classes), label_smoothing)
+        return optax.softmax_cross_entropy(logits, one_hot).mean()
+
+    return model, params, loss_fn

@@ -60,6 +60,32 @@ def test_resnet_depths(depth):
     assert model.apply(variables, x, train=False).shape == (1, 7)
 
 
+@pytest.mark.parametrize("depth", [11, 16])
+def test_vgg_forward_and_train(depth, tmp_path):
+    from edl_tpu.models import vgg
+
+    model, params, loss_fn = vgg.create_model_and_loss(
+        depth=depth, num_classes=4, image_size=32, fc_dim=64,
+        dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    logits = model.apply({"params": params}, x, train=False)
+    assert logits.shape == (2, 4) and logits.dtype == jnp.float32
+    # block structure per the reference spec table
+    n_convs = sum(vgg.VGG_SPECS[depth])
+    conv_names = [k for k in jax.tree_util.tree_flatten_with_path(
+        params)[0] for k in [jax.tree_util.keystr(k[0])]
+        if "conv" in k and "kernel" in k]
+    assert len(conv_names) == n_convs
+
+    trainer = ElasticTrainer(
+        loss_fn, params, optax.sgd(0.01, momentum=0.9),
+        total_batch_size=16, checkpoint_dir=str(tmp_path / "ckpt"))
+    batch = resnet.synthetic_image_batch(16, image_size=32, num_classes=4,
+                                         seed=0)
+    losses = [float(trainer.train_step(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
 def test_resnet_trains_with_bn_aux(tmp_path):
     model, params, extra, loss_fn = resnet.create_model_and_loss(
         depth=18, num_classes=4, vd=True, image_size=32,
