@@ -48,6 +48,11 @@ class BarrierError(EdlError):
     pass
 
 
+class JobFailedError(EdlError):
+    """The job was marked FAILED while this actor was waiting on it."""
+    pass
+
+
 class ClusterChangedError(EdlError):
     pass
 
