@@ -138,6 +138,11 @@ class _BarrierSession(object):
         self._leader_id = None
 
 
+# public alias: callers running their own retry loop (e.g. the
+# launcher's abortable sliced barrier) reuse one session across attempts
+BarrierSession = _BarrierSession
+
+
 def barrier_wait(coord, pod_id, timeout=constants.BARRIER_TIMEOUT):
     """Block until every pod of the current cluster has checked in; returns
     the agreed Cluster. Raises TimeoutError_ after ``timeout`` seconds."""

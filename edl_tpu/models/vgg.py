@@ -4,8 +4,9 @@ Reference parity: the second family in the collective example's model zoo
 (example/collective/resnet50/models/vgg.py:37-115 — 5 conv blocks of
 [1,1,2,2,2]/[2,2,2,2,2]/[2,2,3,3,3]/[2,2,4,4,4] 3x3 convs + 2x2 max
 pools, then 4096-4096-classes FCs with dropout 0.5). TPU-first: NHWC,
-bfloat16 compute with float32 params, global-average option to avoid the
-7x7x512x4096 flatten when finetuning small inputs.
+bfloat16 compute with float32 params; ``global_pool`` replaces the
+7x7x512→4096 flatten with global average pooling, making the head
+input-size-independent (finetuning at non-224 resolutions).
 """
 
 from typing import Any
@@ -29,6 +30,7 @@ class VGG(nn.Module):
     fc_dim: int = 4096
     dtype: Any = jnp.bfloat16
     dropout: float = 0.5
+    global_pool: bool = False  # avg-pool instead of flatten (size-free)
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -44,7 +46,10 @@ class VGG(nn.Module):
                             name="conv%d_%d" % (block + 1, i + 1))(x)
                 x = nn.relu(x)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
-        x = x.reshape((x.shape[0], -1))
+        if self.global_pool:
+            x = x.mean(axis=(1, 2))
+        else:
+            x = x.reshape((x.shape[0], -1))
         for i, name in enumerate(("fc6", "fc7")):
             x = nn.relu(nn.Dense(self.fc_dim, dtype=self.dtype,
                                  param_dtype=jnp.float32, name=name)(x))

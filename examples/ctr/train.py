@@ -52,10 +52,8 @@ def main(argv=None):
             full = deepfm.synthetic_ctr_batch(
                 args.total_batch_size, vocabs,
                 seed=epoch * 100000 + step)
-            lo = env.global_rank * trainer.per_host_batch
-            host_batch = {k: v[lo:lo + trainer.per_host_batch]
-                          for k, v in full.items()}
-            loss = float(trainer.train_step(host_batch))
+            loss = float(trainer.train_step(
+                trainer.local_batch_slice(full)))
         trainer.end_epoch(save=True)
         print("epoch %d loss %.4f" % (epoch, loss), flush=True)
 

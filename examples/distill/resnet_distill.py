@@ -82,17 +82,14 @@ def main(argv=None):
         dr.set_fixed_teacher([e for e in args.teachers.split(",") if e])
 
     loss = None
-    rank = trainer.env.global_rank
-    per_host = trainer.per_host_batch
     for epoch in range(args.epochs):
         trainer.begin_epoch(epoch)
         for image, label, soft_label in dr():
-            lo = rank * per_host  # this rank's slice of the global batch
-            loss = float(trainer.train_step({
-                "image": np.asarray(image)[lo:lo + per_host],
-                "label": np.asarray(label)[lo:lo + per_host],
-                "soft_label": np.asarray(soft_label)[lo:lo + per_host],
-            }))
+            loss = float(trainer.train_step(trainer.local_batch_slice({
+                "image": np.asarray(image),
+                "label": np.asarray(label),
+                "soft_label": np.asarray(soft_label),
+            })))
         trainer.end_epoch(save=False)
         print("epoch %d loss %.4f" % (epoch, loss), flush=True)
     dr.stop()

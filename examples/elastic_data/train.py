@@ -19,7 +19,7 @@ import numpy as np
 import optax
 
 from edl_tpu.controller import train_status as ts
-from edl_tpu.data.reader import ElasticReader, lookup_data_leader
+from edl_tpu.data.reader import ElasticReader
 from edl_tpu.data.splitter import TxtFileSplitter
 from edl_tpu.runtime.trainer import ElasticTrainer, maybe_init_distributed
 
@@ -64,35 +64,35 @@ def main(argv=None):
     print("elastic_data: rank=%d world=%d resumed=%s" %
           (env.global_rank, trainer.world_size, resumed), flush=True)
 
+    # world_size == 1 here (guarded above): this process is the reader
+    # leader. Multi-reader balancing is exercised by the data-plane tests
+    # (tests/test_data_plane.py::test_two_readers_consume_everything).
     pod_id = env.pod_id or ("solo_rank%d" % env.global_rank)
-    if env.global_rank == 0:
-        reader = ElasticReader(pod_id, TxtFileSplitter(),
-                               args.batch_size, file_list=files,
-                               is_leader=True, coord=trainer.coord,
-                               reader_name="fit_data", skip_record=skip)
-    else:
-        ep = lookup_data_leader(trainer.coord, "fit_data")
-        reader = ElasticReader(pod_id, TxtFileSplitter(),
-                               args.batch_size, leader_endpoint=ep,
-                               skip_record=skip)
+    reader = ElasticReader(pod_id, TxtFileSplitter(), args.batch_size,
+                           file_list=files, is_leader=True,
+                           coord=trainer.coord, reader_name="fit_data",
+                           skip_record=skip)
 
     trainer.begin_epoch(trainer.state.next_epoch() if resumed else 0)
     trainer.report_status(ts.TrainStatus.RUNNING)
     loss = None
     seen = 0
+    last_saved = -1
     try:
         for batch in reader:
             if not batch["records"]:
                 continue
-            arrays = _parse(batch["records"])
-            # ragged tails train too: the linear step takes any batch len
-            if len(arrays["y"]) == args.batch_size:
-                loss = float(trainer.train_step(arrays))
+            # every consumed record trains — exactly-once means the
+            # ragged tail gets its gradient step too (one extra compile
+            # for the short shape)
+            loss = float(trainer.train_step(_parse(batch["records"])))
             ElasticReader.mark_consumed(trainer.state, batch)
             seen += len(batch["records"])
-            if trainer.global_step % args.save_every == 0:
+            step = trainer.global_step
+            if step % args.save_every == 0 and step != last_saved:
                 trainer.end_epoch(save=True)
                 trainer.begin_epoch(trainer.state.epoch_no)
+                last_saved = step
     finally:
         reader.stop()
     trainer.end_epoch(save=True)

@@ -78,18 +78,18 @@ def test_distillation_beats_label_only_student():
     def teacher_logits(ids):
         return t_model.apply({"params": t_params}, ids)
 
-    # --- students: 64 labeled samples only vs + teacher distillation ---
-    ids_small, y_small = _data(64, seed=2)
+    # --- students: 16 labeled samples only vs + teacher distillation ---
+    ids_small, y_small = _data(16, seed=2)
     ids_unlab, _ = _data(2048, seed=3)
 
     s_model, s_params0, s_loss_plain = bow.create_model_and_loss(
         vocab_size=VOCAB, distill_weight=0.0)
 
-    def small_batches(steps, bs=32):
+    def small_batches(steps, bs=16):
         for i in range(steps):
-            lo = (i * bs) % max(1, len(ids_small) - bs)
-            yield {"input_ids": jnp.asarray(ids_small[lo:lo + bs]),
-                   "label": jnp.asarray(y_small[lo:lo + bs])}
+            sel = np.arange(i * bs, (i + 1) * bs) % len(ids_small)
+            yield {"input_ids": jnp.asarray(ids_small[sel]),
+                   "label": jnp.asarray(y_small[sel])}
 
     plain_params, _ = _train(s_loss_plain, s_params0, small_batches(300))
     plain_acc = _acc(s_model, plain_params, jnp.asarray(ids_test), y_test)
