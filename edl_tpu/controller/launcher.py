@@ -81,6 +81,14 @@ class Launcher(object):
             on_lost=lambda: self._generator.stop()).start()
 
         if not self._join_cluster():
+            # distinguish "surplus pod, never needed" (clean exit) from
+            # "the job died while this pod waited at the barrier" — e.g.
+            # its peer was killed below min_nodes before the first barrier
+            # completed; the launcher exit code must reflect the verdict
+            if status.load_job_status(self._coord) == status.Status.FAILED:
+                logger.error("job FAILED before pod %s was admitted; "
+                             "exiting with failure", self._pod.id)
+                return False
             logger.info("pod %s never admitted to the cluster; exiting as "
                         "surplus", self._pod.id)
             return True
@@ -107,8 +115,7 @@ class Launcher(object):
             except errors.TimeoutError_:
                 break
             except errors.JobFailedError:
-                logger.error("job FAILED while pod %s waited at the "
-                             "barrier; exiting", self._pod.id)
+                # _launch logs the verdict and maps it to a failure exit
                 return False
             if self._update_local_pod():
                 return True

@@ -227,6 +227,33 @@ def test_below_min_nodes_fails_job(store, tmp_path):
 
 
 @pytest.mark.integration
+def test_join_during_failed_job_exits_nonzero(store, tmp_path):
+    """Deterministic form of the below-min race: if the job is FAILED
+    while a pod is still waiting at the admission barrier (its peer died
+    before the first barrier completed), the launcher must exit 1, not
+    take the surplus-pod clean exit."""
+    job = "launch_join_failed"
+    coord = store.client(root=job)
+    p1 = _spawn_launcher(store.endpoint, job, "2:2", tmp_path, "pod1",
+                         trainer_args=("120", "0"))
+    try:
+        # wait until the pod has registered (it is past launch.py's
+        # failed-job retry reset and parked at the admission barrier,
+        # which can never form alone under 2:2) ...
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if status.load_pods_status(coord):
+                break
+            time.sleep(0.2)
+        assert status.load_pods_status(coord), _dump_logs(tmp_path)
+        # ... then fail the job out from under it
+        status.save_job_status(coord, Status.FAILED)
+        assert p1.wait(timeout=90) == 1, _dump_logs(tmp_path)
+    finally:
+        _kill_group(p1)
+
+
+@pytest.mark.integration
 def test_two_pod_launch_on_native_store(tmp_path):
     """The full elastic launch flow (election, generator, barrier,
     supervision, flags) against the C++ coordination store binary."""
