@@ -21,6 +21,12 @@ from edl_tpu.controller.resource_pods import ResourceRegister
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
+# _join_cluster verdicts: admitted to a cluster including this pod /
+# never needed (clean surplus exit) / the job failed while waiting
+_JOIN_ADMITTED = "admitted"
+_JOIN_SURPLUS = "surplus"
+_JOIN_FAILED = "failed"
+
 
 class Launcher(object):
     def __init__(self, job_env, pod, coord, training_script, script_args=(),
@@ -80,15 +86,17 @@ class Launcher(object):
             on_elected=lambda: self._generator.start(),
             on_lost=lambda: self._generator.stop()).start()
 
-        if not self._join_cluster():
-            # distinguish "surplus pod, never needed" (clean exit) from
-            # "the job died while this pod waited at the barrier" — e.g.
-            # its peer was killed below min_nodes before the first barrier
+        verdict = self._join_cluster()
+        if verdict is _JOIN_FAILED:
+            # the job died while this pod waited at the barrier — e.g. its
+            # peer was killed below min_nodes before the first barrier
             # completed; the launcher exit code must reflect the verdict
-            if status.load_job_status(self._coord) == status.Status.FAILED:
-                logger.error("job FAILED before pod %s was admitted; "
-                             "exiting with failure", self._pod.id)
-                return False
+            # (carried from the barrier's own observation, NOT re-read:
+            # a concurrent retry may already have reset the status key)
+            logger.error("job FAILED before pod %s was admitted; exiting "
+                         "with failure", self._pod.id)
+            return False
+        if verdict is _JOIN_SURPLUS:
             logger.info("pod %s never admitted to the cluster; exiting as "
                         "surplus", self._pod.id)
             return True
@@ -101,7 +109,8 @@ class Launcher(object):
         return self._supervise()
 
     def _join_cluster(self):
-        """Barrier until a cluster that *includes this pod* is agreed.
+        """Barrier until a cluster that *includes this pod* is agreed;
+        returns a _JOIN_* verdict.
 
         A pod not in the current map is a late joiner waiting for the
         generator to scale it in (reference: INITIAL pods appended while
@@ -116,19 +125,21 @@ class Launcher(object):
                 break
             except errors.JobFailedError:
                 # _launch logs the verdict and maps it to a failure exit
-                return False
+                return _JOIN_FAILED
             if self._update_local_pod():
-                return True
+                return _JOIN_ADMITTED
             job = status.load_job_status(self._coord)
-            if job in (status.Status.SUCCEED, status.Status.FAILED):
-                return False
+            if job == status.Status.FAILED:
+                return _JOIN_FAILED
+            if job == status.Status.SUCCEED:
+                return _JOIN_SURPLUS
             if not pending:
                 status.save_pod_status(self._coord, self._pod.id,
                                        status.Status.PENDING)
                 pending = True
                 logger.info("pod %s waiting to be scaled in", self._pod.id)
             time.sleep(constants.GENERATE_INTERVAL)
-        return False
+        return _JOIN_SURPLUS
 
     def _barrier_sliced(self, deadline, poll=0.5, check_every=5.0):
         """Abortable barrier: one cached session retried every ``poll``

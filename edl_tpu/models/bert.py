@@ -74,8 +74,11 @@ class BertSelfAttention(nn.Module):
 class MoeFFN(nn.Module):
     """Mixture-of-experts FFN as a flax module: expert-parallel over the
     mesh's ep axis when a mesh is given, dense fallback otherwise. The
-    Switch load-balancing aux loss is sowed into the "losses" collection
-    (collect with mutable=["losses"] and add to the training loss)."""
+    Switch load-balancing aux loss and the ST-MoE router z-loss are sowed
+    into the "losses" collection (collect with mutable=["losses"] and add
+    to the training loss — create_model_and_loss does this); the
+    capacity-overflow drop fraction is sowed into "metrics" for
+    observability (0 on the dense fallback, which has no capacity)."""
     num_experts: int
     d_ff: int
     mesh: Any = None
@@ -103,13 +106,15 @@ class MoeFFN(nn.Module):
             lambda a: a.astype(self.dtype), params)
         tokens = x.reshape(-1, d_model).astype(self.dtype)
         if self.mesh is not None:
-            y, aux = moe_ffn(params, tokens, self.mesh, k=self.k,
-                             capacity_factor=self.capacity_factor,
-                             return_aux=True)
+            y, metrics = moe_ffn(params, tokens, self.mesh, k=self.k,
+                                 capacity_factor=self.capacity_factor,
+                                 return_metrics=True)
         else:
-            y, aux = moe_ffn_dense(params, tokens, k=self.k,
-                                   return_aux=True)
-        self.sow("losses", "moe_aux", aux)
+            y, metrics = moe_ffn_dense(params, tokens, k=self.k,
+                                       return_metrics=True)
+        self.sow("losses", "moe_aux", metrics["aux_loss"])
+        self.sow("losses", "moe_z", metrics["z_loss"])
+        self.sow("metrics", "moe_drop_fraction", metrics["drop_fraction"])
         return y.reshape(x.shape)
 
 
@@ -376,21 +381,42 @@ def bert_partition_rules():
     ]
 
 
-def create_model_and_loss(model=None, dummy_batch=1, dummy_seq=16, **kw):
+def create_model_and_loss(model=None, dummy_batch=1, dummy_seq=16,
+                          moe_aux_weight=0.01, moe_z_weight=1e-3, **kw):
     """(model, params, loss_fn) for ElasticTrainer (classification).
 
     dummy_batch/dummy_seq size the init trace — sharded models (use_ring
     over sp, MoE over ep) need init shapes divisible by their mesh axes.
+
+    For MoE configs the sowed router losses are folded into the training
+    loss: + moe_aux_weight * Σ load-balance (Switch's 0.01 default)
+    + moe_z_weight * Σ router z-loss (ST-MoE's 1e-3 default).
     """
     model = model or bert_tiny(**kw)
     dummy = jnp.zeros((dummy_batch, dummy_seq), jnp.int32)
     params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+    is_moe = bool(getattr(model, "moe_experts", 0))
 
     def loss_fn(params, batch, rng):
-        logits = model.apply({"params": params}, batch["input_ids"],
-                             batch.get("attention_mask"))
+        if is_moe:
+            # only "losses" is collected here — the "metrics" collection
+            # (drop fraction) is for eval/debug applies, not the hot path
+            logits, muts = model.apply(
+                {"params": params}, batch["input_ids"],
+                batch.get("attention_mask"), mutable=["losses"])
+        else:
+            logits = model.apply({"params": params}, batch["input_ids"],
+                                 batch.get("attention_mask"))
         one_hot = jax.nn.one_hot(batch["label"], model.num_classes)
-        return optax.softmax_cross_entropy(logits, one_hot).mean()
+        loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+        if is_moe:
+            sowed = jax.tree_util.tree_leaves_with_path(
+                muts.get("losses", {}))
+            for path, v in sowed:
+                name = path[-2].key if len(path) >= 2 else ""
+                w = moe_z_weight if name == "moe_z" else moe_aux_weight
+                loss = loss + w * v
+        return loss
 
     return model, params, loss_fn
 

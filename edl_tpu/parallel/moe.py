@@ -35,11 +35,12 @@ def init_moe_params(rng, num_experts, d_model, d_ff):
 
 
 def _route(x, router, k):
-    """(probs [n,E], gates [n,k] renormalized, choices [n,k])."""
-    probs = jax.nn.softmax((x @ router).astype(jnp.float32), axis=-1)
+    """(logits [n,E] f32, probs [n,E], gates [n,k] renorm., choices [n,k])."""
+    logits = (x @ router).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
     gates, choices = lax.top_k(probs, k)
     gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
-    return probs, gates.astype(x.dtype), choices
+    return logits, probs, gates.astype(x.dtype), choices
 
 
 def _aux_loss(probs, choices, num_experts):
@@ -52,12 +53,23 @@ def _aux_loss(probs, choices, num_experts):
     return num_experts * jnp.sum(f * p)
 
 
-def moe_ffn_dense(params, x, k=1, combine_by_gate=True, return_aux=False):
+def _z_loss(logits):
+    """ST-MoE router z-loss: mean_t (logsumexp_e logits)² — penalizes
+    large router logits, which drift into fp32-softmax saturation and
+    training instability in long MoE runs."""
+    return jnp.mean(jax.scipy.special.logsumexp(logits, axis=-1) ** 2)
+
+
+def moe_ffn_dense(params, x, k=1, combine_by_gate=True, return_aux=False,
+                  return_metrics=False):
     """Reference implementation: every expert computed densely, combined
     by the renormalized top-k gates (capacity ignored). k=1 keeps the
-    classic Switch behavior (gate ≡ 1 after renormalization)."""
+    classic Switch behavior (gate ≡ 1 after renormalization).
+
+    return_metrics returns (out, {"aux_loss", "z_loss", "drop_fraction"})
+    — drop_fraction is 0 by construction (no capacity bound here)."""
     num_experts = params["w_in"].shape[0]
-    probs, gates, choices = _route(x, params["router"], k)
+    logits, probs, gates, choices = _route(x, params["router"], k)
     h = jnp.einsum("nd,edf->enf", x, params["w_in"])
     h = jax.nn.relu(h)
     y = jnp.einsum("enf,efd->end", h, params["w_out"])      # [E, n, d]
@@ -67,6 +79,10 @@ def moe_ffn_dense(params, x, k=1, combine_by_gate=True, return_aux=False):
             choices[:, slot], num_experts, dtype=x.dtype) * (
                 gates[:, slot:slot + 1] if combine_by_gate else 1.0)
     out = jnp.einsum("end,ne->nd", y, combine)
+    if return_metrics:
+        return out, {"aux_loss": _aux_loss(probs, choices, num_experts),
+                     "z_loss": _z_loss(logits),
+                     "drop_fraction": jnp.zeros((), jnp.float32)}
     if return_aux:
         return out, _aux_loss(probs, choices, num_experts)
     return out
@@ -75,18 +91,19 @@ def moe_ffn_dense(params, x, k=1, combine_by_gate=True, return_aux=False):
 def _moe_shard(params, x, *, axis_name, num_experts, capacity, k,
                stat_axes):
     """One ep slice: local tokens [n, d], local experts [E/ep, d, ...].
-    Returns (y [n, d], the GLOBAL aux loss — f/p stats are pmean-reduced
-    over all token shards so every slice returns the same value as the
-    dense reference computes)."""
+    Returns (y [n, d], metrics dict) — the metrics are GLOBAL: f/p/z/drop
+    stats are pmean-reduced over all token shards so every slice returns
+    the same values the dense reference computes."""
     ep = lax.psum(1, axis_name)
     experts_local = num_experts // ep
     n, d = x.shape
 
-    probs, gates, choices = _route(x, params["router"], k)
+    logits, probs, gates, choices = _route(x, params["router"], k)
     f = lax.pmean(jnp.mean(jax.nn.one_hot(
         choices[:, 0], num_experts, dtype=jnp.float32), axis=0), stat_axes)
     p = lax.pmean(jnp.mean(probs, axis=0), stat_axes)
     aux = num_experts * jnp.sum(f * p)
+    z = lax.pmean(_z_loss(logits), stat_axes)
 
     # flatten the k routing slots: slot i of token t is row t*k+i
     flat_choice = choices.reshape(n * k)
@@ -130,12 +147,16 @@ def _moe_shard(params, x, *, axis_name, num_experts, capacity, k,
     slot_w = jnp.where(keep, flat_gate, 0)[:, None]
     contrib = (slot_y * slot_w).reshape(n, k, d).sum(axis=1)
     kept_w = slot_w.reshape(n, k).sum(axis=1)
+    # fraction of routing slots that overflowed capacity — THE signal
+    # for tuning capacity_factor (0 = nothing dropped)
+    drop = lax.pmean(jnp.mean(1.0 - keep.astype(jnp.float32)), stat_axes)
+    metrics = {"aux_loss": aux, "z_loss": z, "drop_fraction": drop}
     # token with every slot dropped → identity passthrough
-    return jnp.where(kept_w[:, None] > 0, contrib, x), aux
+    return jnp.where(kept_w[:, None] > 0, contrib, x), metrics
 
 
 def moe_ffn(params, x, mesh, capacity_factor=2.0, k=1,
-            ep_axis=EXPERT_AXIS, return_aux=False):
+            ep_axis=EXPERT_AXIS, return_aux=False, return_metrics=False):
     """Expert-parallel MoE FFN; x: [tokens, d_model] sharded over (dp, ep)
     — the standard EP layout: every slice routes only its own tokens, so
     there is no redundant routing compute or duplicated all_to_all rows.
@@ -144,7 +165,9 @@ def moe_ffn(params, x, mesh, capacity_factor=2.0, k=1,
     the router is replicated. Per-destination capacity =
     ceil(k * tokens_per_slice * capacity_factor / ep). ``k`` routes each
     token to its top-k experts with renormalized gate combine (k=1 ≡
-    Switch). return_aux adds the load-balancing loss (mean over slices).
+    Switch). return_aux adds the load-balancing loss; return_metrics adds
+    the full dict {"aux_loss", "z_loss", "drop_fraction"} (all reduced
+    over token shards, identical on every slice).
     """
     ep = mesh.shape[ep_axis]
     dp = mesh.shape["dp"]
@@ -169,9 +192,12 @@ def moe_ffn(params, x, mesh, capacity_factor=2.0, k=1,
                           stat_axes=("dp", ep_axis)),
         mesh=mesh,
         in_specs=(param_specs, P(("dp", ep_axis))),
-        out_specs=(P(("dp", ep_axis)), P()),
+        out_specs=(P(("dp", ep_axis)),
+                   {"aux_loss": P(), "z_loss": P(), "drop_fraction": P()}),
         check_vma=False)
-    y, aux = fn(params, x)
+    y, metrics = fn(params, x)
+    if return_metrics:
+        return y, metrics
     if return_aux:
-        return y, aux
+        return y, metrics["aux_loss"]
     return y

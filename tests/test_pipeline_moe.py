@@ -375,6 +375,65 @@ def test_moe_bert_layer_trains_on_ep_mesh():
         assert float(jnp.abs(g).sum()) > 0, leaf
 
 
+def test_moe_metrics_zloss_and_drop_fraction():
+    """return_metrics: z-loss agrees between dense and sharded paths;
+    drop_fraction is 0 with ample capacity and rises when capacity is
+    tight (the capacity_factor tuning signal)."""
+    mesh = mesh_mod.make_mesh(dp=2, ep=4)
+    params = init_moe_params(jax.random.PRNGKey(2), num_experts=8,
+                             d_model=16, d_ff=32)
+    x = jnp.asarray(np.random.RandomState(6).randn(32, 16)
+                    .astype(np.float32))
+    _, m_d = moe_ffn_dense(params, x, k=2, return_metrics=True)
+    _, m_s = moe_ffn(params, x, mesh, capacity_factor=8.0, k=2,
+                     return_metrics=True)
+    assert float(m_s["z_loss"]) == pytest.approx(float(m_d["z_loss"]),
+                                                 rel=1e-4)
+    assert float(m_s["aux_loss"]) == pytest.approx(float(m_d["aux_loss"]),
+                                                   rel=1e-4)
+    assert float(m_d["drop_fraction"]) == 0.0
+    assert float(m_s["drop_fraction"]) == 0.0
+    _, m_tight = moe_ffn(params, x, mesh, capacity_factor=0.1, k=2,
+                         return_metrics=True)
+    assert 0.0 < float(m_tight["drop_fraction"]) <= 1.0
+
+
+def test_moe_zloss_penalizes_large_logits():
+    """Scaling the router up must scale the z-loss up — the signal the
+    ST-MoE penalty exists to bound."""
+    params = init_moe_params(jax.random.PRNGKey(0), num_experts=8,
+                             d_model=16, d_ff=32)
+    x = jnp.asarray(np.random.RandomState(7).randn(64, 16)
+                    .astype(np.float32))
+    _, m_small = moe_ffn_dense(params, x, return_metrics=True)
+    big = dict(params, router=params["router"] * 20.0)
+    _, m_big = moe_ffn_dense(big, x, return_metrics=True)
+    assert float(m_big["z_loss"]) > 4 * float(m_small["z_loss"])
+
+
+def test_moe_losses_fold_into_training_loss():
+    """create_model_and_loss must actually apply the sowed MoE router
+    losses — an MoE model's loss_fn sees a different loss than the bare
+    cross-entropy, and the router gets a gradient from the penalty."""
+    from edl_tpu.models.bert import create_model_and_loss, \
+        synthetic_text_batch
+
+    _, params, loss_fn = create_model_and_loss(
+        num_layers=1, moe_experts=4, dtype=jnp.float32)
+    _, _, loss_plain = create_model_and_loss(
+        num_layers=1, moe_experts=4, moe_aux_weight=0.0, moe_z_weight=0.0,
+        dtype=jnp.float32)
+    batch = synthetic_text_batch(8, seq_len=16)
+    rng = jax.random.PRNGKey(0)
+    with_moe = float(loss_fn(params, batch, rng))
+    without = float(loss_plain(params, batch, rng))
+    assert np.isfinite(with_moe) and np.isfinite(without)
+    assert with_moe != pytest.approx(without, abs=1e-6)
+    grads = jax.grad(loss_fn)(params, batch, rng)
+    router_g = grads["layer_0"]["moe"]["router"]
+    assert float(jnp.abs(router_g).sum()) > 0
+
+
 def test_moe_tight_capacity_never_corrupts():
     """capacity_factor=1.0 with skewed routing: in-capacity tokens keep
     their dense outputs (regression for the overflow-clobber bug)."""
