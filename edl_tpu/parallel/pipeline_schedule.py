@@ -23,31 +23,37 @@ IDLE, FWD, BWD = 0, 1, 2
 
 
 def build_schedule(num_stages, num_micro, num_chunks=1, cap_slack=0):
-    """Greedy list schedule with a memory cap; the cap is a heuristic
-    (tightest = Megatron warmup count), so on the rare configs where the
-    greedy order deadlocks under it, retry with a looser cap — an
-    uncapped schedule always closes, so this terminates."""
+    """Build the static schedule tables: the Megatron-exact per-device
+    op order (when its M % P == 0 precondition holds) raced against the
+    greedy list schedule — whichever closes in fewer ticks wins. The
+    greedy memory cap is a heuristic (tightest = Megatron warmup count),
+    so on the rare configs where the greedy order deadlocks under it,
+    retry with a looser cap — an uncapped schedule always closes, so
+    this terminates."""
+    sim = None
     last_err = None
     for slack in range(cap_slack, cap_slack + 4 * num_stages + 3, 2):
         try:
-            return _build_schedule(num_stages, num_micro, num_chunks,
-                                   slack)
+            sim = _greedy_sim(num_stages, num_micro, num_chunks, slack)
+            break
         except RuntimeError as e:
             last_err = e
-    raise last_err
+    if sim is None:
+        raise last_err
+    if num_chunks > 1 and num_micro % num_stages == 0:
+        try:
+            mega = _megatron_sim(num_stages, num_micro, num_chunks)
+            if len(mega[0]) < len(sim[0]):
+                sim = mega
+        except RuntimeError:
+            pass  # simulation failed to close; greedy is always valid
+    ops, done_f, done_b = sim
+    return _tables(num_stages, num_micro, num_chunks, ops, done_f, done_b)
 
 
-def _build_schedule(num_stages, num_micro, num_chunks, cap_slack):
-    """One capped scheduling attempt. Returns a dict of numpy tables:
-
-    op[t, s]     in {IDLE, FWD, BWD}
-    chunk[t, s]  local chunk index v (0 when idle)
-    mb[t, s]     microbatch index (0 when idle)
-    recv_f[t, s] / recv_f_chunk / recv_f_mb: whether the fwd value
-      ARRIVING at device s at tick t (sent at t-1 by s-1) is valid, and
-      which (chunk, mb) it belongs to; likewise recv_b* for backward.
-    n_ticks, max_inflight (per device+chunk saved-input high-water mark).
-    """
+def _greedy_sim(num_stages, num_micro, num_chunks, cap_slack):
+    """One capped greedy scheduling attempt; returns the raw simulation
+    (ops per tick, done_f, done_b) for _tables."""
     P, M, V = num_stages, num_micro, num_chunks
     S = P * V
     done_f = np.full((S, M), -1, np.int64)   # tick each F completed
@@ -70,7 +76,6 @@ def _build_schedule(num_stages, num_micro, num_chunks, cap_slack):
         if t > 16 * (S + M) + 64:            # safety: schedule must close
             raise RuntimeError("scheduler did not converge")
         tick_ops = [(IDLE, 0, 0)] * P
-        busy = [False] * P
         # ready sets at tick t (dependencies completed strictly earlier)
         for s in range(P):
             best = None
@@ -115,7 +120,6 @@ def _build_schedule(num_stages, num_micro, num_chunks, cap_slack):
             if best is not None:
                 kind, v, m, sigma = best
                 tick_ops[s] = (kind, v, m)
-                busy[s] = True
                 if kind == FWD:
                     done_f[sigma, m] = t
                 else:
@@ -123,8 +127,98 @@ def _build_schedule(num_stages, num_micro, num_chunks, cap_slack):
                 completed += 1
         ops.append(tick_ops)
         t += 1
-    T = len(ops)
+    return ops, done_f, done_b
 
+
+def _megatron_order(P, M, V):
+    """Megatron-LM's interleaved 1F1B op order, per device (reference
+    order only — public algorithm): virtual-microbatch index k maps to
+    chunk (k // P) % V and microbatch (k // (P*V)) * P + k % P, forwards
+    ascending, backwards the same walk with chunks mirrored; device r
+    runs 2*(P-r-1) + (V-1)*P warmup forwards, then strict 1F1B, then the
+    backward tail. Requires M % P == 0."""
+    if M % P:
+        raise ValueError("megatron order needs num_micro %% num_stages == 0")
+    total = M * V
+
+    def f_at(k):
+        return (k // P) % V, (k // (P * V)) * P + k % P
+
+    def b_at(k):
+        return V - 1 - (k // P) % V, (k // (P * V)) * P + k % P
+
+    orders = []
+    for r in range(P):
+        warmup = min(total, 2 * (P - r - 1) + (V - 1) * P)
+        seq = []
+        for k in range(warmup):
+            v, m = f_at(k)
+            seq.append((FWD, v * P + r, m))
+        for i in range(total - warmup):
+            v, m = f_at(warmup + i)
+            seq.append((FWD, v * P + r, m))
+            v, m = b_at(i)
+            seq.append((BWD, v * P + r, m))
+        for i in range(total - warmup, total):
+            v, m = b_at(i)
+            seq.append((BWD, v * P + r, m))
+        orders.append(seq)
+    return orders
+
+
+def _megatron_sim(P, M, V):
+    """ASAP tick simulation of the fixed per-device Megatron order under
+    this engine's timing model (one ring hop per tick, one op per device
+    per tick): each device runs its next op as soon as the op's producer
+    finished at a strictly earlier tick; returns (ops, done_f, done_b)."""
+    orders = _megatron_order(P, M, V)
+    S = P * V
+    done_f = np.full((S, M), -1, np.int64)
+    done_b = np.full((S, M), -1, np.int64)
+    heads = [0] * P
+    ops = []
+    total = 2 * S * M
+    completed = 0
+    t = 0
+    while completed < total:
+        if t > 16 * (S + M) + 64:
+            raise RuntimeError("megatron simulation did not converge")
+        tick_ops = [(IDLE, 0, 0)] * P
+        for s in range(P):
+            if heads[s] >= len(orders[s]):
+                continue
+            kind, sigma, m = orders[s][heads[s]]
+            if kind == FWD:
+                ready = sigma == 0 or 0 <= done_f[sigma - 1, m] < t
+            else:
+                ready = done_f[sigma, m] >= 0 and done_f[sigma, m] < t \
+                    and (sigma == S - 1 or 0 <= done_b[sigma + 1, m] < t)
+            if ready:
+                tick_ops[s] = (kind, sigma // P, m)
+                if kind == FWD:
+                    done_f[sigma, m] = t
+                else:
+                    done_b[sigma, m] = t
+                heads[s] += 1
+                completed += 1
+        ops.append(tick_ops)
+        t += 1
+    return ops, done_f, done_b
+
+
+def _tables(P, M, V, ops, done_f, done_b):
+    """Bake a simulation into the engine's numpy tables:
+
+    op[t, s]     in {IDLE, FWD, BWD}
+    chunk[t, s]  local chunk index v (0 when idle)
+    mb[t, s]     microbatch index (0 when idle)
+    recv_f[t, s] / recv_f_chunk / recv_f_mb: whether the fwd value
+      ARRIVING at device s at tick t (sent at t-1 by s-1) is valid, and
+      which (chunk, mb) it belongs to; likewise recv_b* for backward.
+    n_ticks, max_inflight (per device+chunk saved-input high-water mark).
+    """
+    T = len(ops)
+    S = P * V
     op = np.zeros((T, P), np.int32)
     chunk = np.zeros((T, P), np.int32)
     mb = np.zeros((T, P), np.int32)

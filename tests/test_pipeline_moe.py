@@ -143,6 +143,23 @@ def test_interleaved_schedule_valid(pp, mm, vv):
         assert sched["n_ticks"] <= 2 * (pp + mm) - 1
 
 
+@pytest.mark.parametrize("pp,mm,vv", [(4, 8, 2), (4, 16, 2), (4, 16, 4),
+                                      (8, 24, 3)])
+def test_megatron_order_hits_ideal_bubble(pp, mm, vv):
+    """On M % P == 0 configs the Megatron-exact order must achieve the
+    textbook interleaved bubble (P-1)/(V*M + P-1) exactly under this
+    tick model — and build_schedule must therefore pick it over the
+    looser greedy schedule."""
+    from edl_tpu.parallel.pipeline_schedule import (
+        IDLE, build_schedule, validate_schedule)
+    sched = build_schedule(pp, mm, vv)
+    assert validate_schedule(sched)
+    busy = (sched["op"] != IDLE).sum()
+    bubble = 1 - busy / (sched["n_ticks"] * pp)
+    ideal = (pp - 1) / (vv * mm + pp - 1)
+    assert bubble == pytest.approx(ideal, abs=1e-9), (bubble, ideal)
+
+
 def test_interleaved_cuts_wall_clock_for_same_model():
     """Same 8-chunk model on 4 devices: V=2 (1 chunk/tick) must beat
     V=1 (2 chunks fused per stage → 2 units/tick) in work-units."""
