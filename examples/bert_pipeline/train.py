@@ -4,11 +4,15 @@ Net-new vs the reference (its NLP scope was distillation only;
 model parallelism was a roadmap bullet — SURVEY.md §2.7). Demonstrates
 the edl_tpu pipeline plane end to end: stage params sharded over pp,
 batches over dp, stage grads kept pp-sharded through the optimizer, and
-activation recompute inside the 1F1B backward.
+activation recompute inside the 1F1B backward. --chunks V > 1 switches
+to the interleaved (circular) schedule: V virtual stages per device,
+shrinking the pipeline bubble from O(P) to O(P/V).
 
 Run hermetically on a virtual mesh:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   python examples/bert_pipeline/train.py --pp 4 --steps 10
+  # interleaved: num_layers must divide by pp * chunks
+  ... --pp 4 --chunks 2 --num_layers 8 --num_micro 8 --steps 10
 """
 
 import argparse
@@ -25,11 +29,16 @@ def main(argv=None):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from edl_tpu.models.bert import create_bert_pipeline
-    from edl_tpu.parallel.pipeline import pipeline_value_and_grad
+    from edl_tpu.parallel.pipeline import (
+        device_major_stage_params, pipeline_value_and_grad,
+        pipeline_value_and_grad_interleaved)
     from edl_tpu.runtime.mesh import make_mesh
 
     p = argparse.ArgumentParser()
     p.add_argument("--pp", type=int, default=4)
+    p.add_argument("--chunks", type=int, default=1,
+                   help="virtual stages per device (V>1 = interleaved "
+                        "schedule; num_layers must divide by pp*chunks)")
     p.add_argument("--dp", type=int, default=0,
                    help="0 = all remaining devices")
     p.add_argument("--num_layers", type=int, default=4)
@@ -46,6 +55,9 @@ def main(argv=None):
     p.add_argument("--dtype", choices=["bf16", "f32"], default="f32")
     args = p.parse_args(argv)
 
+    if args.num_layers % (args.pp * args.chunks):
+        p.error("--num_layers %d must divide by --pp %d * --chunks %d"
+                % (args.num_layers, args.pp, args.chunks))
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     n = jax.device_count()
     dp = args.dp or max(1, n // args.pp)
@@ -55,10 +67,14 @@ def main(argv=None):
           flush=True)
 
     params, enc, stg, dec, _ = create_bert_pipeline(
-        args.pp, num_layers=args.num_layers, d_model=args.d_model,
+        args.pp * args.chunks, num_layers=args.num_layers,
+        d_model=args.d_model,
         num_heads=args.num_heads, mlp_dim=args.mlp_dim,
         vocab_size=args.vocab_size, max_len=max(64, args.seq_len),
         seq_len=args.seq_len, dtype=dtype)
+    if args.chunks > 1:
+        params = dict(params, stages=device_major_stage_params(
+            params["stages"], args.pp, args.chunks))
     stage_sh = NamedSharding(mesh, P("pp"))
     repl = NamedSharding(mesh, P())
     data_sh = NamedSharding(mesh, P("dp"))
@@ -71,9 +87,15 @@ def main(argv=None):
     opt = jax.jit(tx.init)(params)
 
     def train_step(params, opt, ids, labels):
-        loss, grads = pipeline_value_and_grad(
-            params, ids, labels, encode_fn=enc, stage_fn=stg,
-            decode_fn=dec, mesh=mesh, num_micro=args.num_micro)
+        if args.chunks > 1:
+            loss, grads = pipeline_value_and_grad_interleaved(
+                params, ids, labels, encode_fn=enc, stage_fn=stg,
+                decode_fn=dec, mesh=mesh, num_micro=args.num_micro,
+                num_chunks=args.chunks)
+        else:
+            loss, grads = pipeline_value_and_grad(
+                params, ids, labels, encode_fn=enc, stage_fn=stg,
+                decode_fn=dec, mesh=mesh, num_micro=args.num_micro)
         updates, opt = tx.update(grads, opt, params)
         return optax.apply_updates(params, updates), opt, loss
 
@@ -99,7 +121,9 @@ def main(argv=None):
                   flush=True)
     wall = time.perf_counter() - t0
     print(json.dumps({
-        "model": "bert_pipeline_pp%d_dp%d" % (args.pp, dp),
+        "model": "bert_pipeline_pp%d_dp%d%s" % (
+            args.pp, dp,
+            "_v%d" % args.chunks if args.chunks > 1 else ""),
         "first_loss": first_loss,
         "final_loss": float(loss),
         "steps": args.steps,

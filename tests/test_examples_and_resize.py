@@ -16,12 +16,13 @@ from edl_tpu.tools.resize_driver import ResizeDriver
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(path, args, timeout=240):
+def _run_example(path, args, timeout=240, device_count=2):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update({
         "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=%d"
+                     % device_count,
     })
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, path)] + args,
@@ -44,24 +45,27 @@ def test_resnet_example_standalone():
 
 @pytest.mark.integration
 def test_bert_pipeline_example_learns():
-    env_flags = "--xla_force_host_platform_device_count=8"
-    import subprocess as sp
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": env_flags})
-    proc = sp.run(
-        [sys.executable, "-u",
-         os.path.join(REPO, "examples", "bert_pipeline", "train.py"),
-         "--pp", "4", "--steps", "60", "--d_model", "32",
-         "--num_heads", "2", "--mlp_dim", "64", "--seq_len", "16",
-         "--vocab_size", "50", "--lr", "5e-3"],
-        env=env, capture_output=True, text=True, timeout=300)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    out = json.loads([l for l in proc.stdout.splitlines()
-                      if l.startswith("{")][-1])
+    out = _run_example("examples/bert_pipeline/train.py", [
+        "--pp", "4", "--steps", "60", "--d_model", "32",
+        "--num_heads", "2", "--mlp_dim", "64", "--seq_len", "16",
+        "--vocab_size", "50", "--lr", "5e-3"],
+        timeout=300, device_count=8)
     assert out["model"] == "bert_pipeline_pp4_dp2"
     # the parity task is learnable: loss must drop toward 0 from ~ln(2)
+    assert out["final_loss"] < out["first_loss"] - 0.2, out
+
+
+@pytest.mark.integration
+def test_bert_pipeline_example_interleaved_learns():
+    """--chunks 2: the interleaved (circular) engine behind the same
+    example CLI, on a config where the Megatron-exact schedule wins."""
+    out = _run_example("examples/bert_pipeline/train.py", [
+        "--pp", "2", "--chunks", "2", "--num_layers", "4",
+        "--num_micro", "8", "--steps", "60", "--d_model", "32",
+        "--num_heads", "2", "--mlp_dim", "64", "--seq_len", "16",
+        "--vocab_size", "50", "--lr", "5e-3"],
+        timeout=600, device_count=8)
+    assert out["model"] == "bert_pipeline_pp2_dp4_v2"
     assert out["final_loss"] < out["first_loss"] - 0.2, out
 
 
