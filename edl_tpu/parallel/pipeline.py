@@ -191,18 +191,19 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
             state["buf"], x_in)
 
         # ---- backward path (always executed) -------------------------
+        # ONE stage vjp serves both roles: the last stage chains the
+        # decode head's cotangent into it, mid stages chain the ring
+        # carry — mask-selecting the COTANGENT instead of running
+        # separate full vjps for comp(stage∘decode) and stage saves a
+        # whole stage forward+backward per tick
         x_saved = _tmap(lambda b_: b_[m_b % nP], buf)
-
-        def comp(ps, pd, x):
-            return decode_fn(pd, stage_fn(ps, x), take(ymb, m_b))
-
-        loss_m, vjp_last = jax.vjp(comp, p_stage, p_dec, x_saved)
-        gs_l, gd_l, gx_l = vjp_last(jnp.float32(1.0 / M))
-        _, vjp_mid = jax.vjp(stage_fn, p_stage, x_saved)
-        gs_m, gx_m = vjp_mid(state["bwd_carry"])
+        y_saved, vjp_stage = jax.vjp(stage_fn, p_stage, x_saved)
+        loss_m, vjp_dec = jax.vjp(
+            lambda pd, y_: decode_fn(pd, y_, take(ymb, m_b)),
+            p_dec, y_saved)
+        gd_l, gy_l = vjp_dec(jnp.float32(1.0 / M))
         is_last = idx == nP - 1
-        gs = sel(is_last, gs_l, gs_m)
-        gx = sel(is_last, gx_l, gx_m)
+        gs, gx = vjp_stage(sel(is_last, gy_l, state["bwd_carry"]))
         gd = sel(is_last, gd_l, _tmap(jnp.zeros_like, p_dec))
         _, vjp_enc = jax.vjp(
             lambda p: encode_fn(p, take(xmb, m_b)), p_enc)

@@ -71,20 +71,10 @@ def test_bert_pipeline_example_interleaved_learns():
 
 @pytest.mark.integration
 def test_long_context_example_runs_with_remat():
-    import subprocess as sp
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
-    proc = sp.run(
-        [sys.executable, "-u",
-         os.path.join(REPO, "examples", "long_context", "train.py"),
-         "--sp", "4", "--seq_len", "256", "--steps", "6", "--d_model",
-         "32", "--num_heads", "2", "--mlp_dim", "64", "--remat"],
-        env=env, capture_output=True, text=True, timeout=300)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
-    out = json.loads([l for l in proc.stdout.splitlines()
-                      if l.startswith("{")][-1])
+    out = _run_example("examples/long_context/train.py", [
+        "--sp", "4", "--seq_len", "256", "--steps", "6", "--d_model",
+        "32", "--num_heads", "2", "--mlp_dim", "64", "--remat"],
+        timeout=300, device_count=8)
     assert out["model"] == "bert_ring_sp4_dp2"
     assert out["seq_len"] == 256 and out["remat"]
     assert np.isfinite(out["final_loss"])
