@@ -14,12 +14,15 @@ Modes:
                  device every step: the end-to-end rate a real training
                  loop sees (the role DALI played for the reference).
 Variants: --no-s2d disables the space-to-depth stem; --batch_per_chip
-to sweep. The round-2 sweep on the real v5e chip measured (img/s/chip):
-s2d@128 = 2430.7, plain@128 = 2318.9, plain@256 = 2379.6, s2d@256 =
-2331.8 — so s2d at batch 128 is the default. Host-fed (--feed host)
-measured 156 img/s in the dev-tunnel environment because device_put
-crosses the network tunnel; on a real TPU VM the host feed is local
-PCIe, so that number reflects the tunnel, not the pipeline.
+to sweep; --steps_per_call K scans K train steps per jit dispatch
+(amortizes per-step host dispatch — significant through the remote dev
+tunnel, where each call pays a network round trip). The round-2 sweep
+on the real v5e chip measured (img/s/chip): s2d@128 = 2430.7,
+plain@128 = 2318.9, plain@256 = 2379.6, s2d@256 = 2331.8 — so s2d at
+batch 128 is the default. Host-fed (--feed host) measured 156 img/s in
+the dev-tunnel environment because device_put crosses the network
+tunnel; on a real TPU VM the host feed is local PCIe, so that number
+reflects the tunnel, not the pipeline.
 """
 
 import argparse
@@ -35,10 +38,11 @@ def log(msg):
 
 
 def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
-        s2d=True, feed="device"):
+        s2d=True, feed="device", steps_per_call=1):
     import jax
     import jax.numpy as jnp
     import optax
+    from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from edl_tpu.models import resnet
@@ -47,8 +51,10 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
 
     n_chips = jax.local_device_count()
     batch = batch_per_chip * n_chips
-    log("bench: %d chip(s) (%s), global batch %d, s2d=%s, feed=%s"
-        % (n_chips, jax.devices()[0].platform, batch, s2d, feed))
+    log("bench: %d chip(s) (%s), global batch %d, s2d=%s, feed=%s, "
+        "steps_per_call=%d"
+        % (n_chips, jax.devices()[0].platform, batch, s2d, feed,
+           steps_per_call))
 
     model, params, extra, loss_fn = resnet.create_model_and_loss(
         depth=50, num_classes=1000, vd=True, image_size=image_size,
@@ -61,6 +67,20 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
     # the SAME step the product trainer runs (trainer.make_train_step)
     state = jax.device_put(make_train_state(params, tx, extra), repl)
     step = make_train_step(loss_fn, tx, has_aux=True)
+    if steps_per_call > 1:
+        # scan K steps per dispatch: through the dev tunnel each jit
+        # call pays a network round trip, so per-step dispatch inflates
+        # ms/step; real training loops are dispatch-bound the same way
+        # whenever the host is remote/slow. Same train step, scanned.
+        base_step = step
+
+        def step(state, batch_, rng_):
+            def body(s, _):
+                s2, loss_ = base_step(s, batch_, rng_)
+                return s2, loss_
+            state2, losses = lax.scan(body, state, None,
+                                      length=steps_per_call)
+            return state2, losses[-1]
     jit_step = jax.jit(step,
                        in_shardings=(repl, data_sh, repl),
                        out_shardings=(repl, repl), donate_argnums=(0,))
@@ -104,19 +124,22 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
             state, loss = jit_step(state, next_batch(), rng)
         jax.block_until_ready(loss)
         dt = time.perf_counter() - t0
+        ms_per_step = 1000 * dt / (iters * steps_per_call)
     finally:
         # a failed run must not leave the prefetch thread holding
         # full-size device batches while the fallback config runs
         if prefetcher is not None:
             prefetcher.close()
 
-    imgs_per_sec = batch * iters / dt
+    imgs_per_sec = batch * iters * steps_per_call / dt
     per_chip = imgs_per_sec / n_chips
     log("throughput: %.1f img/s total, %.1f img/s per chip (%.1f ms/step)"
-        % (imgs_per_sec, per_chip, 1000 * dt / iters))
+        % (imgs_per_sec, per_chip, ms_per_step))
     metric = "resnet50_vd_train_imgs_per_sec_per_chip"
     if feed == "host":
         metric += "_hostfed"
+    if steps_per_call > 1:
+        metric += "_scan%d" % steps_per_call
     return {
         "metric": metric,
         "value": round(per_chip, 1),
@@ -133,10 +156,20 @@ def main():
     ap.add_argument("--no-s2d", dest="s2d", action="store_false")
     ap.set_defaults(s2d=True)
     ap.add_argument("--feed", choices=("device", "host"), default="device")
+    ap.add_argument("--steps_per_call", type=int, default=1,
+                    help="scan K train steps per jit dispatch (amortizes "
+                         "host->device dispatch latency)")
     args = ap.parse_args()
+    # argument conflicts fail fast, OUTSIDE the device-failure fallback
+    if args.steps_per_call < 1:
+        ap.error("--steps_per_call must be >= 1")
+    if args.feed == "host" and args.steps_per_call > 1:
+        ap.error("--steps_per_call measures pure device rate and skips "
+                 "the per-step feed; use it with --feed device")
     try:
         result = run(batch_per_chip=args.batch_per_chip, iters=args.iters,
-                     s2d=args.s2d, feed=args.feed)
+                     s2d=args.s2d, feed=args.feed,
+                     steps_per_call=args.steps_per_call)
     except Exception as e:  # noqa: BLE001
         was_r1_cfg = (args.batch_per_chip == 128 and not args.s2d
                       and args.feed == "device")

@@ -47,6 +47,44 @@ def test_lr_schedules():
     assert lr_schedules.scale_lr_for_batch(0.1, 1024) == pytest.approx(0.4)
 
 
+def test_multi_step_matches_sequential_steps():
+    """make_multi_step(K) in one dispatch == K make_train_step calls
+    with the same per-step rng folding."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import (make_multi_step, make_train_state,
+                                         make_train_step)
+
+    params = linear.init_params(feature_dim=4)
+    loss_fn = linear.loss_fn
+    tx = optax.sgd(0.1)
+    K = 3
+    rng = jax.random.PRNGKey(7)
+    rs = np.random.RandomState(0)
+    batches = {
+        "x": rs.randn(K, 8, 4).astype(np.float32),
+        "y": rs.randn(K, 8).astype(np.float32),
+    }
+
+    base = jax.jit(make_train_step(loss_fn, tx))
+    want = make_train_state(params, tx)
+    want_losses = []
+    for i in range(K):
+        b = {k: v[i] for k, v in batches.items()}
+        want, loss = base(want, b, jax.random.fold_in(rng, want["step"]))
+        want_losses.append(float(loss))
+
+    multi = jax.jit(make_multi_step(loss_fn, tx, steps_per_call=K))
+    got, losses = multi(make_train_state(params, tx), batches, rng)
+
+    assert int(got["step"]) == K
+    np.testing.assert_allclose(np.asarray(losses),
+                               np.asarray(want_losses), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(got["params"]),
+                    jax.tree_util.tree_leaves(want["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def test_state_roundtrip_and_adjust(coord):
     st = state_mod.State(total_batch_size=256)
     st.begin_epoch(0, world_size=8)
