@@ -1,0 +1,267 @@
+"""GPT decoder family: causal LM with KV-cache generation, TP/SP-ready.
+
+Net-new vs the reference (its NLP scope stopped at classification
+distillation — SURVEY.md §5.7 marks long-context/causal LM absent): a
+decoder-only transformer for the model zoo, built on the same attention
+substrate as BERT — dense causal attention by default, the Pallas flash
+kernel (`edl_tpu/ops/flash_attention.py`) or ring attention over the sp
+axis (`edl_tpu/parallel/ring_attention.py`) for long sequences — plus an
+incremental-decode path (flax "cache" collection) so teacher-style
+serving and sampling don't re-run the prefix per token.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+
+class CausalSelfAttention(nn.Module):
+    """Causal MHA with an optional single-token decode mode.
+
+    decode=False: full-sequence causal attention via the shared
+    bert.attention_context dispatch (dense / flash / ring).
+    decode=True: x is [b, 1, d]; K/V are written into "cache" variables
+    sized [b, max_len, h, hd] at ``decode_index`` — the ONE source of
+    truth for the decode position (the same value drives the position
+    embedding in Gpt), so a retried step overwrites its own slot instead
+    of silently drifting — and attention runs against the prefix."""
+    num_heads: int
+    max_len: int
+    dtype: Any = jnp.bfloat16
+    use_ring: bool = False
+    use_flash: bool = False
+    mesh: Any = None
+    ring_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, decode=False, decode_index=None):
+        d_model = x.shape[-1]
+        head_dim = d_model // self.num_heads
+        dense = lambda feats, name: nn.DenseGeneral(
+            feats, dtype=self.dtype, param_dtype=jnp.float32, name=name)
+        q = dense((self.num_heads, head_dim), "query")(x)
+        k = dense((self.num_heads, head_dim), "key")(x)
+        v = dense((self.num_heads, head_dim), "value")(x)
+
+        if decode:
+            if x.shape[1] != 1:
+                raise ValueError("decode mode feeds one token at a time")
+            if decode_index is None:
+                raise ValueError("decode mode needs decode_index")
+            b = x.shape[0]
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (b, self.max_len, self.num_heads, head_dim), self.dtype)
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (b, self.max_len, self.num_heads, head_dim), self.dtype)
+            idx = jnp.asarray(decode_index, jnp.int32)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, idx, 0, 0))
+            scale = head_dim ** -0.5
+            scores = jnp.einsum(
+                "bqhd,bkhd->bhqk", (q * scale).astype(jnp.float32),
+                ck.value.astype(jnp.float32))
+            mask = jnp.arange(self.max_len)[None, None, None, :] <= idx
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                             cv.value.astype(jnp.float32))
+            ctx = ctx.astype(self.dtype)
+        else:
+            from edl_tpu.models.bert import attention_context
+            ctx = attention_context(
+                q, k, v, causal=True, mask=None, dtype=self.dtype,
+                ring_axis=self.ring_axis, use_ring=self.use_ring,
+                use_flash=self.use_flash, mesh=self.mesh)
+        return nn.DenseGeneral(d_model, axis=(-2, -1), dtype=self.dtype,
+                               param_dtype=jnp.float32, name="out")(ctx)
+
+
+class GptBlock(nn.Module):
+    """Pre-LN decoder block: x + attn(ln(x)); x + mlp(ln(x))."""
+    num_heads: int
+    mlp_dim: int
+    max_len: int
+    dtype: Any = jnp.bfloat16
+    use_ring: bool = False
+    use_flash: bool = False
+    mesh: Any = None
+    ring_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, decode=False, decode_index=None):
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_attn")(x)
+        x = x + CausalSelfAttention(
+            self.num_heads, self.max_len, self.dtype, self.use_ring,
+            self.use_flash, self.mesh, ring_axis=self.ring_axis,
+            name="attention")(h, decode=decode, decode_index=decode_index)
+        h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_mlp")(x)
+        h = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(x.shape[-1], dtype=self.dtype,
+                     param_dtype=jnp.float32, name="mlp_down")(h)
+        return x + h
+
+
+class Gpt(nn.Module):
+    """Decoder-only causal LM; logits via the tied word embedding."""
+    vocab_size: int = 32000
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 1024
+    dtype: Any = jnp.bfloat16
+    use_ring: bool = False
+    use_flash: bool = False
+    mesh: Any = None
+    ring_axis: Optional[str] = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, decode=False, decode_index=None):
+        embed = nn.Embed(self.vocab_size, self.d_model,
+                         param_dtype=jnp.float32, dtype=self.dtype,
+                         name="word_embed")
+        x = embed(input_ids)
+        s = input_ids.shape[1]
+        if decode:
+            if decode_index is None:
+                raise ValueError("decode mode needs decode_index")
+            pos_ids = jnp.full((1, s), decode_index, jnp.int32)
+        else:
+            pos_ids = jnp.arange(s)[None, :]
+            if self.ring_axis:
+                pos_ids = pos_ids + jax.lax.axis_index(self.ring_axis) * s
+        x = x + nn.Embed(self.max_len, self.d_model,
+                         param_dtype=jnp.float32, dtype=self.dtype,
+                         name="pos_embed")(pos_ids)
+        block_cls = nn.remat(GptBlock) if self.remat else GptBlock
+        for i in range(self.num_layers):
+            x = block_cls(self.num_heads, self.mlp_dim, self.max_len,
+                          self.dtype, self.use_ring, self.use_flash,
+                          self.mesh, ring_axis=self.ring_axis,
+                          name="block_%d" % i)(x, decode=decode,
+                                               decode_index=decode_index)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        # weight-tied LM head (embed.attend = x @ embedding.T)
+        return embed.attend(x.astype(jnp.float32))
+
+
+def gpt_partition_rules():
+    """Megatron-style TP rules, same scheme as bert_partition_rules."""
+    return [
+        (r"attention/(query|key|value)/kernel", P(None, "tp", None)),
+        (r"attention/out/kernel", P("tp", None, None)),
+        (r"mlp_up/kernel", P(None, "tp")),
+        (r"mlp_down/kernel", P("tp", None)),
+        (r"word_embed/embedding", P("tp", None)),
+    ]
+
+
+def gpt_tiny(**kw):
+    kw.setdefault("num_layers", 4)
+    kw.setdefault("d_model", 64)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("mlp_dim", 128)
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("max_len", 128)
+    return Gpt(**kw)
+
+
+def create_model_and_loss(model=None, dummy_batch=1, dummy_seq=16, **kw):
+    """(model, params, loss_fn) for ElasticTrainer — next-token
+    cross-entropy over batch["input_ids"] (shift inside)."""
+    model = model or gpt_tiny(**kw)
+    dummy = jnp.zeros((dummy_batch, dummy_seq), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+
+    def loss_fn(params, batch, rng):
+        ids = batch["input_ids"]
+        logits = model.apply({"params": params}, ids)
+        # predict token t+1 from prefix <= t; integer-label form avoids
+        # materializing a [b, s, vocab] one-hot at LM vocab sizes
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], ids[:, 1:]).mean()
+
+    return model, params, loss_fn
+
+
+def init_cache(model, params, batch_size):
+    """Zeroed KV caches for incremental decode. Shapes come from
+    eval_shape over init — no parameter tensor is materialized, and the
+    cache contents (which init would have polluted with the dummy
+    token's K/V) are created as real zeros."""
+    dummy = jnp.zeros((batch_size, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), dummy, decode=True,
+                           decode_index=jnp.zeros((), jnp.int32)))
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+def generate(model, params, prompt_ids, max_new_tokens, rng=None,
+             temperature=0.0):
+    """Autoregressive sampling with the KV cache, one fused lax.scan:
+    prompt positions are teacher-forced, then ``max_new_tokens`` are
+    sampled (greedy at temperature 0). Returns [b, prompt+new] ids."""
+    b, prompt_len = prompt_ids.shape
+    total = prompt_len + max_new_tokens
+    if total > model.max_len:
+        raise ValueError("prompt+new %d exceeds max_len %d"
+                         % (total, model.max_len))
+    cache = init_cache(model, params, b)
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    # pre-pad the prompt to the full output length
+    seq0 = jnp.concatenate(
+        [prompt_ids, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1)
+
+    def step(carry, t):
+        cache, seq, tok = carry
+        logits, muts = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            decode=True, decode_index=t, mutable=["cache"])
+        logits = logits[:, 0]
+        if temperature > 0:
+            nxt = jax.random.categorical(
+                jax.random.fold_in(rng, t), logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt.astype(jnp.int32)
+        # teacher-force while still inside the prompt
+        in_prompt = t + 1 < prompt_len
+        forced = jax.lax.dynamic_index_in_dim(
+            seq, jnp.minimum(t + 1, total - 1), axis=1, keepdims=False)
+        nxt = jnp.where(in_prompt, forced, nxt)
+        seq = jax.lax.dynamic_update_slice(seq, nxt[:, None],
+                                           (0, t + 1))
+        return (muts["cache"], seq, nxt), None
+
+    carry = (cache, seq0, prompt_ids[:, 0])
+    # feed positions 0..total-2; position t produces token t+1
+    (cache, seq, _), _ = jax.lax.scan(step, carry,
+                                      jnp.arange(total - 1))
+    return seq
+
+
+def synthetic_lm_batch(batch_size, seq_len=32, vocab_size=256, seed=0):
+    """Learnable synthetic stream: arithmetic sequences mod vocab (each
+    next token is prev + step, a pattern a causal LM can learn)."""
+    rng = np.random.RandomState(seed)
+    start = rng.randint(0, vocab_size, (batch_size, 1))
+    step = rng.randint(1, 7, (batch_size, 1))
+    pos = np.arange(seq_len)[None, :]
+    ids = (start + step * pos) % vocab_size
+    return {"input_ids": ids.astype(np.int32)}

@@ -1,0 +1,83 @@
+"""Causal-LM training + generation demo (GPT decoder family).
+
+Net-new vs the reference (no causal LM in its tree — SURVEY.md §5.7).
+Trains on synthetic arithmetic-mod sequences, then greedily generates a
+continuation with the KV cache and reports its pattern accuracy.
+
+Run hermetically:
+  JAX_PLATFORMS=cpu python examples/gpt/train.py --steps 150
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from edl_tpu.models import gpt
+    from edl_tpu.runtime.trainer import make_train_state, make_train_step
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--num_layers", type=int, default=2)
+    p.add_argument("--d_model", type=int, default=64)
+    p.add_argument("--num_heads", type=int, default=4)
+    p.add_argument("--mlp_dim", type=int, default=128)
+    p.add_argument("--vocab_size", type=int, default=64)
+    p.add_argument("--seq_len", type=int, default=24)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--gen_tokens", type=int, default=8)
+    args = p.parse_args(argv)
+
+    model, params, loss_fn = gpt.create_model_and_loss(
+        model=gpt.Gpt(num_layers=args.num_layers, d_model=args.d_model,
+                      num_heads=args.num_heads, mlp_dim=args.mlp_dim,
+                      vocab_size=args.vocab_size, max_len=128,
+                      dtype=jnp.float32))
+    tx = optax.adam(args.lr)
+    state = make_train_state(params, tx)
+    step = jax.jit(make_train_step(loss_fn, tx))
+    rng = jax.random.PRNGKey(0)
+
+    first_loss = loss = None
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        batch = gpt.synthetic_lm_batch(
+            args.batch_size, seq_len=args.seq_len,
+            vocab_size=args.vocab_size, seed=i)
+        batch = {k: jnp.asarray(v) for k, v in batch.items()}
+        state, loss = step(state, batch, rng)
+        if first_loss is None:
+            first_loss = float(loss)
+        if (i + 1) % 50 == 0:
+            print("step %d loss %.4f" % (i + 1, float(loss)), flush=True)
+    wall = time.perf_counter() - t0
+
+    # held-out sequence: start 5, stride 3
+    seq = (5 + 3 * np.arange(6 + args.gen_tokens)) % args.vocab_size
+    prompt = jnp.asarray(seq[None, :6].astype(np.int32))
+    out = gpt.generate(model, state["params"], prompt,
+                       max_new_tokens=args.gen_tokens)
+    got = np.asarray(out)[0, 6:]
+    gen_acc = float((got == seq[6:]).mean())
+    print(json.dumps({
+        "model": "gpt_l%d_d%d" % (args.num_layers, args.d_model),
+        "first_loss": first_loss,
+        "final_loss": float(loss),
+        "gen_accuracy": gen_acc,
+        "generated": got.tolist(),
+        "tokens_per_sec": round(
+            args.batch_size * args.seq_len * args.steps / wall, 1),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,14 @@ def test_long_context_example_runs_with_remat():
 
 
 @pytest.mark.integration
+def test_gpt_example_learns_and_generates():
+    out = _run_example("examples/gpt/train.py",
+                       ["--steps", "150"], timeout=400)
+    assert out["final_loss"] < 0.3 * out["first_loss"]
+    assert out["gen_accuracy"] >= 0.75
+
+
+@pytest.mark.integration
 def test_ctr_example_learns():
     out = _run_example("examples/ctr/train.py", [
         "--epochs", "2", "--steps_per_epoch", "30",
