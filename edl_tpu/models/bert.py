@@ -18,43 +18,6 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 
-def attention_context(q, k, v, *, causal, mask, dtype, ring_axis=None,
-                      use_ring=False, use_flash=False, mesh=None):
-    """The shared attention-impl dispatch for BERT and GPT: in-shard ring
-    (already inside a shard_map over ``ring_axis``) / ring over the sp
-    mesh axis / Pallas flash kernel / dense — one copy of the -1e30 mask
-    convention, sm_scale, and the CPU interpret fallback."""
-    head_dim = q.shape[-1]
-    scale = head_dim ** -0.5
-    if ring_axis:
-        from edl_tpu.parallel.ring_attention import _ring_attention_shard
-        return _ring_attention_shard(q, k, v, axis_name=ring_axis,
-                                     causal=causal, sm_scale=scale)
-    if use_ring:
-        from edl_tpu.parallel.ring_attention import ring_attention
-        return ring_attention(q, k, v, mesh, causal=causal)
-    if use_flash:
-        if mask is not None:
-            raise ValueError(
-                "use_flash does not support attention_mask yet; drop "
-                "the mask (fixed-length batches) or use the dense path")
-        from edl_tpu.ops.flash_attention import mha
-        return mha(q, k, v, causal=causal,
-                   interpret=jax.default_backend() != "tpu")
-    scores = jnp.einsum("bqhd,bkhd->bhqk",
-                        (q * scale).astype(jnp.float32),
-                        k.astype(jnp.float32))
-    if causal:
-        s = q.shape[1]
-        tri = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(tri[None, None], scores, -1e30)
-    if mask is not None:
-        scores = jnp.where(mask[:, None, None, :], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs,
-                      v.astype(jnp.float32)).astype(dtype)
-
-
 class BertSelfAttention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
@@ -75,6 +38,7 @@ class BertSelfAttention(nn.Module):
         q = dense((self.num_heads, head_dim), "query")(x)
         k = dense((self.num_heads, head_dim), "key")(x)
         v = dense((self.num_heads, head_dim), "value")(x)
+        from edl_tpu.ops.attention import attention_context
         ctx = attention_context(
             q, k, v, causal=False, mask=mask, dtype=self.dtype,
             ring_axis=self.ring_axis, use_ring=self.use_ring,

@@ -39,7 +39,8 @@ class CausalSelfAttention(nn.Module):
     ring_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, decode=False, decode_index=None):
+    def __call__(self, x, decode=False, decode_index=None,
+                 prefill=False):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         dense = lambda feats, name: nn.DenseGeneral(
@@ -48,7 +49,25 @@ class CausalSelfAttention(nn.Module):
         k = dense((self.num_heads, head_dim), "key")(x)
         v = dense((self.num_heads, head_dim), "value")(x)
 
-        if decode:
+        if prefill:
+            # ONE batched causal forward over the whole prompt that also
+            # fills cache slots [0:s] — generation then decodes only the
+            # new tokens instead of re-feeding the prefix one at a time
+            b, s = x.shape[:2]
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (b, self.max_len, self.num_heads, head_dim), self.dtype)
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (b, self.max_len, self.num_heads, head_dim), self.dtype)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, 0, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, 0, 0, 0))
+            from edl_tpu.ops.attention import attention_context
+            ctx = attention_context(q, k, v, causal=True, mask=None,
+                                    dtype=self.dtype)
+        elif decode:
             if x.shape[1] != 1:
                 raise ValueError("decode mode feeds one token at a time")
             if decode_index is None:
@@ -76,7 +95,7 @@ class CausalSelfAttention(nn.Module):
                              cv.value.astype(jnp.float32))
             ctx = ctx.astype(self.dtype)
         else:
-            from edl_tpu.models.bert import attention_context
+            from edl_tpu.ops.attention import attention_context
             ctx = attention_context(
                 q, k, v, causal=True, mask=None, dtype=self.dtype,
                 ring_axis=self.ring_axis, use_ring=self.use_ring,
@@ -97,13 +116,16 @@ class GptBlock(nn.Module):
     ring_axis: Optional[str] = None
 
     @nn.compact
-    def __call__(self, x, decode=False, decode_index=None):
+    def __call__(self, x, decode=False, decode_index=None,
+                 prefill=False):
         h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
         x = x + CausalSelfAttention(
             self.num_heads, self.max_len, self.dtype, self.use_ring,
             self.use_flash, self.mesh, ring_axis=self.ring_axis,
-            name="attention")(h, decode=decode, decode_index=decode_index)
+            name="attention")(h, decode=decode,
+                              decode_index=decode_index,
+                              prefill=prefill)
         h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype,
@@ -130,11 +152,16 @@ class Gpt(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, decode=False, decode_index=None):
+    def __call__(self, input_ids, decode=False, decode_index=None,
+                 prefill=False):
+        # Embed with dtype=f32 so the tied-head attend() computes fp32
+        # logits (Embed.attend promotes to its OWN dtype — a bf16 embed
+        # would silently demote the logits); the activation stream is
+        # cast down explicitly instead.
         embed = nn.Embed(self.vocab_size, self.d_model,
-                         param_dtype=jnp.float32, dtype=self.dtype,
+                         param_dtype=jnp.float32, dtype=jnp.float32,
                          name="word_embed")
-        x = embed(input_ids)
+        x = embed(input_ids).astype(self.dtype)
         s = input_ids.shape[1]
         if decode:
             if decode_index is None:
@@ -153,11 +180,154 @@ class Gpt(nn.Module):
                           self.dtype, self.use_ring, self.use_flash,
                           self.mesh, ring_axis=self.ring_axis,
                           name="block_%d" % i)(x, decode=decode,
-                                               decode_index=decode_index)
+                                               decode_index=decode_index,
+                                               prefill=prefill)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         # weight-tied LM head (embed.attend = x @ embedding.T)
         return embed.attend(x.astype(jnp.float32))
+
+
+class GptEmbed(nn.Module):
+    """Pipeline ``encode`` end: token ids → activations. With seq_axis
+    set (in-shard sequence parallelism) each shard embeds its seq SLICE
+    with shard-offset positions."""
+    vocab_size: int
+    d_model: int
+    max_len: int
+    dtype: Any = jnp.bfloat16
+    seq_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, input_ids):
+        s = input_ids.shape[1]
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     param_dtype=jnp.float32, dtype=self.dtype,
+                     name="word_embed")(input_ids)
+        pos_ids = jnp.arange(s)[None, :]
+        if self.seq_axis:
+            pos_ids = pos_ids + jax.lax.axis_index(self.seq_axis) * s
+        return x + nn.Embed(self.max_len, self.d_model,
+                            param_dtype=jnp.float32, dtype=self.dtype,
+                            name="pos_embed")(pos_ids)
+
+
+class GptStage(nn.Module):
+    """One pipeline stage: ``layers_per_stage`` causal blocks. ring_axis
+    composes sequence parallelism INTO the stage (causal in-shard ring —
+    cross-shard causality is the ring algorithm's job)."""
+    layers_per_stage: int
+    num_heads: int
+    mlp_dim: int
+    max_len: int
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    ring_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        block_cls = nn.remat(GptBlock) if self.remat else GptBlock
+        for i in range(self.layers_per_stage):
+            x = block_cls(self.num_heads, self.mlp_dim, self.max_len,
+                          self.dtype, ring_axis=self.ring_axis,
+                          name="block_%d" % i)(x)
+        return x
+
+
+class GptHead(nn.Module):
+    """Pipeline ``decode`` end: final LN + (untied) LM head. The tied
+    head of ``Gpt`` would couple decode params to the encode stage across
+    the pipeline, so the factored form unties it."""
+    vocab_size: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        return nn.Dense(self.vocab_size, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="lm_head")(x)
+
+
+def create_gpt_pipeline(pp, num_layers=4, d_model=64, num_heads=4,
+                        mlp_dim=128, vocab_size=256, max_len=128,
+                        seq_len=32, dtype=jnp.bfloat16, seed=0,
+                        seq_parallel_axis=None):
+    """A causal LM factored for pipeline parallelism.
+
+    Returns (params, encode_fn, stage_fn, decode_fn, sequential_loss)
+    for ``pipeline_value_and_grad`` (same contract as
+    bert.create_bert_pipeline). ``y`` passed to the engine is the FULL
+    [batch, seq] id tensor (replicated along seq shards); the decode end
+    computes the next-token loss, and under ``seq_parallel_axis`` each
+    shard slices its own global-offset targets from it and returns its
+    loss CONTRIBUTION (the engine sums over seq shards). The boundary
+    token between neighboring shards is handled by the global slicing —
+    the last local position of shard i targets the first token of shard
+    i+1."""
+    if num_layers % pp != 0:
+        raise ValueError("num_layers %d not divisible by pp %d"
+                         % (num_layers, pp))
+    spa = seq_parallel_axis
+    embed = GptEmbed(vocab_size, d_model, max_len, dtype)
+    stage = GptStage(num_layers // pp, num_heads, mlp_dim, max_len, dtype)
+    head = GptHead(vocab_size, dtype)
+    embed_sp = GptEmbed(vocab_size, d_model, max_len, dtype, seq_axis=spa)
+    stage_sp = GptStage(num_layers // pp, num_heads, mlp_dim, max_len,
+                        dtype, ring_axis=spa)
+
+    root = jax.random.PRNGKey(seed)
+    k_embed, k_head, *k_stages = jax.random.split(root, 2 + pp)
+    ids = jnp.zeros((1, seq_len), jnp.int32)
+    p_enc = embed.init(k_embed, ids)["params"]
+    act = embed.apply({"params": p_enc}, ids)
+    per_stage = [stage.init(k, act)["params"] for k in k_stages]
+    p_stages = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage)
+    p_dec = head.init(k_head, act)["params"]
+    params = {"encode": p_enc, "stages": p_stages, "decode": p_dec}
+
+    def encode_fn(p, batch_x):
+        return embed_sp.apply({"params": p}, batch_x)
+
+    def stage_fn(p, x):
+        return stage_sp.apply({"params": p}, x)
+
+    def _lm_loss(logits, y, shard_idx):
+        """Loss contribution of this shard's logits [b, s_loc, V] given
+        the FULL targets y [b, s_glob]: local position j predicts global
+        token shard_idx*s_loc + j + 1; the final global position has no
+        target and is masked. Normalized by the GLOBAL token count so
+        contributions sum to the sequential mean."""
+        b, s_loc = logits.shape[:2]
+        s_glob = y.shape[1]
+        # pad y so the last shard's slice never overruns
+        y_pad = jnp.concatenate(
+            [y, jnp.zeros((b, 1), y.dtype)], axis=1)
+        tgt = jax.lax.dynamic_slice(
+            y_pad, (0, shard_idx * s_loc + 1), (b, s_loc))
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), tgt)
+        glob_pos = shard_idx * s_loc + jnp.arange(s_loc)
+        valid = (glob_pos < s_glob - 1).astype(jnp.float32)
+        return (ce * valid[None]).sum() / (b * (s_glob - 1))
+
+    def decode_fn(p, x, y):
+        logits = head.apply({"params": p}, x)
+        if spa:
+            return _lm_loss(logits, y, jax.lax.axis_index(spa))
+        return _lm_loss(logits, y, 0)
+
+    def sequential_loss(params, batch_x, y):
+        x = embed.apply({"params": params["encode"]}, batch_x)
+        for s_i in range(pp):
+            p_s = jax.tree_util.tree_map(lambda a: a[s_i],
+                                         params["stages"])
+            x = stage.apply({"params": p_s}, x)
+        logits = head.apply({"params": params["decode"]}, x)
+        return _lm_loss(logits, y, 0)
+
+    return params, encode_fn, stage_fn, decode_fn, sequential_loss
 
 
 def gpt_partition_rules():
@@ -214,9 +384,10 @@ def init_cache(model, params, batch_size):
 
 def generate(model, params, prompt_ids, max_new_tokens, rng=None,
              temperature=0.0):
-    """Autoregressive sampling with the KV cache, one fused lax.scan:
-    prompt positions are teacher-forced, then ``max_new_tokens`` are
-    sampled (greedy at temperature 0). Returns [b, prompt+new] ids."""
+    """Autoregressive sampling with the KV cache: ONE batched prefill
+    forward fills the cache over the whole prompt (no per-token prefix
+    re-feeding), then a lax.scan decodes ``max_new_tokens`` (greedy at
+    temperature 0). Returns [b, prompt+new] ids."""
     b, prompt_len = prompt_ids.shape
     total = prompt_len + max_new_tokens
     if total > model.max_len:
@@ -224,35 +395,38 @@ def generate(model, params, prompt_ids, max_new_tokens, rng=None,
                          % (total, model.max_len))
     cache = init_cache(model, params, b)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    # pre-pad the prompt to the full output length
+
+    def sample(logits, feed_pos):
+        if temperature > 0:
+            nxt = jax.random.categorical(
+                jax.random.fold_in(rng, feed_pos),
+                logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        return nxt.astype(jnp.int32)
+
+    logits, muts = model.apply(
+        {"params": params, "cache": cache}, prompt_ids, prefill=True,
+        mutable=["cache"])
+    cache = muts["cache"]
+    first = sample(logits[:, -1], prompt_len - 1)
     seq0 = jnp.concatenate(
-        [prompt_ids, jnp.zeros((b, max_new_tokens), jnp.int32)], axis=1)
+        [prompt_ids, first[:, None],
+         jnp.zeros((b, max_new_tokens - 1), jnp.int32)], axis=1)
 
     def step(carry, t):
         cache, seq, tok = carry
         logits, muts = model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             decode=True, decode_index=t, mutable=["cache"])
-        logits = logits[:, 0]
-        if temperature > 0:
-            nxt = jax.random.categorical(
-                jax.random.fold_in(rng, t), logits / temperature, axis=-1)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        nxt = nxt.astype(jnp.int32)
-        # teacher-force while still inside the prompt
-        in_prompt = t + 1 < prompt_len
-        forced = jax.lax.dynamic_index_in_dim(
-            seq, jnp.minimum(t + 1, total - 1), axis=1, keepdims=False)
-        nxt = jnp.where(in_prompt, forced, nxt)
-        seq = jax.lax.dynamic_update_slice(seq, nxt[:, None],
-                                           (0, t + 1))
+        nxt = sample(logits[:, 0], t)
+        seq = jax.lax.dynamic_update_slice(seq, nxt[:, None], (0, t + 1))
         return (muts["cache"], seq, nxt), None
 
-    carry = (cache, seq0, prompt_ids[:, 0])
-    # feed positions 0..total-2; position t produces token t+1
-    (cache, seq, _), _ = jax.lax.scan(step, carry,
-                                      jnp.arange(total - 1))
+    # feed positions prompt_len..total-2; position t produces token t+1
+    (_, seq, _), _ = jax.lax.scan(
+        step, (cache, seq0, first),
+        jnp.arange(prompt_len, total - 1))
     return seq
 
 
