@@ -150,19 +150,67 @@ def resnet_teacher(depth=50, num_classes=1000, image_size=224,
         max_batch=max_batch, host=host, port=port)
 
 
+def gpt_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
+                vocab_size=256, seq_len=32, max_batch=64, host="0.0.0.0",
+                port=0, params=None):
+    """A causal-LM teacher: per-position next-token logits + probs —
+    sequence-level knowledge distillation (the LM counterpart of the
+    reference's ERNIE→BOW soft-label serving). Fixed ``seq_len`` so XLA
+    compiles one program; clients pad shorter sequences.
+
+    ``params`` (a trained Gpt param tree) makes it a real teacher; the
+    default random init serves as a shape-true stand-in for tests."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import gpt
+
+    model = gpt.Gpt(num_layers=num_layers, d_model=d_model,
+                    num_heads=num_heads, mlp_dim=mlp_dim,
+                    vocab_size=vocab_size, max_len=max(seq_len, 16),
+                    dtype=jnp.bfloat16)
+    if params is None:
+        dummy = jnp.zeros((1, seq_len), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+
+    @jax.jit
+    def infer(ids):
+        logits = model.apply({"params": params}, ids)
+        return logits, jax.nn.softmax(logits)
+
+    def predict(feed):
+        ids = np.asarray(feed["input_ids"], np.int32)
+        logits, probs = infer(ids)
+        return {"logits": np.asarray(logits), "probs": np.asarray(probs)}
+
+    return TeacherServer(
+        predict,
+        feed_specs={"input_ids": ([seq_len], "<i4")},
+        fetch_specs={"logits": ([seq_len, vocab_size], "<f4"),
+                     "probs": ([seq_len, vocab_size], "<f4")},
+        max_batch=max_batch, host=host, port=port)
+
+
 def main():
     p = argparse.ArgumentParser("edl_tpu teacher server")
-    p.add_argument("--model", default="nop", choices=["nop", "resnet"])
+    p.add_argument("--model", default="nop",
+                   choices=["nop", "resnet", "gpt"])
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--depth", type=int, default=50)
     p.add_argument("--num_classes", type=int, default=1000)
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--max_batch", type=int, default=64)
+    p.add_argument("--vocab_size", type=int, default=256)
+    p.add_argument("--seq_len", type=int, default=32)
     args = p.parse_args()
     if args.model == "resnet":
         server = resnet_teacher(args.depth, args.num_classes,
                                 args.image_size, args.max_batch,
                                 port=args.port)
+    elif args.model == "gpt":
+        server = gpt_teacher(vocab_size=args.vocab_size,
+                             seq_len=args.seq_len,
+                             max_batch=args.max_batch, port=args.port)
     else:
         server = nop_teacher({"logits": ([args.num_classes], "<f4")},
                              max_batch=args.max_batch, port=args.port)

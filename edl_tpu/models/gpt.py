@@ -24,7 +24,8 @@ class CausalSelfAttention(nn.Module):
     """Causal MHA with an optional single-token decode mode.
 
     decode=False: full-sequence causal attention via the shared
-    bert.attention_context dispatch (dense / flash / ring).
+    edl_tpu.ops.attention.attention_context dispatch (dense / flash /
+    ring).
     decode=True: x is [b, 1, d]; K/V are written into "cache" variables
     sized [b, max_len, h, hd] at ``decode_index`` — the ONE source of
     truth for the decode position (the same value drives the position
@@ -53,6 +54,12 @@ class CausalSelfAttention(nn.Module):
             # ONE batched causal forward over the whole prompt that also
             # fills cache slots [0:s] — generation then decodes only the
             # new tokens instead of re-feeding the prefix one at a time
+            if self.ring_axis or self.use_ring:
+                # the cache layout holds the FULL sequence per device;
+                # a seq-sharded prefill would fill it with local slices
+                raise ValueError("prefill does not support ring "
+                                 "attention (seq-sharded K/V); build the "
+                                 "serving model without use_ring")
             b, s = x.shape[:2]
             ck = self.variable(
                 "cache", "k", jnp.zeros,
@@ -66,7 +73,8 @@ class CausalSelfAttention(nn.Module):
                 cv.value, v.astype(self.dtype), (0, 0, 0, 0))
             from edl_tpu.ops.attention import attention_context
             ctx = attention_context(q, k, v, causal=True, mask=None,
-                                    dtype=self.dtype)
+                                    dtype=self.dtype,
+                                    use_flash=self.use_flash)
         elif decode:
             if x.shape[1] != 1:
                 raise ValueError("decode mode feeds one token at a time")
@@ -393,6 +401,8 @@ def generate(model, params, prompt_ids, max_new_tokens, rng=None,
     if total > model.max_len:
         raise ValueError("prompt+new %d exceeds max_len %d"
                          % (total, model.max_len))
+    if max_new_tokens < 1:
+        return prompt_ids
     cache = init_cache(model, params, b)
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 

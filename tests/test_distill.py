@@ -121,6 +121,42 @@ def test_teacher_server_pad_and_slice():
         server.stop()
 
 
+def test_gpt_teacher_serves_lm_soft_labels():
+    """The causal-LM teacher: per-position logits/probs over the vocab,
+    consistent with a local forward of the same params (sequence-level
+    KD contract)."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.distill.teacher_server import gpt_teacher
+    from edl_tpu.models import gpt as gpt_mod
+
+    server = gpt_teacher(vocab_size=32, seq_len=8, max_batch=4,
+                         host="127.0.0.1").start()
+    try:
+        from edl_tpu.distill.distill_reader import _TeacherConn
+        conn = _TeacherConn(server.endpoint)
+        ids = np.arange(16, dtype=np.int32).reshape(2, 8) % 32
+        out = conn.predict({"input_ids": ids})
+        assert out["logits"].shape == (2, 8, 32)
+        assert out["probs"].shape == (2, 8, 32)
+        np.testing.assert_allclose(out["probs"].sum(-1),
+                                   np.ones((2, 8)), rtol=1e-3)
+        # matches a local forward of the same (seed-0) teacher params
+        model = gpt_mod.Gpt(num_layers=2, d_model=64, num_heads=4,
+                            mlp_dim=128, vocab_size=32, max_len=16,
+                            dtype=jnp.bfloat16)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 8), jnp.int32))["params"]
+        want = np.asarray(model.apply({"params": params},
+                                      jnp.asarray(ids)))
+        # bf16 jit-vs-eager reassociation noise bounds the tolerance
+        np.testing.assert_allclose(out["logits"], want, atol=5e-2)
+        conn.close()
+    finally:
+        server.stop()
+
+
 def test_registry_and_discovery(coord):
     teacher = nop_teacher({"logits": ([4], "<f4")}, max_batch=4,
                           host="127.0.0.1").start()

@@ -102,6 +102,11 @@ def test_gpt_generate_respects_prompt_and_shapes():
     np.testing.assert_array_equal(np.asarray(out)[:, :8], np.asarray(ids))
     with pytest.raises(ValueError):
         gpt.generate(model, params, ids, max_new_tokens=1000)
+    # max_new_tokens=0 returns the prompt unchanged
+    out0 = gpt.generate(model, params, ids, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(ids))
+    out1 = gpt.generate(model, params, ids, max_new_tokens=1)
+    assert out1.shape == (1, 9)
 
 
 def test_gpt_tp_sharded_matches_replicated():
