@@ -1,0 +1,98 @@
+"""Subset-statistics BatchNorm: train-time mean/var from a strided slice
+of the batch.
+
+Why (TPU): profiling the ResNet50_vd train step on v5e showed the convs
+running at ~87% MFU while ~15.8 ms of the 50 ms step went to BatchNorm
+statistic reductions (`convert_reduce_fusion` reading the full activation
+from HBM) — BN, not matmul, is the throughput ceiling. Computing the
+statistics from ``x[::stats_every]`` cuts that HBM traffic by the same
+factor while normalizing the full batch.
+
+Why it is faithful: the reference's headline run normalizes over 32
+images per accelerator (global batch 256 on 8 GPUs, per-GPU BatchNorm —
+/root/reference/README.md:83 with example/collective/resnet50/
+train_with_fleet.py batch math), so a v5e chip training at batch 128
+with ``stats_every=4`` sees the *same* statistics batch (32) as the
+reference; full-batch statistics are the stricter-than-reference default
+(``stats_every=1``).
+
+Under a dp-sharded batch the strided slice stays shard-local whenever
+the per-device batch is divisible by ``stats_every`` (contiguous batch
+partitions each contribute every ``stats_every``-th row), so the only
+cross-device traffic is the [C]-vector statistics all-reduce XLA already
+inserts — the sync-BN cost, not a resharding.
+
+Variable/param structure matches ``flax.linen.BatchNorm`` exactly
+("batch_stats": {mean, var} float32; "params": {scale, bias}), so models
+can switch the flag without breaking checkpoints.
+"""
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class SubsetBatchNorm(nn.Module):
+    """BatchNorm over the trailing feature axis with train statistics
+    computed from ``x[::stats_every]`` (``stats_every<=1`` = full batch).
+
+    The normalization is applied in folded ``x * a + b`` form with ``a``
+    and ``b`` precomputed in float32 from (scale, bias, mean, var) — one
+    fused elementwise pass over the activation.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    use_scale: bool = True
+    use_bias: bool = True
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
+    stats_every: int = 1
+
+    @nn.compact
+    def __call__(self, x, use_running_average=None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        feat = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((feat,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((feat,), jnp.float32))
+        if self.use_scale:
+            scale = self.param("scale", self.scale_init, (feat,),
+                               self.param_dtype).astype(jnp.float32)
+        else:
+            scale = jnp.ones((feat,), jnp.float32)
+        if self.use_bias:
+            bias = self.param("bias", self.bias_init, (feat,),
+                              self.param_dtype).astype(jnp.float32)
+        else:
+            bias = jnp.zeros((feat,), jnp.float32)
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            k = max(1, self.stats_every)
+            s = x[::k] if x.shape[0] >= k else x
+            axes = tuple(range(s.ndim - 1))
+            # one pass over s: E[x] and E[x^2] reduce together (the flax
+            # use_fast_variance formulation), accumulated in f32
+            mean = jnp.mean(s, axes, dtype=jnp.float32)
+            m2 = jnp.mean(jax.lax.square(s.astype(jnp.float32)), axes)
+            var = jnp.maximum(m2 - mean * mean, 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+
+        inv = scale * jax.lax.rsqrt(var + self.epsilon)
+        out_dtype = self.dtype or x.dtype
+        a = inv.astype(out_dtype)
+        b = (bias - mean * inv).astype(out_dtype)
+        return x.astype(out_dtype) * a + b

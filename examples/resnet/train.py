@@ -38,6 +38,9 @@ def main(argv=None):
     p.add_argument("--lr_schedule", choices=["cosine", "piecewise"],
                    default="cosine")
     p.add_argument("--dtype", choices=["bf16", "f32"], default="f32")
+    p.add_argument("--bn_stats_every", type=int, default=1,
+                   help="BN train statistics from every k-th batch row "
+                        "(throughput knob for large per-chip batches)")
     p.add_argument("--fetch_steps", type=int, default=10)
     p.add_argument("--eval_steps", type=int, default=0,
                    help="eval batches per epoch on rank 0 (0 = off)")
@@ -67,7 +70,8 @@ def main(argv=None):
 
     model, params, extra, loss_fn = resnet.create_model_and_loss(
         depth=args.depth, num_classes=args.num_classes,
-        image_size=args.image_size, dtype=dtype)
+        image_size=args.image_size, dtype=dtype,
+        bn_stats_every=args.bn_stats_every)
     trainer = ElasticTrainer(
         loss_fn, params, optax.sgd(schedule, momentum=0.9),
         total_batch_size=args.total_batch_size, extra_state=extra,

@@ -104,3 +104,11 @@ def test_liveft_protocol(coord):
     finally:
         m1.stop()
         m2.stop()
+
+
+def test_profile_bench_breakdown_parser(tmp_path):
+    """The xplane parser handles an empty logdir (no trace produced) and
+    the CLI surface parses; the full trace path needs TPU hardware."""
+    from edl_tpu.tools import profile_bench
+
+    assert profile_bench.xplane_op_breakdown(str(tmp_path), 10) is None
