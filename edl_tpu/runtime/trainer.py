@@ -61,6 +61,21 @@ _REMAT_POLICIES = {
 }
 
 
+def _remat_wrapper(remat_policy):
+    """Validate ``remat_policy`` eagerly and return the loss wrapper
+    (identity for None) — shared by make_train_step/make_accum_step."""
+    if remat_policy is not None and remat_policy not in _REMAT_POLICIES:
+        raise ValueError("remat_policy %r not in %s"
+                         % (remat_policy, sorted(_REMAT_POLICIES)))
+
+    def wrap(fn):
+        if remat_policy is None:
+            return fn
+        return jax.checkpoint(fn, policy=_REMAT_POLICIES[remat_policy]())
+
+    return wrap
+
+
 def make_train_step(loss_fn, tx, has_aux=False, remat_policy=None):
     """Build the canonical SGD step over a make_train_state pytree.
 
@@ -72,14 +87,7 @@ def make_train_step(loss_fn, tx, has_aux=False, remat_policy=None):
     loss in jax.checkpoint with the named policy (activation recompute;
     reference knob train_with_fleet.py:322-325). Combine with the models'
     own per-layer ``remat`` flag for layer-boundary-only memory."""
-    if remat_policy is not None and remat_policy not in _REMAT_POLICIES:
-        raise ValueError("remat_policy %r not in %s"
-                         % (remat_policy, sorted(_REMAT_POLICIES)))
-
-    def _maybe_remat(fn):
-        if remat_policy is None:
-            return fn
-        return jax.checkpoint(fn, policy=_REMAT_POLICIES[remat_policy]())
+    _maybe_remat = _remat_wrapper(remat_policy)
 
     def step(train_state, batch, rng):
         if has_aux:
@@ -131,6 +139,71 @@ def make_multi_step(loss_fn, tx, steps_per_call, has_aux=False,
             return state2, loss
         return lax.scan(body, train_state, batches,
                         length=steps_per_call)
+
+    return step
+
+
+def make_accum_step(loss_fn, tx, accum_steps, has_aux=False,
+                    remat_policy=None):
+    """Gradient accumulation: ONE optimizer update from ``accum_steps``
+    microbatches, scanned in one dispatch.
+
+    step(train_state, batches, rng) -> (train_state, loss) where every
+    leaf of ``batches`` has a leading [accum_steps] axis (microbatch-
+    major) and loss is the mean microbatch loss. Gradients are averaged
+    over microbatches — for a mean-reduced loss this equals the full-
+    batch gradient, so the update is independent of ``accum_steps`` (up
+    to fp roundoff); ``extra`` state (e.g. BatchNorm running stats)
+    chains through the microbatches sequentially, exactly as if they
+    were separate steps.
+
+    The elastic lever: on a scale-down the per-chip batch must absorb
+    total_batch_size/world more rows; instead of growing activation
+    memory, raise ``grad_accum`` — the global batch per UPDATE (and so
+    convergence behavior) is unchanged across the resize. The reference
+    kept global batch constant by resharding rows only
+    (train_with_fleet.py:360-361); accumulation extends that policy past
+    the per-device memory ceiling. The rng is folded per microbatch so
+    dropout streams differ across microbatches."""
+    if accum_steps < 1:
+        raise ValueError("accum_steps must be >= 1")
+    _maybe_remat = _remat_wrapper(remat_policy)
+
+    def step(train_state, batches, rng):
+        params = train_state["params"]
+
+        def body(carry, xs):
+            extra, grad_acc, loss_acc = carry
+            i, batch = xs
+            rng_i = jax.random.fold_in(rng, i)
+            if has_aux:
+                @_maybe_remat
+                def compute(p):
+                    return loss_fn(p, extra, batch, rng_i)
+                (loss, new_extra), grads = jax.value_and_grad(
+                    compute, has_aux=True)(params)
+            else:
+                @_maybe_remat
+                def compute(p):
+                    return loss_fn(p, batch, rng_i)
+                loss, grads = jax.value_and_grad(compute)(params)
+                new_extra = extra
+            grad_acc = jax.tree_util.tree_map(jnp.add, grad_acc, grads)
+            return (new_extra, grad_acc, loss_acc + loss), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (extra, grad_sum, loss_sum), _ = lax.scan(
+            body, (train_state["extra"], zeros, jnp.zeros((), jnp.float32)),
+            (jnp.arange(accum_steps), batches), length=accum_steps)
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grad_sum)
+        updates, opt_state = tx.update(grads, train_state["opt_state"],
+                                       params)
+        return {
+            "params": optax.apply_updates(params, updates),
+            "opt_state": opt_state,
+            "step": train_state["step"] + 1,
+            "extra": extra,
+        }, loss_sum / accum_steps
 
     return step
 
@@ -187,13 +260,17 @@ class ElasticTrainer(object):
         (train_with_fleet.py:360-361, edl_collective_design_doc.md:14-17).
       checkpoint_dir: shared directory for elastic resume ('' disables).
       mesh: optional prebuilt Mesh (default: 1-D dp mesh over all devices).
+      grad_accum: microbatches accumulated per optimizer update
+        (make_accum_step); total_batch_size stays the per-UPDATE global
+        batch, so raising grad_accum after a scale-down keeps both the
+        update size and the per-chip activation memory constant.
     """
 
     def __init__(self, loss_fn, params, tx, total_batch_size,
                  checkpoint_dir=None, mesh=None, env=None, coord=None,
                  keep_checkpoints=3, extra_state=None, has_aux=False,
                  async_save=False, remat_policy=None,
-                 param_shardings=None):
+                 param_shardings=None, grad_accum=1):
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -232,6 +309,21 @@ class ElasticTrainer(object):
         self._tx = tx
         self._has_aux = has_aux
         self._remat_policy = remat_policy
+        # gradient accumulation: total_batch_size stays the rows per
+        # OPTIMIZER UPDATE; each update scans grad_accum microbatches
+        # (see make_accum_step — the past-the-memory-ceiling elastic lever)
+        if grad_accum < 1:
+            raise ValueError("grad_accum must be >= 1")
+        if grad_accum > 1:
+            if self.per_host_batch % grad_accum != 0:
+                raise ValueError(
+                    "per-host batch %d not divisible by grad_accum %d"
+                    % (self.per_host_batch, grad_accum))
+            if self.per_device_batch % grad_accum != 0:
+                raise ValueError(
+                    "per-device batch %d not divisible by grad_accum %d"
+                    % (self.per_device_batch, grad_accum))
+        self._grad_accum = grad_accum
         if extra_state is not None:
             for leaf in jax.tree_util.tree_leaves(extra_state):
                 # only explicit numpy 64-bit leaves are dangerous; Python
@@ -248,7 +340,15 @@ class ElasticTrainer(object):
                         "in trainer.state.user_defined instead" % dt)
         self.state = state_mod.State(total_batch_size=total_batch_size)
         self._repl = NamedSharding(self.mesh, P())
-        self._batch_sharding = self._batch_sharding_early
+        if self._grad_accum > 1:
+            # microbatch-major [k, rows/k, ...]: scan axis replicated,
+            # rows sharded over the same data axes as the flat layout
+            early = self._batch_sharding_early.spec
+            row_axes = early[0] if early else None
+            self._batch_sharding = NamedSharding(self.mesh,
+                                                 P(None, row_axes))
+        else:
+            self._batch_sharding = self._batch_sharding_early
 
         # model parallelism: partition rules (regex, PartitionSpec) or an
         # explicit sharding pytree for the params; optimizer-state
@@ -298,8 +398,13 @@ class ElasticTrainer(object):
     # -- the compiled step ---------------------------------------------------
 
     def _build_step(self):
-        step = make_train_step(self._loss_fn, self._tx, self._has_aux,
-                               remat_policy=self._remat_policy)
+        if self._grad_accum > 1:
+            step = make_accum_step(self._loss_fn, self._tx,
+                                   self._grad_accum, self._has_aux,
+                                   remat_policy=self._remat_policy)
+        else:
+            step = make_train_step(self._loss_fn, self._tx, self._has_aux,
+                                   remat_policy=self._remat_policy)
         return jax.jit(
             step,
             in_shardings=(self._state_shardings, self._batch_sharding,
@@ -329,6 +434,11 @@ class ElasticTrainer(object):
         t0 = time.perf_counter()
         if rng is None:
             rng = jax.random.PRNGKey(self._host_step)
+        if self._grad_accum > 1:
+            k = self._grad_accum
+            host_batch = jax.tree_util.tree_map(
+                lambda x: x.reshape((k, x.shape[0] // k) + x.shape[1:]),
+                host_batch)
         batch = self.shard_batch(host_batch)
         self.train_state, loss = self._jit_step(self.train_state, batch, rng)
         self._host_step += 1

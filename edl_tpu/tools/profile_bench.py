@@ -89,24 +89,29 @@ def xplane_op_breakdown(logdir, steps):
     space = xplane_pb2.XSpace()
     with open(sorted(paths)[-1], "rb") as f:
         space.ParseFromString(f.read())
-    rows = []
+    # merge across device planes (one per chip running the same SPMD
+    # program) and report the PER-CHIP average, so multi-chip hosts don't
+    # inflate ms/step by n_chips
+    agg = collections.Counter()
+    cnt = collections.Counter()
+    n_planes = 0
     for plane in space.planes:
         if not plane.name.startswith("/device:"):
             continue
         for line in plane.lines:
             if line.name != "XLA Ops":
                 continue
-            agg = collections.Counter()
-            cnt = collections.Counter()
+            n_planes += 1
             for ev in line.events:
                 name = plane.event_metadata[ev.metadata_id].name
                 base = re.sub(r"\.\d+", "", name.split(" = ")[0])
                 agg[base] += ev.duration_ps
                 cnt[base] += 1
-            for base, ps in agg.most_common():
-                rows.append((base, ps / 1e9 / steps, cnt[base],
-                             ps / 1e6 / cnt[base]))
-    return rows
+    if n_planes == 0:
+        return None
+    rows = [(base, ps / 1e9 / steps / n_planes, cnt[base],
+             ps / 1e6 / cnt[base]) for base, ps in agg.most_common()]
+    return rows or None
 
 
 def main(argv=None):

@@ -41,6 +41,10 @@ def main(argv=None):
     p.add_argument("--bn_stats_every", type=int, default=1,
                    help="BN train statistics from every k-th batch row "
                         "(throughput knob for large per-chip batches)")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help="microbatches per optimizer update; raise after "
+                        "a scale-down to keep global batch AND per-chip "
+                        "memory constant")
     p.add_argument("--fetch_steps", type=int, default=10)
     p.add_argument("--eval_steps", type=int, default=0,
                    help="eval batches per epoch on rank 0 (0 = off)")
@@ -75,7 +79,7 @@ def main(argv=None):
     trainer = ElasticTrainer(
         loss_fn, params, optax.sgd(schedule, momentum=0.9),
         total_batch_size=args.total_batch_size, extra_state=extra,
-        has_aux=True)
+        has_aux=True, grad_accum=args.grad_accum)
     env = trainer.env
     resumed = trainer.resume()
     start_epoch = trainer.state.next_epoch() if resumed else 0

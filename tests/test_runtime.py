@@ -326,3 +326,89 @@ def test_trainer_batch_sharded_over_dp(tmp_path):
     # each device holds 1/8 of the batch rows
     shard = x.addressable_shards[0]
     assert shard.data.shape == (8, 4)
+
+
+def test_accum_step_matches_full_batch_gradient():
+    """make_accum_step(k): averaged microbatch gradients == the full-batch
+    gradient for a mean-reduced loss, so the update is independent of k."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import (make_accum_step, make_train_state,
+                                         make_train_step)
+
+    params = linear.init_params(feature_dim=4)
+    tx = optax.sgd(0.1)
+    rs = np.random.RandomState(1)
+    full = {
+        "x": rs.randn(16, 4).astype(np.float32),
+        "y": rs.randn(16).astype(np.float32),
+    }
+    rng = jax.random.PRNGKey(3)
+
+    base = jax.jit(make_train_step(linear.loss_fn, tx))
+    want, want_loss = base(make_train_state(params, tx), full, rng)
+
+    K = 4
+    micro = {k: v.reshape((K, 16 // K) + v.shape[1:])
+             for k, v in full.items()}
+    accum = jax.jit(make_accum_step(linear.loss_fn, tx, accum_steps=K))
+    got, got_loss = accum(make_train_state(params, tx), micro, rng)
+
+    assert int(got["step"]) == 1  # ONE optimizer update
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(got["params"]),
+                    jax.tree_util.tree_leaves(want["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_accum_step_chains_extra_state():
+    """has_aux extra state must thread microbatch-to-microbatch (the BN
+    running-stats semantics), ending at the LAST microbatch's value."""
+    from edl_tpu.runtime.trainer import make_accum_step, make_train_state
+
+    def loss_fn(params, extra, batch, rng):
+        loss = ((params["w"] * batch["x"]) ** 2).mean()
+        return loss, {"count": extra["count"] + 1,
+                      "last": batch["x"].mean()}
+
+    tx = optax.sgd(0.01)
+    params = {"w": jnp.ones((4,))}
+    state = make_train_state(params, tx,
+                             {"count": jnp.zeros((), jnp.int32),
+                              "last": jnp.zeros(())})
+    K = 3
+    batches = {"x": np.arange(K * 2 * 4, dtype=np.float32)
+                      .reshape(K, 2, 4)}
+    step = jax.jit(make_accum_step(loss_fn, tx, accum_steps=K,
+                                   has_aux=True))
+    state, _ = step(state, batches, jax.random.PRNGKey(0))
+    assert int(state["extra"]["count"]) == K
+    np.testing.assert_allclose(float(state["extra"]["last"]),
+                               batches["x"][-1].mean(), rtol=1e-6)
+
+
+def test_elastic_trainer_grad_accum_equivalent(tmp_path):
+    """ElasticTrainer(grad_accum=2) produces the same params as
+    grad_accum=1 on the same data (deterministic loss), sharded over the
+    virtual dp mesh."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import ElasticTrainer
+
+    rs = np.random.RandomState(2)
+    batch = {
+        "x": rs.randn(16, 4).astype(np.float32),
+        "y": rs.randn(16).astype(np.float32),
+    }
+
+    params = []
+    for k in (1, 2):
+        tr = ElasticTrainer(linear.loss_fn, linear.init_params(4),
+                            optax.sgd(0.05), total_batch_size=16,
+                            checkpoint_dir="", grad_accum=k)
+        for i in range(3):
+            tr.train_step(batch, rng=jax.random.PRNGKey(i))
+        params.append(jax.tree_util.tree_leaves(
+            jax.device_get(tr.train_state["params"])))
+    for a, b in zip(*params):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
