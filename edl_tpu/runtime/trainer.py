@@ -264,13 +264,18 @@ class ElasticTrainer(object):
         (make_accum_step); total_batch_size stays the per-UPDATE global
         batch, so raising grad_accum after a scale-down keeps both the
         update size and the per-chip activation memory constant.
+      zero1: ZeRO-1 / weight-update sharding — optimizer moments sharded
+        over the dp axis (composes with tensor-parallel param_shardings);
+        XLA turns the grad all-reduce + update into reduce-scatter +
+        sharded update + param all-gather. 1/dp the optimizer memory at
+        unchanged wire bytes.
     """
 
     def __init__(self, loss_fn, params, tx, total_batch_size,
                  checkpoint_dir=None, mesh=None, env=None, coord=None,
                  keep_checkpoints=3, extra_state=None, has_aux=False,
                  async_save=False, remat_policy=None,
-                 param_shardings=None, grad_accum=1):
+                 param_shardings=None, grad_accum=1, zero1=False):
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -359,19 +364,36 @@ class ElasticTrainer(object):
             from edl_tpu.parallel.sharding import shard_params
             params, param_shardings = shard_params(params, self.mesh,
                                                    param_shardings)
-        self.train_state = make_train_state(params, tx, extra_state)
-        if param_shardings is None:
+        if param_shardings is None and not zero1:
+            self.train_state = make_train_state(params, tx, extra_state)
             self._state_shardings = jax.tree_util.tree_map(
                 lambda _: self._repl, self.train_state)
         else:
             from edl_tpu.parallel.sharding import opt_state_shardings
+            if param_shardings is None:
+                # ZeRO-1 with replicated params: only the optimizer
+                # state is dp-sharded (weight-update sharding)
+                param_shardings = jax.tree_util.tree_map(
+                    lambda _: self._repl, params)
             params = jax.device_put(params, param_shardings)
-            opt_shardings = opt_state_shardings(tx, params,
-                                                param_shardings,
-                                                self._repl)
-            self.train_state["params"] = params
-            self.train_state["opt_state"] = jax.jit(
-                tx.init, out_shardings=opt_shardings)(params)
+            # zero1 shards over the full data-replica set — (dcn, dp) on
+            # hybrid meshes, matching the batch axes
+            zero_axes = (self._batch_sharding_early.spec[0]
+                         if self._batch_sharding_early.spec else DATA_AXIS)
+            opt_shardings = opt_state_shardings(
+                tx, params, param_shardings, self._repl,
+                zero1_mesh=self.mesh if zero1 else None,
+                zero1_axis=zero_axes or DATA_AXIS)
+            # init the optimizer state DIRECTLY into its sharded layout —
+            # never materialize the full replicated moments (the zero1
+            # startup-peak would defeat the steady-state memory win)
+            self.train_state = {
+                "params": params,
+                "opt_state": jax.jit(
+                    tx.init, out_shardings=opt_shardings)(params),
+                "step": jnp.zeros((), jnp.int32),
+                "extra": extra_state if extra_state is not None else {},
+            }
             self._state_shardings = jax.tree_util.tree_map(
                 lambda _: self._repl, self.train_state)
             self._state_shardings["params"] = param_shardings
