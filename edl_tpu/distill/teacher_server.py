@@ -113,8 +113,11 @@ def nop_teacher(fetch_specs, max_batch=128, host="0.0.0.0", port=0,
 
 
 def resnet_teacher(depth=50, num_classes=1000, image_size=224,
-                   max_batch=64, host="0.0.0.0", port=0, feed_bf16=True):
-    """A real TPU teacher: ResNet(depth) logits + softmax.
+                   max_batch=64, host="0.0.0.0", port=0, feed_bf16=True,
+                   groups=1, base_width=64, vd=True):
+    """A real TPU teacher: ResNet/ResNeXt(depth) logits + softmax
+    (groups=32, base_width=16, vd=False = the reference's distill
+    teacher ResNeXt101_32x16d_wsl architecture — BASELINE.md).
 
     feed_bf16 halves the host→device feed bytes (the dominant serving cost
     on transfer-bound links) at negligible accuracy cost for soft labels.
@@ -125,7 +128,8 @@ def resnet_teacher(depth=50, num_classes=1000, image_size=224,
 
     from edl_tpu.models import resnet
 
-    model = resnet.ResNet(depth=depth, num_classes=num_classes, vd=True,
+    model = resnet.ResNet(depth=depth, num_classes=num_classes, vd=vd,
+                          groups=groups, base_width=base_width,
                           dtype=jnp.bfloat16)
     dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
@@ -194,9 +198,10 @@ def gpt_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
 def main():
     p = argparse.ArgumentParser("edl_tpu teacher server")
     p.add_argument("--model", default="nop",
-                   choices=["nop", "resnet", "gpt"])
+                   choices=["nop", "resnet", "resnext", "gpt"])
     p.add_argument("--port", type=int, default=0)
-    p.add_argument("--depth", type=int, default=50)
+    p.add_argument("--depth", type=int, default=None,
+                   help="resnet depth (default 50; resnext default 101)")
     p.add_argument("--num_classes", type=int, default=1000)
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--max_batch", type=int, default=64)
@@ -204,9 +209,15 @@ def main():
     p.add_argument("--seq_len", type=int, default=32)
     args = p.parse_args()
     if args.model == "resnet":
-        server = resnet_teacher(args.depth, args.num_classes,
+        server = resnet_teacher(args.depth or 50, args.num_classes,
                                 args.image_size, args.max_batch,
                                 port=args.port)
+    elif args.model == "resnext":
+        # the reference's distill teacher config: ResNeXt101_32x16d
+        server = resnet_teacher(args.depth or 101, args.num_classes,
+                                args.image_size, args.max_batch,
+                                port=args.port, groups=32, base_width=16,
+                                vd=False)
     elif args.model == "gpt":
         server = gpt_teacher(vocab_size=args.vocab_size,
                              seq_len=args.seq_len,

@@ -98,16 +98,22 @@ class BottleneckBlock(nn.Module):
     vd: bool
     dtype: Any = jnp.bfloat16
     bn_stats_every: int = 1
+    # ResNeXt: cardinality (grouped 3x3) and per-group base width; the
+    # inner width is filters * base_width/64 * groups (groups=1,
+    # base_width=64 = plain ResNet)
+    groups: int = 1
+    base_width: int = 64
 
     @nn.compact
     def __call__(self, x, train):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
         norm = _make_norm(train, self.dtype, self.bn_stats_every)
+        width = int(self.filters * self.base_width / 64.0) * self.groups
         residual = x
-        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = conv(width, (1, 1), name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
-        y = conv(self.filters, (3, 3), strides=(self.stride, self.stride),
-                 name="conv2")(y)
+        y = conv(width, (3, 3), strides=(self.stride, self.stride),
+                 feature_group_count=self.groups, name="conv2")(y)
         y = nn.relu(norm(name="bn2")(y))
         y = conv(self.filters * 4, (1, 1), name="conv3")(y)
         y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
@@ -171,6 +177,10 @@ class ResNet(nn.Module):
     # 4 at batch 128/chip reproduces the reference's per-GPU stats batch
     # of 32 — see edl_tpu/ops/batch_norm.py)
     bn_stats_every: int = 1
+    # ResNeXt cardinality/width (bottleneck depths only); the reference's
+    # distill teacher config names ResNeXt101_32x16d_wsl (BASELINE.md)
+    groups: int = 1
+    base_width: int = 64
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -198,13 +208,19 @@ class ResNet(nn.Module):
         if self.remat:
             # train is a static python bool → static_argnums (0 = self)
             block_cls = nn.remat(block_cls, static_argnums=(2,))
+        block_kw = ({"groups": self.groups, "base_width": self.base_width}
+                    if bottleneck else {})
+        if not bottleneck and (self.groups != 1 or self.base_width != 64):
+            raise ValueError("grouped (ResNeXt) blocks need a bottleneck "
+                             "depth (>= 50), got depth=%d" % self.depth)
         for stage, (filters, n_blocks) in enumerate(
                 zip(self.stage_filters, blocks_per_stage)):
             for i in range(n_blocks):
                 stride = 2 if stage > 0 and i == 0 else 1
                 x = block_cls(filters, stride, self.vd, self.dtype,
                               self.bn_stats_every,
-                              name="stage%d_block%d" % (stage, i))(x, train)
+                              name="stage%d_block%d" % (stage, i),
+                              **block_kw)(x, train)
 
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
@@ -216,15 +232,31 @@ def ResNet50_vd(**kw):
     return ResNet(depth=50, vd=True, **kw)
 
 
+def ResNeXt(depth=101, groups=32, base_width=16, **kw):
+    """ResNeXt-{depth} {groups}x{base_width}d (e.g. the reference's
+    distill teacher ResNeXt101_32x16d_wsl — BASELINE.md; 'wsl' names the
+    weakly-supervised pretraining of the public weights, not an
+    architecture difference). Vanilla (non-vd) stem by default, matching
+    the canonical ResNeXt."""
+    kw.setdefault("vd", False)
+    return ResNet(depth=depth, groups=groups, base_width=base_width, **kw)
+
+
+def ResNeXt101_32x16d(**kw):
+    return ResNeXt(depth=101, groups=32, base_width=16, **kw)
+
+
 def create_model_and_loss(depth=50, num_classes=1000, vd=True,
                           image_size=224, label_smoothing=0.1,
                           dtype=jnp.bfloat16, remat=False,
-                          space_to_depth=False, bn_stats_every=1):
+                          space_to_depth=False, bn_stats_every=1,
+                          groups=1, base_width=64):
     """Build (model, params, batch_stats, loss_fn) wired for ElasticTrainer
     with has_aux=True — aux carries the BatchNorm running stats."""
     model = ResNet(depth=depth, num_classes=num_classes, vd=vd, dtype=dtype,
                    remat=remat, space_to_depth=space_to_depth,
-                   bn_stats_every=bn_stats_every)
+                   bn_stats_every=bn_stats_every, groups=groups,
+                   base_width=base_width)
     dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
     variables = model.init(jax.random.PRNGKey(0), dummy, train=False)
     params = variables["params"]

@@ -339,3 +339,25 @@ def test_distill_reader_sample_generator_batching():
     finally:
         dr.stop()
         teacher.stop()
+
+
+def test_resnext_teacher_serves_soft_labels():
+    """The ResNeXt teacher config (the reference's distill teacher family,
+    BASELINE.md): grouped-conv model behind the teacher RPC, soft labels
+    sum to 1."""
+    from edl_tpu.distill.distill_reader import _TeacherConn
+    from edl_tpu.distill.teacher_server import resnet_teacher
+
+    server = resnet_teacher(depth=50, num_classes=16, image_size=32,
+                            max_batch=4, host="127.0.0.1", groups=4,
+                            base_width=16, vd=False).start()
+    try:
+        conn = _TeacherConn(server.endpoint)
+        out = conn.predict(
+            {"image": np.zeros((2, 32, 32, 3), np.float32)})
+        assert out["logits"].shape == (2, 16)
+        np.testing.assert_allclose(out["probs"].sum(-1), np.ones(2),
+                                   rtol=1e-3)
+        conn.close()
+    finally:
+        server.stop()

@@ -127,3 +127,49 @@ def test_resnet_trains_with_bn_aux(tmp_path):
                                                 np.asarray(b), rtol=1e-5),
         stats_after, restored)
     del chex_like
+
+
+def test_resnext_grouped_bottleneck():
+    """ResNeXt: grouped 3x3 with base_width-scaled inner channels; the
+    32x16d config widens conv1/conv2 to 512 channels per stage-0 block
+    while the grouped conv keeps params at width^2*9/groups."""
+    import jax
+
+    from edl_tpu.models import resnet
+
+    model = resnet.ResNeXt(depth=50, groups=4, base_width=16,
+                           num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    # inner width: 64 * 16/64 * 4 = 64 for stage-0 (filters=64)
+    k = variables["params"]["stage0_block0"]["conv2"]["kernel"]
+    # grouped conv kernel: [3, 3, width/groups, width]
+    assert k.shape == (3, 3, 64 // 4, 64)
+    # vanilla (non-vd) stem by default
+    assert "stem" in variables["params"]
+    # trains one step
+    _, params, extra, loss_fn = resnet.create_model_and_loss(
+        depth=50, num_classes=10, vd=False, image_size=32,
+        dtype=jnp.float32, groups=4, base_width=16)
+    import optax
+
+    from edl_tpu.runtime.trainer import make_train_state, make_train_step
+    tx = optax.sgd(0.01)
+    state = make_train_state(params, tx, extra)
+    step = jax.jit(make_train_step(loss_fn, tx, has_aux=True))
+    batch = {"image": np.zeros((4, 32, 32, 3), np.float32),
+             "label": np.zeros((4,), np.int32)}
+    state, loss = step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(loss))
+
+
+def test_resnext_rejects_basicblock_groups():
+    from edl_tpu.models import resnet
+
+    model = resnet.ResNet(depth=18, groups=2, num_classes=10,
+                          dtype=jnp.float32)
+    with pytest.raises(ValueError, match="bottleneck"):
+        model.init(jax.random.PRNGKey(0),
+                   jnp.zeros((1, 32, 32, 3)), train=False)
