@@ -208,6 +208,25 @@ def make_accum_step(loss_fn, tx, accum_steps, has_aux=False,
     return step
 
 
+def auto_grad_accum(per_device_batch, max_per_device_batch):
+    """Smallest microbatch count k (dividing ``per_device_batch``) whose
+    per-device microbatch fits ``max_per_device_batch``.
+
+    The elastic memory policy: state the per-device activation budget
+    once; each stop-resume restart computes the accumulation that keeps
+    total_batch_size (and so convergence) constant at the new world
+    size. k = per_device_batch is always feasible (microbatch 1)."""
+    if max_per_device_batch <= 0:
+        raise ValueError("max_per_device_batch must be positive")
+    if per_device_batch < 1:
+        raise ValueError("per_device_batch must be >= 1")
+    for k in range(1, per_device_batch + 1):
+        if per_device_batch % k == 0 \
+                and per_device_batch // k <= max_per_device_batch:
+            return k
+    raise AssertionError("unreachable: k == per_device_batch always fits")
+
+
 def enable_compilation_cache():
     """Persistent XLA compilation cache, keyed by program (incl. mesh
     shape). Cuts stop-resume resize recovery to O(restart) when the new
@@ -269,13 +288,18 @@ class ElasticTrainer(object):
         XLA turns the grad all-reduce + update into reduce-scatter +
         sharded update + param all-gather. 1/dp the optimizer memory at
         unchanged wire bytes.
+      max_per_device_batch: declarative alternative to grad_accum — a
+        per-device batch budget; each restart picks the smallest
+        accumulation that fits it at the current world size
+        (auto_grad_accum).
     """
 
     def __init__(self, loss_fn, params, tx, total_batch_size,
                  checkpoint_dir=None, mesh=None, env=None, coord=None,
                  keep_checkpoints=3, extra_state=None, has_aux=False,
                  async_save=False, remat_policy=None,
-                 param_shardings=None, grad_accum=1, zero1=False):
+                 param_shardings=None, grad_accum=1, zero1=False,
+                 max_per_device_batch=None):
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -319,6 +343,15 @@ class ElasticTrainer(object):
         # (see make_accum_step — the past-the-memory-ceiling elastic lever)
         if grad_accum < 1:
             raise ValueError("grad_accum must be >= 1")
+        if max_per_device_batch is not None:
+            if grad_accum != 1:
+                raise ValueError(
+                    "pass either grad_accum or max_per_device_batch, not "
+                    "both — the budget exists to CHOOSE the accumulation")
+            # the declarative form: a per-device batch budget instead of
+            # an explicit k — recomputed per world size on every restart
+            grad_accum = auto_grad_accum(self.per_device_batch,
+                                         max_per_device_batch)
         if grad_accum > 1:
             if self.per_host_batch % grad_accum != 0:
                 raise ValueError(

@@ -48,6 +48,9 @@ def main(argv=None):
     p.add_argument("--zero1", action="store_true",
                    help="ZeRO-1 weight-update sharding: optimizer "
                         "moments sharded over dp")
+    p.add_argument("--max_per_device_batch", type=int, default=None,
+                   help="per-device batch budget; grad accumulation is "
+                        "chosen per world size to fit it")
     p.add_argument("--fetch_steps", type=int, default=10)
     p.add_argument("--eval_steps", type=int, default=0,
                    help="eval batches per epoch on rank 0 (0 = off)")
@@ -82,7 +85,8 @@ def main(argv=None):
     trainer = ElasticTrainer(
         loss_fn, params, optax.sgd(schedule, momentum=0.9),
         total_batch_size=args.total_batch_size, extra_state=extra,
-        has_aux=True, grad_accum=args.grad_accum, zero1=args.zero1)
+        has_aux=True, grad_accum=args.grad_accum, zero1=args.zero1,
+        max_per_device_batch=args.max_per_device_batch)
     env = trainer.env
     resumed = trainer.resume()
     start_epoch = trainer.state.next_epoch() if resumed else 0

@@ -537,3 +537,40 @@ def test_zero1_on_hybrid_mesh_uses_full_replica_set(tmp_path):
     l0 = float(tr.train_step(batch, rng=jax.random.PRNGKey(0)))
     l1 = float(tr.train_step(batch, rng=jax.random.PRNGKey(0)))
     assert np.isfinite([l0, l1]).all() and l1 < l0
+
+
+def test_auto_grad_accum_policy():
+    """max_per_device_batch picks the smallest dividing accumulation that
+    fits the budget — the per-world-size elastic memory policy."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import auto_grad_accum
+
+    assert auto_grad_accum(8, 8) == 1
+    assert auto_grad_accum(8, 4) == 2
+    assert auto_grad_accum(8, 3) == 4   # 8/2=4 > 3; next divisor 4 -> 2
+    assert auto_grad_accum(8, 1) == 8
+    assert auto_grad_accum(6, 4) == 2   # divisors only: 6/2=3 fits
+    with pytest.raises(ValueError):
+        auto_grad_accum(8, 0)
+
+    # through the trainer: 8 devices, total 64 -> per-device 8; budget 2
+    # -> grad_accum 4 (observable via the microbatch-major reshape)
+    tr = ElasticTrainer(linear.loss_fn, linear.init_params(4),
+                        optax.sgd(0.05), total_batch_size=64,
+                        checkpoint_dir="", max_per_device_batch=2)
+    assert tr._grad_accum == 4
+    rs = np.random.RandomState(5)
+    batch = {"x": rs.randn(64, 4).astype(np.float32),
+             "y": rs.randn(64).astype(np.float32)}
+    loss = float(tr.train_step(batch))
+    assert np.isfinite(loss)
+
+
+def test_auto_grad_accum_rejects_explicit_conflict():
+    from edl_tpu.models import linear
+
+    with pytest.raises(ValueError, match="not\\s+both"):
+        ElasticTrainer(linear.loss_fn, linear.init_params(4),
+                       optax.sgd(0.05), total_batch_size=64,
+                       checkpoint_dir="", grad_accum=2,
+                       max_per_device_batch=2)
