@@ -574,3 +574,33 @@ def test_auto_grad_accum_rejects_explicit_conflict():
                        optax.sgd(0.05), total_batch_size=64,
                        checkpoint_dir="", grad_accum=2,
                        max_per_device_batch=2)
+
+
+def test_resize_invariant_training_under_budget(tmp_path):
+    """The elastic headline: with a fixed total_batch_size and a
+    per-device budget, training at world 8 (accum 1) and at world 2
+    (accum 4 chosen automatically) produces the same parameters — a
+    resize changes THROUGHPUT, never convergence."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime import mesh as mesh_mod
+
+    rs = np.random.RandomState(7)
+    batch = {"x": rs.randn(32, 4).astype(np.float32),
+             "y": rs.randn(32).astype(np.float32)}
+
+    finals = []
+    for n_dev in (8, 2):
+        mesh = mesh_mod.make_mesh(dp=n_dev,
+                                  devices=jax.devices()[:n_dev])
+        tr = ElasticTrainer(linear.loss_fn, linear.init_params(4),
+                            optax.sgd(0.05), total_batch_size=32,
+                            checkpoint_dir="", mesh=mesh,
+                            max_per_device_batch=4)
+        # world 8: per-device 4 -> accum 1; world 2: per-device 16 -> 4
+        assert tr._grad_accum == (1 if n_dev == 8 else 4)
+        for i in range(3):
+            tr.train_step(batch, rng=jax.random.PRNGKey(i))
+        finals.append(jax.tree_util.tree_leaves(
+            jax.device_get(tr.train_state["params"])))
+    for a, b in zip(*finals):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
