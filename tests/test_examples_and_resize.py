@@ -338,3 +338,22 @@ def test_resize_driver_schedule(store, tmp_path):
         assert status.load_job_status(coord) != Status.FAILED
     finally:
         driver.shutdown(kill=True)
+
+
+@pytest.mark.integration
+def test_gpt_distill_example_with_lm_teacher():
+    """Sequence-level KD end-to-end: gpt teacher backend -> DistillReader
+    -> student GPT trained on per-position soft targets."""
+    from edl_tpu.distill.teacher_server import gpt_teacher
+
+    teacher = gpt_teacher(vocab_size=64, seq_len=16, max_batch=8,
+                          host="127.0.0.1").start()
+    try:
+        out = _run_example("examples/distill/gpt_distill.py", [
+            "--epochs", "1", "--steps_per_epoch", "4",
+            "--total_batch_size", "8", "--seq_len", "16",
+            "--vocab_size", "64", "--teachers", teacher.endpoint])
+        assert out["steps"] == 4
+        assert np.isfinite(out["final_loss"])
+    finally:
+        teacher.stop()
