@@ -390,12 +390,36 @@ def init_cache(model, params, batch_size):
         lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
 
 
+def _filter_logits(logits, top_k=0, top_p=0.0):
+    """Mask logits outside the sampling nucleus: keep the top_k largest
+    (0 = all) and/or the smallest prefix of the sorted distribution whose
+    probability mass reaches top_p (0 = all). Static shapes throughout
+    (sort + mask, no dynamic gather sizes) so it scans under jit."""
+    if top_k and top_k > 0:
+        k = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep ranks whose PRECEDING mass is < top_p (always >= 1 token)
+        keep = jnp.concatenate(
+            [jnp.zeros_like(cum[..., :1]), cum[..., :-1]], axis=-1) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return logits
+
+
 def generate(model, params, prompt_ids, max_new_tokens, rng=None,
-             temperature=0.0):
+             temperature=0.0, top_k=0, top_p=0.0):
     """Autoregressive sampling with the KV cache: ONE batched prefill
     forward fills the cache over the whole prompt (no per-token prefix
     re-feeding), then a lax.scan decodes ``max_new_tokens`` (greedy at
-    temperature 0). Returns [b, prompt+new] ids."""
+    temperature 0; temperature > 0 samples, optionally truncated to the
+    ``top_k`` largest logits and/or the ``top_p`` nucleus). Returns
+    [b, prompt+new] ids."""
     b, prompt_len = prompt_ids.shape
     total = prompt_len + max_new_tokens
     if total > model.max_len:
@@ -408,9 +432,12 @@ def generate(model, params, prompt_ids, max_new_tokens, rng=None,
 
     def sample(logits, feed_pos):
         if temperature > 0:
+            # temperature FIRST, then the nucleus: top_p must be a mass
+            # of the actual sampling distribution (the HF processor order)
+            scaled = _filter_logits(logits / temperature, top_k=top_k,
+                                    top_p=top_p)
             nxt = jax.random.categorical(
-                jax.random.fold_in(rng, feed_pos),
-                logits / temperature, axis=-1)
+                jax.random.fold_in(rng, feed_pos), scaled, axis=-1)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         return nxt.astype(jnp.int32)

@@ -34,6 +34,12 @@ def main(argv=None):
     p.add_argument("--steps", type=int, default=150)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--gen_tokens", type=int, default=8)
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="0 = greedy; >0 samples")
+    p.add_argument("--top_k", type=int, default=0,
+                   help="sample from the k largest logits (0 = all)")
+    p.add_argument("--top_p", type=float, default=0.0,
+                   help="nucleus sampling mass (0 = off)")
     args = p.parse_args(argv)
 
     model, params, loss_fn = gpt.create_model_and_loss(
@@ -64,7 +70,9 @@ def main(argv=None):
     seq = (5 + 3 * np.arange(6 + args.gen_tokens)) % args.vocab_size
     prompt = jnp.asarray(seq[None, :6].astype(np.int32))
     out = gpt.generate(model, state["params"], prompt,
-                       max_new_tokens=args.gen_tokens)
+                       max_new_tokens=args.gen_tokens,
+                       temperature=args.temperature, top_k=args.top_k,
+                       top_p=args.top_p)
     got = np.asarray(out)[0, 6:]
     gen_acc = float((got == seq[6:]).mean())
     print(json.dumps({

@@ -222,3 +222,43 @@ def test_gpt_trains_under_elastic_trainer(tmp_path):
                                        seed=i % 2)
         losses.append(float(trainer.train_step(batch)))
     assert losses[-1] < losses[0]
+
+
+def test_filter_logits_top_k_and_top_p():
+    from edl_tpu.models.gpt import _filter_logits
+
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.10]]))
+    # top_k=2: only the two largest survive
+    f = _filter_logits(logits, top_k=2)
+    assert np.isfinite(np.asarray(f[0, :2])).all()
+    assert np.isinf(np.asarray(f[0, 2:])).all()
+    # top_p=0.6: 0.5 alone has preceding mass 0 < 0.6; adding 0.25 has
+    # preceding mass 0.5 < 0.6 -> kept; 0.15 preceded by 0.75 -> cut
+    f = _filter_logits(logits, top_p=0.6)
+    assert np.isfinite(np.asarray(f[0, :2])).all()
+    assert np.isinf(np.asarray(f[0, 2:])).all()
+    # top_p tiny: always keeps at least the argmax
+    f = _filter_logits(logits, top_p=1e-6)
+    assert np.isfinite(float(f[0, 0]))
+    assert np.isinf(np.asarray(f[0, 1:])).all()
+    # unsorted input: mask follows VALUES, not positions
+    shuffled = logits[:, ::-1]
+    f = _filter_logits(shuffled, top_k=1)
+    assert np.isfinite(float(f[0, -1])) and np.isinf(f[0, 0])
+
+
+def test_generate_topk_sampling_stays_in_pattern():
+    """top_k=1 sampling at temperature>0 must equal greedy decoding."""
+    import jax
+
+    from edl_tpu.models import gpt
+
+    model = gpt.gpt_tiny(vocab_size=32, max_len=32)
+    ids = jnp.asarray(gpt.synthetic_lm_batch(2, seq_len=8,
+                                             vocab_size=32)["input_ids"])
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    greedy = gpt.generate(model, params, ids, max_new_tokens=6)
+    top1 = gpt.generate(model, params, ids, max_new_tokens=6,
+                        temperature=0.7, top_k=1,
+                        rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(top1))
