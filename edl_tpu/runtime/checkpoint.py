@@ -6,10 +6,21 @@ train_with_fleet.py:426-434,562-570). TPU twist: the commit protocol is
 manifest-last (a version directory is valid iff its MANIFEST file exists and
 checksums match), which also works on stores without atomic rename (GCS).
 
-Layout:
+Layout (dense, the default):
     <dir>/v_00000012/arrays.npz   flat {path: ndarray} of the pytree leaves
     <dir>/v_00000012/meta.json    user metadata + dtype tags (bfloat16)
     <dir>/v_00000012/MANIFEST     written last: {"version", "crc"}
+
+Layout (sharded — save_sharded/restore with a target):
+    <dir>/v_00000012/arrays.r<k>.npz     rank k's owned array shards,
+                                         keys "path@s0:e0;s1:e1;..."
+    <dir>/v_00000012/shardmeta.r<k>.json rank k's crc + dtype tags
+    <dir>/v_00000012/meta.json, MANIFEST rank 0, AFTER the barrier
+
+Sharded mode is the scalable path: every host writes only its
+addressable shards (no rank-0 gather, write bandwidth scales with host
+count — the Orbax role); the commit stays manifest-last, with the
+manifest recording every rank file's crc.
 """
 
 import io
@@ -133,6 +144,145 @@ class CheckpointManager(object):
         for v in versions[:-self._keep] if self._keep else []:
             self._fs.delete_tree(self._vdir(v))
 
+    # -- sharded save --------------------------------------------------------
+
+    @staticmethod
+    def _owned_shards(leaf):
+        """(index, ndarray) pairs this process must write: one entry per
+        distinct shard (replica_id 0 de-duplicates replicas), or the
+        whole array for host values / fully-replicated leaves on rank 0
+        handled by the caller."""
+        out = []
+        for s in leaf.addressable_shards:
+            if s.replica_id == 0:
+                out.append((s.index, np.asarray(s.data)))
+        return out
+
+    @staticmethod
+    def _shard_key(key, index, shape):
+        spans = []
+        for sl, dim in zip(index, shape):
+            start = 0 if sl.start is None else int(sl.start)
+            stop = dim if sl.stop is None else int(sl.stop)
+            spans.append("%d:%d" % (start, stop))
+        return "%s@%s" % (key, ";".join(spans))
+
+    def save_sharded(self, version, tree, meta=None, rank=0, nranks=1,
+                     barrier=None):
+        """Cooperative sharded save: EVERY rank calls this with the same
+        ``version``/``tree``; each writes only the shards it owns, then
+        ``barrier()`` (required when nranks > 1), then rank 0 commits the
+        MANIFEST recording all rank files + crcs. Returns the version dir
+        (all ranks)."""
+        vdir = self._vdir(version)
+        if rank == 0:
+            self._fs.delete_tree(vdir)
+            self._fs.makedirs(vdir)
+        if barrier is not None:
+            barrier()  # rank0's directory reset must precede any write
+        elif nranks > 1:
+            raise ValueError("sharded save with nranks > 1 needs a barrier")
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        dtypes = {}
+        to_save = {}
+        for path, leaf in flat:
+            key = _path_key(path)
+            if hasattr(leaf, "addressable_shards") \
+                    and hasattr(leaf, "sharding"):
+                shards = self._owned_shards(leaf)
+                # fully-replicated leaves land on every process with
+                # replica_id spread; only write replica 0's copy
+                for index, arr in shards:
+                    to_save[self._shard_key(key, index, leaf.shape)] = arr
+                    if _BFLOAT16 is not None and arr.dtype == _BFLOAT16:
+                        dtypes[key] = "bfloat16"
+            elif rank == 0:
+                arr = np.asarray(leaf)
+                index = tuple(slice(0, d) for d in arr.shape)
+                to_save[self._shard_key(key, index, arr.shape)] = arr
+                if _BFLOAT16 is not None and arr.dtype == _BFLOAT16:
+                    dtypes[key] = "bfloat16"
+        packed = {}
+        for k, arr in to_save.items():
+            if _BFLOAT16 is not None and arr.dtype == _BFLOAT16:
+                arr = arr.view(np.uint16)
+            packed[k] = arr
+        buf = io.BytesIO()
+        np.savez(buf, **packed)
+        payload = buf.getvalue()
+        with self._fs.open("%s/arrays.r%d.npz" % (vdir, rank), "wb") as f:
+            f.write(payload)
+        with self._fs.open("%s/shardmeta.r%d.json" % (vdir, rank),
+                           "w") as f:
+            json.dump({"crc": zlib.crc32(payload), "dtypes": dtypes,
+                       "nbytes": len(payload)}, f)
+
+        if barrier is not None:
+            barrier()  # every rank's file must exist before the commit
+        if rank == 0:
+            crcs = {}
+            dtypes_all = {}
+            for r in range(nranks):
+                with self._fs.open("%s/shardmeta.r%d.json" % (vdir, r),
+                                   "r") as f:
+                    sm = json.load(f)
+                crcs[str(r)] = sm["crc"]
+                dtypes_all.update(sm["dtypes"])
+            with self._fs.open(vdir + "/meta.json", "w") as f:
+                json.dump({"meta": meta or {}, "dtypes": dtypes_all}, f)
+            with self._fs.open(vdir + "/MANIFEST", "w") as f:
+                json.dump({"version": version, "sharded": True,
+                           "ranks": nranks, "crcs": crcs}, f)
+            logger.info("sharded checkpoint v%d committed (%d ranks)",
+                        version, nranks)
+            self._gc()
+        return vdir
+
+    def _restore_sharded(self, vdir, manifest, meta_blob, target):
+        if target is None:
+            raise IOError("sharded checkpoint restore needs a target "
+                          "structure (shapes/dtypes)")
+        flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+        specs = {}
+        for path, leaf in flat:
+            specs[_path_key(path)] = (tuple(leaf.shape),
+                                      np.dtype(leaf.dtype))
+        buffers = {}
+        filled = {k: 0 for k in specs}
+        for r in range(int(manifest["ranks"])):
+            with self._fs.open("%s/arrays.r%d.npz" % (vdir, r),
+                               "rb") as f:
+                payload = f.read()
+            if zlib.crc32(payload) != manifest["crcs"][str(r)]:
+                raise IOError("checksum mismatch in %s rank %d"
+                              % (vdir, r))
+            npz = np.load(io.BytesIO(payload))
+            for skey in npz.files:
+                key, _, spans = skey.rpartition("@")
+                if key not in specs:
+                    continue
+                shape, dtype = specs[key]
+                arr = npz[skey]
+                if meta_blob["dtypes"].get(key) == "bfloat16":
+                    if _BFLOAT16 is None:  # pragma: no cover
+                        raise IOError("bfloat16 checkpoint needs ml_dtypes")
+                    arr = arr.view(_BFLOAT16)
+                if key not in buffers:
+                    buffers[key] = np.zeros(shape, dtype)
+                idx = tuple(slice(*map(int, sp.split(":")))
+                            for sp in spans.split(";") if sp)
+                buffers[key][idx] = arr
+                filled[key] += arr.size
+        missing = {k for k in specs if filled[k] < int(np.prod(
+            specs[k][0], dtype=np.int64))}
+        # scalars: prod(())==1, filled must be >= 1
+        if missing:
+            raise MissingKeysError(missing)
+        keys = [_path_key(p) for p, _ in flat]
+        return jax.tree_util.tree_unflatten(treedef,
+                                            [buffers[k] for k in keys])
+
     # -- restore -------------------------------------------------------------
 
     def restore_latest(self, target=None):
@@ -155,6 +305,11 @@ class CheckpointManager(object):
         vdir = self._vdir(version)
         with self._fs.open(vdir + "/MANIFEST", "r") as f:
             manifest = json.load(f)
+        if manifest.get("sharded"):
+            with self._fs.open(vdir + "/meta.json", "r") as f:
+                meta_blob = json.load(f)
+            tree = self._restore_sharded(vdir, manifest, meta_blob, target)
+            return version, tree, meta_blob["meta"]
         with self._fs.open(vdir + "/arrays.npz", "rb") as f:
             payload = f.read()
         if zlib.crc32(payload) != manifest["crc"]:
