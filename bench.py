@@ -298,7 +298,8 @@ def main():
     attempts = [(requested, "requested")]
     r1_cfg = ["--no-s2d", "--iters", str(args.iters)]
     if args.s2d or args.batch_per_chip != 128 or args.feed != "device" \
-            or args.steps_per_call != 1 or args.bn_stats_every != 1:
+            or args.steps_per_call != 1 or args.bn_stats_every != 1 \
+            or args.image_size != 224:
         attempts.append((r1_cfg, "r1cfg"))
     for argv, tag in attempts:
         budget = min(ATTEMPT_TIMEOUT_S, remaining() - reserve)

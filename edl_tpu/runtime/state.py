@@ -46,6 +46,7 @@ class EpochAttr(Serializable):
         self.world_size = 0
         self.step_num = 0
         self.avg_step_time = 0.0
+        self.ended = False
 
 
 class State(Serializable):
@@ -73,9 +74,19 @@ class State(Serializable):
         attr = self.epochs.get(str(self.epoch_no), {})
         attr["step_num"] = step_num
         attr["avg_step_time"] = avg_step_time
+        attr["ended"] = True
         self.epochs[str(self.epoch_no)] = attr
 
     def next_epoch(self):
+        """The epoch a restart should run: the interrupted epoch itself
+        when the newest checkpoint was written mid-epoch (emergency
+        preemption save — its remaining data must not be skipped), else
+        the one after the last completed epoch. Older checkpoints lack
+        the ``ended`` flag but were only ever written at epoch end, so
+        the compat default is True."""
+        attr = self.epochs.get(str(self.epoch_no))
+        if attr is not None and not attr.get("ended", True):
+            return self.epoch_no
         return self.epoch_no + 1
 
     # -- resize hooks --------------------------------------------------------

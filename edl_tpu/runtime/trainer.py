@@ -449,6 +449,7 @@ class ElasticTrainer(object):
         self._host_step = 0
         self._async_save = async_save
         self._save_thread = None
+        self._preempted = False
 
     # -- the compiled step ---------------------------------------------------
 
@@ -498,7 +499,77 @@ class ElasticTrainer(object):
         self.train_state, loss = self._jit_step(self.train_state, batch, rng)
         self._host_step += 1
         self._step_times.append(time.perf_counter() - t0)
+        if self._preempted:
+            self._emergency_save()
         return loss
+
+    # -- preemption (grace-window emergency checkpoint) ----------------------
+
+    def install_preemption_handler(self, signals=None):
+        """Arm the grace-window emergency checkpoint.
+
+        The launcher's kill path is process-tree SIGTERM, then SIGKILL
+        after a grace period (train_process.terminate_trainers; k8s pod
+        deletion behaves the same). The handler only sets a flag —
+        async-signal-safe, and a save cannot run mid-XLA-dispatch — and
+        the next step/epoch boundary writes a checkpoint at the CURRENT
+        step, then raises PreemptedError. The restart then resumes from
+        that step (re-running the interrupted epoch's remaining data —
+        State.next_epoch) instead of replaying from the last epoch-end
+        save. Returns self so it chains after construction.
+
+        Multi-host: with cross-host SHARDED state (tp/sp over hosts) the
+        save gather is a collective, and nothing guarantees every rank
+        observes its SIGTERM at the same step boundary — a rank entering
+        the gather while another is inside the next jit step would
+        deadlock the grace window. That case skips the save (the restart
+        falls back to the last epoch-end checkpoint); replicated or
+        single-host state saves normally.
+        """
+        import signal as signal_mod
+        if signals is None:
+            signals = (signal_mod.SIGTERM,)
+        for s in signals:
+            signal_mod.signal(s, self._on_preempt_signal)
+        return self
+
+    def _on_preempt_signal(self, signum, frame):
+        self._preempted = True
+
+    @property
+    def preempted(self):
+        return self._preempted
+
+    def _emergency_save(self, already_saved=False):
+        from edl_tpu.utils.errors import PreemptedError
+
+        if self._ckpt is None:
+            raise PreemptedError(
+                "preempted at step %d; no checkpoint dir configured — "
+                "nothing saved, restart begins fresh" % self._host_step)
+        if jax.process_count() > 1 \
+                and not self._state_fully_addressable():
+            # the save gather is a collective; ranks may sit at different
+            # step boundaries when their signals landed -> deadlock risk.
+            # Fall back to the last epoch-end checkpoint instead.
+            logger.warning("preempted with cross-host sharded state; "
+                           "skipping the emergency save (collective "
+                           "alignment not guaranteed)")
+            raise PreemptedError(
+                "preempted at step %d; emergency save skipped (cross-"
+                "host sharded state) — restart resumes from the last "
+                "epoch checkpoint" % self._host_step)
+        if not already_saved:
+            logger.info("preemption signal: emergency checkpoint at "
+                        "step %d", self._host_step)
+            self.wait_for_save()
+            was_async, self._async_save = self._async_save, False
+            try:
+                self.save()
+            finally:
+                self._async_save = was_async
+        raise PreemptedError(
+            "preempted; checkpoint saved at step %d" % self._host_step)
 
     @property
     def global_step(self):
@@ -511,6 +582,10 @@ class ElasticTrainer(object):
     # -- epochs / status -----------------------------------------------------
 
     def begin_epoch(self, epoch_no):
+        if self._preempted:
+            # SIGTERM landed between epochs (eval, data setup): save at
+            # this boundary rather than silently swallowing the stop
+            self._emergency_save()
         self.state.begin_epoch(epoch_no, self.world_size)
         self._step_times = []
         self.report_status(train_status_mod.TrainStatus.RUNNING)
@@ -522,6 +597,9 @@ class ElasticTrainer(object):
         self.state.global_step = self.global_step
         if save:
             self.save()
+        if self._preempted:
+            # the epoch-end save (if any) already covers this step
+            self._emergency_save(already_saved=save)
 
     def report_status(self, status):
         if self.coord is not None and self.env.pod_id:

@@ -85,6 +85,11 @@ class TimeoutError_(EdlError):
     """Raised when handle_errors_until_timeout gives up."""
 
 
+class PreemptedError(EdlError):
+    """The trainer was preempted (SIGTERM) and saved an emergency
+    checkpoint; the process should exit so the restart resumes from it."""
+
+
 _NAME_TO_CLS = None
 
 

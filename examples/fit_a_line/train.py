@@ -34,6 +34,7 @@ def main(argv=None):
     trainer = ElasticTrainer(
         linear.loss_fn, linear.init_params(), optax.sgd(args.lr),
         total_batch_size=args.total_batch_size)
+    trainer.install_preemption_handler()
     env = trainer.env
     resumed = trainer.resume()
     start_epoch = trainer.state.next_epoch() if resumed else 0
@@ -41,23 +42,31 @@ def main(argv=None):
           % (env.global_rank, trainer.world_size, start_epoch, resumed),
           flush=True)
 
+    from edl_tpu.utils.errors import PreemptedError
+
     loss = None
-    for epoch in range(start_epoch, args.epochs):
-        if epoch == args.epochs - 1:
-            trainer.report_status(ts.TrainStatus.NEARTHEEND)
-        trainer.begin_epoch(epoch)
-        for step in range(args.steps_per_epoch):
-            seed = epoch * 10000 + step
-            full = linear.synthetic_batch(args.total_batch_size, seed=seed)
-            loss = float(trainer.train_step(
-                trainer.local_batch_slice(full)))
-            if args.step_sleep:
-                import time
-                time.sleep(args.step_sleep)
-        trainer.end_epoch(save=True)
-        print("epoch %d done: loss=%.5f step=%d" % (epoch, loss,
-                                                    trainer.global_step),
-              flush=True)
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            if epoch == args.epochs - 1:
+                trainer.report_status(ts.TrainStatus.NEARTHEEND)
+            trainer.begin_epoch(epoch)
+            for step in range(args.steps_per_epoch):
+                seed = epoch * 10000 + step
+                full = linear.synthetic_batch(args.total_batch_size,
+                                              seed=seed)
+                loss = float(trainer.train_step(
+                    trainer.local_batch_slice(full)))
+                if args.step_sleep:
+                    import time
+                    time.sleep(args.step_sleep)
+            trainer.end_epoch(save=True)
+            print("epoch %d done: loss=%.5f step=%d"
+                  % (epoch, loss, trainer.global_step), flush=True)
+    except PreemptedError as e:
+        # emergency checkpoint written at the current step; exit-101 is
+        # the restart convention (liveft) so supervisors restart us
+        print("preempted: %s" % e, flush=True)
+        return 101
 
     trainer.report_status(ts.TrainStatus.SUCCEED)
     print(json.dumps({"final_loss": loss, "steps": trainer.global_step,

@@ -88,6 +88,7 @@ def main(argv=None):
         has_aux=True, grad_accum=args.grad_accum, zero1=args.zero1,
         max_per_device_batch=args.max_per_device_batch)
     env = trainer.env
+    trainer.install_preemption_handler()
     resumed = trainer.resume()
     start_epoch = trainer.state.next_epoch() if resumed else 0
     print("resnet%d_vd: rank=%d world=%d start_epoch=%d resumed=%s"
@@ -143,36 +144,44 @@ def main(argv=None):
             num_classes=args.num_classes, seed=2**31 - 1 - i)
             for i in range(args.eval_steps))
 
+    from edl_tpu.utils.errors import PreemptedError
+
     loss = None
     accs = None
     imgs_seen = 0
     t_start = time.perf_counter()
-    for epoch in range(start_epoch, args.epochs):
-        if epoch == args.epochs - 1:
-            trainer.report_status(ts.TrainStatus.NEARTHEEND)
-        trainer.begin_epoch(epoch)
-        t_epoch = time.perf_counter()
-        for step, host_batch in enumerate(host_batches(epoch)):
-            loss = float(trainer.train_step(host_batch))
-            imgs_seen += args.total_batch_size
-            if (step + 1) % args.fetch_steps == 0:
-                dt = time.perf_counter() - t_epoch
-                print("epoch %d step %d loss %.4f  %.1f img/s"
-                      % (epoch, step + 1, loss,
-                         args.total_batch_size * (step + 1) / dt),
-                      flush=True)
-        trainer.end_epoch(save=True)
-        if evaluator is not None:
-            # rank-0 eval, reference parity: train_with_fleet.py:573-610.
-            # device_get first: the train state is sharded over the GLOBAL
-            # mesh and a single-rank jit over it would touch devices this
-            # process cannot address in multi-host runs
-            import jax as _jax
-            host_params = _jax.device_get(trainer.train_state["params"])
-            host_extra = _jax.device_get(trainer.extra_state)
-            accs = evaluator.evaluate(host_params, host_extra,
-                                      eval_batches())
-            print("epoch %d eval: %s" % (epoch, accs), flush=True)
+    try:
+        for epoch in range(start_epoch, args.epochs):
+            if epoch == args.epochs - 1:
+                trainer.report_status(ts.TrainStatus.NEARTHEEND)
+            trainer.begin_epoch(epoch)
+            t_epoch = time.perf_counter()
+            for step, host_batch in enumerate(host_batches(epoch)):
+                loss = float(trainer.train_step(host_batch))
+                imgs_seen += args.total_batch_size
+                if (step + 1) % args.fetch_steps == 0:
+                    dt = time.perf_counter() - t_epoch
+                    print("epoch %d step %d loss %.4f  %.1f img/s"
+                          % (epoch, step + 1, loss,
+                             args.total_batch_size * (step + 1) / dt),
+                          flush=True)
+            trainer.end_epoch(save=True)
+            if evaluator is not None:
+                # rank-0 eval, reference parity: train_with_fleet.py:573-610.
+                # device_get first: the train state is sharded over the GLOBAL
+                # mesh and a single-rank jit over it would touch devices this
+                # process cannot address in multi-host runs
+                import jax as _jax
+                host_params = _jax.device_get(trainer.train_state["params"])
+                host_extra = _jax.device_get(trainer.extra_state)
+                accs = evaluator.evaluate(host_params, host_extra,
+                                          eval_batches())
+                print("epoch %d eval: %s" % (epoch, accs), flush=True)
+    except PreemptedError as e:
+        # emergency checkpoint already written; exit with the restart
+        # convention code (liveft's exit-101) so supervisors restart us
+        print("preempted: %s" % e, flush=True)
+        return 101
 
     trainer.report_status(ts.TrainStatus.SUCCEED)
     wall = time.perf_counter() - t_start

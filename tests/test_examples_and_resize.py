@@ -44,6 +44,66 @@ def test_resnet_example_standalone():
 
 
 @pytest.mark.integration
+def test_fit_a_line_preemption_emergency_checkpoint(tmp_path):
+    """SIGTERM mid-epoch: the trainer writes an emergency checkpoint at
+    the current step, exits 101 (the restart convention), and a restart
+    resumes from that step — not from the last epoch boundary."""
+    import signal
+    import time
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "EDL_TPU_CHECKPOINT_PATH": str(tmp_path / "ckpt"),
+    })
+    cmd = [sys.executable, "-u",
+           os.path.join(REPO, "examples/fit_a_line/train.py"),
+           "--epochs", "2", "--steps_per_epoch", "500",
+           "--step_sleep", "0.02"]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    # wait for training to actually start (first step done), then preempt
+    deadline = time.time() + 120
+    lines = []
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line == "" and proc.poll() is not None:
+            break  # child died before starting
+        lines.append(line)
+        if line.startswith("fit_a_line:"):
+            break
+    time.sleep(2.0)  # a few 20ms steps into epoch 0
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    lines.append(out)
+    assert proc.returncode == 101, "".join(lines)
+    assert "preempted" in out, out
+
+    # the emergency checkpoint landed mid-epoch-0 (no epoch-end save
+    # exists before step 500)
+    from edl_tpu.runtime.checkpoint import CheckpointManager
+
+    versions = CheckpointManager(str(tmp_path / "ckpt")).versions()
+    assert versions, out
+    emergency_step = versions[-1]
+    assert 0 < emergency_step < 500, (versions, out)
+
+    # a restart resumes from it and completes (no sleep: fast finish)
+    cmd2 = [sys.executable, "-u",
+            os.path.join(REPO, "examples/fit_a_line/train.py"),
+            "--epochs", "2", "--steps_per_epoch", "500"]
+    proc2 = subprocess.run(cmd2, env=env, capture_output=True, text=True,
+                           timeout=240)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "resumed=True" in proc2.stdout, proc2.stdout
+    final = json.loads([l for l in proc2.stdout.splitlines()
+                        if l.startswith("{")][-1])
+    assert final["steps"] > emergency_step
+
+
+@pytest.mark.integration
 def test_bert_pipeline_example_learns():
     out = _run_example("examples/bert_pipeline/train.py", [
         "--pp", "4", "--steps", "60", "--d_model", "32",

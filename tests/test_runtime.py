@@ -151,6 +151,42 @@ def test_elastic_trainer_learns_and_resumes(tmp_path):
     assert loss2 < first * 0.05
 
 
+def test_preemption_saves_emergency_checkpoint(tmp_path):
+    """SIGTERM mid-training: the next step boundary writes a checkpoint
+    at the CURRENT step and raises PreemptedError; a restarted trainer
+    resumes from it with zero lost steps (the grace window that
+    train_process.terminate_trainers's SIGTERM->SIGKILL kill provides)."""
+    import os
+    import signal
+
+    from edl_tpu.utils.errors import PreemptedError
+
+    try:
+        trainer, make_batch, _ = _linreg_trainer(tmp_path)
+        trainer.install_preemption_handler()
+        trainer.begin_epoch(0)
+        for i in range(5):
+            trainer.train_step(make_batch(i))
+        assert not trainer.preempted
+        os.kill(os.getpid(), signal.SIGTERM)  # launcher / k8s preemption
+        with pytest.raises(PreemptedError):
+            trainer.train_step(make_batch(5))
+        assert trainer.preempted
+
+        # the emergency checkpoint carries the step that completed (6),
+        # not the last epoch-end save (there was none)
+        trainer2, make_batch2, _ = _linreg_trainer(tmp_path)
+        assert trainer2.resume()
+        assert trainer2.global_step == 6
+        # a mid-epoch save must re-run the interrupted epoch, not skip
+        # its remaining data
+        assert trainer2.state.next_epoch() == 0
+        trainer2.train_step(make_batch2(6))
+        assert trainer2.global_step == 7
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
 def test_resume_preserves_adjust_hooks_and_extra_state(tmp_path):
     trainer, make_batch, _ = _linreg_trainer(tmp_path)
     trainer.begin_epoch(0)
