@@ -12,15 +12,22 @@ Layout (dense, the default):
     <dir>/v_00000012/MANIFEST     written last: {"version", "crc"}
 
 Layout (sharded — save_sharded/restore with a target):
+    <dir>/v_00000012/STARTED             rank 0's go sentinel (dir reset
+                                         done; other ranks may write)
     <dir>/v_00000012/arrays.r<k>.npz     rank k's owned array shards,
                                          keys "path@s0:e0;s1:e1;..."
     <dir>/v_00000012/shardmeta.r<k>.json rank k's crc + dtype tags
-    <dir>/v_00000012/meta.json, MANIFEST rank 0, AFTER the barrier
+    <dir>/v_00000012/done.r<k>           rank k's publish marker, written
+                                         after its data files close
+    <dir>/v_00000012/meta.json, MANIFEST rank 0, after every rank's
+                                         done marker is visible
 
 Sharded mode is the scalable path: every host writes only its
 addressable shards (no rank-0 gather, write bandwidth scales with host
 count — the Orbax role); the commit stays manifest-last, with the
-manifest recording every rank file's crc.
+manifest recording every rank file's crc. Rank synchronization is by
+filesystem visibility on the shared store (no device collectives — the
+write may run from a background thread).
 """
 
 import io
@@ -75,6 +82,24 @@ def to_host_tree(tree):
     return jax.tree_util.tree_map(fetch, tree)
 
 
+def to_host_tree_local(tree):
+    """Fetch a device pytree to host numpy WITHOUT any collective: every
+    leaf must be host data, fully addressable, or fully replicated (a
+    complete local replica exists). This is the emergency-checkpoint
+    fetch — preempted ranks cannot rendezvous, so a gather is off the
+    table; raises ValueError on cross-host *sharded* leaves."""
+    def fetch(x):
+        if not hasattr(x, "addressable_shards"):
+            return np.asarray(x)
+        if getattr(x, "is_fully_addressable", True):
+            return jax.device_get(x)
+        if getattr(x, "is_fully_replicated", False):
+            return np.asarray(x.addressable_data(0))
+        raise ValueError("cross-host sharded leaf: no local replica to "
+                         "fetch without a collective")
+    return jax.tree_util.tree_map(fetch, tree)
+
+
 def _paths(tree):
     """Flat path keys + treedef without materializing leaves (target may
     hold ShapeDtypeStructs)."""
@@ -105,6 +130,29 @@ class CheckpointManager(object):
                 if self._fs.exists("%s/%s/MANIFEST" % (self._dir, name)):
                     out.append(v)
         return sorted(out)
+
+    def clean_uncommitted(self):
+        """Delete version dirs without a MANIFEST — garbage from crashed
+        save attempts (the manifest-last invariant makes them invisible
+        to restore, but a stale STARTED sentinel inside one could let a
+        later sharded save at the SAME version mis-order its barrier).
+        Call at process start, before any save; in multi-host jobs only
+        rank 0 should call it (concurrent deletes race)."""
+        removed = []
+        for name in self._fs.listdir(self._dir):
+            if not name.startswith("v_"):
+                continue
+            try:
+                int(name[2:])
+            except ValueError:
+                continue
+            if not self._fs.exists("%s/%s/MANIFEST" % (self._dir, name)):
+                self._fs.delete_tree("%s/%s" % (self._dir, name))
+                removed.append(name)
+        if removed:
+            logger.info("cleaned %d uncommitted checkpoint dir(s): %s",
+                        len(removed), removed)
+        return removed
 
     # -- save ---------------------------------------------------------------
 
@@ -167,21 +215,55 @@ class CheckpointManager(object):
             spans.append("%d:%d" % (start, stop))
         return "%s@%s" % (key, ";".join(spans))
 
+    def _fs_wait(self, predicate, what, timeout):
+        import time
+        deadline = time.monotonic() + timeout
+        delay = 0.02
+        while not predicate():
+            if time.monotonic() > deadline:
+                raise IOError("sharded save: timed out waiting for %s"
+                              % what)
+            time.sleep(delay)
+            delay = min(delay * 1.5, 0.5)
+
     def save_sharded(self, version, tree, meta=None, rank=0, nranks=1,
-                     barrier=None):
+                     barrier=None, timeout=120.0):
         """Cooperative sharded save: EVERY rank calls this with the same
-        ``version``/``tree``; each writes only the shards it owns, then
-        ``barrier()`` (required when nranks > 1), then rank 0 commits the
-        MANIFEST recording all rank files + crcs. Returns the version dir
-        (all ranks)."""
+        ``version``/``tree``; each writes only the shards it owns; rank 0
+        commits the MANIFEST recording all rank files + crcs. Returns the
+        version dir (all ranks).
+
+        Synchronization is by FILESYSTEM VISIBILITY on the shared store
+        (the premise of elastic checkpoints), not device collectives:
+        rank 0 resets the version dir and drops a STARTED sentinel;
+        other ranks wait for it before writing; each rank publishes a
+        done.r<k> marker strictly after its data files close, and rank 0
+        waits for every done marker before committing. This keeps the
+        save legal from background writer threads (no collective may run
+        off the main stream) and identical on GCS (no rename needed). An
+        explicit ``barrier`` callable replaces the sentinel protocol
+        when the caller already has a rendezvous (tests, jax.distributed
+        sync points).
+
+        A STARTED left by a CRASHED attempt at the same version would
+        let a rank skip the wait and lose its files to rank 0's reset —
+        that is why trainers call clean_uncommitted() at process start.
+        (Within one run versions are monotonic, so a same-version retry
+        against a live stale sentinel cannot occur in the trainer.)"""
         vdir = self._vdir(version)
+        use_sentinel = barrier is None and nranks > 1
         if rank == 0:
             self._fs.delete_tree(vdir)
             self._fs.makedirs(vdir)
+            if use_sentinel:
+                with self._fs.open(vdir + "/STARTED", "w") as f:
+                    f.write(str(version))
         if barrier is not None:
             barrier()  # rank0's directory reset must precede any write
-        elif nranks > 1:
-            raise ValueError("sharded save with nranks > 1 needs a barrier")
+        elif use_sentinel:
+            self._fs_wait(
+                lambda: self._fs.exists(vdir + "/STARTED"),
+                "rank 0 STARTED sentinel (v%d)" % version, timeout)
 
         flat, _ = jax.tree_util.tree_flatten_with_path(tree)
         dtypes = {}
@@ -217,10 +299,24 @@ class CheckpointManager(object):
                            "w") as f:
             json.dump({"crc": zlib.crc32(payload), "dtypes": dtypes,
                        "nbytes": len(payload)}, f)
+        if use_sentinel:
+            # the done marker is written (and closed) strictly AFTER the
+            # data files: its EXISTENCE is the signal, so rank 0 never
+            # json.loads a shardmeta that is still streaming to disk
+            # (POSIX open(w) creates the file before content lands)
+            with self._fs.open("%s/done.r%d" % (vdir, rank), "w") as f:
+                f.write("1")
 
         if barrier is not None:
             barrier()  # every rank's file must exist before the commit
         if rank == 0:
+            if use_sentinel:
+                self._fs_wait(
+                    lambda: all(self._fs.exists(
+                        "%s/done.r%d" % (vdir, r))
+                        for r in range(nranks)),
+                    "all %d rank done markers (v%d)" % (nranks, version),
+                    timeout)
             crcs = {}
             dtypes_all = {}
             for r in range(nranks):

@@ -80,10 +80,12 @@ class State(Serializable):
     def next_epoch(self):
         """The epoch a restart should run: the interrupted epoch itself
         when the newest checkpoint was written mid-epoch (emergency
-        preemption save — its remaining data must not be skipped), else
-        the one after the last completed epoch. Older checkpoints lack
-        the ``ended`` flag but were only ever written at epoch end, so
-        the compat default is True."""
+        preemption save) — the epoch is re-run from its start so none of
+        its data is skipped (already-consumed batches are replayed;
+        exactly-once resume is the ElasticReader/data_checkpoint path) —
+        else the one after the last completed epoch. Older checkpoints
+        lack the ``ended`` flag but were only ever written at epoch end,
+        so the compat default is True."""
         attr = self.epochs.get(str(self.epoch_no))
         if attr is not None and not attr.get("ended", True):
             return self.epoch_no

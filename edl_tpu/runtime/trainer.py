@@ -437,6 +437,13 @@ class ElasticTrainer(object):
         self._ckpt = (CheckpointManager(checkpoint_dir,
                                         keep=keep_checkpoints)
                       if checkpoint_dir else None)
+        if self._ckpt is not None and jax.process_index() == 0:
+            # crashed-attempt garbage (incl. stale sharded-save STARTED
+            # sentinels that would mis-order a later same-version save)
+            try:
+                self._ckpt.clean_uncommitted()
+            except Exception:
+                logger.exception("uncommitted-checkpoint cleanup failed")
         self.coord = coord
         if self.coord is None and self.env.under_launcher:
             self.coord = CoordClient(self.env.store_endpoints,
@@ -450,6 +457,12 @@ class ElasticTrainer(object):
         self._async_save = async_save
         self._save_thread = None
         self._preempted = False
+        # non-daemon writer + atexit join: process exit must not lose the
+        # final checkpoint mid-write (manifest-last keeps partials
+        # invisible, but losing the last epoch silently is a regression).
+        # Registered ONCE — not per save.
+        import atexit
+        atexit.register(self.wait_for_save)
 
     # -- the compiled step ---------------------------------------------------
 
@@ -513,18 +526,21 @@ class ElasticTrainer(object):
         deletion behaves the same). The handler only sets a flag —
         async-signal-safe, and a save cannot run mid-XLA-dispatch — and
         the next step/epoch boundary writes a checkpoint at the CURRENT
-        step, then raises PreemptedError. The restart then resumes from
-        that step (re-running the interrupted epoch's remaining data —
-        State.next_epoch) instead of replaying from the last epoch-end
-        save. Returns self so it chains after construction.
+        step, then raises PreemptedError. The restart resumes the model
+        at that step and RE-RUNS the interrupted epoch from its start
+        (State.next_epoch): no optimizer progress is lost, but batches
+        the interrupted epoch already consumed are replayed (epoch-
+        granular loops; an ElasticReader loop resumes exactly instead,
+        via State.data_checkpoint record ranges). Returns self so it
+        chains after construction.
 
-        Multi-host: with cross-host SHARDED state (tp/sp over hosts) the
-        save gather is a collective, and nothing guarantees every rank
-        observes its SIGTERM at the same step boundary — a rank entering
-        the gather while another is inside the next jit step would
-        deadlock the grace window. That case skips the save (the restart
-        falls back to the last epoch-end checkpoint); replicated or
-        single-host state saves normally.
+        Multi-host, because preempted ranks cannot rendezvous (signals
+        land at different step boundaries, so neither a gather nor the
+        sharded-save barrier is safe): with replicated(-or-host-only)
+        state, rank 0 alone writes a complete dense checkpoint from its
+        local replicas; with cross-host SHARDED state (tp/sp over
+        hosts) the save is skipped and the restart falls back to the
+        last epoch-end checkpoint.
         """
         import signal as signal_mod
         if signals is None:
@@ -540,26 +556,39 @@ class ElasticTrainer(object):
     def preempted(self):
         return self._preempted
 
+    def _state_locally_fetchable(self):
+        """True when every state leaf can reach host memory WITHOUT a
+        collective: host data, fully addressable, or fully replicated
+        (a complete local replica exists)."""
+        return all(
+            not hasattr(x, "addressable_shards")
+            or getattr(x, "is_fully_addressable", True)
+            or getattr(x, "is_fully_replicated", False)
+            for x in jax.tree_util.tree_leaves(self.train_state))
+
     def _emergency_save(self, already_saved=False):
+        """Write the grace-window checkpoint and raise PreemptedError.
+
+        Preempted ranks cannot rendezvous — signals land at different
+        step boundaries — so NO cooperative path (collective gather or
+        the sharded-save fs barrier) is allowed here. Single process:
+        the normal dense save. Multi-process with replicated(-or-host)
+        state: rank 0 alone writes a complete dense checkpoint from its
+        local replicas. Multi-process with cross-host SHARDED state: no
+        single rank holds the model — skip, and the restart falls back
+        to the last epoch-end checkpoint."""
         from edl_tpu.utils.errors import PreemptedError
 
         if self._ckpt is None:
             raise PreemptedError(
                 "preempted at step %d; no checkpoint dir configured — "
                 "nothing saved, restart begins fresh" % self._host_step)
-        if jax.process_count() > 1 \
-                and not self._state_fully_addressable():
-            # the save gather is a collective; ranks may sit at different
-            # step boundaries when their signals landed -> deadlock risk.
-            # Fall back to the last epoch-end checkpoint instead.
-            logger.warning("preempted with cross-host sharded state; "
-                           "skipping the emergency save (collective "
-                           "alignment not guaranteed)")
+        if already_saved:
             raise PreemptedError(
-                "preempted at step %d; emergency save skipped (cross-"
-                "host sharded state) — restart resumes from the last "
-                "epoch checkpoint" % self._host_step)
-        if not already_saved:
+                "preempted; checkpoint saved at step %d" % self._host_step)
+        self.state.global_step = self.global_step  # else stale since the
+        # last end_epoch — the store/meta snapshot must show real progress
+        if jax.process_count() <= 1:
             logger.info("preemption signal: emergency checkpoint at "
                         "step %d", self._host_step)
             self.wait_for_save()
@@ -568,6 +597,31 @@ class ElasticTrainer(object):
                 self.save()
             finally:
                 self._async_save = was_async
+            raise PreemptedError(
+                "preempted; checkpoint saved at step %d" % self._host_step)
+        if not self._state_locally_fetchable():
+            logger.warning("preempted with cross-host sharded state; "
+                           "skipping the emergency save (no rank holds "
+                           "the full model and ranks cannot rendezvous)")
+            raise PreemptedError(
+                "preempted at step %d; emergency save skipped (cross-"
+                "host sharded state) — restart resumes from the last "
+                "epoch checkpoint" % self._host_step)
+        if jax.process_index() != 0:
+            raise PreemptedError(
+                "preempted at step %d; emergency checkpoint is rank 0's "
+                "(replicated state) — this rank wrote nothing"
+                % self._host_step)
+        logger.info("preemption signal: rank-0 local emergency "
+                    "checkpoint at step %d", self._host_step)
+        self.wait_for_save()
+        import json
+        state_snapshot = json.loads(self.state.to_json())
+        self._ckpt.save(self.global_step,
+                        checkpoint_mod.to_host_tree_local(
+                            dict(self.train_state)),
+                        meta={"state": state_snapshot})
+        self._save_state_to_store(state_snapshot)
         raise PreemptedError(
             "preempted; checkpoint saved at step %d" % self._host_step)
 
@@ -620,10 +674,18 @@ class ElasticTrainer(object):
                    for x in jax.tree_util.tree_leaves(self.train_state))
 
     def save(self):
-        """Rank-0 writes the versioned checkpoint + State (reference:
-        rank0 fleet.save_check_point per epoch, train_with_fleet.py:562).
-        EVERY process must call this when the state is sharded across
-        hosts — the gather is a collective; only the write is rank-0.
+        """Write the versioned checkpoint + State (reference: rank0
+        fleet.save_check_point per epoch, train_with_fleet.py:562).
+
+        Fully-addressable state (single process): rank 0 writes the
+        dense checkpoint. Any cross-process state (is_fully_addressable
+        is False for every multi-host jax.Array, replicated included):
+        EVERY process calls this and writes only the shards it owns
+        replica 0 of (CheckpointManager.save_sharded) — no gather
+        collective, write bandwidth scales with host count (the Orbax
+        role), and synchronization is filesystem visibility on the
+        shared store, not device collectives. For replicated leaves the
+        replica-0 dedup means rank 0 writes them once.
 
         With ``async_save=True`` the write overlaps training: the state is
         copied ON DEVICE first (so later steps may donate the originals),
@@ -631,49 +693,50 @@ class ElasticTrainer(object):
         commit keeps partial writes invisible."""
         if self._ckpt is None:
             return
-        gathered = None
-        if not self._state_fully_addressable():
-            # collective: all ranks participate, then non-writers return
-            gathered = checkpoint_mod.to_host_tree(dict(self.train_state))
-        if self.env.global_rank != 0:
-            return
-        self.wait_for_save()
         version = self.global_step
         # deep-snapshot the control-plane state NOW — the background writer
         # must not see the live State's nested dicts mutating under it
         import json
         state_snapshot = json.loads(self.state.to_json())
         meta = {"state": state_snapshot}
-        if not self._async_save:
-            tree = (gathered if gathered is not None
-                    else checkpoint_mod.to_host_tree(
-                        dict(self.train_state)))
-            self._ckpt.save(version, tree, meta=meta)
-            self._save_state_to_store(state_snapshot)
-            return
-        # immutable snapshot, independent of donated buffers: already on
-        # host when gathered; else a device-side copy
-        snapshot = (gathered if gathered is not None else
-                    jax.tree_util.tree_map(jnp.copy,
-                                           dict(self.train_state)))
 
-        def _write():
-            try:
-                self._ckpt.save(version,
-                                checkpoint_mod.to_host_tree(snapshot),
+        if not self._state_fully_addressable():
+            # per-host sharded write; every rank participates
+            rank = jax.process_index()
+            nranks = jax.process_count()
+
+            def write(tree):
+                self._ckpt.save_sharded(version, tree, meta=meta,
+                                        rank=rank, nranks=nranks)
+                if rank == 0:
+                    self._save_state_to_store(state_snapshot)
+        else:
+            if self.env.global_rank != 0:
+                return
+
+            def write(tree):
+                self._ckpt.save(version, checkpoint_mod.to_host_tree(tree),
                                 meta=meta)
                 self._save_state_to_store(state_snapshot)
+
+        self.wait_for_save()
+        if not self._async_save:
+            write(dict(self.train_state))
+            return
+        # immutable snapshot, independent of donated buffers: a
+        # device-side copy later steps cannot touch
+        snapshot = jax.tree_util.tree_map(jnp.copy,
+                                          dict(self.train_state))
+
+        def _bg():
+            try:
+                write(snapshot)
             except Exception:
                 logger.exception("async checkpoint v%d failed", version)
 
-        # non-daemon + atexit join: process exit must not lose the final
-        # checkpoint mid-write (manifest-last keeps partials invisible,
-        # but losing the last epoch silently is still a regression)
         self._save_thread = threading.Thread(
-            target=_write, daemon=False, name="ckpt-save-%d" % version)
+            target=_bg, daemon=False, name="ckpt-save-%d" % version)
         self._save_thread.start()
-        import atexit
-        atexit.register(self.wait_for_save)
 
     def wait_for_save(self):
         """Block until any in-flight async checkpoint write finishes."""
@@ -694,25 +757,33 @@ class ElasticTrainer(object):
             return False
         # newest-first: per version, try the full state; when only the extra
         # keys are missing (legacy checkpoint), retry THAT version core-only
-        # rather than falling back to an older checkpoint
-        # (to_host_tree: every rank calls resume(), so the cross-host
-        # gather of sharded leaves is a valid collective here)
-        host_state = checkpoint_mod.to_host_tree(dict(self.train_state))
+        # rather than falling back to an older checkpoint. The target is a
+        # ShapeDtypeStruct tree — restore needs structure only, so no
+        # gather of cross-host sharded leaves is ever required
+
+        def _spec(x):
+            a = x if hasattr(x, "shape") and hasattr(x, "dtype") \
+                else np.asarray(x)
+            return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+        target = jax.tree_util.tree_map(_spec, dict(self.train_state))
         restored = None
         for version in reversed(self._ckpt.versions()):
             try:
-                restored = self._ckpt.restore(version, target=host_state)
+                restored = self._ckpt.restore(version, target=target)
                 break
             except Exception as e:  # noqa: BLE001
                 if isinstance(e, MissingKeysError) \
-                        and jax.tree_util.tree_leaves(host_state["extra"]):
-                    core = dict(host_state)
-                    extra_target = core.pop("extra")
+                        and jax.tree_util.tree_leaves(target["extra"]):
+                    core = dict(target)
+                    core.pop("extra")
                     try:
                         restored = self._ckpt.restore(version, target=core)
                         logger.info("checkpoint v%d has no extra state; "
                                     "keeping the initial one", version)
-                        restored[1]["extra"] = extra_target
+                        # the live (initial) extra arrays, already laid
+                        # out by self._state_shardings
+                        restored[1]["extra"] = self.train_state["extra"]
                         break
                     except Exception as e2:  # noqa: BLE001
                         e = e2

@@ -117,6 +117,140 @@ def test_manifest_contents(ckpt_fs):
     assert manifest["version"] == 5 and manifest["nbytes"] > 0
 
 
+def _sharded_tree(seed):
+    """A train-state-shaped tree with dp-sharded, replicated, bf16 and
+    host-numpy leaves over the 8-device CPU mesh."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    rng = np.random.RandomState(seed)
+    w = rng.randn(16, 4).astype(np.float32)
+    moments = rng.randn(16, 4).astype(np.float32)
+    bf = (rng.randn(8, 2) * seed).astype(np.float32)
+    tree = {
+        "params": {"w": jax.device_put(
+            w, NamedSharding(mesh, P()))},            # replicated
+        "opt": {"mu": jax.device_put(
+            moments, NamedSharding(mesh, P("dp")))},  # zero1-style shard
+        "bf16": jax.device_put(jnp.asarray(bf, jnp.bfloat16),
+                               NamedSharding(mesh, P("dp"))),
+        "step": np.int32(seed),                       # host leaf
+    }
+    host = {"params": {"w": w}, "opt": {"mu": moments},
+            "bf16": bf, "step": np.int32(seed)}
+    return tree, host
+
+
+def _struct_target(tree):
+    import jax
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                       getattr(x, "dtype",
+                                               np.asarray(x).dtype)),
+        tree)
+
+
+def test_sharded_save_restore_roundtrip(ckpt_fs):
+    base, fs = ckpt_fs
+    cm = _cm(ckpt_fs)
+    tree, host = _sharded_tree(3)
+    cm.save_sharded(3, tree, meta={"epoch": 0})
+    with fs.open(base + "/v_00000003/MANIFEST", "r") as f:
+        manifest = json.load(f)
+    assert manifest["sharded"] is True and manifest["ranks"] == 1
+    version, restored, meta = cm.restore_latest(
+        target=_struct_target(tree))
+    assert version == 3 and meta == {"epoch": 0}
+    np.testing.assert_array_equal(restored["params"]["w"],
+                                  host["params"]["w"])
+    np.testing.assert_array_equal(restored["opt"]["mu"], host["opt"]["mu"])
+    np.testing.assert_array_equal(
+        np.asarray(restored["bf16"], np.float32),
+        np.asarray(jnp.asarray(host["bf16"], jnp.bfloat16), np.float32))
+    assert restored["bf16"].dtype == jnp.bfloat16
+    assert int(restored["step"]) == 3
+
+
+def test_sharded_sentinel_protocol_two_ranks(ckpt_fs):
+    """The fs-visibility barrier: rank 1 (no coordination channel) must
+    wait for rank 0's STARTED sentinel before writing, and rank 0 must
+    wait for rank 1's shard file before committing the manifest."""
+    import threading
+    import time
+
+    base, fs = ckpt_fs
+    cm0, cm1 = _cm(ckpt_fs), _cm(ckpt_fs)
+    tree, host = _sharded_tree(9)
+    errs = []
+
+    def rank1():
+        try:
+            cm1.save_sharded(9, {}, rank=1, nranks=2)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    time.sleep(0.3)  # rank 1 is polling for STARTED; nothing written yet
+    assert not fs.exists(base + "/v_00000009/arrays.r1.npz")
+    cm0.save_sharded(9, tree, meta={"k": 1}, rank=0, nranks=2)
+    t.join(timeout=30)
+    assert not t.is_alive() and not errs, errs
+    with fs.open(base + "/v_00000009/MANIFEST", "r") as f:
+        manifest = json.load(f)
+    assert manifest["ranks"] == 2 and set(manifest["crcs"]) == {"0", "1"}
+    version, restored, meta = cm0.restore_latest(
+        target=_struct_target(tree))
+    assert version == 9 and meta == {"k": 1}
+    np.testing.assert_array_equal(restored["opt"]["mu"], host["opt"]["mu"])
+
+
+def test_sharded_corrupt_rank_file_falls_back(ckpt_fs):
+    base, fs = ckpt_fs
+    cm = _cm(ckpt_fs)
+    tree1, _ = _sharded_tree(1)
+    tree2, _ = _sharded_tree(2)
+    cm.save_sharded(1, tree1)
+    cm.save_sharded(2, tree2)
+    with fs.open(base + "/v_00000002/arrays.r0.npz", "wb") as f:
+        f.write(b"garbage")
+    version, restored, _ = cm.restore_latest(target=_struct_target(tree1))
+    assert version == 1 and int(restored["step"]) == 1
+
+
+def test_clean_uncommitted_removes_crashed_attempts(ckpt_fs):
+    """A SIGKILLed sharded save leaves an uncommitted dir whose STARTED
+    sentinel would mis-order a later same-version save; the janitor
+    (called by trainers at process start) removes it and never touches
+    committed versions."""
+    base, fs = ckpt_fs
+    cm = _cm(ckpt_fs)
+    tree, _ = _sharded_tree(1)
+    cm.save_sharded(1, tree)
+    fs.makedirs(base + "/v_00000002")
+    with fs.open(base + "/v_00000002/STARTED", "w") as f:
+        f.write("2")
+    with fs.open(base + "/v_00000002/arrays.r1.npz", "wb") as f:
+        f.write(b"partial")
+    removed = cm.clean_uncommitted()
+    assert removed == ["v_00000002"]
+    assert cm.versions() == [1]
+    assert not fs.exists(base + "/v_00000002/STARTED")
+    assert cm.clean_uncommitted() == []  # idempotent
+
+
+def test_sharded_missing_coverage_detected(ckpt_fs):
+    from edl_tpu.runtime.checkpoint import MissingKeysError
+
+    cm = _cm(ckpt_fs)
+    tree, _ = _sharded_tree(4)
+    cm.save_sharded(4, {"params": tree["params"]})
+    target = _struct_target(tree)
+    with pytest.raises(MissingKeysError):
+        cm.restore(4, target=target)
+
+
 def test_gcs_fs_primitives():
     """GCSFS exists/listdir/delete_tree semantics on the flat namespace."""
     from edl_tpu.tools.fake_gcs import FakeGCSServer

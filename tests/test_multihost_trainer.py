@@ -1,8 +1,7 @@
 """ElasticTrainer across REAL processes with params tp-sharded ACROSS the
-process boundary: train → save (collective gather + rank-0 write) →
-fresh-trainer resume on both ranks. This is the deadlock scenario of the
-multi-host checkpoint path: save() must be called by every rank, gather
-collectively, and only rank 0 writes."""
+process boundary: train → save (per-host SHARDED write: each rank writes
+only its own shards, fs-sentinel barriers, rank-0 manifest commit — no
+gather collective) → fresh-trainer resume on both ranks."""
 
 import os
 import socket
@@ -60,8 +59,23 @@ assert host_batch["label"].shape[0] == 16, host_batch["label"].shape
 for i in range(2):
     loss = float(trainer.train_step(host_batch))
 trainer.begin_epoch(0)
-trainer.end_epoch(save=True)   # collective gather; rank-0 write
+trainer.end_epoch(save=True)   # per-rank sharded write; rank-0 commit
+# every rank wrote its own shard file; rank 0 committed the manifest
+# (non-zero ranks return before the commit — only rank 0 may read it)
+import glob
+import json as _json
+vdir = sorted(glob.glob(ckpt + "/v_*"))[-1]
+assert os.path.exists("%s/arrays.r%d.npz" % (vdir, rank)), vdir
+if rank == 0:
+    with open(vdir + "/MANIFEST") as f:
+        _m = _json.load(f)
+    assert _m.get("sharded") and _m["ranks"] == 2, _m
 print("SAVED rank=%d loss=%.6f" % (rank, loss), flush=True)
+
+# rank 0's save_sharded returns only after the MANIFEST commit, so this
+# barrier guarantees the commit is visible before any rank resumes
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("ckpt-committed")
 
 trainer2 = make_trainer()
 assert trainer2.resume(), "resume failed"
@@ -72,6 +86,98 @@ assert not q2.is_fully_addressable
 l2 = float(trainer2.train_step(host_batch))
 print("RESUMED rank=%d loss=%.6f" % (rank, l2), flush=True)
 """
+
+
+WORKER_DP = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+coordinator, nprocs, rank, ckpt = (sys.argv[1], int(sys.argv[2]),
+                                   int(sys.argv[3]), sys.argv[4])
+import os
+os.environ["EDL_TPU_GLOBAL_RANK"] = str(rank)
+os.environ["EDL_TPU_WORLD_SIZE"] = str(nprocs)
+jax.distributed.initialize(coordinator_address=coordinator,
+                           num_processes=nprocs, process_id=rank)
+import optax
+from edl_tpu.models import linear
+from edl_tpu.runtime.trainer import ElasticTrainer
+from edl_tpu.utils.errors import PreemptedError
+
+def make_trainer():
+    return ElasticTrainer(linear.loss_fn, linear.init_params(),
+                          optax.sgd(0.05), total_batch_size=16,
+                          checkpoint_dir=ckpt)
+
+trainer = make_trainer()
+# pure dp: params replicated across BOTH processes (not fully
+# addressable, but every rank holds a complete local replica)
+w = trainer.train_state["params"]["w"]
+assert not w.is_fully_addressable and w.is_fully_replicated
+
+full = linear.synthetic_batch(16, seed=0)
+for i in range(3):
+    trainer.train_step(trainer.local_batch_slice(full))
+trainer._preempted = True  # both ranks' SIGTERM flags (simulated)
+try:
+    trainer.train_step(trainer.local_batch_slice(full))
+    raise AssertionError("expected PreemptedError")
+except PreemptedError as e:
+    msg = str(e)
+if rank == 0:
+    assert "saved at step 4" in msg, msg
+else:
+    assert "rank 0" in msg, msg
+print("PREEMPTED rank=%d" % rank, flush=True)
+
+# rank 0's dense local save is synchronous; barrier so rank 1 sees it
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("emergency-committed")
+
+trainer2 = make_trainer()
+assert trainer2.resume(), "resume failed"
+assert trainer2.global_step == 4, trainer2.global_step
+trainer2.train_step(trainer2.local_batch_slice(full))
+print("RESUMED rank=%d step=%d" % (rank, trainer2.global_step),
+      flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_multihost_dp_emergency_preemption_save(tmp_path):
+    """2-process pure-dp job: on preemption rank 0 alone writes a dense
+    emergency checkpoint from its local replica (no collective, no
+    rendezvous with rank 1), and both ranks resume from it."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = "127.0.0.1:%d" % port
+    worker_py = tmp_path / "worker_dp.py"
+    worker_py.write_text(WORKER_DP)
+    ckpt = str(tmp_path / "ckpt")
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker_py), coordinator, "2", str(rank),
+         ckpt],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode("utf-8", "replace"))
+            assert p.returncode == 0, "\n".join(outs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    text = "\n".join(outs)
+    assert text.count("PREEMPTED") == 2, text
+    assert text.count("RESUMED") == 2, text
 
 
 @pytest.mark.integration
