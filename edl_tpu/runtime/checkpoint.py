@@ -82,21 +82,30 @@ def to_host_tree(tree):
     return jax.tree_util.tree_map(fetch, tree)
 
 
+def leaf_locally_fetchable(x):
+    """True when ``x`` can reach host memory WITHOUT a collective: host
+    data, fully addressable, or fully replicated (a complete local
+    replica exists). The single predicate behind to_host_tree_local and
+    the trainer's emergency-save eligibility check — they must agree."""
+    return (not hasattr(x, "addressable_shards")
+            or getattr(x, "is_fully_addressable", True)
+            or getattr(x, "is_fully_replicated", False))
+
+
 def to_host_tree_local(tree):
     """Fetch a device pytree to host numpy WITHOUT any collective: every
-    leaf must be host data, fully addressable, or fully replicated (a
-    complete local replica exists). This is the emergency-checkpoint
-    fetch — preempted ranks cannot rendezvous, so a gather is off the
-    table; raises ValueError on cross-host *sharded* leaves."""
+    leaf must satisfy leaf_locally_fetchable. This is the emergency-
+    checkpoint fetch — preempted ranks cannot rendezvous, so a gather is
+    off the table; raises ValueError on cross-host *sharded* leaves."""
     def fetch(x):
+        if not leaf_locally_fetchable(x):
+            raise ValueError("cross-host sharded leaf: no local replica "
+                             "to fetch without a collective")
         if not hasattr(x, "addressable_shards"):
             return np.asarray(x)
         if getattr(x, "is_fully_addressable", True):
             return jax.device_get(x)
-        if getattr(x, "is_fully_replicated", False):
-            return np.asarray(x.addressable_data(0))
-        raise ValueError("cross-host sharded leaf: no local replica to "
-                         "fetch without a collective")
+        return np.asarray(x.addressable_data(0))
     return jax.tree_util.tree_map(fetch, tree)
 
 

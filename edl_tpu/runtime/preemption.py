@@ -1,0 +1,198 @@
+"""Coordinated preemption stop: agree on a common stop STEP across all
+ranks through the coordination store, so the grace-window emergency
+checkpoint can use the normal cooperative save even for cross-host
+SHARDED state (tp/sp over hosts).
+
+Why: SIGTERMs land on each host at slightly different wall-clock times,
+so ranks observe the flag at different step boundaries. Any cooperative
+save (collective gather, or the sharded save's filesystem barrier)
+started from misaligned boundaries deadlocks or times out. The protocol:
+
+  1. a flagged rank publishes   preempt:<stage>/req_<rank> = its step
+  2. rank 0 (watcher thread) sees any req and publishes (put-if-absent)
+                                preempt:<stage>/stop_at = its step + margin
+  3. every rank's watcher reads stop_at; the trainer stops at that exact
+     step boundary, where the cooperative save is safe, and raises
+     PreemptedError on ALL ranks.
+
+Keys carry a TTL, republished periodically while the preemption is
+pending, so they self-expire after the job moves on — a restarted job
+can never trip over its predecessor's stop_at — and are namespaced by
+the cluster stage uuid (a new incarnation never sees the old stage's
+keys even within the TTL). The stop step is chosen ahead of every
+rank: max(leader step, all requesters' steps) plus a margin ADAPTIVE to
+the observation latency (the step-equivalent of a few watcher poll
+intervals, from the leader's measured step time) — with fast steps a
+fixed step count would already be in the past by the time a watcher
+polls. If a rank still overshoots (extreme skew), the aligned save is
+impossible: that rank raises PreemptedError without saving, the
+stopped ranks' save barrier times out, and every rank still exits via
+PreemptedError with the restart falling back to the last epoch
+checkpoint; a rank blocked inside a dispatched collective is freed by
+the supervisor's SIGKILL after the grace period. The checkpoint is
+best-effort under pathological skew — never corrupted, and the failure
+mode equals not having the feature.
+
+Reference role: the reference had no mid-epoch preemption save at all
+(per-epoch checkpoints only, train_with_fleet.py:562); this is net-new
+elasticity depth for TPU pods, where preemption is routine.
+"""
+
+import threading
+
+from edl_tpu.utils.logger import logger
+
+KEY_TTL = 120.0
+
+
+class CoordinatedStop(object):
+    """One per trainer process. ``stop_at`` becomes the agreed stop step
+    (read it each boundary); ``request(step)`` publishes this rank's
+    preemption flag. A daemon watcher thread polls the store."""
+
+    def __init__(self, coord, rank, stage="default", margin=4,
+                 poll_interval=0.25, current_step=None, min_step=0,
+                 step_time=None):
+        self._coord = coord
+        self._rank = rank
+        self._service = "preempt:%s" % (stage or "default")
+        self._margin = margin
+        self._poll = poll_interval
+        self._current_step = current_step or (lambda: 0)
+        # seconds per train step (callable), for the adaptive margin; 0
+        # or None falls back to the fixed step margin
+        self._step_time = step_time or (lambda: 0.0)
+        self.stop_at = None
+        # stop_at values at or below min_step are STALE (left by a prior
+        # incarnation within the key TTL when the stage uuid did not
+        # change). The trainer raises this to the resumed step after
+        # checkpoint restore; a legitimate stop is always published
+        # ahead of every live rank's step.
+        self.min_step = min_step
+        self._requested = False
+        self._last_pub = 0.0
+        self._stop_evt = threading.Event()
+        self._thread = None
+
+    @property
+    def started(self):
+        return self._thread is not None
+
+    def start(self):
+        """Idempotent. Callers should start the watcher only once the
+        baseline step is final (after any checkpoint resume): a watcher
+        polling with a too-low min_step would accept a stale stop_at in
+        the window before the baseline is raised."""
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="preempt-watch-r%d"
+                                            % self._rank)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop_evt.set()
+
+    def request(self, step):
+        """Publish this rank's preemption flag (TTL'd, republished every
+        few seconds while pending — a single put could expire during a
+        long compile before the leader's watcher ever polls). The
+        published step is clamped above min_step so the leader's
+        staleness filter never discards a live request."""
+        import time
+        now = time.monotonic()
+        if self._requested and now - self._last_pub < min(2.0,
+                                                          KEY_TTL / 3.0):
+            return
+        self._requested = True
+        self._last_pub = now
+        try:
+            # put-if-absent: a no-op while the key is alive, an
+            # automatic refresh once the TTL lapsed
+            self._coord.set_server_not_exists(
+                self._service, "req_%d" % self._rank,
+                str(max(int(step), self.min_step + 1)), ttl=KEY_TTL)
+        except Exception:
+            logger.exception("preempt request publish failed")
+
+    # -- watcher ------------------------------------------------------------
+
+    def _read_stop_at(self):
+        try:
+            v = self._coord.get_value(self._service, "stop_at")
+        except Exception:
+            logger.exception("preempt stop_at read failed")
+            return None
+        if isinstance(v, bytes):
+            v = v.decode("utf-8", "replace")
+        try:
+            return None if v is None else int(v)
+        except (TypeError, ValueError):
+            return None
+
+    def _leader_maybe_publish(self):
+        try:
+            reqs = self._coord.get_service(self._service)
+        except Exception:
+            return
+
+        def as_step(value):
+            if isinstance(value, bytes):
+                value = value.decode("utf-8", "replace")
+            try:
+                return int(value)
+            except (TypeError, ValueError):
+                return None
+
+        # reqs at or below min_step are a prior incarnation's leftovers
+        # (same stage uuid within the key TTL) — not a live preemption
+        req_steps = [s for name, v in reqs
+                     if name.startswith("req_")
+                     and (s := as_step(v)) is not None
+                     and s > self.min_step]
+        if not req_steps:
+            return
+        # the stop must land AHEAD of every rank's step counter when its
+        # watcher observes it: steps are fast (ms) while observation is
+        # poll-paced (100s of ms), so a fixed step margin would already
+        # be in the past — convert a few poll intervals into steps using
+        # the measured step time, and start from the furthest-ahead
+        # counter we know of (leader or any requester)
+        dt = float(self._step_time() or 0.0)
+        adaptive = int(4.0 * self._poll / dt) + 1 if dt > 0 else 0
+        stop = (max([int(self._current_step())] + req_steps)
+                + max(self._margin, adaptive))
+        try:
+            existing = self._read_stop_at()
+            if existing is not None and existing <= self.min_step:
+                # a stale key from a prior incarnation blocks the
+                # put-if-absent: overwrite it (one leader per job)
+                self._coord.set_server_with_lease(
+                    self._service, "stop_at", str(stop), ttl=KEY_TTL)
+                logger.info("preemption leader: stop_at=%d published "
+                            "(over stale %d)", stop, existing)
+            elif existing is None and self._coord.set_server_not_exists(
+                    self._service, "stop_at", str(stop),
+                    ttl=KEY_TTL) is not None:
+                logger.info("preemption leader: stop_at=%d published", stop)
+        except Exception:
+            logger.exception("preempt stop_at publish failed")
+
+    def _run(self):
+        warned_stale = False
+        while not self._stop_evt.wait(self._poll):
+            got = self._read_stop_at()
+            if got is not None:
+                if got <= self.min_step:
+                    if not warned_stale:
+                        warned_stale = True
+                        logger.warning(
+                            "ignoring stale preemption stop_at=%d "
+                            "(<= min_step %d)", got, self.min_step)
+                else:
+                    self.stop_at = got
+                    logger.info("preemption stop_at=%d observed (rank %d)",
+                                got, self._rank)
+                    return
+            if self._rank == 0:
+                self._leader_maybe_publish()

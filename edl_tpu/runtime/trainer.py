@@ -457,12 +457,17 @@ class ElasticTrainer(object):
         self._async_save = async_save
         self._save_thread = None
         self._preempted = False
+        self._coord_stop = None
         # non-daemon writer + atexit join: process exit must not lose the
         # final checkpoint mid-write (manifest-last keeps partials
         # invisible, but losing the last epoch silently is a regression).
-        # Registered ONCE — not per save.
+        # Registered ONCE, via weakref: the atexit registry must not pin
+        # discarded trainers (and their device state) for the process
+        # lifetime when several are constructed (restarts, notebooks).
         import atexit
-        atexit.register(self.wait_for_save)
+        import weakref
+        ref = weakref.ref(self)
+        atexit.register(lambda: (lambda t: t and t.wait_for_save())(ref()))
 
     # -- the compiled step ---------------------------------------------------
 
@@ -512,13 +517,26 @@ class ElasticTrainer(object):
         self.train_state, loss = self._jit_step(self.train_state, batch, rng)
         self._host_step += 1
         self._step_times.append(time.perf_counter() - t0)
-        if self._preempted:
+        if self._coord_stop is not None:
+            if not self._coord_stop.started:
+                # first boundary: the baseline is final (resume() ran
+                # before any step), so stale keys are now rejectable
+                self._coord_stop.min_step = max(self._coord_stop.min_step,
+                                                self._host_step - 1)
+                self._coord_stop.start()
+            if self._preempted:
+                self._coord_stop.request(self._host_step)
+            stop = self._coord_stop.stop_at
+            if stop is not None and self._host_step >= stop:
+                self._coordinated_save_and_raise(missed=self._host_step
+                                                 > stop)
+        elif self._preempted:
             self._emergency_save()
         return loss
 
     # -- preemption (grace-window emergency checkpoint) ----------------------
 
-    def install_preemption_handler(self, signals=None):
+    def install_preemption_handler(self, signals=None, coordinated=None):
         """Arm the grace-window emergency checkpoint.
 
         The launcher's kill path is process-tree SIGTERM, then SIGKILL
@@ -534,20 +552,48 @@ class ElasticTrainer(object):
         via State.data_checkpoint record ranges). Returns self so it
         chains after construction.
 
-        Multi-host, because preempted ranks cannot rendezvous (signals
-        land at different step boundaries, so neither a gather nor the
-        sharded-save barrier is safe): with replicated(-or-host-only)
-        state, rank 0 alone writes a complete dense checkpoint from its
-        local replicas; with cross-host SHARDED state (tp/sp over
-        hosts) the save is skipped and the restart falls back to the
-        last epoch-end checkpoint.
+        Multi-host: ``coordinated`` (default: auto-on when multi-process
+        AND a coordination store is attached) runs the CoordinatedStop
+        protocol — a flagged rank publishes its preemption to the store,
+        rank 0 publishes an agreed stop step a few steps ahead, and
+        EVERY rank stops at that exact boundary, where the normal
+        cooperative save (per-host sharded write) is safe even for
+        cross-host tp/sp-sharded state. Without a store, preempted ranks
+        cannot rendezvous (signals land at different step boundaries, so
+        neither a gather nor the sharded-save barrier is safe): with
+        replicated(-or-host-only) state, rank 0 alone writes a complete
+        dense checkpoint from its local replicas; with cross-host
+        SHARDED state the save is skipped and the restart falls back to
+        the last epoch-end checkpoint.
         """
         import signal as signal_mod
         if signals is None:
             signals = (signal_mod.SIGTERM,)
         for s in signals:
             signal_mod.signal(s, self._on_preempt_signal)
+        if coordinated is None:
+            coordinated = jax.process_count() > 1 and self.coord is not None
+        if coordinated and self._coord_stop is None:
+            if self.coord is None:
+                raise ValueError("coordinated preemption needs a "
+                                 "coordination store (coord=)")
+            from edl_tpu.runtime.preemption import CoordinatedStop
+            # created here, STARTED at the first step boundary — by then
+            # any resume() has fixed the baseline step, so a stale
+            # stop_at from a prior incarnation can never be accepted
+            self._coord_stop = CoordinatedStop(
+                self.coord, jax.process_index(),
+                stage=self.env.cluster_stage or "default",
+                current_step=lambda: self._host_step,
+                min_step=self._host_step,
+                step_time=self._recent_step_time)
         return self
+
+    def _recent_step_time(self):
+        """Mean of the last few step wall times (0.0 when unknown) — the
+        preemption leader converts watcher poll latency into steps."""
+        tail = self._step_times[-5:]
+        return sum(tail) / len(tail) if tail else 0.0
 
     def _on_preempt_signal(self, signum, frame):
         self._preempted = True
@@ -556,15 +602,52 @@ class ElasticTrainer(object):
     def preempted(self):
         return self._preempted
 
+    def _coordinated_save_and_raise(self, missed=False):
+        """All ranks reached the agreed stop step: the normal cooperative
+        save (per-host sharded write, or rank-0 dense) is safe here —
+        every rank sits at the SAME boundary, so the fs barrier aligns
+        and the version numbers match.
+
+        ``missed`` (this rank observed stop_at only after passing it —
+        extreme skew): the aligned save is impossible; raise WITHOUT
+        saving so the stopped ranks' barrier times out rather than
+        committing a mixed-step checkpoint. Any save failure still exits
+        via PreemptedError — the restart falls back to the last
+        epoch-end checkpoint."""
+        from edl_tpu.utils.errors import PreemptedError
+
+        self._coord_stop.stop()
+        if missed:
+            logger.warning("coordinated stop step %s observed late at "
+                           "step %d; skipping the aligned save",
+                           self._coord_stop.stop_at, self._host_step)
+            raise PreemptedError(
+                "preempted; missed the coordinated stop step (%s < %d) — "
+                "no emergency save, restart resumes from the last epoch "
+                "checkpoint" % (self._coord_stop.stop_at, self._host_step))
+        logger.info("coordinated preemption stop at step %d",
+                    self._host_step)
+        self.state.global_step = self.global_step
+        self.wait_for_save()
+        was_async, self._async_save = self._async_save, False
+        try:
+            self.save()
+        except Exception as e:  # noqa: BLE001
+            logger.exception("coordinated emergency save failed")
+            raise PreemptedError(
+                "preempted; coordinated emergency save failed (%r) — "
+                "restart resumes from the last epoch checkpoint" % (e,))
+        finally:
+            self._async_save = was_async
+        raise PreemptedError(
+            "preempted (coordinated stop); checkpoint saved at step %d"
+            % self._host_step)
+
     def _state_locally_fetchable(self):
         """True when every state leaf can reach host memory WITHOUT a
-        collective: host data, fully addressable, or fully replicated
-        (a complete local replica exists)."""
-        return all(
-            not hasattr(x, "addressable_shards")
-            or getattr(x, "is_fully_addressable", True)
-            or getattr(x, "is_fully_replicated", False)
-            for x in jax.tree_util.tree_leaves(self.train_state))
+        collective (the same predicate to_host_tree_local enforces)."""
+        return all(checkpoint_mod.leaf_locally_fetchable(x)
+                   for x in jax.tree_util.tree_leaves(self.train_state))
 
     def _emergency_save(self, already_saved=False):
         """Write the grace-window checkpoint and raise PreemptedError.
@@ -608,6 +691,22 @@ class ElasticTrainer(object):
                 "host sharded state) — restart resumes from the last "
                 "epoch checkpoint" % self._host_step)
         if jax.process_index() != 0:
+            # best-effort: wait briefly for rank 0's manifest so a fast
+            # per-process restart (liveft exit-101) cannot resume an
+            # older version than rank 0 does. The launcher's stop-resume
+            # path re-barriers the whole cluster and needs no wait.
+            import time
+            try:
+                baseline = max(self._ckpt.versions() or [0])
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline:
+                    vs = self._ckpt.versions()
+                    if vs and max(vs) > baseline:
+                        break
+                    time.sleep(0.25)
+            except Exception:
+                logger.exception("waiting for rank-0 emergency manifest "
+                                 "failed")
             raise PreemptedError(
                 "preempted at step %d; emergency checkpoint is rank 0's "
                 "(replicated state) — this rank wrote nothing"
@@ -638,8 +737,13 @@ class ElasticTrainer(object):
     def begin_epoch(self, epoch_no):
         if self._preempted:
             # SIGTERM landed between epochs (eval, data setup): save at
-            # this boundary rather than silently swallowing the stop
-            self._emergency_save()
+            # this boundary rather than silently swallowing the stop.
+            # Coordinated mode only REQUESTS here — all ranks reach the
+            # stop inside the next epoch's step loop together
+            if self._coord_stop is not None:
+                self._coord_stop.request(self._host_step)
+            else:
+                self._emergency_save()
         self.state.begin_epoch(epoch_no, self.world_size)
         self._step_times = []
         self.report_status(train_status_mod.TrainStatus.RUNNING)
@@ -652,8 +756,11 @@ class ElasticTrainer(object):
         if save:
             self.save()
         if self._preempted:
-            # the epoch-end save (if any) already covers this step
-            self._emergency_save(already_saved=save)
+            if self._coord_stop is not None:
+                self._coord_stop.request(self._host_step)
+            else:
+                # the epoch-end save (if any) already covers this step
+                self._emergency_save(already_saved=save)
 
     def report_status(self, status):
         if self.coord is not None and self.env.pod_id:
@@ -805,6 +912,10 @@ class ElasticTrainer(object):
                         prev_world, self.world_size)
             self.state.adjust(self.world_size)
         self._host_step = self.global_step
+        if self._coord_stop is not None:
+            # preempt keys published by the incarnation that wrote this
+            # checkpoint are at or below its final step: stale from here
+            self._coord_stop.min_step = self._host_step
         logger.info("resumed from checkpoint v%d (epoch %d, step %d)",
                     version, self.state.epoch_no, self.global_step)
         return True

@@ -143,6 +143,124 @@ print("RESUMED rank=%d step=%d" % (rank, trainer2.global_step),
 """
 
 
+WORKER_TP_COORD = """\
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+(coordinator, nprocs, rank, ckpt,
+ store_ep) = (sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),
+              sys.argv[4], sys.argv[5])
+import os
+os.environ["EDL_TPU_GLOBAL_RANK"] = str(rank)
+os.environ["EDL_TPU_WORLD_SIZE"] = str(nprocs)
+jax.distributed.initialize(coordinator_address=coordinator,
+                           num_processes=nprocs, process_id=rank)
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.models import bert
+from edl_tpu.runtime.trainer import ElasticTrainer
+from edl_tpu.utils.errors import PreemptedError
+
+coord = CoordClient([store_ep], root="coordjob")
+devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+mine = [d for d in devs if d.process_index == 0]
+theirs = [d for d in devs if d.process_index == 1]
+mesh = Mesh(np.stack([mine, theirs], axis=1), ("dp", "tp"))
+
+def make_trainer():
+    model, params, loss_fn = bert.create_model_and_loss(
+        model=bert.bert_tiny(dtype=jnp.float32))
+    t = ElasticTrainer(
+        loss_fn, params, optax.adamw(1e-3), total_batch_size=16,
+        checkpoint_dir=ckpt, mesh=mesh, coord=coord,
+        param_shardings=bert.bert_partition_rules())
+    t.install_preemption_handler(coordinated=True)
+    t._coord_stop._poll = 0.05
+    return t
+
+trainer = make_trainer()
+qkv = trainer.train_state["params"]["layer_0"]["attention"]["query"][
+    "kernel"]
+assert not qkv.is_fully_addressable  # tp crosses the process boundary
+assert trainer._coord_stop is not None
+
+full = bert.synthetic_text_batch(16, seq_len=16)
+host_batch = trainer.local_batch_slice(full)
+stopped_at = None
+for i in range(60):
+    if i == 2 and rank == 1:
+        trainer._preempted = True  # SIGTERM lands on rank 1 ONLY
+    try:
+        trainer.train_step(host_batch)
+    except PreemptedError as e:
+        assert "coordinated stop" in str(e), str(e)
+        stopped_at = trainer.global_step
+        break
+assert stopped_at is not None, "never stopped (rank %d)" % rank
+print("STOPPED rank=%d step=%d" % (rank, stopped_at), flush=True)
+
+from jax.experimental import multihost_utils
+multihost_utils.sync_global_devices("emergency-committed")
+
+trainer2 = make_trainer()
+assert trainer2.resume(), "resume failed"
+assert trainer2.global_step == stopped_at, trainer2.global_step
+trainer2.train_step(host_batch)
+print("RESUMED rank=%d step=%d" % (rank, trainer2.global_step),
+      flush=True)
+"""
+
+
+@pytest.mark.integration
+def test_multihost_tp_coordinated_preemption(tmp_path):
+    """The full coordinated-stop arc across 2 REAL processes with
+    tp-sharded state: SIGTERM on rank 1 only -> store rendezvous on a
+    common stop step -> cooperative SHARDED emergency save at that
+    aligned boundary on both ranks -> both resume from it."""
+    from edl_tpu.coordination.server import StoreServer
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coordinator = "127.0.0.1:%d" % port
+    worker_py = tmp_path / "worker_coord.py"
+    worker_py.write_text(WORKER_TP_COORD)
+    ckpt = str(tmp_path / "ckpt")
+
+    store = StoreServer(host="127.0.0.1").start()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker_py), coordinator, "2", str(rank),
+         ckpt, store.endpoint],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out.decode("utf-8", "replace"))
+            assert p.returncode == 0, "\n".join(outs)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        store.stop()
+    text = "\n".join(outs)
+    assert text.count("STOPPED") == 2, text
+    assert text.count("RESUMED") == 2, text
+    # both ranks stopped at the SAME agreed step
+    steps = sorted(ln.split("step=")[1] for ln in text.splitlines()
+                   if ln.startswith("STOPPED"))
+    assert steps[0] == steps[1], text
+
+
 @pytest.mark.integration
 def test_multihost_dp_emergency_preemption_save(tmp_path):
     """2-process pure-dp job: on preemption rank 0 alone writes a dense

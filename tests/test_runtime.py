@@ -187,6 +187,37 @@ def test_preemption_saves_emergency_checkpoint(tmp_path):
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
 
 
+def test_coordinated_stop_protocol(coord):
+    """CoordinatedStop: a flagged rank's request makes the rank-0 watcher
+    publish stop_at = leader_step + margin, and every rank's watcher
+    observes the same value (the aligned-boundary guarantee)."""
+    import time
+
+    from edl_tpu.runtime.preemption import CoordinatedStop
+
+    c0 = CoordinatedStop(coord, 0, stage="stg1", margin=4,
+                         poll_interval=0.05,
+                         current_step=lambda: 10).start()
+    c1 = CoordinatedStop(coord, 1, stage="stg1",
+                         poll_interval=0.05).start()
+    try:
+        time.sleep(0.2)
+        assert c0.stop_at is None and c1.stop_at is None
+        c1.request(12)  # rank 1 got SIGTERM at its step 12
+        deadline = time.time() + 10
+        while time.time() < deadline and (c0.stop_at is None
+                                          or c1.stop_at is None):
+            time.sleep(0.05)
+        # max(leader step 10, requester step 12) + margin 4
+        assert c0.stop_at == 16 and c1.stop_at == 16
+        # a different stage (a restarted incarnation) sees nothing
+        c2 = CoordinatedStop(coord, 1, stage="stg2", poll_interval=0.05)
+        assert c2._read_stop_at() is None
+    finally:
+        c0.stop()
+        c1.stop()
+
+
 def test_resume_preserves_adjust_hooks_and_extra_state(tmp_path):
     trainer, make_batch, _ = _linreg_trainer(tmp_path)
     trainer.begin_epoch(0)
