@@ -21,11 +21,11 @@ BINARY = os.path.join(NATIVE_DIR, "build", "edl_tpu_store")
 
 def ensure_binary():
     """Return the binary path, (re)building via make — a no-op when the
-    build is already up to date with the sources."""
-    result = subprocess.run(["make"], cwd=NATIVE_DIR, check=True,
-                            capture_output=True, text=True)
-    if "up to date" not in result.stdout:
-        logger.info("built native store server in %s", NATIVE_DIR)
+    build is already up to date; serialized across processes (see
+    edl_tpu.utils.buildlock)."""
+    from edl_tpu.utils.buildlock import locked_make
+    locked_make(NATIVE_DIR, "build/edl_tpu_store",
+                what="native store server")
     return BINARY
 
 

@@ -1,0 +1,162 @@
+"""ctypes bindings for the native (C++) image data loader.
+
+native/data_loader.cc is the production host-feed path for TPU VMs (the
+DALI role — SURVEY.md §2.6): threaded libjpeg decode + augment + in-order
+batch assembly behind a bounded queue, yielding the same {"image",
+"label"} numpy batches as edl_tpu.data.input_pipeline's tf.data path
+(identical normalization constants and augmentation semantics, so the
+two are drop-in interchangeable; `examples/resnet/train.py --loader
+native` selects this one). Falls back loudly, not silently: callers opt
+in, and a missing toolchain raises at construction.
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+from edl_tpu.utils.logger import logger
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO, "native")
+LIB_PATH = os.path.join(NATIVE_DIR, "build", "libedl_tpu_loader.so")
+
+_lib = None
+
+
+def ensure_loader_lib():
+    """Build (make, a no-op when fresh) and dlopen the loader library.
+    The build is target-specific and runs under an exclusive file lock:
+    N host processes starting together must not race two compilers onto
+    the same .so (a truncated library loads as garbage)."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    from edl_tpu.utils.buildlock import locked_make
+    locked_make(NATIVE_DIR, "build/libedl_tpu_loader.so",
+                what="native data loader")
+    lib = ctypes.CDLL(LIB_PATH)
+    lib.edl_loader_create.restype = ctypes.c_void_p
+    lib.edl_loader_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.edl_loader_next.restype = ctypes.c_int
+    lib.edl_loader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.edl_loader_error_count.restype = ctypes.c_long
+    lib.edl_loader_error_count.argtypes = [ctypes.c_void_p]
+    lib.edl_loader_destroy.restype = None
+    lib.edl_loader_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeImageLoader(object):
+    """One pass over ``files`` ([(path, label)]) as an iterator of
+    {"image": [rows, S, S, 3] float32, "label": [rows] int32} batches.
+
+    train=True shuffles (by ``seed``), augments (random crop + flip,
+    per-item deterministic), and drops the ragged tail; eval keeps file
+    order and yields the tail. Re-create per epoch with a fresh seed —
+    the reference's pass_id-seeded reader contract."""
+
+    def __init__(self, files, batch_size, image_size=224, train=True,
+                 seed=0, num_threads=None, queue_depth=3):
+        if not files:
+            raise ValueError("no input files")
+        for p, _ in files:
+            if not p.lower().endswith((".jpg", ".jpeg")):
+                raise ValueError(
+                    "native loader decodes JPEG only; %r is not (use the "
+                    "tf.data pipeline for mixed formats)" % p)
+        self._lib = ensure_loader_lib()
+        self._batch = batch_size
+        self._size = image_size
+        paths = (ctypes.c_char_p * len(files))(
+            *[p.encode() for p, _ in files])
+        labels = (ctypes.c_int32 * len(files))(*[l for _, l in files])
+        if num_threads is None:
+            num_threads = min(8, os.cpu_count() or 1)
+        self._handle = self._lib.edl_loader_create(
+            paths, labels, len(files), batch_size, image_size,
+            1 if train else 0, seed & (2**64 - 1), num_threads,
+            queue_depth, 1 if train else 0)
+        if not self._handle:
+            raise RuntimeError("native loader creation failed "
+                               "(empty after drop_remainder?)")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._handle is None:
+            raise StopIteration
+        img = np.empty((self._batch, self._size, self._size, 3),
+                       np.float32)
+        lbl = np.empty((self._batch,), np.int32)
+        rows = self._lib.edl_loader_next(
+            self._handle,
+            img.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            lbl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rows < 0:
+            raise RuntimeError("native loader failed")
+        if rows == 0:
+            self.close()
+            raise StopIteration
+        return {"image": img[:rows], "label": lbl[:rows]}
+
+    @property
+    def decode_errors(self):
+        """Files that failed to read/decode so far (rows zero-filled);
+        keeps the final count after close()."""
+        if self._handle is None:
+            return getattr(self, "_errors_final", 0)
+        return int(self._lib.edl_loader_error_count(self._handle))
+
+    def close(self):
+        if self._handle is not None:
+            self._errors_final = int(
+                self._lib.edl_loader_error_count(self._handle))
+            self._lib.edl_loader_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pragma: no cover — interpreter teardown
+            pass
+
+
+def native_image_folder_pipeline(root, batch_size, image_size=224,
+                                 train=True, epoch_seed=0, shard_index=0,
+                                 shard_count=1, num_threads=None):
+    """Drop-in counterpart of input_pipeline.image_folder_pipeline backed
+    by the native loader: same directory layout, sharding (every
+    shard_count-th file), per-epoch seeding, and batch contract."""
+    from edl_tpu.data.input_pipeline import list_image_files
+
+    files, _ = list_image_files(root)
+    files = files[shard_index::shard_count]
+    if not files:
+        raise ValueError("no images under %s for shard %d/%d"
+                         % (root, shard_index, shard_count))
+    loader = NativeImageLoader(files, batch_size, image_size=image_size,
+                               train=train, seed=epoch_seed,
+                               num_threads=num_threads)
+    try:
+        for batch in loader:
+            yield batch
+    finally:
+        loader.close()
+        if loader.decode_errors:
+            logger.warning("native loader: %d files failed to decode "
+                           "(zero-filled)", loader.decode_errors)

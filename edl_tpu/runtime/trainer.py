@@ -458,6 +458,8 @@ class ElasticTrainer(object):
         self._save_thread = None
         self._preempted = False
         self._coord_stop = None
+        self._preempt_t0 = None
+        self._coord_deadline = 15.0
         # non-daemon writer + atexit join: process exit must not lose the
         # final checkpoint mid-write (manifest-last keeps partials
         # invisible, but losing the last epoch silently is a regression).
@@ -526,10 +528,21 @@ class ElasticTrainer(object):
                 self._coord_stop.start()
             if self._preempted:
                 self._coord_stop.request(self._host_step)
+                if self._preempt_t0 is None:
+                    self._preempt_t0 = time.monotonic()
             stop = self._coord_stop.stop_at
             if stop is not None and self._host_step >= stop:
                 self._coordinated_save_and_raise(missed=self._host_step
                                                  > stop)
+            elif self._preempted and (time.monotonic() - self._preempt_t0
+                                      > self._coord_deadline):
+                # no agreed stop within the deadline (store unreachable,
+                # rank 0 dead): the local emergency path is strictly
+                # better than training until SIGKILL with no checkpoint
+                logger.warning("no coordinated stop within %.0fs; "
+                               "falling back to the local emergency "
+                               "save", self._coord_deadline)
+                self._emergency_save()
         elif self._preempted:
             self._emergency_save()
         return loss
@@ -695,13 +708,16 @@ class ElasticTrainer(object):
             # per-process restart (liveft exit-101) cannot resume an
             # older version than rank 0 does. The launcher's stop-resume
             # path re-barriers the whole cluster and needs no wait.
-            import time
+            # rank 0's emergency version is its boundary step, within
+            # dispatch skew of ours — waiting for "newer than a post-hoc
+            # max" would never fire when rank 0 committed FIRST, burning
+            # the whole grace window in the fast case
+            target_floor = self._host_step - 3
             try:
-                baseline = max(self._ckpt.versions() or [0])
                 deadline = time.monotonic() + 10.0
                 while time.monotonic() < deadline:
                     vs = self._ckpt.versions()
-                    if vs and max(vs) > baseline:
+                    if vs and max(vs) >= target_floor:
                         break
                     time.sleep(0.25)
             except Exception:

@@ -1,0 +1,30 @@
+"""Serialized, target-specific `make` for the in-tree native components.
+
+Multiple trainer processes starting on one VM all try to ensure their
+native binaries at import time; two concurrent compilers writing the
+same output file produce a truncated binary/library. An exclusive flock
+on a per-directory lockfile serializes them (the losers find the target
+up to date), and building the SPECIFIC target keeps an unrelated
+component's compile error from blocking this one.
+"""
+
+import fcntl
+import os
+import subprocess
+
+from edl_tpu.utils.logger import logger
+
+
+def locked_make(native_dir, target, what="native component"):
+    lock_path = os.path.join(native_dir, ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            result = subprocess.run(["make", target], cwd=native_dir,
+                                    check=True, capture_output=True,
+                                    text=True)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    if "up to date" not in result.stdout:
+        logger.info("built %s in %s", what, native_dir)
+    return os.path.join(native_dir, target)

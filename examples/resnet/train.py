@@ -59,6 +59,9 @@ def main(argv=None):
                         "jpegs); default = synthetic stream")
     p.add_argument("--eval_dir", default=None,
                    help="image-folder eval split (with --data_dir)")
+    p.add_argument("--loader", choices=["tf", "native"], default="tf",
+                   help="host decode pipeline: tf.data (portable) or "
+                        "the C++ native loader (production TPU-VM feed)")
     args = p.parse_args(argv)
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
@@ -110,10 +113,15 @@ def main(argv=None):
         else the deterministic synthetic stream), capped at
         steps_per_epoch."""
         if args.data_dir:
-            from edl_tpu.data.input_pipeline import image_folder_pipeline
+            if args.loader == "native":
+                from edl_tpu.data.native_loader import (
+                    native_image_folder_pipeline as folder_pipeline)
+            else:
+                from edl_tpu.data.input_pipeline import (
+                    image_folder_pipeline as folder_pipeline)
             n = 0
             while n < args.steps_per_epoch:  # cycle the folder if short
-                for b in image_folder_pipeline(
+                for b in folder_pipeline(
                         args.data_dir, trainer.per_host_batch,
                         image_size=args.image_size, train=True,
                         epoch_seed=epoch * 131 + n,
@@ -135,8 +143,13 @@ def main(argv=None):
 
     def eval_batches():
         if args.eval_dir:
-            from edl_tpu.data.input_pipeline import image_folder_pipeline
-            return image_folder_pipeline(
+            if args.loader == "native":
+                from edl_tpu.data.native_loader import (
+                    native_image_folder_pipeline as folder_pipeline)
+            else:
+                from edl_tpu.data.input_pipeline import (
+                    image_folder_pipeline as folder_pipeline)
+            return folder_pipeline(
                 args.eval_dir, args.total_batch_size,
                 image_size=args.image_size, train=False)
         return (resnet.synthetic_image_batch(

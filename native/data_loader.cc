@@ -1,0 +1,377 @@
+// Native host-side image data loader — the C++ half of the DALI role.
+//
+// The Python tf.data pipeline (edl_tpu/data/input_pipeline.py) is the
+// portable path; this loader is the production path for TPU VMs where
+// the host CPU feeds the chips and Python-side decode becomes the
+// bottleneck. Same contract as image_folder_pipeline: JPEG decode,
+// train = bilinear resize to 1.15*S square -> random SxS crop ->
+// random horizontal flip, eval = bilinear resize to SxS; ImageNet
+// mean/std normalization; deterministic per-item RNG (derived from the
+// global seed and the item's position, independent of thread
+// interleaving); in-order batch assembly with a bounded in-flight
+// window for back-pressure.
+//
+// C ABI (ctypes — see edl_tpu/data/native_loader.py):
+//   edl_loader_create(paths, labels, n, batch, image_size, train, seed,
+//                     threads, queue_depth, drop_remainder) -> handle
+//   edl_loader_next(handle, images_out, labels_out) -> rows (0 = end)
+//   edl_loader_error_count(handle) -> decode failures so far (zero-filled)
+//   edl_loader_destroy(handle)
+//
+// Build: part of native/Makefile (-ljpeg; libjpeg is the same decoder
+// tf.io.decode_jpeg uses, so pixel output matches the tf pipeline).
+
+#include <cstdio>  // jpeglib.h needs FILE declared first
+
+#include <jpeglib.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ImageNet mean/std in 0..255 scale — MUST match input_pipeline.py.
+const float kMean[3] = {0.485f * 255.f, 0.456f * 255.f, 0.406f * 255.f};
+const float kStd[3] = {0.229f * 255.f, 0.224f * 255.f, 0.225f * 255.f};
+
+uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+void jpeg_error_exit(j_common_ptr cinfo) {
+  JpegErr* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// Decode a JPEG byte buffer to tightly-packed RGB; false on failure.
+bool decode_jpeg(const unsigned char* data, size_t len,
+                 std::vector<unsigned char>* rgb, int* w, int* h) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_error_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(data),
+               static_cast<unsigned long>(len));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = static_cast<int>(cinfo.output_width);
+  *h = static_cast<int>(cinfo.output_height);
+  if (*w <= 0 || *h <= 0 || cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  rgb->resize(static_cast<size_t>(*w) * *h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = rgb->data() +
+        static_cast<size_t>(cinfo.output_scanline) * *w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear resize (half-pixel centers, no antialias — tf.image.resize's
+// default) from uint8 HWC to float HWC.
+void resize_bilinear(const unsigned char* src, int sw, int sh,
+                     float* dst, int dw, int dh) {
+  const float sx = static_cast<float>(sw) / dw;
+  const float sy = static_cast<float>(sh) / dh;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = static_cast<int>(std::floor(fy));
+    float wy = fy - y0;
+    int y1 = std::min(y0 + 1, sh - 1);
+    y0 = std::max(y0, 0);
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = static_cast<int>(std::floor(fx));
+      float wx = fx - x0;
+      int x1 = std::min(x0 + 1, sw - 1);
+      x0 = std::max(x0, 0);
+      const unsigned char* p00 = src + (static_cast<size_t>(y0) * sw + x0) * 3;
+      const unsigned char* p01 = src + (static_cast<size_t>(y0) * sw + x1) * 3;
+      const unsigned char* p10 = src + (static_cast<size_t>(y1) * sw + x0) * 3;
+      const unsigned char* p11 = src + (static_cast<size_t>(y1) * sw + x1) * 3;
+      float* out = dst + (static_cast<size_t>(y) * dw + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float top = p00[c] * (1 - wx) + p01[c] * wx;
+        float bot = p10[c] * (1 - wx) + p11[c] * wx;
+        out[c] = top * (1 - wy) + bot * wy;
+      }
+    }
+  }
+}
+
+struct Batch {
+  std::vector<float> images;
+  std::vector<int32_t> labels;
+  int rows = 0;        // expected rows in this batch
+  int filled = 0;      // decoded rows so far
+  int index = -1;      // which batch this slot currently holds
+};
+
+struct Loader {
+  std::vector<std::string> paths;
+  std::vector<int32_t> labels;
+  std::vector<int> order;  // shuffled item order
+  int batch = 0;
+  int image_size = 0;
+  bool train = false;
+  uint64_t seed = 0;
+  int queue_depth = 0;
+  bool drop_remainder = false;
+  int num_batches = 0;
+
+  std::mutex mu;
+  std::condition_variable cv_work;   // workers wait: window / items
+  std::condition_variable cv_ready;  // consumer waits: batch complete
+  int next_item = 0;   // next item position to hand to a worker
+  int base = 0;        // next batch index the consumer will take
+  bool stopping = false;
+  std::vector<Batch> slots;
+  std::vector<std::thread> threads;
+  std::atomic<long> decode_errors{0};
+
+  int item_count() const {
+    return drop_remainder ? num_batches * batch
+                          : static_cast<int>(order.size());
+  }
+
+  Batch* slot_for(int batch_idx) { return &slots[batch_idx % queue_depth]; }
+
+  // Prepare the slot for batch_idx (caller holds mu). Slots recycle in
+  // ring order, so by the time batch_idx maps to a slot the previous
+  // occupant (batch_idx - queue_depth) has been consumed.
+  void arm_slot(int batch_idx) {
+    Batch* b = slot_for(batch_idx);
+    if (b->index == batch_idx) return;
+    b->index = batch_idx;
+    b->filled = 0;
+    int start = batch_idx * batch;
+    b->rows = std::min(batch, item_count() - start);
+    std::fill(b->images.begin(), b->images.end(), 0.f);
+    std::fill(b->labels.begin(), b->labels.end(), 0);
+  }
+
+  void worker() {
+    std::vector<unsigned char> file_buf, rgb, crop_src;
+    std::vector<float> resized;
+    for (;;) {
+      int pos;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] {
+          return stopping ||
+                 (next_item < item_count() &&
+                  next_item / batch < base + queue_depth);
+        });
+        if (stopping) return;
+        pos = next_item++;
+        arm_slot(pos / batch);
+      }
+      process_item(pos, &file_buf, &rgb, &resized);
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        Batch* b = slot_for(pos / batch);
+        if (++b->filled == b->rows) cv_ready.notify_all();
+      }
+    }
+  }
+
+  void process_item(int pos, std::vector<unsigned char>* file_buf,
+                    std::vector<unsigned char>* rgb,
+                    std::vector<float>* resized) {
+    const int S = image_size;
+    Batch* b = slot_for(pos / batch);
+    float* out = b->images.data() +
+        static_cast<size_t>(pos % batch) * S * S * 3;
+    int item = order[pos];
+    b->labels[pos % batch] = labels[item];
+
+    bool ok = false;
+    FILE* f = std::fopen(paths[item].c_str(), "rb");
+    if (f) {
+      std::fseek(f, 0, SEEK_END);
+      long n = std::ftell(f);
+      std::fseek(f, 0, SEEK_SET);
+      if (n > 0) {
+        file_buf->resize(n);
+        ok = std::fread(file_buf->data(), 1, n, f) ==
+             static_cast<size_t>(n);
+      }
+      std::fclose(f);
+    }
+    int w = 0, h = 0;
+    if (ok) ok = decode_jpeg(file_buf->data(), file_buf->size(), rgb, &w, &h);
+    if (!ok) {
+      decode_errors.fetch_add(1);
+      return;  // slot was zero-filled on arm
+    }
+
+    // per-ITEM rng: identical augmentation regardless of which thread
+    // or order the item is processed in
+    uint64_t rs = seed ^ (0x9E3779B97F4A7C15ull * (uint64_t(pos) + 1));
+    if (train) {
+      int R = static_cast<int>(std::lround(S * 1.15));
+      resized->resize(static_cast<size_t>(R) * R * 3);
+      resize_bilinear(rgb->data(), w, h, resized->data(), R, R);
+      int max_off = R - S;
+      int ox = static_cast<int>(splitmix64(&rs) % (max_off + 1));
+      int oy = static_cast<int>(splitmix64(&rs) % (max_off + 1));
+      bool flip = (splitmix64(&rs) & 1) != 0;
+      for (int y = 0; y < S; ++y) {
+        const float* src_row = resized->data() +
+            (static_cast<size_t>(y + oy) * R + ox) * 3;
+        float* dst_row = out + static_cast<size_t>(y) * S * 3;
+        for (int x = 0; x < S; ++x) {
+          const float* px = src_row + static_cast<size_t>(x) * 3;
+          float* q = dst_row +
+              static_cast<size_t>(flip ? S - 1 - x : x) * 3;
+          for (int c = 0; c < 3; ++c)
+            q[c] = (px[c] - kMean[c]) / kStd[c];
+        }
+      }
+    } else {
+      resized->resize(static_cast<size_t>(S) * S * 3);
+      resize_bilinear(rgb->data(), w, h, resized->data(), S, S);
+      for (size_t i = 0; i < resized->size(); i += 3)
+        for (int c = 0; c < 3; ++c)
+          out[i + c] = ((*resized)[i + c] - kMean[c]) / kStd[c];
+    }
+  }
+
+  int next(float* images, int32_t* labels_out) {
+    Batch* b;
+    int rows;
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      if (base >= num_batches) return 0;
+      arm_slot(base);  // ensure armed even if no worker touched it yet
+      b = slot_for(base);
+      cv_ready.wait(lk, [&] { return stopping || b->filled == b->rows; });
+      if (stopping) return -1;
+      rows = b->rows;
+    }
+    // copy OUTSIDE the mutex: this is ~100s of MB per large batch and
+    // must not stall the decode workers. Safe: the slot stays bound to
+    // batch `base` (arm_slot only recycles it for batch base+W, which
+    // workers may not touch until base advances below) and every
+    // producer for it finished before filled == rows was observed.
+    std::memcpy(images, b->images.data(),
+                static_cast<size_t>(rows) * image_size * image_size * 3 *
+                    sizeof(float));
+    std::memcpy(labels_out, b->labels.data(),
+                static_cast<size_t>(rows) * sizeof(int32_t));
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      ++base;
+    }
+    cv_work.notify_all();  // window advanced
+    return rows;
+  }
+
+  void stop() {
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_work.notify_all();
+    cv_ready.notify_all();
+    for (auto& t : threads) t.join();
+    threads.clear();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* edl_loader_create(const char** paths, const int32_t* labels,
+                        int n_files, int batch, int image_size, int train,
+                        uint64_t seed, int num_threads, int queue_depth,
+                        int drop_remainder) {
+  if (n_files <= 0 || batch <= 0 || image_size <= 0) return nullptr;
+  Loader* L = new Loader();
+  L->paths.reserve(n_files);
+  L->labels.assign(labels, labels + n_files);
+  for (int i = 0; i < n_files; ++i) L->paths.emplace_back(paths[i]);
+  L->batch = batch;
+  L->image_size = image_size;
+  L->train = train != 0;
+  L->seed = seed;
+  L->queue_depth = std::max(1, queue_depth);
+  L->drop_remainder = drop_remainder != 0;
+
+  L->order.resize(n_files);
+  for (int i = 0; i < n_files; ++i) L->order[i] = i;
+  if (L->train) {
+    uint64_t rs = seed;
+    for (int i = n_files - 1; i > 0; --i) {
+      int j = static_cast<int>(splitmix64(&rs) % (uint64_t(i) + 1));
+      std::swap(L->order[i], L->order[j]);
+    }
+  }
+  L->num_batches = L->drop_remainder ? n_files / batch
+                                     : (n_files + batch - 1) / batch;
+  if (L->num_batches == 0) {
+    delete L;
+    return nullptr;
+  }
+  L->slots.resize(L->queue_depth);
+  for (auto& s : L->slots) {
+    s.images.resize(static_cast<size_t>(batch) * image_size * image_size *
+                    3);
+    s.labels.resize(batch);
+  }
+  int nt = std::max(1, num_threads);
+  for (int i = 0; i < nt; ++i)
+    L->threads.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+int edl_loader_next(void* h, float* images, int32_t* labels) {
+  if (!h) return -1;
+  return static_cast<Loader*>(h)->next(images, labels);
+}
+
+long edl_loader_error_count(void* h) {
+  if (!h) return -1;
+  return static_cast<Loader*>(h)->decode_errors.load();
+}
+
+void edl_loader_destroy(void* h) {
+  if (!h) return;
+  Loader* L = static_cast<Loader*>(h);
+  L->stop();
+  delete L;
+}
+
+}  // extern "C"
