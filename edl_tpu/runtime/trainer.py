@@ -889,19 +889,26 @@ class ElasticTrainer(object):
                 else np.asarray(x)
             return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
 
+        # placed restore: each process reads only the shard entries its
+        # devices need and assembles the sharded jax.Arrays directly —
+        # host memory stays O(local shards), no full-model materialize
         target = jax.tree_util.tree_map(_spec, dict(self.train_state))
         restored = None
         for version in reversed(self._ckpt.versions()):
             try:
-                restored = self._ckpt.restore(version, target=target)
+                restored = self._ckpt.restore_placed(
+                    version, target, self._state_shardings)
                 break
             except Exception as e:  # noqa: BLE001
                 if isinstance(e, MissingKeysError) \
                         and jax.tree_util.tree_leaves(target["extra"]):
                     core = dict(target)
                     core.pop("extra")
+                    core_sh = dict(self._state_shardings)
+                    core_sh.pop("extra")
                     try:
-                        restored = self._ckpt.restore(version, target=core)
+                        restored = self._ckpt.restore_placed(
+                            version, core, core_sh)
                         logger.info("checkpoint v%d has no extra state; "
                                     "keeping the initial one", version)
                         # the live (initial) extra arrays, already laid
@@ -915,7 +922,7 @@ class ElasticTrainer(object):
         if restored is None:
             return False
         version, tree, meta = restored
-        self.train_state = jax.device_put(tree, self._state_shardings)
+        self.train_state = tree
         if meta.get("state"):
             # hooks are process-local: carry them onto the restored state
             self.state = self.state.carry_hooks_to(
