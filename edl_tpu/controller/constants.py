@@ -31,3 +31,9 @@ BARRIER_TIMEOUT = int(os.environ.get("EDL_TPU_BARRIER_TIMEOUT", "600"))
 RESIZE_BARRIER_TIMEOUT = int(
     os.environ.get("EDL_TPU_RESIZE_BARRIER_TIMEOUT", "120"))
 FLAG_WAIT_TIMEOUT = int(os.environ.get("EDL_TPU_FLAG_WAIT_TIMEOUT", "300"))
+# trainers exiting with this code were PREEMPTED after an emergency
+# checkpoint (liveft restart convention) — not failed; the launcher
+# awaits the membership change and respawns in place if none comes
+PREEMPT_EXIT_CODE = 101
+PREEMPT_RESPAWN_WAIT = float(
+    os.environ.get("EDL_TPU_PREEMPT_RESPAWN_WAIT", "20"))

@@ -179,16 +179,34 @@ class Launcher(object):
         return True
 
     def _supervise(self):
+        awaiting_since = None  # set when trainers exited PREEMPTED (101)
         while True:
             time.sleep(constants.SUPERVISE_INTERVAL)
 
-            done, failed = train_process.watch_trainers(self._procs)
-            if failed:
-                logger.error("a trainer failed on pod %s", self._pod.id)
-                return self._exit(False)
-            if done:
-                logger.info("all trainers on pod %s finished", self._pod.id)
-                return self._exit(True)
+            if self._procs:
+                done, failed = train_process.watch_trainers(self._procs)
+                if failed:
+                    codes = {tp.proc.returncode for tp in self._procs
+                             if tp.proc.poll() not in (None, 0)}
+                    if codes == {constants.PREEMPT_EXIT_CODE}:
+                        # preempted, not failed: an emergency checkpoint
+                        # was written (or the epoch one stands); await
+                        # the membership change that usually caused this
+                        logger.info("trainers preempted (exit %d) on pod "
+                                    "%s; awaiting resize",
+                                    constants.PREEMPT_EXIT_CODE,
+                                    self._pod.id)
+                        train_process.terminate_trainers(self._procs)
+                        self._procs = []
+                        awaiting_since = time.monotonic()
+                    else:
+                        logger.error("a trainer failed on pod %s",
+                                     self._pod.id)
+                        return self._exit(False)
+                elif done:
+                    logger.info("all trainers on pod %s finished",
+                                self._pod.id)
+                    return self._exit(True)
 
             if self._resource_register.is_broken():
                 logger.error("resource registration lost; killing trainers")
@@ -204,10 +222,30 @@ class Launcher(object):
                         logger.info("pod %s evicted during resize; clean "
                                     "exit", self._pod.id)
                         return True
+                    awaiting_since = None
                 except errors.EdlError as e:
                     logger.error("resize failed on pod %s: %r", self._pod.id,
                                  e)
                     return self._exit(False)
+            elif awaiting_since is not None and (
+                    time.monotonic() - awaiting_since
+                    > max(constants.PREEMPT_RESPAWN_WAIT,
+                          # a real pod eviction needs lease expiry +
+                          # (possibly) re-election + generator publish +
+                          # watcher poll to surface; respawning against
+                          # the stale cluster before that wastes a
+                          # restart cycle on a dead coordinator
+                          2 * constants.ETCD_TTL + 5)):
+                # the preemption was trainer-only (no pod left the
+                # cluster): respawn in place; trainers resume from the
+                # emergency checkpoint
+                logger.info("no resize followed the preemption; "
+                            "respawning trainers in place on pod %s",
+                            self._pod.id)
+                self._procs = train_process.start_trainers(
+                    self._job_env, self._pod, self._cluster, self._script,
+                    self._script_args, self._job_env.log_dir)
+                awaiting_since = None
 
     def _resize(self):
         """Stop-resume elasticity (reference: launcher.py:221-244): kill

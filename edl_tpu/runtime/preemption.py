@@ -33,6 +33,13 @@ the supervisor's SIGKILL after the grace period. The checkpoint is
 best-effort under pathological skew — never corrupted, and the failure
 mode equals not having the feature.
 
+Caveat: the stop is enforced at HOST step boundaries, so a training
+loop that never synchronizes (no loss fetch, no metrics) can dispatch
+far past the agreed step before its watcher observes it — the margin
+covers normal dispatch-ahead, not a free-running dispatch loop. Real
+loops sync every step or few (loss logging, metrics), which is the
+cadence the adaptive margin is computed from.
+
 Reference role: the reference had no mid-epoch preemption save at all
 (per-epoch checkpoints only, train_with_fleet.py:562); this is net-new
 elasticity depth for TPU pods, where preemption is routine.

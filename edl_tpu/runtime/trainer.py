@@ -451,6 +451,12 @@ class ElasticTrainer(object):
 
         self._jit_step = self._build_step()
         self._step_times = []
+        # start-to-start wall intervals (NOT in-call durations: jit
+        # dispatch returns in ~ms while the real cadence includes data
+        # loading and device time) — the preemption stop margin must be
+        # computed from the true step rate
+        self._step_intervals = []
+        self._last_step_start = None
         # host-side mirror of the step counter: seeds default rngs without
         # forcing a device sync on the donated step array every step
         self._host_step = 0
@@ -506,8 +512,14 @@ class ElasticTrainer(object):
                     self._batch_sharding, x), host_batch)
         return jax.device_put(host_batch, self._batch_sharding)
 
+    _STEP_WINDOW = 8  # intervals kept for the cadence estimate
+
     def train_step(self, host_batch, rng=None):
         t0 = time.perf_counter()
+        if self._last_step_start is not None:
+            self._step_intervals.append(t0 - self._last_step_start)
+            del self._step_intervals[:-self._STEP_WINDOW]
+        self._last_step_start = t0
         if rng is None:
             rng = jax.random.PRNGKey(self._host_step)
         if self._grad_accum > 1:
@@ -603,9 +615,14 @@ class ElasticTrainer(object):
         return self
 
     def _recent_step_time(self):
-        """Mean of the last few step wall times (0.0 when unknown) — the
-        preemption leader converts watcher poll latency into steps."""
-        tail = self._step_times[-5:]
+        """Mean of the recent start-to-start step intervals (0.0 when
+        unknown) — the preemption leader converts watcher poll latency
+        into steps. Start-to-start MEAN, not in-call time or a median:
+        async jit dispatch returns in milliseconds, and a loop that
+        syncs only every k steps shows k-1 tiny gaps plus one gap
+        carrying the device time — the mean recovers the true per-step
+        cadence where a median would collapse to the dispatch gap."""
+        tail = self._step_intervals
         return sum(tail) / len(tail) if tail else 0.0
 
     def _on_preempt_signal(self, signum, frame):

@@ -31,14 +31,27 @@ from edl_tpu.utils.logger import logger
 
 
 class ResizeDriver(object):
+    """``stop_signal="kill"`` models hard preemption (SIGKILL, the
+    reference demo's behavior); ``"term"`` models GRACEFUL preemption
+    (k8s pod deletion): the launcher group gets SIGTERM, trainers with
+    the preemption handler write a grace-window emergency checkpoint,
+    and stragglers are SIGKILLed after ``grace`` seconds. Recovery
+    events then carry ``resumed_step`` (the store-visible global step)
+    so drills can compare steps-lost-per-preemption across modes."""
+
     def __init__(self, store_endpoints, job_id, nodes_range, script_argv,
-                 log_dir="./resize_driver_logs", env_extra=None):
+                 log_dir="./resize_driver_logs", env_extra=None,
+                 stop_signal="kill", grace=10.0):
+        if stop_signal not in ("kill", "term"):
+            raise ValueError("stop_signal must be 'kill' or 'term'")
         self._store_endpoints = store_endpoints
         self._job_id = job_id
         self._nodes_range = nodes_range
         self._script_argv = list(script_argv)
         self._log_dir = log_dir
         self._env_extra = env_extra or {}
+        self._stop_signal = stop_signal
+        self._grace = grace
         self._coord = CoordClient(store_endpoints, root=job_id)
         self._pods = []  # list of Popen
         self._counter = 0
@@ -73,6 +86,52 @@ class ResizeDriver(object):
         except ProcessLookupError:
             pass
 
+    def _terminate_launcher(self, proc):
+        """Graceful preemption: SIGTERM the group (trainers included) so
+        preemption handlers can write their emergency checkpoint."""
+        logger.info("resize driver: SIGTERM launcher pid %d (graceful "
+                    "preemption, %.0fs grace)", proc.pid, self._grace)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+
+    def _reap(self, victims):
+        """Wait up to the grace period for SIGTERMed process GROUPS to
+        exit, then SIGKILL stragglers (the k8s deletion contract). The
+        launcher itself dies instantly (default SIGTERM disposition),
+        so the deadline must be enforced on the whole group — orphaned
+        trainers finishing their emergency save, or stuck in a save
+        barrier, are the processes the grace/SIGKILL is FOR. setsid at
+        spawn makes pgid == launcher pid, valid after the leader dies."""
+        deadline = time.monotonic() + self._grace
+
+        def group_alive(pgid):
+            try:
+                os.killpg(pgid, 0)
+                return True
+            except ProcessLookupError:
+                return False
+
+        pgids = [p.pid for p in victims]
+        while time.monotonic() < deadline and any(
+                group_alive(g) for g in pgids):
+            for p in victims:
+                if p.poll() is None:
+                    try:
+                        p.wait(timeout=0.05)
+                    except subprocess.TimeoutExpired:
+                        pass
+            time.sleep(0.2)
+        for g in pgids:
+            if group_alive(g):
+                logger.warning("resize driver: grace expired for group "
+                               "%d; SIGKILL", g)
+                try:
+                    os.killpg(g, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
     def _alive_pods(self):
         self._pods = [p for p in self._pods if p.poll() is None]
         return self._pods
@@ -80,9 +139,16 @@ class ResizeDriver(object):
     def set_target(self, n):
         """Adjust the live launcher count to ``n``; kills newest first."""
         alive = self._alive_pods()
+        victims = []
         while len(alive) > n:
             victim = alive.pop()
-            self._kill_launcher(victim)
+            if self._stop_signal == "term":
+                self._terminate_launcher(victim)
+                victims.append(victim)
+            else:
+                self._kill_launcher(victim)
+        if victims:
+            self._reap(victims)
         while len(alive) < n:
             alive.append(self._spawn_launcher())
         self._pods = alive
@@ -111,12 +177,22 @@ class ResizeDriver(object):
                                                 prev_stage=prev_stage)
             prev_stage = cluster.stage
             event = {"target": target, "recovery_s": round(waited, 2),
-                     "stage": cluster.stage, "ts": round(t0, 1)}
+                     "stage": cluster.stage, "ts": round(t0, 1),
+                     "resumed_step": self._store_global_step()}
             self.events.append(event)
             logger.info("resize driver: reached %d pods in %.2fs", target,
                         waited)
             time.sleep(interval)
         return self.events
+
+    def _store_global_step(self):
+        """The trainers' last store-published global step (None early)."""
+        try:
+            from edl_tpu.runtime import state as state_mod
+            st = state_mod.load_from_store(self._coord)
+            return None if st is None else int(st.global_step)
+        except Exception:
+            return None
 
     def shutdown(self, kill=True):
         for p in self._alive_pods():
@@ -135,6 +211,14 @@ def main():
                         help="seconds to hold each target")
     parser.add_argument("--nodes_range", default="1:16")
     parser.add_argument("--log_dir", default="./resize_driver_logs")
+    parser.add_argument("--signal", choices=("kill", "term"),
+                        default="kill",
+                        help="kill = hard preemption (SIGKILL); term = "
+                             "graceful (SIGTERM + grace, triggering the "
+                             "trainers' emergency checkpoints)")
+    parser.add_argument("--grace", type=float, default=10.0,
+                        help="seconds between SIGTERM and SIGKILL in "
+                             "--signal term mode")
     parser.add_argument("script_argv", nargs=argparse.REMAINDER,
                         help="-- training script and args")
     args = parser.parse_args()
@@ -143,7 +227,8 @@ def main():
         argv = argv[1:]
     schedule = [int(x) for x in args.schedule.split(",")]
     driver = ResizeDriver(args.store_endpoints, args.job_id,
-                          args.nodes_range, argv, log_dir=args.log_dir)
+                          args.nodes_range, argv, log_dir=args.log_dir,
+                          stop_signal=args.signal, grace=args.grace)
     try:
         events = driver.run_schedule(schedule, args.interval)
     except BaseException:

@@ -401,6 +401,70 @@ def test_resize_driver_schedule(store, tmp_path):
 
 
 @pytest.mark.integration
+def test_resize_driver_graceful_preemption(store, tmp_path):
+    """--signal term: the graceful-preemption drill. SIGTERM reaches the
+    victim pod's whole group; the trainers' coordinated stop writes a
+    MID-EPOCH emergency checkpoint across ranks; the surviving launcher
+    treats exit-101 as preemption (not failure) and the resized cluster
+    resumes — steps survive that a SIGKILL drill would replay."""
+    import glob
+
+    from edl_tpu.runtime.checkpoint import CheckpointManager
+
+    driver = ResizeDriver(
+        store.endpoint, "graceful_job", "1:2",
+        [os.path.join(REPO, "examples", "fit_a_line", "train.py"),
+         "--epochs", "100", "--steps_per_epoch", "50",
+         "--step_sleep", "0.1"],
+        log_dir=str(tmp_path), stop_signal="term", grace=15.0,
+        env_extra={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                   "EDL_TPU_POD_IP": "127.0.0.1", "EDL_TPU_TTL": "3",
+                   "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                   "EDL_TPU_CHECKPOINT_PATH": str(tmp_path / "ckpt"),
+                   "PALLAS_AXON_POOL_IPS": ""})
+    try:
+        import time
+
+        from edl_tpu.controller import train_status as ts_mod
+
+        coord = store.client(root="graceful_job")
+        driver.set_target(2)
+        c2, _ = driver.wait_cluster(2)
+        # preempt only once training is actually RUNNING (the trainers
+        # report it at begin_epoch, after the handler is installed) —
+        # a SIGTERM during distributed init has nothing to checkpoint
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            sts = [ts_mod.load_train_status(coord, pid)
+                   for pid in c2.pod_ids()]
+            if any(s is not None for s in sts):
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError("training never started")
+        time.sleep(2.0)  # a dozen 0.1s steps into epoch 0
+        driver.set_target(1)
+        _, waited = driver.wait_cluster(1, prev_stage=c2.stage)
+        events = [{"target": 1, "recovery_s": waited,
+                   "resumed_step": driver._store_global_step()}]
+        assert status.load_job_status(coord) != Status.FAILED
+        # epoch-end saves land at multiples of 50; a mid-epoch version
+        # proves the SIGTERM emergency checkpoint fired
+        versions = CheckpointManager(str(tmp_path / "ckpt")).versions()
+        assert versions, "no checkpoint written during the drill"
+        assert any(v % 50 != 0 for v in versions), versions
+        assert events[-1]["resumed_step"], events
+        logs = ""
+        for p in glob.glob(str(tmp_path / "pod*_trainers") +
+                           "/workerlog.*"):
+            with open(p, errors="replace") as f:
+                logs += f.read()
+        assert "preempted" in logs, logs[-2000:]
+    finally:
+        driver.shutdown(kill=True)
+
+
+@pytest.mark.integration
 def test_gpt_distill_example_with_lm_teacher():
     """Sequence-level KD end-to-end: gpt teacher backend -> DistillReader
     -> student GPT trained on per-position soft targets."""

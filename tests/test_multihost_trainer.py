@@ -188,14 +188,21 @@ qkv = trainer.train_state["params"]["layer_0"]["attention"]["query"][
 assert not qkv.is_fully_addressable  # tp crosses the process boundary
 assert trainer._coord_stop is not None
 
+import time
+
 full = bert.synthetic_text_batch(16, seq_len=16)
 host_batch = trainer.local_batch_slice(full)
 stopped_at = None
-for i in range(60):
+for i in range(120):
     if i == 2 and rank == 1:
         trainer._preempted = True  # SIGTERM lands on rank 1 ONLY
     try:
-        trainer.train_step(host_batch)
+        # synced + paced like a real training loop (loss fetch for
+        # logging): a loop that never syncs can dispatch past any
+        # coordinated stop step before its watcher observes it
+        loss = trainer.train_step(host_batch)
+        jax.block_until_ready(loss)
+        time.sleep(0.05)
     except PreemptedError as e:
         assert "coordinated stop" in str(e), str(e)
         stopped_at = trainer.global_step
