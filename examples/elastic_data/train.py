@@ -41,6 +41,8 @@ def main(argv=None):
                    help="records per reader batch (= train batch here)")
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--save_every", type=int, default=10)
+    p.add_argument("--step_sleep", type=float, default=0.0,
+                   help="artificial per-step delay (preemption drills)")
     args = p.parse_args(argv)
 
     import glob
@@ -52,6 +54,7 @@ def main(argv=None):
     trainer = ElasticTrainer(
         linear.loss_fn, linear.init_params(), optax.sgd(args.lr),
         total_batch_size=args.batch_size)
+    trainer.install_preemption_handler()
     env = trainer.env
     if trainer.world_size > 1:
         # reader-paced stepping is per-pod; a multi-process jax world
@@ -73,29 +76,46 @@ def main(argv=None):
                            coord=trainer.coord, reader_name="fit_data",
                            skip_record=skip)
 
-    trainer.begin_epoch(trainer.state.next_epoch() if resumed else 0)
-    trainer.report_status(ts.TrainStatus.RUNNING)
     loss = None
     seen = 0
     last_saved = -1
+    from edl_tpu.utils.errors import PreemptedError
+
     try:
+        # begin/end_epoch also raise PreemptedError at their boundary —
+        # every epoch call must sit inside this handler or a SIGTERM
+        # there exits 1 (a "crash") instead of the 101 restart code
+        trainer.begin_epoch(trainer.state.next_epoch() if resumed else 0)
+        trainer.report_status(ts.TrainStatus.RUNNING)
         for batch in reader:
             if not batch["records"]:
                 continue
-            # every consumed record trains — exactly-once means the
-            # ragged tail gets its gradient step too (one extra compile
-            # for the short shape)
-            loss = float(trainer.train_step(_parse(batch["records"])))
+            # mark BEFORE the step: any checkpoint written at this
+            # step's boundary (periodic save below, or the SIGTERM
+            # emergency save inside train_step) must already cover the
+            # batch whose gradient that checkpoint contains — marking
+            # after would let a preemption replay the in-flight batch.
+            # Every consumed record trains, incl. the ragged tail (one
+            # extra compile for the short shape).
             ElasticReader.mark_consumed(trainer.state, batch)
+            loss = float(trainer.train_step(_parse(batch["records"])))
             seen += len(batch["records"])
+            if args.step_sleep:
+                import time
+                time.sleep(args.step_sleep)
             step = trainer.global_step
             if step % args.save_every == 0 and step != last_saved:
                 trainer.end_epoch(save=True)
                 trainer.begin_epoch(trainer.state.epoch_no)
                 last_saved = step
+        trainer.end_epoch(save=True)
+    except PreemptedError as e:
+        # emergency checkpoint (weights + consumed ranges) written;
+        # exit-101 so supervisors restart us for an exactly-once resume
+        print("preempted: %s" % e, flush=True)
+        return 101
     finally:
         reader.stop()
-    trainer.end_epoch(save=True)
     trainer.report_status(ts.TrainStatus.SUCCEED)
 
     print(json.dumps({
