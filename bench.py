@@ -19,7 +19,11 @@ accelerator tunnel blocks inside C++ where Python signals are never
 delivered — round 2's judged run timed out because backend init hung
 ~25 min). Attempt order: requested config -> r1 baseline config ->
 CPU-scrubbed small config, all within BENCH_TOTAL_BUDGET (default
-1080s); a JSON line is printed no matter what.
+900s); a JSON line is printed no matter what. When a DEFAULT-sized
+config times out, the backend is hung and the r1 retry is skipped
+(same backend, same hang) — a custom heavy config (--iters/--batch
+well past default) timing out still falls back through r1cfg, since
+there the config, not the backend, is the likely culprit.
 
 Variants: --no-s2d disables the space-to-depth stem; --batch_per_chip
 to sweep; --steps_per_call K scans K train steps per jit dispatch
@@ -50,9 +54,9 @@ BASELINE_IMGS_PER_SEC_PER_CHIP = 1828.0 / 8.0
 # kills the attempt subprocess. Attempts run in fresh subprocesses;
 # the final fallback scrubs the env and measures on CPU so the driver
 # always gets a parseable JSON line in bounded time.
-ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "420"))
-CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "420"))
-TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET", "1080"))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "300"))
+CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "240"))
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET", "900"))
 
 
 def log(msg):
@@ -207,9 +211,11 @@ def _oneshot(args):
 def _attempt(argv, timeout_s, env=None, tag=""):
     """Run one bench attempt in a subprocess with a hard kill-timeout.
 
-    Returns the parsed JSON result dict, or None on failure/timeout.
-    A subprocess (not a thread/SIGALRM) because a sick TPU tunnel blocks
-    inside C++ where Python signals are never delivered."""
+    Returns (result, timed_out): the parsed JSON dict or None, and
+    whether the kill-timeout fired — a HUNG backend will hang again, so
+    the caller skips same-backend retries after a timeout. A subprocess
+    (not a thread/SIGALRM) because a sick TPU tunnel blocks inside C++
+    where Python signals are never delivered."""
     cmd = [sys.executable, os.path.abspath(__file__), "--_oneshot"] + argv
     log("bench attempt%s: %s (timeout %ds)"
         % (tag and " [%s]" % tag, " ".join(argv) or "<default>", timeout_s))
@@ -219,20 +225,20 @@ def _attempt(argv, timeout_s, env=None, tag=""):
     except subprocess.TimeoutExpired:
         log("attempt%s timed out after %ds — killed"
             % (tag and " [%s]" % tag, timeout_s))
-        return None
+        return None, True
     if proc.returncode != 0:
         log("attempt%s exited rc=%d" % (tag and " [%s]" % tag,
                                         proc.returncode))
-        return None
+        return None, False
     for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return json.loads(line), False
             except ValueError:
                 pass
     log("attempt%s produced no JSON line" % (tag and " [%s]" % tag))
-    return None
+    return None, False
 
 
 def _build_parser():
@@ -307,10 +313,20 @@ def main():
             log("skipping [%s]: %.0fs left is under the CPU-fallback "
                 "reserve" % (tag, remaining()))
             break
-        result = _attempt(argv, int(budget), tag=tag)
+        result, timed_out = _attempt(argv, int(budget), tag=tag)
         if result is not None:
             if tag == "r1cfg":
                 result["metric"] += "_r1cfg"  # mark substituted config
+            break
+        heavy = (args.iters > 60 or args.batch_per_chip > 256
+                 or args.steps_per_call > 4)
+        if timed_out and not heavy:
+            # a DEFAULT-sized config timing out means the backend HUNG
+            # (healthy runs finish in ~90s): a different config on the
+            # same backend will hang the same way — go straight to CPU.
+            # A heavy custom config may simply have outrun the budget;
+            # let it fall through to the r1 baseline on-device.
+            log("backend hung; skipping further device attempts")
             break
 
     if result is None:
@@ -323,9 +339,9 @@ def main():
         env = force_cpu_env(os.environ.copy(), 1)
         argv = ["--batch_per_chip", "8", "--image_size", "64",
                 "--iters", "5", "--no-s2d"]
-        result = _attempt(argv, int(max(60, min(CPU_TIMEOUT_S,
-                                               remaining() - 10))),
-                          env=env, tag="cpu")
+        result, _ = _attempt(argv, int(max(60, min(CPU_TIMEOUT_S,
+                                                   remaining() - 10))),
+                             env=env, tag="cpu")
         if result is not None:
             result["metric"] += "_cpufallback"
     if result is None:

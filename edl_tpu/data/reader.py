@@ -184,9 +184,13 @@ class ElasticReader(object):
     @staticmethod
     def mark_consumed(state, batch):
         """Record a consumed batch in the elastic State's data checkpoint
-        (reference DataCheckpoint :25-31); call after training on it, then
-        persist the State with the epoch checkpoint so a restart resumes
-        behind the consumed ranges via ``skip_record``."""
+        (reference DataCheckpoint :25-31). Call BEFORE the train step:
+        any checkpoint written at that step's boundary — the periodic
+        save or the SIGTERM emergency save inside train_step — must
+        already cover the batch whose gradient it contains, or a
+        preemption replays the in-flight batch on resume. Persist the
+        State with the epoch checkpoint so a restart resumes behind the
+        consumed ranges via ``skip_record``."""
         lo, hi = batch["range"]
         state.data_checkpoint.mark_processed(batch["file"], lo, hi)
 

@@ -307,13 +307,23 @@ def test_elastic_data_exactly_once_across_preemption(store, tmp_path):
            "--step_sleep", "0.15"]
     p1 = sp.Popen(cmd, env=env, stdout=sp.PIPE, stderr=sp.STDOUT,
                   text=True)
+    # select-based wait: a bare readline() would block past the deadline
+    # if the child hangs before printing anything
+    import select
+
     deadline = time.time() + 120
-    while time.time() < deadline:
+    started = False
+    while time.time() < deadline and not started:
+        ready, _, _ = select.select([p1.stdout], [], [], 1.0)
+        if not ready:
+            if p1.poll() is not None:
+                raise AssertionError("run 1 died before starting")
+            continue
         line = p1.stdout.readline()
         if line == "" and p1.poll() is not None:
             raise AssertionError("run 1 died before starting")
-        if line.startswith("elastic_data:"):
-            break
+        started = line.startswith("elastic_data:")
+    assert started, "run 1 never printed its banner within the deadline"
     time.sleep(2.5)  # ~15 batches in
     p1.send_signal(sig.SIGTERM)
     out1, _ = p1.communicate(timeout=120)
