@@ -112,3 +112,26 @@ def test_profile_bench_breakdown_parser(tmp_path):
     from edl_tpu.tools import profile_bench
 
     assert profile_bench.xplane_op_breakdown(str(tmp_path), 10) is None
+
+
+@pytest.mark.integration
+def test_bench_gpt_mode_oneshot(tmp_path):
+    """bench.py --model gpt (tiny, CPU): the LM benchmark surface emits
+    a parseable tok/s JSON line through the oneshot path."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--_oneshot",
+         "--model", "gpt", "--gpt_tiny", "--batch_per_chip", "2",
+         "--seq_len", "32", "--iters", "2"],
+        env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads([l for l in proc.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert out["unit"] == "tok/s/chip" and out["value"] > 0

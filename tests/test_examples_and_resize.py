@@ -305,28 +305,33 @@ def test_elastic_data_exactly_once_across_preemption(store, tmp_path):
            os.path.join(REPO, "examples", "elastic_data", "train.py"),
            "--data_dir", str(data_dir), "--batch_size", "8",
            "--step_sleep", "0.15"]
-    p1 = sp.Popen(cmd, env=env, stdout=sp.PIPE, stderr=sp.STDOUT,
-                  text=True)
-    # select-based wait: a bare readline() would block past the deadline
-    # if the child hangs before printing anything
+    # unbuffered binary pipe + os.read: select on a TextIOWrapper lies
+    # once readline() pulls multiple lines into the user-space buffer,
+    # and a bare readline() would block past the deadline on a hang
     import select
 
+    p1 = sp.Popen(cmd, env=env, stdout=sp.PIPE, stderr=sp.STDOUT,
+                  bufsize=0)
+    fd = p1.stdout.fileno()
     deadline = time.time() + 120
-    started = False
-    while time.time() < deadline and not started:
-        ready, _, _ = select.select([p1.stdout], [], [], 1.0)
-        if not ready:
-            if p1.poll() is not None:
-                raise AssertionError("run 1 died before starting")
-            continue
-        line = p1.stdout.readline()
-        if line == "" and p1.poll() is not None:
-            raise AssertionError("run 1 died before starting")
-        started = line.startswith("elastic_data:")
-    assert started, "run 1 never printed its banner within the deadline"
+    seen = b""
+    while time.time() < deadline and b"elastic_data:" not in seen:
+        ready, _, _ = select.select([fd], [], [], 1.0)
+        if ready:
+            chunk = os.read(fd, 65536)
+            if chunk == b"":
+                raise AssertionError("run 1 died before starting:\n"
+                                     + seen.decode(errors="replace"))
+            seen += chunk
+        elif p1.poll() is not None:
+            raise AssertionError("run 1 died before starting:\n"
+                                 + seen.decode(errors="replace"))
+    assert b"elastic_data:" in seen, \
+        "run 1 never printed its banner within the deadline"
     time.sleep(2.5)  # ~15 batches in
     p1.send_signal(sig.SIGTERM)
-    out1, _ = p1.communicate(timeout=120)
+    raw1, _ = p1.communicate(timeout=120)
+    out1 = (seen + raw1).decode(errors="replace")
     assert p1.returncode == 101, out1
     assert "preempted" in out1, out1
 
