@@ -452,8 +452,10 @@ def main():
             result["metric"] += "_cpufallback"
     if result is None:
         # never leave the driver with nothing to parse
-        result = {"metric": "resnet50_vd_bench_failed_all_attempts",
-                  "value": 0.0, "unit": "img/s/chip", "vs_baseline": 0.0}
+        name = "gpt2s" if args.model == "gpt" else "resnet50_vd"
+        unit = "tok/s/chip" if args.model == "gpt" else "img/s/chip"
+        result = {"metric": "%s_bench_failed_all_attempts" % name,
+                  "value": 0.0, "unit": unit, "vs_baseline": 0.0}
     print(json.dumps(result), flush=True)
 
 

@@ -555,6 +555,54 @@ def test_resize_driver_graceful_preemption(store, tmp_path):
 
 
 @pytest.mark.integration
+def test_chaos_soak_mixed_preemptions(store, tmp_path):
+    """Bounded chaos soak: a deterministic-seed sequence of resize
+    mutations with MIXED preemption modes (hard SIGKILL and graceful
+    SIGTERM) against one job, then run-to-completion — the job must
+    never FAIL, recover after every mutation, and finish SUCCEED."""
+    import random
+    import time
+
+    rng = random.Random(7)  # jitters the sleeps only — the mutation
+    # sequence itself is explicit so BOTH modes provably run
+    driver = ResizeDriver(
+        store.endpoint, "chaos_job", "1:2",
+        [os.path.join(REPO, "examples", "fit_a_line", "train.py"),
+         "--epochs", "6", "--steps_per_epoch", "30",
+         "--step_sleep", "0.1"],
+        log_dir=str(tmp_path), stop_signal="kill", grace=15.0,
+        env_extra={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                   "EDL_TPU_POD_IP": "127.0.0.1", "EDL_TPU_TTL": "3",
+                   "XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=2",
+                   "EDL_TPU_CHECKPOINT_PATH": str(tmp_path / "ckpt"),
+                   "PALLAS_AXON_POOL_IPS": ""})
+    coord = store.client(root="chaos_job")
+    try:
+        driver.set_target(2)
+        prev_stage = driver.wait_cluster(2)[0].stage
+        for step_i, (mode, target) in enumerate(
+                [("term", 1), ("kill", 2), ("term", 1)]):
+            time.sleep(rng.uniform(2.0, 4.0))
+            driver._stop_signal = mode
+            driver.set_target(target)
+            cluster, waited = driver.wait_cluster(target,
+                                                  prev_stage=prev_stage)
+            prev_stage = cluster.stage
+            assert waited < 120, (step_i, mode, target, waited)
+        # let the survivor finish the job
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if status.load_job_status(coord) == Status.SUCCEED:
+                break
+            assert status.load_job_status(coord) != Status.FAILED
+            time.sleep(1.0)
+        assert status.load_job_status(coord) == Status.SUCCEED
+    finally:
+        driver.shutdown(kill=True)
+
+
+@pytest.mark.integration
 def test_gpt_distill_example_with_lm_teacher():
     """Sequence-level KD end-to-end: gpt teacher backend -> DistillReader
     -> student GPT trained on per-position soft targets."""
