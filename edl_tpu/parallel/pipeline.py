@@ -142,11 +142,15 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
     fwd_perm = [(i, (i + 1) % nP) for i in range(nP)]
     bwd_perm = [((i + 1) % nP, i) for i in range(nP)]
 
+    # ring buffer of saved stage INPUTS: the skew-1 1F1B schedule holds
+    # <= P microbatches in flight; the seq-parallel PAIR schedule's
+    # skew-2 window holds <= 2P-1 (stage s spans pairs m+s .. m+2P-2-s,
+    # so the max slot distance is 2P-2 — 2P-1 slots collision-free)
+    n_slots = 2 * nP - 1 if seq_axes else nP
     state = dict(
         fwd_carry=zeros_act,
         bwd_carry=zeros_act,
-        # ring buffer of saved stage INPUTS: 1F1B holds <= P in flight
-        buf=_tmap(lambda s: jnp.zeros((nP,) + s.shape, s.dtype), act),
+        buf=_tmap(lambda s: jnp.zeros((n_slots,) + s.shape, s.dtype), act),
         g_enc=_tmap(jnp.zeros_like, p_enc),
         g_stage=_tmap(jnp.zeros_like, p_stage),
         g_dec=_tmap(jnp.zeros_like, p_dec),
@@ -157,73 +161,74 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
         return _tmap(lambda a, n: a + jnp.where(valid, n, 0).astype(a.dtype),
                      acc, new)
 
-    def tick_uniform(t, state):
-        """seq-parallel variant: stage_fn/decode_fn contain collectives
-        over seq_axes, and collectives must execute on EVERY device in
-        the same order each tick — different pp stages taking different
-        lax.cond branches would leave subgroup collectives with missing
-        participants. So both the forward and the backward path are
-        computed every tick and the results are mask-selected (the
-        throughput price of composing sp into an SPMD pipeline)."""
-        tf = t - idx
-        is_fwd = (tf % 2 == 0)
-        m_f = jnp.clip(tf // 2, 0, M - 1)
-        f_valid = jnp.logical_and(is_fwd,
-                                  jnp.logical_and(tf // 2 >= 0,
-                                                  tf // 2 < M))
-        tb = t - (2 * nP - 1 - idx)
-        m_b = jnp.clip(tb // 2, 0, M - 1)
-        b_valid = jnp.logical_and(~is_fwd,
-                                  jnp.logical_and(tb >= 0, tb // 2 < M))
-
+    def tick_pair(k, state):
+        """Seq-parallel PAIR schedule: stage_fn/decode_fn contain
+        collectives over seq_axes, and collectives must execute on EVERY
+        device in the same order each tick — different pp stages taking
+        different lax.cond branches would leave subgroup collectives
+        with missing participants. Instead of computing BOTH roles every
+        skew-1 tick and mask-selecting (the round-2 design: 2x the
+        arithmetic and 2(M+P) ticks), each pair-iteration runs ONE
+        unconditioned forward subtick then ONE unconditioned backward
+        subtick, each valid for (almost) every iteration of its ramp:
+        stage s forwards microbatch m at pair m+s and backwards it at
+        pair m + 2P-2-s (a skew of one full fwd+bwd pair per stage).
+        Same FLOPs as the divergent 1F1B, M + 2P-2 iterations, no
+        conditioned collectives; the price is an activation stash of
+        <= 2P-1 microbatch inputs instead of <= P."""
         def sel(pred, a, b):
             return _tmap(lambda u, v: jnp.where(pred, u, v), a, b)
 
-        # ---- forward path (always executed) --------------------------
-        enc_out = encode_fn(p_enc, take(xmb, m_f))
+        # ---- forward subtick -----------------------------------------
+        m_f = k - idx
+        f_valid = jnp.logical_and(m_f >= 0, m_f < M)
+        mf = jnp.clip(m_f, 0, M - 1)
+        enc_out = encode_fn(p_enc, take(xmb, mf))
         x_in = sel(idx == 0, enc_out, state["fwd_carry"])
         y = stage_fn(p_stage, x_in)
-        slot_f = m_f % nP
         buf = _tmap(
             lambda b_, v: jnp.where(
                 f_valid,
-                lax.dynamic_update_index_in_dim(b_, v, slot_f, 0), b_),
+                lax.dynamic_update_index_in_dim(b_, v, mf % n_slots, 0),
+                b_),
             state["buf"], x_in)
+        fwd_carry = _tmap(
+            lambda v: lax.ppermute(v, axis_name, fwd_perm),
+            sel(f_valid, y, zeros_act))
 
-        # ---- backward path (always executed) -------------------------
+        # ---- backward subtick ----------------------------------------
         # ONE stage vjp serves both roles: the last stage chains the
         # decode head's cotangent into it, mid stages chain the ring
         # carry — mask-selecting the COTANGENT instead of running
-        # separate full vjps for comp(stage∘decode) and stage saves a
-        # whole stage forward+backward per tick
-        x_saved = _tmap(lambda b_: b_[m_b % nP], buf)
+        # separate full vjps for comp(stage∘decode) and stage
+        m_b = k - (2 * nP - 2 - idx)
+        b_valid = jnp.logical_and(m_b >= 0, m_b < M)
+        mb_ = jnp.clip(m_b, 0, M - 1)
+        x_saved = _tmap(lambda b_: b_[mb_ % n_slots], buf)
         y_saved, vjp_stage = jax.vjp(stage_fn, p_stage, x_saved)
         loss_m, vjp_dec = jax.vjp(
-            lambda pd, y_: decode_fn(pd, y_, take(ymb, m_b)),
+            lambda pd, y_: decode_fn(pd, y_, take(ymb, mb_)),
             p_dec, y_saved)
         gd_l, gy_l = vjp_dec(jnp.float32(1.0 / M))
         is_last = idx == nP - 1
         gs, gx = vjp_stage(sel(is_last, gy_l, state["bwd_carry"]))
         gd = sel(is_last, gd_l, _tmap(jnp.zeros_like, p_dec))
         _, vjp_enc = jax.vjp(
-            lambda p: encode_fn(p, take(xmb, m_b)), p_enc)
+            lambda p: encode_fn(p, take(xmb, mb_)), p_enc)
         ge = sel(idx == 0, vjp_enc(gx)[0], _tmap(jnp.zeros_like, p_enc))
 
-        state = dict(
-            state, buf=buf,
+        return dict(
+            buf=buf,
+            fwd_carry=fwd_carry,
+            bwd_carry=_tmap(
+                lambda v: lax.ppermute(v, axis_name, bwd_perm),
+                sel(b_valid, gx, zeros_act)),
             g_stage=masked_add(state["g_stage"], gs, b_valid),
             g_dec=masked_add(state["g_dec"], gd, b_valid),
             g_enc=masked_add(state["g_enc"], ge, b_valid),
             loss=state["loss"] + jnp.where(
                 jnp.logical_and(b_valid, is_last), loss_m,
                 0).astype(jnp.float32) / M)
-        state["fwd_carry"] = _tmap(
-            lambda v: lax.ppermute(v, axis_name, fwd_perm),
-            sel(f_valid, y, zeros_act))
-        state["bwd_carry"] = _tmap(
-            lambda v: lax.ppermute(v, axis_name, bwd_perm),
-            sel(b_valid, gx, zeros_act))
-        return state
 
     def tick(t, state):
         tf = t - idx                   # forward clock of this stage
@@ -291,8 +296,10 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
             lambda v: lax.ppermute(v, axis_name, bwd_perm), g_send)
         return state
 
-    state = lax.fori_loop(0, 2 * (nP + M) - 2,
-                          tick_uniform if seq_axes else tick, state)
+    if seq_axes:
+        state = lax.fori_loop(0, M + 2 * nP - 2, tick_pair, state)
+    else:
+        state = lax.fori_loop(0, 2 * (nP + M) - 2, tick, state)
 
     # encode/decode grads + loss live on one stage each → share over pp;
     # reduce over the batch axes (mean: /n_batch) and the seq axes (sum:
