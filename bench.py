@@ -10,9 +10,11 @@ vs_baseline = (img/s per local chip) / 228.5.
 
 Modes:
   --feed device  (default) data staged on device once: pure compute rate.
-  --feed host    numpy batches from the input pipeline are sharded onto
-                 device every step: the end-to-end rate a real training
-                 loop sees (the role DALI played for the reference).
+  --feed host    numpy batches from the synthetic input pipeline are
+                 sharded onto device every step: the end-to-end rate a
+                 real training loop sees (the DALI role).
+  --feed native  the C++ JPEG loader on REAL images (--data_dir) feeds
+                 the step: decode+augment+normalize end to end.
 Robustness: the top-level process never touches jax. Each measurement
 attempt runs in a fresh subprocess with a hard kill-timeout (a sick
 accelerator tunnel blocks inside C++ where Python signals are never
@@ -64,7 +66,8 @@ def log(msg):
 
 
 def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
-        s2d=True, feed="device", steps_per_call=1, bn_stats_every=1):
+        s2d=True, feed="device", steps_per_call=1, bn_stats_every=1,
+        data_dir=None):
     import jax
     import jax.numpy as jnp
     import optax
@@ -114,16 +117,35 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
     rng = jax.device_put(jax.random.PRNGKey(0), repl)
 
     prefetcher = None
-    if feed == "host":
-        from edl_tpu.data.input_pipeline import synthetic_pipeline
+    if feed in ("host", "native"):
         from edl_tpu.data.prefetch import DevicePrefetcher
 
         def to_bf16(b):
             return {"image": b["image"].astype(jnp.bfloat16),
                     "label": b["label"]}
-        prefetcher = DevicePrefetcher(
-            synthetic_pipeline(batch, image_size=image_size),
-            data_sh, size=2, transform=to_bf16)
+
+        if feed == "native":
+            # the C++ loader on REAL JPEGs: the end-to-end DALI-role
+            # rate (decode+augment+normalize feeding the train step)
+            from edl_tpu.data.native_loader import (
+                native_image_folder_pipeline)
+
+            def stream():
+                epoch = 0
+                while True:
+                    # train=True drops the ragged tail: every batch
+                    # is full-size by construction
+                    for b in native_image_folder_pipeline(
+                            data_dir, batch, image_size=image_size,
+                            train=True, epoch_seed=epoch):
+                        yield b
+                    epoch += 1
+            source = stream()
+        else:
+            from edl_tpu.data.input_pipeline import synthetic_pipeline
+            source = synthetic_pipeline(batch, image_size=image_size)
+        prefetcher = DevicePrefetcher(source, data_sh, size=2,
+                                      transform=to_bf16)
         next_batch = lambda: next(prefetcher)
     else:
         key = jax.random.PRNGKey(0)
@@ -179,6 +201,8 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
         metric += "_suspect"
     if feed == "host":
         metric += "_hostfed"
+    elif feed == "native":
+        metric += "_nativefed"
     if steps_per_call > 1:
         metric += "_scan%d" % steps_per_call
     if bn_stats_every > 1:
@@ -276,7 +300,8 @@ def _oneshot(args):
     kwargs = dict(batch_per_chip=args.batch_per_chip, iters=args.iters,
                   s2d=args.s2d, feed=args.feed,
                   steps_per_call=args.steps_per_call,
-                  bn_stats_every=args.bn_stats_every)
+                  bn_stats_every=args.bn_stats_every,
+                  data_dir=args.data_dir)
     if args.image_size != 224:
         kwargs.update(image_size=args.image_size, warmup=2)
     result = run(**kwargs)
@@ -337,7 +362,13 @@ def _build_parser():
     ap.add_argument("--s2d", dest="s2d", action="store_true")
     ap.add_argument("--no-s2d", dest="s2d", action="store_false")
     ap.set_defaults(s2d=True)
-    ap.add_argument("--feed", choices=("device", "host"), default="device")
+    ap.add_argument("--feed", choices=("device", "host", "native"),
+                    default="device",
+                    help="device = staged-once compute rate; host = "
+                         "synthetic pipeline fed per step; native = the "
+                         "C++ JPEG loader on --data_dir fed per step")
+    ap.add_argument("--data_dir", default=None,
+                    help="image-folder root for --feed native")
     ap.add_argument("--steps_per_call", type=int, default=1,
                     help="scan K train steps per jit dispatch (amortizes "
                          "host->device dispatch latency)")
@@ -360,9 +391,11 @@ def main():
         ap.error("--steps_per_call must be >= 1")
     if args.bn_stats_every < 1:
         ap.error("--bn_stats_every must be >= 1")
-    if args.feed == "host" and args.steps_per_call > 1:
+    if args.feed != "device" and args.steps_per_call > 1:
         ap.error("--steps_per_call measures pure device rate and skips "
                  "the per-step feed; use it with --feed device")
+    if args.feed == "native" and not args.data_dir:
+        ap.error("--feed native needs --data_dir")
     if getattr(args, "_oneshot"):
         _oneshot(args)
         return
@@ -392,6 +425,8 @@ def main():
         requested += ["--no-s2d"]
     if args.feed != "device":
         requested += ["--feed", args.feed]
+    if args.data_dir:
+        requested += ["--data_dir", args.data_dir]
     if args.steps_per_call != 1:
         requested += ["--steps_per_call", str(args.steps_per_call)]
     if args.bn_stats_every != 1:
@@ -420,8 +455,12 @@ def main():
             break
         # (no gpt clause: gpt has no further device attempts anyway,
         # and run_gpt clamps seq_len to the model's max_len)
+        # feed != device is config-caused slowness (disk/decode), not
+        # a backend hang — the ~90s healthy-run calibration only holds
+        # for device/synthetic feeds
         heavy = (args.iters > 60 or args.batch_per_chip > 256
-                 or args.steps_per_call > 4 or args.image_size > 224)
+                 or args.steps_per_call > 4 or args.image_size > 224
+                 or args.feed != "device")
         if timed_out and not heavy:
             # a DEFAULT-sized config timing out means the backend HUNG
             # (healthy runs finish in ~90s): a different config on the
