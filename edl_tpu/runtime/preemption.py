@@ -114,11 +114,18 @@ class CoordinatedStop(object):
         self._requested = True
         self._last_pub = now
         try:
-            # put-if-absent: a no-op while the key is alive, an
-            # automatic refresh once the TTL lapsed
-            self._coord.set_server_not_exists(
-                self._service, "req_%d" % self._rank,
-                str(max(int(step), self.min_step + 1)), ttl=KEY_TTL)
+            value = str(max(int(step), self.min_step + 1))
+            if self._coord.set_server_not_exists(
+                    self._service, "req_%d" % self._rank, value,
+                    ttl=KEY_TTL) is None:
+                # the key exists — either our own earlier publish (an
+                # overwrite is an idempotent refresh) or a STALE one
+                # from a prior same-stage incarnation, which would
+                # shadow this live request past the leader's staleness
+                # filter: overwrite unconditionally
+                self._coord.set_server_with_lease(
+                    self._service, "req_%d" % self._rank, value,
+                    ttl=KEY_TTL)
         except Exception:
             logger.exception("preempt request publish failed")
 

@@ -2,6 +2,8 @@
 ElasticTrainer end-to-end on the 8-device CPU mesh (data-parallel sharding
 with XLA-inserted gradient reduction)."""
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -9,6 +11,8 @@ import optax
 import pytest
 
 from edl_tpu.runtime import lr_schedules, mesh as mesh_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 from edl_tpu.runtime import state as state_mod
 from edl_tpu.runtime.trainer import ElasticTrainer
 
@@ -216,6 +220,71 @@ def test_coordinated_stop_protocol(coord):
     finally:
         c0.stop()
         c1.stop()
+
+
+def test_coordinated_stop_staleness_defenses(coord):
+    """A restarted incarnation must never act on its predecessor's keys:
+    stop_at and request values at or below min_step are rejected, a
+    stale stop_at is overwritten (put-if-absent would block on it), and
+    requests are clamped above min_step so live ones always survive the
+    leader's filter."""
+    import time
+
+    from edl_tpu.runtime.preemption import CoordinatedStop
+
+    # predecessor's leftovers: stop_at=30 and a request at step 25
+    coord.set_server_not_exists("preempt:stgX", "stop_at", "30", ttl=60)
+    coord.set_server_not_exists("preempt:stgX", "req_1", "25", ttl=60)
+
+    # the resumed job's baseline is step 30 — everything above is stale
+    c0 = CoordinatedStop(coord, 0, stage="stgX", margin=4,
+                         poll_interval=0.05, current_step=lambda: 31,
+                         min_step=30).start()
+    c1 = CoordinatedStop(coord, 1, stage="stgX", poll_interval=0.05,
+                         min_step=30).start()
+    try:
+        time.sleep(0.4)
+        # stale stop_at/req observed but rejected; no new stop published
+        assert c0.stop_at is None and c1.stop_at is None
+
+        # a LIVE preemption now: the request clamps above min_step and
+        # the leader overwrites the stale stop_at
+        c1.request(5)  # a silly-low step still publishes min_step + 1
+        deadline = time.time() + 10
+        while time.time() < deadline and (c0.stop_at is None
+                                          or c1.stop_at is None):
+            time.sleep(0.05)
+        # max(leader 31, clamped request 31) + margin 4
+        assert c0.stop_at == 35 and c1.stop_at == 35
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_locked_make_serializes_concurrent_builds(tmp_path):
+    """Two processes running locked_make on the same target do not race
+    two compilers onto one output file."""
+    import subprocess
+    import sys
+
+    native_dir = tmp_path / "native"
+    native_dir.mkdir()
+    (native_dir / "Makefile").write_text(
+        "out.txt:\n"
+        "\tsh -c 'echo start >> log.txt; sleep 0.5; echo $$$$ > out.txt;"
+        " echo done >> log.txt'\n")
+    code = ("import sys; sys.path.insert(0, %r); "
+            "from edl_tpu.utils.buildlock import locked_make; "
+            "locked_make(%r, 'out.txt')"
+            % (REPO, str(native_dir)))
+    procs = [subprocess.Popen([sys.executable, "-c", code])
+             for _ in range(2)]
+    for p in procs:
+        assert p.wait(timeout=60) == 0
+    # the second holder found the target up to date: exactly one build
+    log = (native_dir / "log.txt").read_text().splitlines()
+    assert log == ["start", "done"], log
+    assert (native_dir / "out.txt").exists()
 
 
 def test_resume_preserves_adjust_hooks_and_extra_state(tmp_path):
