@@ -180,6 +180,12 @@ class Launcher(object):
 
     def _supervise(self):
         awaiting_since = None  # set when trainers exited PREEMPTED (101)
+        # a real pod eviction needs lease expiry + (possibly)
+        # re-election + generator publish + watcher poll to surface;
+        # respawning against the stale cluster before that wastes a
+        # restart cycle on a dead coordinator
+        respawn_wait = max(constants.PREEMPT_RESPAWN_WAIT,
+                           2 * constants.ETCD_TTL + 5)
         while True:
             time.sleep(constants.SUPERVISE_INTERVAL)
 
@@ -228,14 +234,7 @@ class Launcher(object):
                                  e)
                     return self._exit(False)
             elif awaiting_since is not None and (
-                    time.monotonic() - awaiting_since
-                    > max(constants.PREEMPT_RESPAWN_WAIT,
-                          # a real pod eviction needs lease expiry +
-                          # (possibly) re-election + generator publish +
-                          # watcher poll to surface; respawning against
-                          # the stale cluster before that wastes a
-                          # restart cycle on a dead coordinator
-                          2 * constants.ETCD_TTL + 5)):
+                    time.monotonic() - awaiting_since > respawn_wait):
                 # the preemption was trainer-only (no pod left the
                 # cluster): respawn in place; trainers resume from the
                 # emergency checkpoint

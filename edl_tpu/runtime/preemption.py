@@ -131,18 +131,24 @@ class CoordinatedStop(object):
 
     # -- watcher ------------------------------------------------------------
 
+    @staticmethod
+    def _as_step(value):
+        """Store value -> int step, None when absent/garbled (the one
+        decoder for stop_at and request values)."""
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", "replace")
+        try:
+            return None if value is None else int(value)
+        except (TypeError, ValueError):
+            return None
+
     def _read_stop_at(self):
         try:
             v = self._coord.get_value(self._service, "stop_at")
         except Exception:
             logger.exception("preempt stop_at read failed")
             return None
-        if isinstance(v, bytes):
-            v = v.decode("utf-8", "replace")
-        try:
-            return None if v is None else int(v)
-        except (TypeError, ValueError):
-            return None
+        return self._as_step(v)
 
     def _leader_maybe_publish(self):
         try:
@@ -150,19 +156,11 @@ class CoordinatedStop(object):
         except Exception:
             return
 
-        def as_step(value):
-            if isinstance(value, bytes):
-                value = value.decode("utf-8", "replace")
-            try:
-                return int(value)
-            except (TypeError, ValueError):
-                return None
-
         # reqs at or below min_step are a prior incarnation's leftovers
         # (same stage uuid within the key TTL) — not a live preemption
         req_steps = [s for name, v in reqs
                      if name.startswith("req_")
-                     and (s := as_step(v)) is not None
+                     and (s := self._as_step(v)) is not None
                      and s > self.min_step]
         if not req_steps:
             return

@@ -28,6 +28,19 @@ import pytest  # noqa: E402
 from edl_tpu.coordination.embedded import (  # noqa: E402
     EmbeddedStore, set_global_endpoints)
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cpu_subprocess_env(n_devices=2, **extra):
+    """Environment for example/worker SUBPROCESSES on a hermetic
+    n-device CPU platform: the force_cpu_env scrub recipe (the one true
+    source — tests must not hand-roll JAX_PLATFORMS/XLA_FLAGS/axon
+    scrubbing) plus PYTHONPATH, with ``extra`` vars merged on top."""
+    env = force_cpu_env(dict(os.environ), n_devices)
+    env["PYTHONPATH"] = REPO
+    env.update(extra)
+    return env
+
 
 @pytest.fixture()
 def store():

@@ -122,10 +122,8 @@ def test_bench_gpt_mode_oneshot(tmp_path):
     import subprocess
     import sys
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo})
+    from conftest import REPO as repo, cpu_subprocess_env
+    env = cpu_subprocess_env(8)
     proc = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py"), "--_oneshot",
          "--model", "gpt", "--gpt_tiny", "--batch_per_chip", "2",

@@ -17,13 +17,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_example(path, args, timeout=240, device_count=2):
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({
-        "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=%d"
-                     % device_count,
-    })
+    from conftest import cpu_subprocess_env
+    env = cpu_subprocess_env(device_count)
     proc = subprocess.run(
         [sys.executable, "-u", os.path.join(REPO, path)] + args,
         env=env, capture_output=True, text=True, timeout=timeout)
@@ -51,13 +46,9 @@ def test_fit_a_line_preemption_emergency_checkpoint(tmp_path):
     import signal
     import time
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({
-        "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-        "EDL_TPU_CHECKPOINT_PATH": str(tmp_path / "ckpt"),
-    })
+    from conftest import cpu_subprocess_env
+    env = cpu_subprocess_env(
+        2, EDL_TPU_CHECKPOINT_PATH=str(tmp_path / "ckpt"))
     cmd = [sys.executable, "-u",
            os.path.join(REPO, "examples/fit_a_line/train.py"),
            "--epochs", "2", "--steps_per_epoch", "500",
@@ -238,10 +229,9 @@ def test_elastic_data_example_end_to_end(store, tmp_path):
     data_dir, total = _make_linear_dataset(tmp_path / "data", files=8,
                                            per_file=64, seed=0)
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "EDL_TPU_POD_IP": "127.0.0.1",
-                "EDL_TPU_TTL": "3", "JAX_PLATFORMS": "cpu"})
+    from conftest import cpu_subprocess_env
+    env = cpu_subprocess_env(8, EDL_TPU_POD_IP="127.0.0.1",
+                             EDL_TPU_TTL="3")
     log = open(str(tmp_path / "pod1.log"), "wb")
     p = sp.Popen(
         [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
@@ -289,18 +279,15 @@ def test_elastic_data_exactly_once_across_preemption(store, tmp_path):
     data_dir, total = _make_linear_dataset(tmp_path / "data", files=4,
                                            per_file=64, seed=1)
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                # the launcher env contract, minus the launcher: the
-                # coord-backed reader registry needs a trainer identity
-                "EDL_TPU_STORE_ENDPOINTS": store.endpoint,
-                "EDL_TPU_JOB_ID": "eonce",
-                "EDL_TPU_POD_ID": "pod_eonce",
-                "EDL_TPU_TRAINER_ID": "t0",
-                "EDL_TPU_GLOBAL_RANK": "0",
-                "EDL_TPU_WORLD_SIZE": "1",
-                "EDL_TPU_CHECKPOINT_PATH": str(tmp_path / "ckpt")})
+    from conftest import cpu_subprocess_env
+    # the launcher env contract, minus the launcher: the coord-backed
+    # reader registry needs a trainer identity
+    env = cpu_subprocess_env(
+        8, EDL_TPU_STORE_ENDPOINTS=store.endpoint,
+        EDL_TPU_JOB_ID="eonce", EDL_TPU_POD_ID="pod_eonce",
+        EDL_TPU_TRAINER_ID="t0", EDL_TPU_GLOBAL_RANK="0",
+        EDL_TPU_WORLD_SIZE="1",
+        EDL_TPU_CHECKPOINT_PATH=str(tmp_path / "ckpt"))
     cmd = [sys.executable, "-u",
            os.path.join(REPO, "examples", "elastic_data", "train.py"),
            "--data_dir", str(data_dir), "--batch_size", "8",

@@ -239,10 +239,8 @@ def test_multihost_tp_coordinated_preemption(tmp_path):
     ckpt = str(tmp_path / "ckpt")
 
     store = StoreServer(host="127.0.0.1").start()
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    from conftest import cpu_subprocess_env
+    env = cpu_subprocess_env(4)
     procs = [subprocess.Popen(
         [sys.executable, str(worker_py), coordinator, "2", str(rank),
          ckpt, store.endpoint],
@@ -281,10 +279,8 @@ def test_multihost_dp_emergency_preemption_save(tmp_path):
     worker_py.write_text(WORKER_DP)
     ckpt = str(tmp_path / "ckpt")
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    from conftest import cpu_subprocess_env
+    env = cpu_subprocess_env(4)
     procs = [subprocess.Popen(
         [sys.executable, str(worker_py), coordinator, "2", str(rank),
          ckpt],
@@ -315,10 +311,8 @@ def test_multihost_tp_trainer_save_resume(tmp_path):
     worker_py.write_text(WORKER)
     ckpt = str(tmp_path / "ckpt")
 
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    from conftest import cpu_subprocess_env
+    env = cpu_subprocess_env(4)
     procs = [subprocess.Popen(
         [sys.executable, str(worker_py), coordinator, "2", str(rank),
          ckpt],

@@ -139,10 +139,8 @@ def test_resnet_example_trains_with_native_loader(loader_lib, tmp_path):
 
     data = _make_real_dataset(str(tmp_path / "train"), classes=2,
                               per_class=16, size=40)
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env.update({"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    from conftest import cpu_subprocess_env
+    env = cpu_subprocess_env(2)
     proc = subprocess.run(
         [sys.executable, "-u",
          os.path.join(REPO, "examples/resnet/train.py"),
