@@ -9,7 +9,6 @@ import threading
 
 from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants
-from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
 

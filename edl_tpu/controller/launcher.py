@@ -12,7 +12,6 @@ import json
 import time
 
 from edl_tpu.controller import barrier as barrier_mod
-from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, status, train_process
 from edl_tpu.controller.cluster_generator import Generator
 from edl_tpu.controller.cluster_watcher import ClusterWatcher
