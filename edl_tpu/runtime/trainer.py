@@ -463,6 +463,7 @@ class ElasticTrainer(object):
         self._async_save = async_save
         self._save_thread = None
         self._preempted = False
+        self._preempt_armed = False
         self._coord_stop = None
         self._preempt_t0 = None
         self._coord_deadline = 15.0
@@ -559,6 +560,67 @@ class ElasticTrainer(object):
             self._emergency_save()
         return loss
 
+    # -- the high-level loop -------------------------------------------------
+
+    def fit(self, epochs, batches_fn, eval_fn=None, resume=True,
+            preemption_exit_code=101, log_fn=None, signals=None,
+            coordinated=None):
+        """The full elastic training loop in one call: arm the
+        preemption handler, resume from the newest checkpoint, iterate
+        epochs (begin → train_step over ``batches_fn(epoch)`` → end +
+        save), rank-0 eval, and the final SUCCEED status report.
+
+        batches_fn(epoch) -> iterable of per-host batches (use
+        local_batch_slice/an input pipeline shard for multi-host).
+        eval_fn(trainer, epoch) runs on rank 0 after each epoch's save.
+        On preemption the emergency checkpoint is already written; the
+        process exits with ``preemption_exit_code`` (the exit-101
+        restart convention) — pass None to get PreemptedError raised
+        instead. ``signals``/``coordinated`` forward to
+        install_preemption_handler; a handler the caller armed earlier
+        is left untouched. Returns {"resumed", "steps", "final_loss",
+        "world"}.
+        """
+        from edl_tpu.utils.errors import PreemptedError
+
+        if not self._preempt_armed:
+            self.install_preemption_handler(signals=signals,
+                                            coordinated=coordinated)
+        resumed = self.resume() if resume else False
+        start_epoch = self.state.next_epoch() if resumed else 0
+        say = log_fn or logger.info
+        say("fit: rank=%d world=%d start_epoch=%d resumed=%s"
+            % (self.env.global_rank, self.world_size, start_epoch,
+               resumed))
+        loss = None
+        try:
+            for epoch in range(start_epoch, epochs):
+                self.begin_epoch(epoch)
+                if epoch == epochs - 1:
+                    # AFTER begin_epoch: it reports RUNNING, which would
+                    # clobber the scale-out-stopping NEARTHEEND verdict
+                    self.report_status(train_status_mod.TrainStatus
+                                       .NEARTHEEND)
+                for batch in batches_fn(epoch):
+                    loss = self.train_step(batch)
+                self.end_epoch(save=True)
+                say("fit: epoch %d done step=%d loss=%s"
+                    % (epoch, self.global_step,
+                       "%.5f" % float(loss) if loss is not None
+                       else "n/a"))
+                if eval_fn is not None and self.env.global_rank == 0:
+                    eval_fn(self, epoch)
+        except PreemptedError as e:
+            say("fit: preempted: %s" % e)
+            if preemption_exit_code is None:
+                raise
+            import sys
+            sys.exit(preemption_exit_code)
+        self.report_status(train_status_mod.TrainStatus.SUCCEED)
+        return {"resumed": resumed, "steps": self.global_step,
+                "final_loss": None if loss is None else float(loss),
+                "world": self.world_size}
+
     # -- preemption (grace-window emergency checkpoint) ----------------------
 
     def install_preemption_handler(self, signals=None, coordinated=None):
@@ -596,6 +658,7 @@ class ElasticTrainer(object):
             signals = (signal_mod.SIGTERM,)
         for s in signals:
             signal_mod.signal(s, self._on_preempt_signal)
+        self._preempt_armed = True
         if coordinated is None:
             coordinated = jax.process_count() > 1 and self.coord is not None
         if coordinated and self._coord_stop is None:

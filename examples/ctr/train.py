@@ -45,9 +45,11 @@ def main(argv=None):
 
     loss = None
     for epoch in range(start_epoch, args.epochs):
-        if epoch == args.epochs - 1:
-            trainer.report_status(ts.TrainStatus.NEARTHEEND)
         trainer.begin_epoch(epoch)
+        if epoch == args.epochs - 1:
+            # after begin_epoch: it reports RUNNING, which would
+            # clobber the scale-out-stopping NEARTHEEND verdict
+            trainer.report_status(ts.TrainStatus.NEARTHEEND)
         for step in range(args.steps_per_epoch):
             full = deepfm.synthetic_ctr_batch(
                 args.total_batch_size, vocabs,

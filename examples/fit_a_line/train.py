@@ -5,7 +5,9 @@ Run under the launcher:   python -m edl_tpu.controller.launch ... train.py
 
 Reference parity: example/fit_a_line/train_ft.py — a tiny regression proving
 the whole stack: launcher → barrier → trainer → per-epoch checkpoint →
-kill/resize → resume from checkpoint (SURVEY.md §7 step 3).
+kill/resize → resume from checkpoint (SURVEY.md §7 step 3). The loop is
+ElasticTrainer.fit(): resume, per-epoch save, SIGTERM → emergency
+checkpoint → exit 101 all come from the one call.
 """
 
 import argparse
@@ -14,7 +16,6 @@ import sys
 
 import optax
 
-from edl_tpu.controller import train_status as ts
 from edl_tpu.runtime.trainer import ElasticTrainer, maybe_init_distributed
 
 
@@ -34,43 +35,22 @@ def main(argv=None):
     trainer = ElasticTrainer(
         linear.loss_fn, linear.init_params(), optax.sgd(args.lr),
         total_batch_size=args.total_batch_size)
-    trainer.install_preemption_handler()
-    env = trainer.env
-    resumed = trainer.resume()
-    start_epoch = trainer.state.next_epoch() if resumed else 0
-    print("fit_a_line: rank=%d world=%d start_epoch=%d resumed=%s"
-          % (env.global_rank, trainer.world_size, start_epoch, resumed),
-          flush=True)
 
-    from edl_tpu.utils.errors import PreemptedError
+    def batches(epoch):
+        for step in range(args.steps_per_epoch):
+            seed = epoch * 10000 + step
+            full = linear.synthetic_batch(args.total_batch_size, seed=seed)
+            yield trainer.local_batch_slice(full)
+            if args.step_sleep:
+                import time
+                time.sleep(args.step_sleep)
 
-    loss = None
-    try:
-        for epoch in range(start_epoch, args.epochs):
-            if epoch == args.epochs - 1:
-                trainer.report_status(ts.TrainStatus.NEARTHEEND)
-            trainer.begin_epoch(epoch)
-            for step in range(args.steps_per_epoch):
-                seed = epoch * 10000 + step
-                full = linear.synthetic_batch(args.total_batch_size,
-                                              seed=seed)
-                loss = float(trainer.train_step(
-                    trainer.local_batch_slice(full)))
-                if args.step_sleep:
-                    import time
-                    time.sleep(args.step_sleep)
-            trainer.end_epoch(save=True)
-            print("epoch %d done: loss=%.5f step=%d"
-                  % (epoch, loss, trainer.global_step), flush=True)
-    except PreemptedError as e:
-        # emergency checkpoint written at the current step; exit-101 is
-        # the restart convention (liveft) so supervisors restart us
-        print("preempted: %s" % e, flush=True)
-        return 101
-
-    trainer.report_status(ts.TrainStatus.SUCCEED)
-    print(json.dumps({"final_loss": loss, "steps": trainer.global_step,
-                      "world": trainer.world_size}), flush=True)
+    result = trainer.fit(args.epochs, batches,
+                         log_fn=lambda m: print(
+                             m.replace("fit:", "fit_a_line:"), flush=True))
+    print(json.dumps({"final_loss": result["final_loss"],
+                      "steps": result["steps"],
+                      "world": result["world"]}), flush=True)
     return 0
 
 

@@ -165,9 +165,11 @@ def main(argv=None):
     t_start = time.perf_counter()
     try:
         for epoch in range(start_epoch, args.epochs):
-            if epoch == args.epochs - 1:
-                trainer.report_status(ts.TrainStatus.NEARTHEEND)
             trainer.begin_epoch(epoch)
+            if epoch == args.epochs - 1:
+                # after begin_epoch: it reports RUNNING, which would
+                # clobber the scale-out-stopping NEARTHEEND verdict
+                trainer.report_status(ts.TrainStatus.NEARTHEEND)
             t_epoch = time.perf_counter()
             for step, host_batch in enumerate(host_batches(epoch)):
                 loss = float(trainer.train_step(host_batch))

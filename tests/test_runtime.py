@@ -191,6 +191,48 @@ def test_preemption_saves_emergency_checkpoint(tmp_path):
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
 
 
+def test_fit_loop_trains_resumes_and_preempts(tmp_path):
+    """ElasticTrainer.fit(): the one-call loop trains to convergence,
+    a second fit() resumes from the checkpoints it wrote, and a
+    preemption mid-loop raises PreemptedError (code=None) after the
+    emergency save."""
+    import signal
+
+    from edl_tpu.utils.errors import PreemptedError
+
+    trainer, make_batch, w_true = _linreg_trainer(tmp_path)
+    out = trainer.fit(2, lambda e: (make_batch(e * 100 + i)
+                                    for i in range(15)))
+    assert out["steps"] == 30 and not out["resumed"]
+    assert out["final_loss"] < 0.05
+    np.testing.assert_allclose(
+        np.asarray(trainer.train_state["params"]["w"]), w_true, atol=0.2)
+
+    trainer2, make_batch2, _ = _linreg_trainer(tmp_path)
+    out2 = trainer2.fit(3, lambda e: (make_batch2(e * 100 + i)
+                                      for i in range(15)))
+    assert out2["resumed"] and out2["steps"] == 45
+
+    try:
+        trainer3, make_batch3, _ = _linreg_trainer(tmp_path)
+
+        def batches(epoch):
+            for i in range(15):
+                if i == 4:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                yield make_batch3(epoch * 100 + i)
+
+        with pytest.raises(PreemptedError):
+            trainer3.fit(9, batches, preemption_exit_code=None)
+        # the emergency checkpoint carries the preempted step, beyond
+        # the resumed 45 but before epoch 3's end at 60
+        trainer4, _, _ = _linreg_trainer(tmp_path)
+        assert trainer4.resume()
+        assert 45 < trainer4.global_step < 60
+    finally:
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
 def test_coordinated_stop_protocol(coord):
     """CoordinatedStop: a flagged rank's request makes the rank-0 watcher
     publish stop_at = leader_step + margin, and every rank's watcher
