@@ -947,6 +947,21 @@ class ElasticTrainer(object):
             self._save_thread.join()
             self._save_thread = None
 
+    def close(self):
+        """Release background resources: join any in-flight async save
+        and stop the preemption watcher thread. Idempotent; the trainer
+        remains usable for reads afterwards (notebooks constructing
+        several trainers should close the ones they drop)."""
+        self.wait_for_save()
+        if self._coord_stop is not None:
+            self._coord_stop.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     def _save_state_to_store(self, state_dict):
         if self.coord is not None:
             snap = state_mod.State()
