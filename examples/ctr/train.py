@@ -17,7 +17,6 @@ def main(argv=None):
 
     import optax
 
-    from edl_tpu.controller import train_status as ts
     from edl_tpu.models import deepfm
     from edl_tpu.runtime.trainer import ElasticTrainer
 
@@ -36,32 +35,22 @@ def main(argv=None):
         field_vocab_sizes=vocabs, embed_dim=args.embed_dim)
     trainer = ElasticTrainer(loss_fn, params, optax.adam(args.lr),
                              total_batch_size=args.total_batch_size)
-    env = trainer.env
-    resumed = trainer.resume()
-    start_epoch = trainer.state.next_epoch() if resumed else 0
-    print("deepfm: rank=%d world=%d start_epoch=%d resumed=%s"
-          % (env.global_rank, trainer.world_size, start_epoch, resumed),
-          flush=True)
 
-    loss = None
-    for epoch in range(start_epoch, args.epochs):
-        trainer.begin_epoch(epoch)
-        if epoch == args.epochs - 1:
-            # after begin_epoch: it reports RUNNING, which would
-            # clobber the scale-out-stopping NEARTHEEND verdict
-            trainer.report_status(ts.TrainStatus.NEARTHEEND)
+    def batches(epoch):
         for step in range(args.steps_per_epoch):
             full = deepfm.synthetic_ctr_batch(
                 args.total_batch_size, vocabs,
                 seed=epoch * 100000 + step)
-            loss = float(trainer.train_step(
-                trainer.local_batch_slice(full)))
-        trainer.end_epoch(save=True)
-        print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+            yield trainer.local_batch_slice(full)
 
-    trainer.report_status(ts.TrainStatus.SUCCEED)
-    print(json.dumps({"final_loss": loss, "steps": trainer.global_step,
-                      "world": trainer.world_size}), flush=True)
+    # the one-call elastic loop: resume, per-epoch save, preemption ->
+    # emergency checkpoint -> exit 101, final SUCCEED
+    result = trainer.fit(args.epochs, batches,
+                         log_fn=lambda m: print(
+                             m.replace("fit:", "deepfm:"), flush=True))
+    print(json.dumps({"final_loss": result["final_loss"],
+                      "steps": result["steps"],
+                      "world": result["world"]}), flush=True)
     return 0
 
 
