@@ -299,6 +299,34 @@ def test_restore_placed_rejects_oversized_and_tampered(ckpt_fs):
         cm.restore_placed(2, _struct_target(tree), sh)
 
 
+def test_restore_placed_axis_changing_reshard(ckpt_fs):
+    """Save sharded along dim 0, restore sharded along dim 1: every
+    (saved-row-span x needed-col-block) pair PARTIALLY overlaps, so the
+    2-D span intersection in paste() is what reassembles the tensor —
+    the layout-change case (dp checkpoint onto a tp axis)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cm = _cm(ckpt_fs)
+    tree, host = _sharded_tree(6)
+    cm.save_sharded(6, tree)  # mu: (16, 4) split along dim 0 over dp=8
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    repl = NamedSharding(mesh4, P())
+    col_sharded = NamedSharding(mesh4, P(None, "tp"))  # dim-1 split
+    shardings = {"params": {"w": repl}, "opt": {"mu": col_sharded},
+                 "bf16": repl, "step": repl}
+    v, restored, _ = cm.restore_placed(6, _struct_target(tree), shardings)
+    assert v == 6
+    np.testing.assert_array_equal(np.asarray(restored["opt"]["mu"]),
+                                  host["opt"]["mu"])
+    assert restored["opt"]["mu"].sharding.is_equivalent_to(
+        col_sharded, restored["opt"]["mu"].ndim)
+    np.testing.assert_array_equal(
+        np.asarray(restored["bf16"], np.float32),
+        np.asarray(jnp.asarray(host["bf16"], jnp.bfloat16), np.float32))
+
+
 def test_restore_placed_missing_key(ckpt_fs):
     from edl_tpu.runtime.checkpoint import MissingKeysError
 

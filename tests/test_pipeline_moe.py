@@ -264,6 +264,35 @@ def test_1f1b_composes_with_sequence_parallelism():
             atol=1e-5, err_msg=jax.tree_util.keystr(path))
 
 
+def test_pair_schedule_fewer_microbatches_than_stages():
+    """sp x pp with M < P: the pair schedule's ramp masks and skew-2
+    buffer windows must stay exact when the pipeline never fills."""
+    from edl_tpu.models.bert import create_bert_pipeline
+    from edl_tpu.parallel.pipeline import pipeline_value_and_grad
+
+    pp, sp = 4, 2
+    mesh = mesh_mod.make_mesh(dp=1, pp=pp, sp=sp)
+    params, encode, stage, decode, seq_loss = create_bert_pipeline(
+        pp, num_layers=4, d_model=32, num_heads=2, mlp_dim=64,
+        vocab_size=100, max_len=64, seq_len=16, dtype=jnp.float32,
+        seq_parallel_axis="sp")
+    rng = np.random.RandomState(5)
+    n = 4
+    ids = jnp.asarray(rng.randint(0, 100, (n, 16)).astype(np.int32))
+    labels = jnp.asarray(rng.randint(0, 2, (n,)).astype(np.int32))
+
+    want_loss, want_g = jax.value_and_grad(seq_loss)(params, ids, labels)
+    got_loss, got_g = jax.jit(lambda p, i, l: pipeline_value_and_grad(
+        p, i, l, encode_fn=encode, stage_fn=stage, decode_fn=decode,
+        mesh=mesh, num_micro=2, seq_axes=("sp",)))(params, ids, labels)
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(want_g),
+                    jax.tree_util.tree_leaves(got_g)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=5e-4, atol=1e-5)
+
+
 def test_1f1b_composes_with_remat():
     """remat'd stages under the 1F1B schedule: same loss/grads (the 1F1B
     backward already recomputes the stage from its saved input, so remat
