@@ -71,6 +71,7 @@ def main(argv=None):
     trainer = ElasticTrainer(
         loss_fn, params, optax.adamw(1e-3),
         total_batch_size=args.total_batch_size)
+    trainer.install_preemption_handler()
 
     def gen():
         for step in range(args.steps_per_epoch):
@@ -89,16 +90,25 @@ def main(argv=None):
     else:
         dr.set_fixed_teacher([e for e in args.teachers.split(",") if e])
 
+    from edl_tpu.utils.errors import PreemptedError
+
     loss = None
-    for epoch in range(args.epochs):
-        trainer.begin_epoch(epoch)
-        for input_ids, _label, probs in dr():
-            loss = float(trainer.train_step(trainer.local_batch_slice({
-                "input_ids": np.asarray(input_ids),
-                "soft_label": np.asarray(probs),
-            })))
-        trainer.end_epoch(save=False)
-        print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+    try:
+        for epoch in range(args.epochs):
+            trainer.begin_epoch(epoch)
+            for input_ids, _label, probs in dr():
+                loss = float(trainer.train_step(trainer.local_batch_slice({
+                    "input_ids": np.asarray(input_ids),
+                    "soft_label": np.asarray(probs),
+                })))
+            trainer.end_epoch(save=False)
+            print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+    except PreemptedError as e:
+        # emergency checkpoint written (when a checkpoint dir is
+        # configured); exit-101 is the restart convention
+        print("preempted: %s" % e, flush=True)
+        dr.stop()
+        return 101
     dr.stop()
     print(json.dumps({"final_loss": loss, "steps": trainer.global_step}),
           flush=True)

@@ -37,6 +37,7 @@ def main(argv=None):
         vocab_size=args.vocab_size, distill_weight=0.5)
     trainer = ElasticTrainer(loss_fn, params, optax.adam(1e-3),
                              total_batch_size=args.batch_size)
+    trainer.install_preemption_handler()
 
     def gen():
         rng = np.random.RandomState(0)
@@ -54,17 +55,26 @@ def main(argv=None):
     else:
         dr.set_fixed_teacher([e for e in args.teachers.split(",") if e])
 
+    from edl_tpu.utils.errors import PreemptedError
+
     loss = None
-    for epoch in range(args.epochs):
-        trainer.begin_epoch(epoch)
-        for input_ids, label, soft_label in dr():
-            loss = float(trainer.train_step(trainer.local_batch_slice({
-                "input_ids": np.asarray(input_ids),
-                "label": np.asarray(label),
-                "soft_label": np.asarray(soft_label),
-            })))
-        trainer.end_epoch(save=False)
-        print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+    try:
+        for epoch in range(args.epochs):
+            trainer.begin_epoch(epoch)
+            for input_ids, label, soft_label in dr():
+                loss = float(trainer.train_step(trainer.local_batch_slice({
+                    "input_ids": np.asarray(input_ids),
+                    "label": np.asarray(label),
+                    "soft_label": np.asarray(soft_label),
+                })))
+            trainer.end_epoch(save=False)
+            print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+    except PreemptedError as e:
+        # emergency checkpoint written (when a checkpoint dir is
+        # configured); exit-101 is the restart convention
+        print("preempted: %s" % e, flush=True)
+        dr.stop()
+        return 101
     dr.stop()
     print(json.dumps({"final_loss": loss}), flush=True)
     return 0

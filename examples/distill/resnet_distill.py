@@ -65,6 +65,7 @@ def main(argv=None):
         loss_fn, params, optax.sgd(0.05, momentum=0.9),
         total_batch_size=args.total_batch_size, extra_state=extra,
         has_aux=True)
+    trainer.install_preemption_handler()
 
     def gen():
         for step in range(args.steps_per_epoch):
@@ -81,17 +82,26 @@ def main(argv=None):
     else:
         dr.set_fixed_teacher([e for e in args.teachers.split(",") if e])
 
+    from edl_tpu.utils.errors import PreemptedError
+
     loss = None
-    for epoch in range(args.epochs):
-        trainer.begin_epoch(epoch)
-        for image, label, soft_label in dr():
-            loss = float(trainer.train_step(trainer.local_batch_slice({
-                "image": np.asarray(image),
-                "label": np.asarray(label),
-                "soft_label": np.asarray(soft_label),
-            })))
-        trainer.end_epoch(save=False)
-        print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+    try:
+        for epoch in range(args.epochs):
+            trainer.begin_epoch(epoch)
+            for image, label, soft_label in dr():
+                loss = float(trainer.train_step(trainer.local_batch_slice({
+                    "image": np.asarray(image),
+                    "label": np.asarray(label),
+                    "soft_label": np.asarray(soft_label),
+                })))
+            trainer.end_epoch(save=False)
+            print("epoch %d loss %.4f" % (epoch, loss), flush=True)
+    except PreemptedError as e:
+        # emergency checkpoint written (when a checkpoint dir is
+        # configured); exit-101 is the restart convention
+        print("preempted: %s" % e, flush=True)
+        dr.stop()
+        return 101
     dr.stop()
     print(json.dumps({"final_loss": loss, "steps": trainer.global_step}),
           flush=True)
