@@ -25,7 +25,9 @@ CPU-scrubbed small config, all within BENCH_TOTAL_BUDGET (default
 config times out, the backend is hung and the r1 retry is skipped
 (same backend, same hang) — a custom heavy config (--iters/--batch
 well past default) timing out still falls back through r1cfg, since
-there the config, not the backend, is the likely culprit.
+there the config, not the backend, is the likely culprit. The 420s
+first-attempt budget also covers a slow-but-eventually-healthy
+backend init; dead-tunnel worst case stays ~11 min (420 + CPU 240).
 
 Variants: --no-s2d disables the space-to-depth stem; --batch_per_chip
 to sweep; --steps_per_call K scans K train steps per jit dispatch
@@ -56,7 +58,7 @@ BASELINE_IMGS_PER_SEC_PER_CHIP = 1828.0 / 8.0
 # kills the attempt subprocess. Attempts run in fresh subprocesses;
 # the final fallback scrubs the env and measures on CPU so the driver
 # always gets a parseable JSON line in bounded time.
-ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "300"))
+ATTEMPT_TIMEOUT_S = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "420"))
 CPU_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "240"))
 TOTAL_BUDGET_S = int(os.environ.get("BENCH_TOTAL_BUDGET", "900"))
 
