@@ -233,6 +233,23 @@ def test_fit_loop_trains_resumes_and_preempts(tmp_path):
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
 
 
+def test_fit_reports_neartheend_after_running(tmp_path):
+    """Status order on the LAST epoch: begin_epoch reports RUNNING, so
+    fit() must publish NEARTHEEND after it — the scale-out-stopping
+    verdict would otherwise be clobbered for the entire final epoch."""
+    from edl_tpu.controller.train_status import TrainStatus
+
+    trainer, make_batch, _ = _linreg_trainer(tmp_path)
+    calls = []
+    trainer.report_status = calls.append
+    trainer.fit(2, lambda e: (make_batch(e * 10 + i) for i in range(3)))
+    assert calls[-1] == TrainStatus.SUCCEED
+    near = calls.index(TrainStatus.NEARTHEEND)
+    assert calls[near - 1] == TrainStatus.RUNNING
+    # and nothing overwrites NEARTHEEND before the SUCCEED
+    assert calls[near + 1:] == [TrainStatus.SUCCEED]
+
+
 def test_coordinated_stop_protocol(coord):
     """CoordinatedStop: a flagged rank's request makes the rank-0 watcher
     publish stop_at = leader_step + margin, and every rank's watcher
