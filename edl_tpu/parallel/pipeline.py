@@ -318,6 +318,40 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
     return loss, {"encode": g_enc, "stages": g_stage, "decode": g_dec}
 
 
+def make_pipeline_train_step(tx, *, encode_fn, stage_fn, decode_fn, mesh,
+                             num_micro=None, seq_axes=None,
+                             x_key="input_ids", y_key="label"):
+    """An ElasticTrainer ``step_fn`` driving the 1F1B engine: the hook
+    that puts pipeline-parallel training inside the elastic harness —
+    stop-resume checkpointing (stage params stay pp-sharded through the
+    sharded save and the placed restore), preemption, and fit() all
+    apply. The train state is the canonical make_train_state pytree
+    whose "params" is the pipeline tree {"encode", "stages", "decode"};
+    pass param_shardings placing "stages" on the pp axis. ``tx`` MUST
+    be the same GradientTransformation object given to ElasticTrainer —
+    the trainer's tx.init builds the opt_state this step updates, and a
+    mismatched transform trains with the wrong hyperparameters (or
+    fails with an opaque pytree error for different structures)."""
+    import optax
+
+    def step(train_state, batch, rng):
+        del rng  # the pipelined stacks are deterministic (no dropout)
+        loss, grads = pipeline_value_and_grad(
+            train_state["params"], batch[x_key], batch[y_key],
+            encode_fn=encode_fn, stage_fn=stage_fn, decode_fn=decode_fn,
+            mesh=mesh, num_micro=num_micro, seq_axes=seq_axes)
+        updates, opt_state = tx.update(grads, train_state["opt_state"],
+                                       train_state["params"])
+        return {
+            "params": optax.apply_updates(train_state["params"], updates),
+            "opt_state": opt_state,
+            "step": train_state["step"] + 1,
+            "extra": train_state["extra"],
+        }, loss
+
+    return step
+
+
 def pipeline_value_and_grad(params, x, y, *, encode_fn, stage_fn, decode_fn,
                             mesh, num_micro=None, pipe_axis=PIPE_AXIS,
                             batch_axes=None, seq_axes=None):

@@ -292,6 +292,17 @@ class ElasticTrainer(object):
         per-device batch budget; each restart picks the smallest
         accumulation that fits it at the current world size
         (auto_grad_accum).
+      step_fn: a custom train step (train_state, batch, rng) ->
+        (train_state, loss) replacing the canonical make_train_step —
+        the hook that puts engines owning their own backward (the 1F1B
+        pipeline's pipeline_value_and_grad) inside the elastic harness:
+        checkpoint/resume, preemption, sharded saves and placed
+        restores all apply to the custom step's state. Mutually
+        exclusive with the loss-level knobs (has_aux / grad_accum /
+        remat_policy / max_per_device_batch); pass param_shardings
+        (e.g. stages over "pp") for the layout, and build the step with
+        the SAME ``tx`` object passed here (it initializes the
+        opt_state the step updates).
     """
 
     def __init__(self, loss_fn, params, tx, total_batch_size,
@@ -299,7 +310,16 @@ class ElasticTrainer(object):
                  keep_checkpoints=3, extra_state=None, has_aux=False,
                  async_save=False, remat_policy=None,
                  param_shardings=None, grad_accum=1, zero1=False,
-                 max_per_device_batch=None):
+                 max_per_device_batch=None, step_fn=None):
+        if step_fn is not None and (has_aux or grad_accum != 1
+                                    or remat_policy is not None
+                                    or max_per_device_batch is not None):
+            raise ValueError(
+                "step_fn owns the whole step: has_aux/grad_accum/"
+                "remat_policy/max_per_device_batch do not apply")
+        if step_fn is None and loss_fn is None:
+            raise ValueError("need loss_fn (canonical step) or step_fn")
+        self._step_fn = step_fn
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
         if checkpoint_dir is None:
@@ -481,7 +501,9 @@ class ElasticTrainer(object):
     # -- the compiled step ---------------------------------------------------
 
     def _build_step(self):
-        if self._grad_accum > 1:
+        if self._step_fn is not None:
+            step = self._step_fn
+        elif self._grad_accum > 1:
             step = make_accum_step(self._loss_fn, self._tx,
                                    self._grad_accum, self._has_aux,
                                    remat_policy=self._remat_policy)
