@@ -320,6 +320,7 @@ def _pipe_1f1b_shard(params, xs, ys, *, encode_fn, stage_fn, decode_fn,
 
 def make_pipeline_train_step(tx, *, encode_fn, stage_fn, decode_fn, mesh,
                              num_micro=None, seq_axes=None,
+                             num_chunks=None,
                              x_key="input_ids", y_key="label"):
     """An ElasticTrainer ``step_fn`` driving the 1F1B engine: the hook
     that puts pipeline-parallel training inside the elastic harness —
@@ -331,15 +332,32 @@ def make_pipeline_train_step(tx, *, encode_fn, stage_fn, decode_fn, mesh,
     be the same GradientTransformation object given to ElasticTrainer —
     the trainer's tx.init builds the opt_state this step updates, and a
     mismatched transform trains with the wrong hyperparameters (or
-    fails with an opaque pytree error for different structures)."""
+    fails with an opaque pytree error for different structures).
+
+    num_chunks selects the interleaved (circular) engine with that many
+    virtual stages per device ("stages" then carries the device-major
+    [P*V, ...] layout from device_major_stage_params); the interleaved
+    engine does not take seq_axes."""
     import optax
+
+    if num_chunks is not None and seq_axes:
+        raise ValueError("the interleaved engine does not compose with "
+                         "seq_axes (use the 1F1B pair schedule)")
 
     def step(train_state, batch, rng):
         del rng  # the pipelined stacks are deterministic (no dropout)
-        loss, grads = pipeline_value_and_grad(
-            train_state["params"], batch[x_key], batch[y_key],
-            encode_fn=encode_fn, stage_fn=stage_fn, decode_fn=decode_fn,
-            mesh=mesh, num_micro=num_micro, seq_axes=seq_axes)
+        if num_chunks is not None:
+            loss, grads = pipeline_value_and_grad_interleaved(
+                train_state["params"], batch[x_key], batch[y_key],
+                encode_fn=encode_fn, stage_fn=stage_fn,
+                decode_fn=decode_fn, mesh=mesh, num_chunks=num_chunks,
+                num_micro=num_micro)
+        else:
+            loss, grads = pipeline_value_and_grad(
+                train_state["params"], batch[x_key], batch[y_key],
+                encode_fn=encode_fn, stage_fn=stage_fn,
+                decode_fn=decode_fn, mesh=mesh, num_micro=num_micro,
+                seq_axes=seq_axes)
         updates, opt_state = tx.update(grads, train_state["opt_state"],
                                        train_state["params"])
         return {

@@ -314,6 +314,83 @@ def test_elastic_trainer_runs_the_pipeline_engine(tmp_path):
     assert loss < first, (loss, first)
 
 
+def test_elastic_trainer_runs_interleaved_pipeline(tmp_path):
+    """num_chunks routes the elastic step_fn through the interleaved
+    (circular) engine: train on dp x pp with V=2 virtual stages,
+    checkpoint, resume, layouts intact."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from edl_tpu.parallel.pipeline import (device_major_stage_params,
+                                           make_pipeline_train_step)
+
+    pp, V = 4, 2
+    mesh = mesh_mod.make_mesh(dp=2, pp=pp)
+    repl = NamedSharding(mesh, P())
+    stage_sh = NamedSharding(mesh, P("pp"))
+    S, d = pp * V, 8
+    rng = np.random.RandomState(11)
+
+    def encode(p, xb):
+        return jnp.tanh(xb @ p["w"])
+
+    def stage(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def decode(p, act, labels):
+        logits = act @ p["w"]
+        oh = jax.nn.one_hot(labels, 2)
+        return -(jax.nn.log_softmax(logits) * oh).sum(-1).mean()
+
+    def build():
+        pparams = {
+            "encode": {"w": jnp.asarray(
+                rng.randn(3, d).astype(np.float32) * 0.3)},
+            "stages": device_major_stage_params(
+                {"w": jnp.asarray(np.stack(
+                    [np.eye(d) * 0.9 for _ in range(S)])
+                    .astype(np.float32)),
+                 "b": jnp.zeros((S, d), jnp.float32)}, pp, V),
+            "decode": {"w": jnp.asarray(
+                rng.randn(d, 2).astype(np.float32) * 0.3)},
+        }
+        shardings = {
+            "encode": {"w": repl},
+            "stages": jax.tree_util.tree_map(lambda _: stage_sh,
+                                             pparams["stages"]),
+            "decode": {"w": repl},
+        }
+        tx = optax.adam(5e-3)
+        step = make_pipeline_train_step(
+            tx, encode_fn=encode, stage_fn=stage, decode_fn=decode,
+            mesh=mesh, num_micro=4, num_chunks=V, x_key="x")
+        return ElasticTrainer(
+            None, pparams, tx, total_batch_size=16,
+            checkpoint_dir=str(tmp_path / "ckpt"), mesh=mesh,
+            param_shardings=shardings, step_fn=step)
+
+    data = np.random.RandomState(4)
+
+    def batch(i):
+        x = data.randn(16, 3).astype(np.float32)
+        return {"x": x, "label": (x.sum(1) > 0).astype(np.int32)}
+
+    tr = build()
+    first = float(tr.train_step(batch(0)))
+    for i in range(1, 6):
+        tr.train_step(batch(i))
+    tr.begin_epoch(0)
+    tr.end_epoch(save=True)
+
+    tr2 = build()
+    assert tr2.resume() and tr2.global_step == 6
+    assert "pp" in str(
+        tr2.train_state["params"]["stages"]["w"].sharding.spec)
+    loss = None
+    for i in range(6, 40):
+        loss = float(tr2.train_step(batch(i)))
+    assert loss < first, (loss, first)
+
+
 def test_coordinated_stop_protocol(coord):
     """CoordinatedStop: a flagged rank's request makes the rank-0 watcher
     publish stop_at = leader_step + margin, and every rank's watcher
