@@ -1,10 +1,13 @@
-"""Pipeline-parallel BERT training (dp x pp) on the 1F1B schedule.
+"""Pipeline-parallel BERT training (dp x pp) on the 1F1B schedule,
+inside the ELASTIC harness.
 
 Net-new vs the reference (its NLP scope was distillation only;
 model parallelism was a roadmap bullet — SURVEY.md §2.7). Demonstrates
 the edl_tpu pipeline plane end to end: stage params sharded over pp,
 batches over dp, stage grads kept pp-sharded through the optimizer, and
-activation recompute inside the 1F1B backward. --chunks V > 1 switches
+activation recompute inside the 1F1B backward — all as ElasticTrainer's
+step_fn, so checkpoint/stop-resume (layout-preserving sharded saves and
+placed restores) and SIGTERM preemption apply. --chunks V > 1 switches
 to the interleaved (circular) schedule: V virtual stages per device,
 shrinking the pipeline bubble from O(P) to O(P/V).
 
@@ -29,10 +32,11 @@ def main(argv=None):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from edl_tpu.models.bert import create_bert_pipeline
-    from edl_tpu.parallel.pipeline import (
-        device_major_stage_params, pipeline_value_and_grad,
-        pipeline_value_and_grad_interleaved)
+    from edl_tpu.parallel.pipeline import (device_major_stage_params,
+                                           make_pipeline_train_step)
     from edl_tpu.runtime.mesh import make_mesh
+    from edl_tpu.runtime.trainer import ElasticTrainer
+    from edl_tpu.utils.errors import PreemptedError
 
     p = argparse.ArgumentParser()
     p.add_argument("--pp", type=int, default=4)
@@ -77,55 +81,58 @@ def main(argv=None):
             params["stages"], args.pp, args.chunks))
     stage_sh = NamedSharding(mesh, P("pp"))
     repl = NamedSharding(mesh, P())
-    data_sh = NamedSharding(mesh, P("dp"))
-    params = {
-        "encode": jax.device_put(params["encode"], repl),
-        "stages": jax.device_put(params["stages"], stage_sh),
-        "decode": jax.device_put(params["decode"], repl),
+    shardings = {
+        "encode": jax.tree_util.tree_map(lambda _: repl,
+                                         params["encode"]),
+        "stages": jax.tree_util.tree_map(lambda _: stage_sh,
+                                         params["stages"]),
+        "decode": jax.tree_util.tree_map(lambda _: repl,
+                                         params["decode"]),
     }
     tx = optax.adamw(args.lr)
-    opt = jax.jit(tx.init)(params)
-
-    def train_step(params, opt, ids, labels):
-        if args.chunks > 1:
-            loss, grads = pipeline_value_and_grad_interleaved(
-                params, ids, labels, encode_fn=enc, stage_fn=stg,
-                decode_fn=dec, mesh=mesh, num_micro=args.num_micro,
-                num_chunks=args.chunks)
-        else:
-            loss, grads = pipeline_value_and_grad(
-                params, ids, labels, encode_fn=enc, stage_fn=stg,
-                decode_fn=dec, mesh=mesh, num_micro=args.num_micro)
-        updates, opt = tx.update(grads, opt, params)
-        return optax.apply_updates(params, updates), opt, loss
-
-    jit_step = jax.jit(train_step, donate_argnums=(0, 1))
-    rng = np.random.RandomState(0)
+    step_fn = make_pipeline_train_step(
+        tx, encode_fn=enc, stage_fn=stg, decode_fn=dec, mesh=mesh,
+        num_micro=args.num_micro,
+        num_chunks=args.chunks if args.chunks > 1 else None)
     batch = dp * args.batch_per_dp
+    trainer = ElasticTrainer(None, params, tx, total_batch_size=batch,
+                             mesh=mesh, param_shardings=shardings,
+                             step_fn=step_fn)
+    trainer.install_preemption_handler()
+    resumed = trainer.resume()
+    print("bert_pipeline: resumed=%s step=%d" % (resumed,
+                                                 trainer.global_step),
+          flush=True)
+
+    rng = np.random.RandomState(0)
     loss = None
     t0 = time.perf_counter()
     first_loss = None
-    for step in range(args.steps):
-        ids = jax.device_put(
-            rng.randint(0, args.vocab_size,
-                        (batch, args.seq_len)).astype(np.int32), data_sh)
-        # learnable synthetic task: label = parity of the first token
-        labels = jax.device_put(
-            (np.asarray(jax.device_get(ids))[:, 0] % 2).astype(np.int32),
-            data_sh)
-        params, opt, loss = jit_step(params, opt, ids, labels)
-        if first_loss is None:
-            first_loss = float(loss)
-        if (step + 1) % 5 == 0:
-            print("step %d loss %.4f" % (step + 1, float(loss)),
-                  flush=True)
+    try:
+        trainer.begin_epoch(0)
+        for step in range(args.steps):
+            ids = rng.randint(0, args.vocab_size,
+                              (batch, args.seq_len)).astype(np.int32)
+            # learnable synthetic task: label = parity of first token
+            host = {"input_ids": ids,
+                    "label": (ids[:, 0] % 2).astype(np.int32)}
+            loss = float(trainer.train_step(
+                trainer.local_batch_slice(host)))
+            if first_loss is None:
+                first_loss = loss
+            if (step + 1) % 5 == 0:
+                print("step %d loss %.4f" % (step + 1, loss), flush=True)
+        trainer.end_epoch(save=True)
+    except PreemptedError as e:
+        print("preempted: %s" % e, flush=True)
+        return 101
     wall = time.perf_counter() - t0
     print(json.dumps({
         "model": "bert_pipeline_pp%d_dp%d%s" % (
             args.pp, dp,
             "_v%d" % args.chunks if args.chunks > 1 else ""),
         "first_loss": first_loss,
-        "final_loss": float(loss),
+        "final_loss": loss,
         "steps": args.steps,
         "tokens_per_sec": round(batch * args.seq_len * args.steps / wall,
                                 1),
