@@ -121,7 +121,49 @@ def test_bert_pipeline_example_interleaved_learns():
 
 
 @pytest.mark.integration
-def test_long_context_example_runs_with_remat():
+def test_bert_pipeline_preemption_resume(tmp_path):
+    """SIGTERM the PIPELINED trainer mid-run: emergency checkpoint with
+    pp-sharded stages, exit 101, and a rerun resumes past the preempted
+    step — elasticity composed with pipeline parallelism at the process
+    level."""
+    import signal
+    import time
+
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env(
+        8, EDL_TPU_CHECKPOINT_PATH=str(tmp_path / "ckpt"))
+    cmd = [sys.executable, "-u",
+           os.path.join(REPO, "examples/bert_pipeline/train.py"),
+           "--pp", "4", "--steps", "400", "--d_model", "32",
+           "--num_heads", "2", "--mlp_dim", "64", "--seq_len", "16",
+           "--vocab_size", "50"]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line == "" and proc.poll() is not None:
+            raise AssertionError("died before starting")
+        if line.startswith("step 5 "):  # compiled and actually stepping
+            break
+    time.sleep(1.0)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=180)
+    assert proc.returncode == 101, out
+    assert "preempted" in out, out
+
+    from edl_tpu.runtime.checkpoint import CheckpointManager
+
+    versions = CheckpointManager(str(tmp_path / "ckpt")).versions()
+    assert versions and 0 < versions[-1] < 400, (versions, out)
+
+    proc2 = subprocess.run(
+        cmd[:6] + ["40"] + cmd[7:], env=env, capture_output=True,
+        text=True, timeout=400)
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    assert "resumed=True step=%d" % versions[-1] in proc2.stdout, \
+        proc2.stdout
     out = _run_example("examples/long_context/train.py", [
         "--sp", "4", "--seq_len", "256", "--steps", "6", "--d_model",
         "32", "--num_heads", "2", "--mlp_dim", "64", "--remat"],
