@@ -240,10 +240,52 @@ class Launcher(object):
                 logger.info("no resize followed the preemption; "
                             "respawning trainers in place on pod %s",
                             self._pod.id)
+                self._clear_preempt_keys()
                 self._procs = train_process.start_trainers(
                     self._job_env, self._pod, self._cluster, self._script,
                     self._script_args, self._job_env.log_dir)
                 awaiting_since = None
+
+    def _clear_preempt_keys(self):
+        """Retire STALE preempt:<stage>/* keys before a respawn that
+        REUSES the cluster stage: within the keys' TTL a stale stop_at
+        could make the respawned incarnation immediately re-preempt
+        itself when it resumes from an older checkpoint (min_step below
+        the stale stop), costing an extra restart cycle.
+
+        Staleness criterion (same one the trainer uses): a key's step
+        value at or below the store-published resumed global step is a
+        leftover — the emergency save published that step, so trainers
+        resume there, and a LIVE preemption on another pod always has
+        req/stop values ahead of every live rank's counter, which is
+        ahead of the last checkpoint. A blanket delete would tear down
+        an in-flight preemption's agreed stop_at mid-protocol and split
+        the stop step across ranks. With no published step yet there is
+        nothing to compare — keep everything and rely on the trainer's
+        min_step filter."""
+        from edl_tpu.runtime import state as state_mod
+        service = "preempt:%s" % (self._cluster.stage or "default")
+        try:
+            st = state_mod.load_from_store(self._coord)
+            floor = None if st is None else int(st.global_step)
+        except Exception:
+            floor = None
+        if floor is None:
+            return
+        try:
+            for name, value in self._coord.get_service(service):
+                try:
+                    if isinstance(value, bytes):
+                        value = value.decode("utf-8", "replace")
+                    if int(value) <= floor:
+                        self._coord.remove_server(service, name)
+                except (TypeError, ValueError):
+                    pass
+                except Exception:
+                    pass
+        except Exception:
+            logger.exception("clearing preemption keys failed "
+                             "(stage %s)", self._cluster.stage)
 
     def _resize(self):
         """Stop-resume elasticity (reference: launcher.py:221-244): kill

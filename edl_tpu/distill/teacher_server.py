@@ -223,8 +223,14 @@ def main():
                              seq_len=args.seq_len,
                              max_batch=args.max_batch, port=args.port)
     else:
-        server = nop_teacher({"logits": ([args.num_classes], "<f4")},
-                             max_batch=args.max_batch, port=args.port)
+        # image-shaped feeds so the NOP backend is interchangeable with
+        # the resnet one (same student driver, model cost zeroed out)
+        server = nop_teacher(
+            {"logits": ([args.num_classes], "<f4"),
+             "probs": ([args.num_classes], "<f4")},
+            feed_specs={"image": ([args.image_size, args.image_size, 3],
+                                  "<f4")},
+            max_batch=args.max_batch, port=args.port)
     server.start()
     print("TEACHER_ENDPOINT=%s" % server.endpoint, flush=True)
     stop = threading.Event()

@@ -32,6 +32,7 @@ write may run from a background thread).
 
 import io
 import json
+import uuid
 import zlib
 
 import jax
@@ -159,6 +160,16 @@ class CheckpointManager(object):
                     out.append(v)
         return sorted(out)
 
+    def meta(self, version):
+        """User metadata of a committed version (the ``meta=`` blob the
+        saver passed), or None when the version/meta is unreadable."""
+        try:
+            with self._fs.open(self._vdir(version) + "/meta.json",
+                               "r") as f:
+                return json.load(f).get("meta")
+        except (IOError, OSError, ValueError):
+            return None
+
     def clean_uncommitted(self):
         """Delete version dirs without a MANIFEST — garbage from crashed
         save attempts (the manifest-last invariant makes them invisible
@@ -268,78 +279,156 @@ class CheckpointManager(object):
         when the caller already has a rendezvous (tests, jax.distributed
         sync points).
 
-        A STARTED left by a CRASHED attempt at the same version would
-        let a rank skip the wait and lose its files to rank 0's reset —
-        that is why trainers call clean_uncommitted() at process start.
-        (Within one run versions are monotonic, so a same-version retry
-        against a live stale sentinel cannot occur in the trainer.)"""
+        The STARTED sentinel carries a per-attempt NONCE: ranks echo it
+        in their done markers and rank 0 only accepts markers from the
+        current attempt, so a sentinel left by a crashed or older
+        attempt at the same version (restore fell back to an older
+        version, zero-step epoch re-save) cannot mis-pair two attempts.
+        A non-rank-0 rank that wrote against a stale nonce detects the
+        mismatch after publishing and rewrites its files under the new
+        nonce instead of silently losing them to rank 0's reset. The
+        sentinel and done markers are removed at commit so committed
+        version dirs never carry live protocol state; trainers still
+        call clean_uncommitted() at process start for crashed attempts."""
         vdir = self._vdir(version)
         use_sentinel = barrier is None and nranks > 1
+        nonce = None
         if rank == 0:
             self._fs.delete_tree(vdir)
             self._fs.makedirs(vdir)
             if use_sentinel:
+                nonce = uuid.uuid4().hex
                 with self._fs.open(vdir + "/STARTED", "w") as f:
-                    f.write(str(version))
+                    f.write(nonce)
         if barrier is not None:
             barrier()  # rank0's directory reset must precede any write
-        elif use_sentinel:
-            self._fs_wait(
-                lambda: self._fs.exists(vdir + "/STARTED"),
-                "rank 0 STARTED sentinel (v%d)" % version, timeout)
 
-        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-        dtypes = {}
-        to_save = {}
-        for path, leaf in flat:
-            key = _path_key(path)
-            if hasattr(leaf, "addressable_shards") \
-                    and hasattr(leaf, "sharding"):
-                shards = self._owned_shards(leaf)
-                # fully-replicated leaves land on every process with
-                # replica_id spread; only write replica 0's copy
-                for index, arr in shards:
-                    to_save[self._shard_key(key, index, leaf.shape)] = arr
+        def read_sentinel():
+            try:
+                with self._fs.open(vdir + "/STARTED", "r") as f:
+                    return f.read() or None
+            except (IOError, OSError):
+                return None
+
+        def write_rank_files():
+            flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+            dtypes = {}
+            to_save = {}
+            for path, leaf in flat:
+                key = _path_key(path)
+                if hasattr(leaf, "addressable_shards") \
+                        and hasattr(leaf, "sharding"):
+                    shards = self._owned_shards(leaf)
+                    # fully-replicated leaves land on every process with
+                    # replica_id spread; only write replica 0's copy
+                    for index, arr in shards:
+                        to_save[self._shard_key(key, index, leaf.shape)] \
+                            = arr
+                        if _BFLOAT16 is not None \
+                                and arr.dtype == _BFLOAT16:
+                            dtypes[key] = "bfloat16"
+                elif rank == 0:
+                    arr = np.asarray(leaf)
+                    index = tuple(slice(0, d) for d in arr.shape)
+                    to_save[self._shard_key(key, index, arr.shape)] = arr
                     if _BFLOAT16 is not None and arr.dtype == _BFLOAT16:
                         dtypes[key] = "bfloat16"
-            elif rank == 0:
-                arr = np.asarray(leaf)
-                index = tuple(slice(0, d) for d in arr.shape)
-                to_save[self._shard_key(key, index, arr.shape)] = arr
+            packed = {}
+            for k, arr in to_save.items():
                 if _BFLOAT16 is not None and arr.dtype == _BFLOAT16:
-                    dtypes[key] = "bfloat16"
-        packed = {}
-        for k, arr in to_save.items():
-            if _BFLOAT16 is not None and arr.dtype == _BFLOAT16:
-                arr = arr.view(np.uint16)
-            packed[k] = arr
-        buf = io.BytesIO()
-        np.savez(buf, **packed)
-        payload = buf.getvalue()
-        with self._fs.open("%s/arrays.r%d.npz" % (vdir, rank), "wb") as f:
-            f.write(payload)
-        with self._fs.open("%s/shardmeta.r%d.json" % (vdir, rank),
-                           "w") as f:
-            json.dump({"crc": zlib.crc32(payload), "dtypes": dtypes,
-                       "nbytes": len(payload)}, f)
-        if use_sentinel:
-            # the done marker is written (and closed) strictly AFTER the
-            # data files: its EXISTENCE is the signal, so rank 0 never
-            # json.loads a shardmeta that is still streaming to disk
-            # (POSIX open(w) creates the file before content lands)
-            with self._fs.open("%s/done.r%d" % (vdir, rank), "w") as f:
-                f.write("1")
+                    arr = arr.view(np.uint16)
+                packed[k] = arr
+            buf = io.BytesIO()
+            np.savez(buf, **packed)
+            payload = buf.getvalue()
+            with self._fs.open("%s/arrays.r%d.npz" % (vdir, rank),
+                               "wb") as f:
+                f.write(payload)
+            with self._fs.open("%s/shardmeta.r%d.json" % (vdir, rank),
+                               "w") as f:
+                json.dump({"crc": zlib.crc32(payload), "dtypes": dtypes,
+                           "nbytes": len(payload)}, f)
+
+        if rank == 0 or not use_sentinel:
+            write_rank_files()
+            if use_sentinel:
+                with self._fs.open("%s/done.r%d" % (vdir, rank),
+                                   "w") as f:
+                    f.write(nonce)
+        else:
+            # Write-then-wait-for-resolution loop. A rank cannot tell a
+            # stale sentinel (crashed/older attempt) from rank 0 merely
+            # being slow, so after publishing against nonce N it waits
+            # until either the MANIFEST commits with attempt == N (rank
+            # 0 only commits once every done marker carries its nonce,
+            # so a matching commit proves our files belong to it) or the
+            # sentinel's nonce changes (rank 0 reset the attempt we had
+            # joined and deleted our files — rewrite under the new one).
+            import time as _time
+
+            def manifest_attempt():
+                try:
+                    with self._fs.open(vdir + "/MANIFEST", "r") as f:
+                        return json.load(f).get("attempt")
+                except (IOError, OSError, ValueError):
+                    return None
+
+            deadline = _time.monotonic() + timeout
+            committed = False
+            while not committed:
+                self._fs_wait(
+                    lambda: read_sentinel() is not None,
+                    "rank 0 STARTED sentinel (v%d)" % version,
+                    max(0.01, deadline - _time.monotonic()))
+                nonce = read_sentinel()
+                if nonce is None:
+                    continue
+                try:
+                    write_rank_files()
+                    # done marker is written (and closed) strictly
+                    # AFTER the data files: rank 0 never json.loads a
+                    # shardmeta that is still streaming to disk
+                    with self._fs.open("%s/done.r%d" % (vdir, rank),
+                                       "w") as f:
+                        f.write(nonce)
+                except (IOError, OSError):
+                    # rank 0's delete_tree reset the dir under our open
+                    # writes (we had joined a stale attempt): re-enter
+                    # the loop and rewrite under the fresh nonce
+                    if _time.monotonic() > deadline:
+                        raise
+                    continue
+                delay = 0.02
+                while True:
+                    if manifest_attempt() == nonce:
+                        committed = True
+                        break
+                    cur = read_sentinel()
+                    if cur is not None and cur != nonce:
+                        break  # superseded: retry under the new nonce
+                    if _time.monotonic() > deadline:
+                        raise IOError(
+                            "sharded save v%d rank %d: no commit or "
+                            "supersession for attempt %s"
+                            % (version, rank, nonce))
+                    _time.sleep(delay)
+                    delay = min(delay * 1.5, 0.25)
 
         if barrier is not None:
             barrier()  # every rank's file must exist before the commit
         if rank == 0:
             if use_sentinel:
+                def done_current(r):
+                    try:
+                        with self._fs.open("%s/done.r%d" % (vdir, r),
+                                           "r") as f:
+                            return f.read() == nonce
+                    except (IOError, OSError):
+                        return False
                 self._fs_wait(
-                    lambda: all(self._fs.exists(
-                        "%s/done.r%d" % (vdir, r))
-                        for r in range(nranks)),
-                    "all %d rank done markers (v%d)" % (nranks, version),
-                    timeout)
+                    lambda: all(done_current(r) for r in range(nranks)),
+                    "all %d rank done markers (v%d, attempt %s)"
+                    % (nranks, version, nonce), timeout)
             crcs = {}
             dtypes_all = {}
             for r in range(nranks):
@@ -352,7 +441,17 @@ class CheckpointManager(object):
                 json.dump({"meta": meta or {}, "dtypes": dtypes_all}, f)
             with self._fs.open(vdir + "/MANIFEST", "w") as f:
                 json.dump({"version": version, "sharded": True,
-                           "ranks": nranks, "crcs": crcs}, f)
+                           "ranks": nranks, "crcs": crcs,
+                           "attempt": nonce}, f)
+            if use_sentinel:
+                # retire the attempt's protocol state so a later save
+                # at this version can never pair with this one
+                for name in (["STARTED"]
+                             + ["done.r%d" % r for r in range(nranks)]):
+                    try:
+                        self._fs.delete("%s/%s" % (vdir, name))
+                    except (IOError, OSError):
+                        pass
             logger.info("sharded checkpoint v%d committed (%d ranks)",
                         version, nranks)
             self._gc()

@@ -37,6 +37,10 @@ class FileSystem(object):
     def delete_tree(self, path):
         raise NotImplementedError
 
+    def delete(self, path):
+        """Delete a single file; missing files are not an error."""
+        raise NotImplementedError
+
     def rename(self, src, dst):
         raise NotImplementedError
 
@@ -59,6 +63,12 @@ class LocalFS(FileSystem):
 
     def delete_tree(self, path):
         shutil.rmtree(path, ignore_errors=True)
+
+    def delete(self, path):
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
 
     def rename(self, src, dst):
         os.replace(src, dst)
@@ -201,6 +211,16 @@ class GCSFS(FileSystem):
             except urllib.error.HTTPError as e:
                 if e.code != 404:
                     raise
+
+    def delete(self, path):
+        bucket, obj = _split_gs(path)
+        try:
+            with self._request(
+                    "DELETE", self._obj_url(bucket, obj)) as resp:
+                resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
 
     def rename(self, src, dst):
         raise NotImplementedError(

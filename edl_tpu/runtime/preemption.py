@@ -59,7 +59,8 @@ class CoordinatedStop(object):
 
     def __init__(self, coord, rank, stage="default", margin=4,
                  poll_interval=0.25, current_step=None, min_step=0,
-                 step_time=None):
+                 step_time=None, grace_budget=8.0,
+                 heartbeat_interval=1.0):
         self._coord = coord
         self._rank = rank
         self._service = "preempt:%s" % (stage or "default")
@@ -69,6 +70,16 @@ class CoordinatedStop(object):
         # seconds per train step (callable), for the adaptive margin; 0
         # or None falls back to the fixed step margin
         self._step_time = step_time or (lambda: 0.0)
+        # the stop lead in WALL-CLOCK terms must fit inside the
+        # SIGTERM->SIGKILL grace window: with multi-second steps a fixed
+        # 4-step margin would overshoot it and the save would be killed
+        # mid-flight, so the lead is capped at grace_budget seconds
+        self._grace_budget = grace_budget
+        # every rank (not just requesters) publishes step_<rank> at this
+        # cadence so the leader's stop_at clears the furthest-ahead
+        # rank's counter, not just the requesters'/leader's
+        self._hb_interval = heartbeat_interval
+        self._last_hb = 0.0
         self.stop_at = None
         # stop_at values at or below min_step are STALE (left by a prior
         # incarnation within the key TTL when the stage uuid did not
@@ -157,23 +168,37 @@ class CoordinatedStop(object):
             return
 
         # reqs at or below min_step are a prior incarnation's leftovers
-        # (same stage uuid within the key TTL) — not a live preemption
+        # (same stage uuid within the key TTL) — not a live preemption;
+        # step_<rank> heartbeats widen the max to EVERY live rank's
+        # counter so a fast non-requesting rank cannot already be past
+        # the stop when its watcher observes it
         req_steps = [s for name, v in reqs
                      if name.startswith("req_")
                      and (s := self._as_step(v)) is not None
                      and s > self.min_step]
         if not req_steps:
             return
+        hb_steps = [s for name, v in reqs
+                    if name.startswith("step_")
+                    and (s := self._as_step(v)) is not None
+                    and s > self.min_step]
         # the stop must land AHEAD of every rank's step counter when its
         # watcher observes it: steps are fast (ms) while observation is
         # poll-paced (100s of ms), so a fixed step margin would already
-        # be in the past — convert a few poll intervals into steps using
-        # the measured step time, and start from the furthest-ahead
-        # counter we know of (leader or any requester)
+        # be in the past — convert the observation latency (a few poll
+        # intervals plus one heartbeat period of staleness) into steps
+        # using the measured step time. With SLOW steps the lead is
+        # capped so margin*step_time stays inside the kill grace window.
         dt = float(self._step_time() or 0.0)
-        adaptive = int(4.0 * self._poll / dt) + 1 if dt > 0 else 0
-        stop = (max([int(self._current_step())] + req_steps)
-                + max(self._margin, adaptive))
+        lead = self._margin
+        if dt > 0:
+            adaptive = int((4.0 * self._poll + self._hb_interval)
+                           / dt) + 1
+            lead = max(self._margin, adaptive)
+            max_lead = max(1, int(self._grace_budget / dt))
+            lead = min(lead, max_lead)
+        stop = (max([int(self._current_step())] + req_steps + hb_steps)
+                + lead)
         try:
             existing = self._read_stop_at()
             if existing is not None and existing <= self.min_step:
@@ -190,9 +215,27 @@ class CoordinatedStop(object):
         except Exception:
             logger.exception("preempt stop_at publish failed")
 
+    def _publish_step_heartbeat(self):
+        """Publish this rank's current step (TTL'd) so the leader's
+        stop_at computation covers the furthest-ahead rank, not just
+        requesters. Cheap: one store write per heartbeat interval."""
+        import time
+        now = time.monotonic()
+        if now - self._last_hb < self._hb_interval:
+            return
+        self._last_hb = now
+        try:
+            self._coord.set_server_with_lease(
+                self._service, "step_%d" % self._rank,
+                str(max(int(self._current_step()), self.min_step + 1)),
+                ttl=max(10.0, 4 * self._hb_interval))
+        except Exception:
+            logger.exception("preempt step heartbeat failed")
+
     def _run(self):
         warned_stale = False
         while not self._stop_evt.wait(self._poll):
+            self._publish_step_heartbeat()
             got = self._read_stop_at()
             if got is not None:
                 if got <= self.min_step:

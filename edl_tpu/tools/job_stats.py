@@ -60,17 +60,24 @@ def collect_job_stats(coord, rpc_timeout=5.0):
     else:
         out["train"] = None
 
-    # per-pod resize-recovery histories (written by each launcher)
+    # per-pod resize-recovery histories (written by each launcher) +
+    # per-rank missed-coordinated-stop counters (written by trainers)
     resize = {}
+    missed = {}
     try:
-        for pod_id, raw in coord.get_service(constants.SERVICE_METRICS):
+        for key, raw in coord.get_service(constants.SERVICE_METRICS):
             try:
-                resize[pod_id] = json.loads(raw)
+                val = json.loads(raw)
             except ValueError:
                 continue
+            if key.startswith("preempt_missed"):
+                missed[key] = val
+            else:
+                resize[key] = val
     except Exception:
         pass
     out["resize_history"] = resize
+    out["preempt_missed"] = missed
     events = sorted(
         (e for h in resize.values() for e in h
          if isinstance(e, dict) and "recovery_s" in e),

@@ -1,0 +1,154 @@
+"""End-to-end service-distill throughput measurement.
+
+Answers the reference's headline serving number (README.md:85 — 1514
+img/s with 40 teachers + 8 students) with a MEASURED repo number: one
+real TPU teacher (ResNet50_vd by default) fed by N student processes
+over the real RPC path (ndarray codec, pad-to-compiled-batch, ordered
+task framing), on one host.
+
+Orchestrator mode (default): spawns the teacher subprocess (inherits
+the TPU env), waits for its endpoint, spawns N CPU-scrubbed student
+subprocesses, and prints one JSON line with the aggregate img/s.
+
+    python -m edl_tpu.tools.measure_distill --students 4 --batches 40
+
+Student mode (internal): one DistillReader pumping image batches at the
+teacher, reporting its own samples/s as JSON on stdout.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def run_student(endpoint, batches, batch_size, image_size, fetch):
+    from edl_tpu.distill.distill_reader import DistillReader
+
+    data = np.random.RandomState(os.getpid() % 1000).randn(
+        batch_size, image_size, image_size, 3).astype(np.float32)
+
+    def gen():
+        for _ in range(batches):
+            yield (data,)
+
+    dr = DistillReader(ins=["image"], predicts=[fetch], max_in_flight=8)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([endpoint])
+    try:
+        # warmup epoch: connections + the teacher's XLA compile
+        for _ in dr():
+            break
+        t0 = time.perf_counter()
+        n = sum(1 for _ in dr())
+        dt = time.perf_counter() - t0
+    finally:
+        dr.stop()
+    return {"batches": n, "batch_size": batch_size,
+            "seconds": round(dt, 2),
+            "samples_per_sec": round(n * batch_size / dt, 1)}
+
+
+def _cpu_env():
+    from edl_tpu.utils.cpu_mesh import force_cpu_env
+    return force_cpu_env(dict(os.environ), 1)
+
+
+def orchestrate(args):
+    teacher_cmd = [sys.executable, "-m", "edl_tpu.distill.teacher_server",
+                   "--model", args.model, "--max_batch",
+                   str(args.teacher_batch), "--image_size",
+                   str(args.image_size)]
+    if args.depth:
+        teacher_cmd += ["--depth", str(args.depth)]
+    teacher = subprocess.Popen(teacher_cmd, stdout=subprocess.PIPE,
+                               text=True)
+    endpoint = None
+    deadline = time.time() + 120
+    try:
+        while time.time() < deadline:
+            line = teacher.stdout.readline()
+            if not line:
+                break
+            if line.startswith("TEACHER_ENDPOINT="):
+                endpoint = line.strip().split("=", 1)[1]
+                break
+        if endpoint is None:
+            raise RuntimeError("teacher never published its endpoint")
+        endpoint = endpoint.replace("0.0.0.0", "127.0.0.1")
+
+        student_cmd = [sys.executable, "-m",
+                       "edl_tpu.tools.measure_distill", "--student",
+                       "--teacher_endpoint", endpoint,
+                       "--batches", str(args.batches),
+                       "--batch_size", str(args.batch_size),
+                       "--image_size", str(args.image_size),
+                       "--fetch", args.fetch]
+        env = _cpu_env()
+        t0 = time.perf_counter()
+        students = [subprocess.Popen(student_cmd,
+                                     stdout=subprocess.PIPE, text=True,
+                                     env=env)
+                    for _ in range(args.students)]
+        outs = []
+        for s in students:
+            out, _ = s.communicate(timeout=args.timeout)
+            if s.returncode != 0:
+                raise RuntimeError("student failed rc=%d" % s.returncode)
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+        wall = time.perf_counter() - t0
+        total = sum(o["batches"] * o["batch_size"] for o in outs)
+        # aggregate rate over each student's measured window (excludes
+        # its warmup); wall includes warmup/compile, reported separately
+        agg = sum(o["samples_per_sec"] for o in outs)
+        print(json.dumps({
+            "metric": "distill_imgs_per_sec_per_teacher",
+            "value": round(agg, 1),
+            "unit": "img/s",
+            "students": args.students,
+            "teacher_model": "%s%s" % (args.model, args.depth or ""),
+            "teacher_batch": args.teacher_batch,
+            "student_batch": args.batch_size,
+            "total_images": total,
+            "wall_s_incl_warmup": round(wall, 1),
+            "per_student": [o["samples_per_sec"] for o in outs],
+        }))
+    finally:
+        teacher.terminate()
+        try:
+            teacher.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            teacher.kill()
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("measure end-to-end distill throughput")
+    p.add_argument("--student", action="store_true")
+    p.add_argument("--teacher_endpoint", default=None)
+    p.add_argument("--students", type=int, default=4)
+    p.add_argument("--batches", type=int, default=40)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--teacher_batch", type=int, default=64)
+    p.add_argument("--model", default="resnet",
+                   choices=["resnet", "resnext", "nop"])
+    p.add_argument("--depth", type=int, default=None)
+    p.add_argument("--fetch", default="probs",
+                   help="which teacher output students pull")
+    p.add_argument("--timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+    if args.student:
+        out = run_student(args.teacher_endpoint, args.batches,
+                          args.batch_size, args.image_size, args.fetch)
+        print(json.dumps(out))
+        return 0
+    return orchestrate(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

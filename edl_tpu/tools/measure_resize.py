@@ -1,0 +1,166 @@
+"""TPU resize-recovery measurement: seconds from SIGKILL to the first
+post-restore step, cold vs warm XLA compile cache.
+
+SURVEY.md §7 names restart latency as THE metric to engineer for
+elastic TPU training, and the reference's fault-tolerance story is
+judged in minutes (doc/edl_live_fault_tolerance.md:37, <5 min). This
+tool produces the repo's measured number on real hardware: one launcher
+pod (one chip) training the resnet example, hard-killed mid-run, then
+respawned; recovery is the wall time until the store-visible global
+step advances past the pre-kill step (i.e. the trainer re-initialized,
+re-compiled — or cache-hit — restored, and committed new progress).
+
+    python -m edl_tpu.tools.measure_resize --arcs cold,warm
+
+Each arc prints one JSON line; "warm" sets EDL_TPU_COMPILE_CACHE to a
+dir populated by the arc's initial launch, "cold" leaves it unset.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _spawn_store(tmp):
+    from edl_tpu.coordination.server import StoreServer
+    s = StoreServer(host="127.0.0.1", port=0)
+    s.start()
+    return s
+
+
+def _spawn_pod(store_endpoint, job_id, log_dir, ckpt_dir, cache_dir,
+               args):
+    env = dict(os.environ)  # TPU env inherited
+    env.update({
+        "PYTHONPATH": REPO,
+        "EDL_TPU_POD_IP": "127.0.0.1",
+        "EDL_TPU_TTL": "3",
+        "EDL_TPU_CHECKPOINT_PATH": ckpt_dir,
+    })
+    if cache_dir:
+        env["EDL_TPU_COMPILE_CACHE"] = cache_dir
+    os.makedirs(log_dir, exist_ok=True)
+    log = open(os.path.join(log_dir, "pod.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
+         "--job_id", job_id,
+         "--store_endpoints", store_endpoint,
+         "--nodes_range", "1:1",
+         "--log_dir", os.path.join(log_dir, "trainers"),
+         os.path.join(REPO, "examples", "resnet", "train.py"),
+         "--epochs", "1000",
+         "--steps_per_epoch", str(args.steps_per_epoch),
+         "--total_batch_size", str(args.batch),
+         "--image_size", str(args.image_size),
+         "--num_classes", "100", "--dtype", "bf16",
+         "--fetch_steps", "1"],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+        preexec_fn=os.setsid)
+    log.close()
+    return proc
+
+
+def _kill_group(proc):
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+
+
+def _store_step(coord):
+    try:
+        from edl_tpu.runtime import state as state_mod
+        st = state_mod.load_from_store(coord)
+        return None if st is None else int(st.global_step)
+    except Exception:
+        return None
+
+
+def _wait_step(coord, pred, timeout, proc=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        s = _store_step(coord)
+        if s is not None and pred(s):
+            return s, time.monotonic() - t0
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError("pod exited rc=%r before reaching the "
+                               "target step" % proc.returncode)
+        time.sleep(0.2)
+    raise TimeoutError("step predicate not reached in %.0fs" % timeout)
+
+
+def run_arc(tag, cache_dir, args):
+    from edl_tpu.coordination.client import CoordClient
+
+    tmp = tempfile.mkdtemp(prefix="measure_resize_%s_" % tag)
+    store = _spawn_store(tmp)
+    job_id = "rz_%s_%d" % (tag, os.getpid())
+    coord = CoordClient([store.endpoint], root=job_id)
+    pod = None
+    try:
+        pod = _spawn_pod(store.endpoint, job_id,
+                         os.path.join(tmp, "logs"),
+                         os.path.join(tmp, "ckpt"), cache_dir, args)
+        # initial launch: first epoch committed == compile + ckpt work
+        s0, t_first = _wait_step(coord, lambda s: s >= args.steps_per_epoch,
+                                 args.timeout, pod)
+        t0 = time.monotonic()
+        _kill_group(pod)
+        pod = _spawn_pod(store.endpoint, job_id,
+                         os.path.join(tmp, "logs2"),
+                         os.path.join(tmp, "ckpt"), cache_dir, args)
+        s1, _ = _wait_step(coord, lambda s: s > s0, args.timeout, pod)
+        recovery = time.monotonic() - t0
+        return {
+            "metric": "resize_recovery_s_%s_cache" % tag,
+            "value": round(recovery, 1),
+            "unit": "s",
+            "initial_launch_to_first_epoch_s": round(t_first, 1),
+            "pre_kill_step": s0, "first_post_restore_step": s1,
+            "steps_per_epoch": args.steps_per_epoch,
+            "batch": args.batch, "image_size": args.image_size,
+        }
+    finally:
+        if pod is not None:
+            _kill_group(pod)
+        store.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("measure kill->first-step recovery")
+    p.add_argument("--arcs", default="cold,warm")
+    p.add_argument("--steps_per_epoch", type=int, default=20)
+    p.add_argument("--batch", type=int, default=128)
+    p.add_argument("--image_size", type=int, default=224)
+    p.add_argument("--timeout", type=float, default=600.0)
+    args = p.parse_args(argv)
+    cache_dir = tempfile.mkdtemp(prefix="measure_resize_cache_")
+    rc = 0
+    try:
+        for tag in args.arcs.split(","):
+            tag = tag.strip()
+            try:
+                out = run_arc(tag,
+                              cache_dir if tag == "warm" else None, args)
+                print(json.dumps(out), flush=True)
+            except Exception as e:  # noqa: BLE001
+                print(json.dumps({"metric": "resize_recovery_s_%s_cache"
+                                  % tag, "error": repr(e)}), flush=True)
+                rc = 1
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -206,6 +206,55 @@ def test_sharded_sentinel_protocol_two_ranks(ckpt_fs):
     np.testing.assert_array_equal(restored["opt"]["mu"], host["opt"]["mu"])
 
 
+def test_sharded_stale_sentinel_nonce_recovery(ckpt_fs):
+    """A STARTED sentinel left by a crashed/older attempt at the SAME
+    version must not pair the two attempts: rank 1 that joined the stale
+    attempt rewrites its files under rank 0's fresh nonce, and the
+    commit retires the sentinel + done markers (advisor r3, medium)."""
+    import threading
+    import time
+
+    base, fs = ckpt_fs
+    cm0, cm1 = _cm(ckpt_fs), _cm(ckpt_fs)
+    tree, host = _sharded_tree(7)
+    vdir = base + "/v_00000007"
+    # simulate a crashed attempt: live stale sentinel, no MANIFEST
+    fs.makedirs(vdir)
+    with fs.open(vdir + "/STARTED", "w") as f:
+        f.write("stalestalestale")
+    errs = []
+
+    def rank1():
+        try:
+            cm1.save_sharded(7, {}, rank=1, nranks=2, timeout=30)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=rank1)
+    t.start()
+    # rank 1 sees the stale sentinel and publishes against it
+    deadline = time.time() + 10
+    while not fs.exists(vdir + "/done.r1") and time.time() < deadline:
+        time.sleep(0.02)
+    assert fs.exists(vdir + "/done.r1")
+    # now rank 0 starts the REAL attempt: reset + fresh nonce
+    cm0.save_sharded(7, tree, meta={"k": 7}, rank=0, nranks=2,
+                     timeout=30)
+    t.join(timeout=30)
+    assert not t.is_alive() and not errs, errs
+    with fs.open(vdir + "/MANIFEST", "r") as f:
+        manifest = json.load(f)
+    assert manifest["ranks"] == 2 and set(manifest["crcs"]) == {"0", "1"}
+    # protocol state is retired at commit
+    assert not fs.exists(vdir + "/STARTED")
+    assert not fs.exists(vdir + "/done.r0")
+    assert not fs.exists(vdir + "/done.r1")
+    version, restored, meta = cm0.restore_latest(
+        target=_struct_target(tree))
+    assert version == 7 and meta == {"k": 7}
+    np.testing.assert_array_equal(restored["opt"]["mu"], host["opt"]["mu"])
+
+
 def test_sharded_corrupt_rank_file_falls_back(ckpt_fs):
     base, fs = ckpt_fs
     cm = _cm(ckpt_fs)

@@ -461,6 +461,95 @@ def test_coordinated_stop_staleness_defenses(coord):
         c1.stop()
 
 
+def test_coordinated_stop_covers_ahead_nonrequester(coord):
+    """A non-requesting rank whose step counter runs AHEAD of both the
+    leader and the requester publishes step heartbeats, so the leader's
+    stop_at still lands ahead of it (advisor r3: stop_at was
+    max(leader, requesters) only)."""
+    import time
+
+    from edl_tpu.runtime.preemption import CoordinatedStop
+
+    c0 = CoordinatedStop(coord, 0, stage="stgA", margin=4,
+                         poll_interval=0.05,
+                         current_step=lambda: 10).start()
+    c1 = CoordinatedStop(coord, 1, stage="stgA", poll_interval=0.05,
+                         current_step=lambda: 12).start()
+    # rank 2 is far ahead and never receives a signal
+    c2 = CoordinatedStop(coord, 2, stage="stgA", poll_interval=0.05,
+                         current_step=lambda: 40,
+                         heartbeat_interval=0.05).start()
+    try:
+        # let rank 2's heartbeat land before the preemption fires
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                coord.get_value("preempt:stgA", "step_2") is None:
+            time.sleep(0.02)
+        c1.request(12)
+        deadline = time.time() + 10
+        while time.time() < deadline and (c0.stop_at is None
+                                          or c2.stop_at is None):
+            time.sleep(0.05)
+        # stop must clear rank 2's counter (40), not just max(10,12)
+        assert c0.stop_at is not None and c0.stop_at > 40
+        assert c2.stop_at == c0.stop_at
+    finally:
+        c0.stop()
+        c1.stop()
+        c2.stop()
+
+
+def test_coordinated_stop_margin_capped_by_grace_budget(coord):
+    """With multi-second steps the stop lead is capped so
+    lead*step_time fits the SIGTERM->SIGKILL grace window instead of
+    scheduling the save past the kill (advisor r3)."""
+    import time
+
+    from edl_tpu.runtime.preemption import CoordinatedStop
+
+    # 5 s/step, 8 s grace budget -> lead = max(1, int(8/5)) = 1 step,
+    # despite margin=4
+    c0 = CoordinatedStop(coord, 0, stage="stgB", margin=4,
+                         poll_interval=0.05, current_step=lambda: 100,
+                         step_time=lambda: 5.0,
+                         grace_budget=8.0).start()
+    try:
+        c0.request(100)
+        deadline = time.time() + 10
+        while time.time() < deadline and c0.stop_at is None:
+            time.sleep(0.05)
+        assert c0.stop_at == 101, c0.stop_at
+    finally:
+        c0.stop()
+
+
+def test_launcher_clears_only_stale_preempt_keys(coord):
+    """Respawn-in-place retires preempt keys at or below the resumed
+    step (advisor r3: stale stop_at re-preempts the respawn) but must
+    NOT touch a live in-flight preemption's keys (code review r4: a
+    blanket delete would split the agreed stop step mid-protocol)."""
+    import types
+
+    from edl_tpu.controller.launcher import Launcher
+    from edl_tpu.runtime import state as state_mod
+
+    st = state_mod.State()
+    st.global_step = 50
+    state_mod.save_to_store(coord, st)
+    # stale leftovers (<= resumed step 50) and live keys (ahead of it)
+    coord.set_server_with_lease("preempt:stg9", "stop_at", "48", ttl=60)
+    coord.set_server_with_lease("preempt:stg9", "req_1", "47", ttl=60)
+    coord.set_server_with_lease("preempt:stg9", "req_2", "55", ttl=60)
+    coord.set_server_with_lease("preempt:stg9", "step_3", "60", ttl=60)
+
+    stub = types.SimpleNamespace(
+        _coord=coord, _cluster=types.SimpleNamespace(stage="stg9"))
+    Launcher._clear_preempt_keys(stub)
+    left = dict(coord.get_service("preempt:stg9"))
+    assert "stop_at" not in left and "req_1" not in left
+    assert left.get("req_2") == "55" and left.get("step_3") == "60"
+
+
 def test_locked_make_serializes_concurrent_builds(tmp_path):
     """Two processes running locked_make on the same target do not race
     two compilers onto one output file."""

@@ -1,0 +1,13 @@
+#!/bin/bash
+# End-to-end distill serving measurement on the real chip (VERDICT r3
+# next-round item 3): a ResNet50_vd teacher on the TPU, driven by N
+# CPU student processes over the real RPC path. One JSON line per
+# config. Run from a healthy tunnel window (the harvester does).
+cd "$(dirname "$0")/.." || exit 1
+for n in 2 4 8; do
+  echo "--- students=$n ---"
+  timeout 280 python -m edl_tpu.tools.measure_distill \
+    --model resnet --depth 50 --students "$n" \
+    --batches 30 --batch_size 64 --teacher_batch 64 \
+    --image_size 224 --timeout 260
+done

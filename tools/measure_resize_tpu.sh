@@ -1,0 +1,7 @@
+#!/bin/bash
+# TPU resize recovery (VERDICT r3 next-round item 5): SIGKILL -> first
+# post-restore step on the real chip, cold vs warm XLA compile cache.
+cd "$(dirname "$0")/.." || exit 1
+timeout 850 python -m edl_tpu.tools.measure_resize \
+  --arcs cold,warm --steps_per_epoch 20 --batch 128 --image_size 224 \
+  --timeout 400
