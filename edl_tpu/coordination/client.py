@@ -7,6 +7,7 @@ with add/remove diffing — but speaks to the in-tree Store over framed RPC.
 """
 
 import threading
+import time
 
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors
@@ -90,7 +91,8 @@ class Watcher(object):
 
 
 class CoordClient(object):
-    def __init__(self, endpoints, root="edl", timeout=60.0):
+    def __init__(self, endpoints, root="edl", timeout=60.0,
+                 failover_grace=15.0):
         if isinstance(endpoints, str):
             endpoints = [e for e in endpoints.split(",") if e]
         self._endpoints = list(endpoints)
@@ -98,6 +100,10 @@ class CoordClient(object):
             raise errors.ConnectError("no coordination endpoints given")
         self._root = root
         self._timeout = timeout
+        # how long a call keeps retrying endpoint rotation when EVERY
+        # endpoint refuses — covers the primary-death -> standby-promote
+        # window (standby.py); single-endpoint clients fail fast
+        self._failover_grace = failover_grace
         # per-thread connections: a watcher's long-poll must not block
         # lease-refresh heartbeats issued from other threads
         self._local = threading.local()
@@ -123,25 +129,39 @@ class CoordClient(object):
 
     def _call(self, method, *args, **kwargs):
         last = None
-        # +1: a stale cached connection (severed by a server restart) costs
-        # one attempt; the fresh reconnect deserves its own
-        for _ in range(len(self._endpoints) + 1):
-            rpc = getattr(self._local, "rpc", None)
-            if rpc is None:
-                with self._ep_lock:
-                    endpoint = self._endpoints[0]
-                rpc = self._local.rpc = RpcClient(endpoint,
-                                                  timeout=self._timeout)
-            try:
-                return rpc.call(method, *args, **kwargs)
-            except errors.ConnectError as e:
-                last = e
-                rpc.close()
-                self._local.rpc = None
-                with self._ep_lock:
-                    if self._endpoints[0] == rpc.endpoint:
-                        self._endpoints.append(self._endpoints.pop(0))
-        raise last
+        deadline = None
+        while True:
+            # +1: a stale cached connection (severed by a server restart)
+            # costs one attempt; the fresh reconnect deserves its own
+            for _ in range(len(self._endpoints) + 1):
+                rpc = getattr(self._local, "rpc", None)
+                if rpc is None:
+                    with self._ep_lock:
+                        endpoint = self._endpoints[0]
+                    rpc = self._local.rpc = RpcClient(
+                        endpoint, timeout=self._timeout)
+                try:
+                    return rpc.call(method, *args, **kwargs)
+                except errors.ConnectError as e:
+                    last = e
+                    rpc.close()
+                    self._local.rpc = None
+                    with self._ep_lock:
+                        if self._endpoints[0] == rpc.endpoint:
+                            self._endpoints.append(self._endpoints.pop(0))
+            if len(self._endpoints) < 2:
+                raise last
+            # multi-endpoint deployments have a FAILOVER WINDOW: the
+            # primary is gone but the standby has not promoted yet and
+            # still answers ConnectError. Retrying rotation rounds for
+            # a bounded grace keeps control-plane calls alive across
+            # the takeover instead of surfacing a transient outage.
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + self._failover_grace
+            if now >= deadline:
+                raise last
+            time.sleep(0.5)
 
     # -- raw KV -------------------------------------------------------------
 
@@ -150,6 +170,11 @@ class CoordClient(object):
 
     def get_key(self, key):
         return self._call("store_get", key)
+
+    def get_prefix_raw(self, prefix):
+        """Raw (kv dicts incl. lease_id, revision) under a raw-key
+        prefix — the replication primitive (standby.py)."""
+        return self._call("store_get_prefix", prefix)
 
     def delete(self, key):
         return self._call("store_delete", key)

@@ -1,0 +1,240 @@
+"""Warm-standby replication for the coordination store — the
+availability story the reference got from etcd clustering
+(scripts/download_etcd.sh:18-36 ran a raft cluster; client endpoint
+lists are plural in edl/discovery/etcd_client.py:51-56).
+
+The in-tree store is durable (WAL, fsync, crash-tested) but a
+single-node primary stalls the whole control plane until restarted.
+This module adds a second server that keeps a live mirror and takes
+over on primary loss, completing the story without importing raft:
+
+- The standby runs a full Store + RpcServer but REJECTS client ops
+  with ConnectError while the primary is alive, so CoordClient's
+  endpoint rotation always lands writes on the primary (no
+  split-brain window from clients racing the two servers).
+- A replication thread long-polls the primary's event stream and
+  mirrors PERMANENT keys (the WAL-covered set: cluster maps, job
+  status, train state). Leased keys are deliberately NOT mirrored —
+  the store's own restart semantics already demand that ephemeral
+  owners re-register within a TTL, and promotion reuses exactly that
+  contract.
+- On sustained primary unreachability the standby PROMOTES: it seeds
+  its revision floor above everything the primary ever issued, so
+  every watcher holding primary revisions gets a "reset" event and
+  re-lists, and starts serving. From the control plane's view a
+  promotion is indistinguishable from a store restart-with-WAL — a
+  scenario every component already survives (tests/test_store_durability.py).
+
+One-way door: a demoted primary must never rejoin with its old
+identity. Operational contract (docs/operations.md): wipe or restart
+the old primary as a NEW standby pointed at the promoted server.
+
+Durability bound, stated honestly: writes are acked by the primary
+alone, so a failover can lose the last <= ``sync_poll`` seconds of
+acked permanent writes (RPO ~ sync_poll; raft's is 0). For this
+control plane that loss re-runs a cluster commit or re-publishes a
+status — every writer is a periodic reconciler, so a lost write is
+re-written by its owner — which is why asynchronous mirroring is the
+right cost/benefit against a full consensus log.
+"""
+
+import argparse
+import threading
+import time
+
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.coordination.store import Store
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+# revision headroom over the primary's last seen revision: covers ops
+# the primary issued after our last successful sync (same margin the
+# Store's own WAL restart path uses)
+_REV_MARGIN = 1 << 20
+
+
+class StandbyServer(object):
+    """``primary_endpoints``: where the live primary serves.
+    ``auto_promote``: take over after ``promote_after`` seconds of
+    primary unreachability (set False for operator-driven failover via
+    ``promote()``)."""
+
+    def __init__(self, primary_endpoints, host="0.0.0.0", port=0,
+                 wal_path=None, auto_promote=True, promote_after=5.0,
+                 sync_poll=2.0):
+        self.store = Store(wal_path=wal_path)
+        self._primary = CoordClient(primary_endpoints, timeout=10.0)
+        self._auto_promote = auto_promote
+        self._promote_after = promote_after
+        self._sync_poll = sync_poll
+        self._promoted = threading.Event()
+        self._stop = threading.Event()
+        self._last_primary_rev = 0
+        self._last_ok = None  # monotonic time of last successful sync
+        self.synced = threading.Event()  # first full snapshot applied
+
+        self._rpc = RpcServer(host=host, port=port)
+        s = self.store
+        for name in ("put", "put_if_absent", "get", "get_prefix",
+                     "delete", "delete_prefix", "txn", "wait_events",
+                     "lease_grant", "lease_refresh", "lease_revoke",
+                     "revision"):
+            self._rpc.register("store_" + name,
+                               self._guard(getattr(s, name)))
+        self._rpc.register("standby_status", self.status)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="standby-sync")
+
+    # -- serving gate --------------------------------------------------------
+
+    def _guard(self, fn):
+        def guarded(*a, **k):
+            if not self._promoted.is_set():
+                # ConnectError re-raises client-side as ConnectError,
+                # which is the one error CoordClient rotates on — the
+                # client walks back to the primary
+                raise errors.ConnectError("standby: not serving "
+                                          "(primary is authoritative)")
+            return fn(*a, **k)
+        return guarded
+
+    def status(self):
+        return {"promoted": self._promoted.is_set(),
+                "synced": self.synced.is_set(),
+                "last_primary_rev": self._last_primary_rev}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        self._rpc.start()
+        self._thread.start()
+        logger.info("standby serving (gated) on %s", self.endpoint)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=self._sync_poll + 12)
+        self._rpc.stop()
+        self.store.close()
+
+    @property
+    def endpoint(self):
+        return self._rpc.endpoint
+
+    @property
+    def promoted(self):
+        return self._promoted.is_set()
+
+    # -- replication ---------------------------------------------------------
+
+    def _snapshot_sync(self):
+        """Mirror the primary's permanent keys wholesale. Control-plane
+        state is tiny (a few KB), so a full snapshot per change beats
+        replaying per-event semantics (no lease info on events)."""
+        kvs, rev = self._primary.get_prefix_raw("")
+        if self._promoted.is_set():
+            # a concurrent promote() made the local store authoritative
+            return self._last_primary_rev
+        want = {kv["key"]: kv["value"] for kv in kvs
+                if kv["lease_id"] is None}
+        have, _ = self.store.get_prefix("")
+        for kv in have:
+            if kv["lease_id"] is None and kv["key"] not in want:
+                self.store.delete(kv["key"])
+        for key, value in want.items():
+            cur = self.store.get(key)
+            if cur is None or cur["value"] != value:
+                self.store.put(key, value)
+        self._last_primary_rev = max(self._last_primary_rev, rev)
+        return rev
+
+    def _run(self):
+        rev = None
+        while not self._stop.is_set():
+            if self._promoted.is_set():
+                return
+            try:
+                if rev is None or not self.synced.is_set():
+                    rev = self._snapshot_sync()
+                    self.synced.set()
+                else:
+                    events, new_rev = self._primary.wait_events(
+                        "", rev, self._sync_poll)
+                    # an operator promote() may have landed while the
+                    # long-poll was in flight: applying this (old
+                    # primary) snapshot would clobber writes the
+                    # promoted store has since accepted
+                    if self._promoted.is_set():
+                        return
+                    self._last_primary_rev = max(self._last_primary_rev,
+                                                 new_rev)
+                    if events:
+                        rev = self._snapshot_sync()
+                    else:
+                        rev = new_rev
+                self._last_ok = time.monotonic()
+            except errors.EdlError:
+                now = time.monotonic()
+                if self._last_ok is None:
+                    self._last_ok = now  # start the clock on first failure
+                if (self._auto_promote
+                        and self.synced.is_set()
+                        and now - self._last_ok >= self._promote_after):
+                    # never auto-promote an UNSYNCED store: serving an
+                    # empty control plane is strictly worse than staying
+                    # gated (and if the outage is a standby<->primary
+                    # partition only, an empty promote is split-brain
+                    # with nothing to show for it)
+                    self.promote()
+                    return
+                self._stop.wait(0.5)
+            except Exception:
+                logger.exception("standby sync failed")
+                self._stop.wait(0.5)
+
+    def promote(self):
+        """Take over: revision floor above anything the primary issued,
+        then open the serving gate. Idempotent."""
+        if self._promoted.is_set():
+            return
+        self.store.seed_revision_above(self._last_primary_rev
+                                       + _REV_MARGIN)
+        self._promoted.set()
+        logger.warning("standby PROMOTED (primary unreachable); serving "
+                       "as primary on %s", self.endpoint)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("edl_tpu store standby")
+    p.add_argument("--primary", required=True,
+                   help="primary endpoints, comma-separated host:port")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=2380)
+    p.add_argument("--data_dir", default=None,
+                   help="WAL dir (durable standby)")
+    p.add_argument("--promote_after", type=float, default=5.0)
+    p.add_argument("--no-auto-promote", dest="auto_promote",
+                   action="store_false")
+    args = p.parse_args(argv)
+    import os
+    wal = (os.path.join(args.data_dir, "standby.wal")
+           if args.data_dir else None)
+    s = StandbyServer(args.primary.split(","), host=args.host,
+                      port=args.port, wal_path=wal,
+                      auto_promote=args.auto_promote,
+                      promote_after=args.promote_after)
+    s.start()
+    print("STANDBY_ENDPOINT=%s" % s.endpoint, flush=True)
+    stop = threading.Event()
+    import signal
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    s.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

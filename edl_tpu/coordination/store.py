@@ -226,6 +226,22 @@ class Store(object):
         with self._lock:
             return self._rev
 
+    def seed_revision_above(self, rev):
+        """Jump the revision AND the re-list floor above ``rev``: every
+        watcher holding an older revision gets a reset event and
+        re-lists. The standby-promotion primitive — makes a takeover
+        look exactly like the restart-with-WAL path (which seeds the
+        same way in __init__)."""
+        with self._lock:
+            self._rev = max(self._rev, int(rev))
+            self._floor_rev = self._rev
+            self._events.clear()
+            self._cond.notify_all()
+            if self._wal is not None:
+                self._log({"op": "rev", "r": self._rev})
+                self._wal_watermark = self._rev
+                self._sync_locked()
+
     def lease_grant(self, ttl):
         with self._lock:
             lid = self._next_lease

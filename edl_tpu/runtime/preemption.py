@@ -60,7 +60,7 @@ class CoordinatedStop(object):
     def __init__(self, coord, rank, stage="default", margin=4,
                  poll_interval=0.25, current_step=None, min_step=0,
                  step_time=None, grace_budget=8.0,
-                 heartbeat_interval=1.0):
+                 heartbeat_interval=2.0):
         self._coord = coord
         self._rank = rank
         self._service = "preempt:%s" % (stage or "default")
@@ -77,9 +77,14 @@ class CoordinatedStop(object):
         self._grace_budget = grace_budget
         # every rank (not just requesters) publishes step_<rank> at this
         # cadence so the leader's stop_at clears the furthest-ahead
-        # rank's counter, not just the requesters'/leader's
+        # rank's counter, not just the requesters'/leader's. It must
+        # run BEFORE any preemption is pending (stop_at is computed
+        # from whatever is on the store at request time), so the cost
+        # is bounded instead: one lease granted once then refreshed,
+        # one leased put (no fsync) per interval, 2s default cadence
         self._hb_interval = heartbeat_interval
         self._last_hb = 0.0
+        self._hb_lease = None
         self.stop_at = None
         # stop_at values at or below min_step are STALE (left by a prior
         # incarnation within the key TTL when the stage uuid did not
@@ -218,18 +223,26 @@ class CoordinatedStop(object):
     def _publish_step_heartbeat(self):
         """Publish this rank's current step (TTL'd) so the leader's
         stop_at computation covers the furthest-ahead rank, not just
-        requesters. Cheap: one store write per heartbeat interval."""
+        requesters. One lease is granted once and refreshed; each
+        interval costs refresh + leased put (no fsync)."""
         import time
         now = time.monotonic()
         if now - self._last_hb < self._hb_interval:
             return
         self._last_hb = now
+        value = str(max(int(self._current_step()), self.min_step + 1))
+        key = self._coord.server_key(self._service,
+                                     "step_%d" % self._rank)
+        ttl = max(10.0, 4 * self._hb_interval)
         try:
-            self._coord.set_server_with_lease(
-                self._service, "step_%d" % self._rank,
-                str(max(int(self._current_step()), self.min_step + 1)),
-                ttl=max(10.0, 4 * self._hb_interval))
+            if self._hb_lease is not None and \
+                    self._coord.lease_refresh(self._hb_lease):
+                self._coord.put(key, value, lease_id=self._hb_lease)
+            else:
+                self._hb_lease = self._coord.lease_grant(ttl)
+                self._coord.put(key, value, lease_id=self._hb_lease)
         except Exception:
+            self._hb_lease = None
             logger.exception("preempt step heartbeat failed")
 
     def _run(self):

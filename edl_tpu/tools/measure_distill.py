@@ -67,18 +67,35 @@ def orchestrate(args):
         teacher_cmd += ["--depth", str(args.depth)]
     teacher = subprocess.Popen(teacher_cmd, stdout=subprocess.PIPE,
                                text=True)
-    endpoint = None
-    deadline = time.time() + 120
     try:
+        # readline() blocks with no timeout, so a teacher that wedges
+        # during device init without printing would hang the
+        # orchestrator forever — read from a thread, bound the join
+        import queue
+        import threading
+        lines = queue.Queue()
+
+        def pump():
+            for line in teacher.stdout:
+                lines.put(line)
+            lines.put(None)
+        threading.Thread(target=pump, daemon=True).start()
+        endpoint = None
+        deadline = time.time() + 120
         while time.time() < deadline:
-            line = teacher.stdout.readline()
-            if not line:
+            try:
+                line = lines.get(timeout=max(
+                    0.1, deadline - time.time()))
+            except queue.Empty:
+                break
+            if line is None:
                 break
             if line.startswith("TEACHER_ENDPOINT="):
                 endpoint = line.strip().split("=", 1)[1]
                 break
         if endpoint is None:
-            raise RuntimeError("teacher never published its endpoint")
+            raise RuntimeError("teacher never published its endpoint "
+                               "within 120s")
         endpoint = endpoint.replace("0.0.0.0", "127.0.0.1")
 
         student_cmd = [sys.executable, "-m",

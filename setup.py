@@ -15,6 +15,7 @@ setup(
             # reference parity: `edlrun` (setup.py.in:85)
             "edl-tpu-run=edl_tpu.controller.launch:main",
             "edl-tpu-store=edl_tpu.coordination.server:main",
+            "edl-tpu-store-standby=edl_tpu.coordination.standby:main",
             "edl-tpu-teacher=edl_tpu.distill.teacher_server:main",
             "edl-tpu-discovery=edl_tpu.distill.discovery_server:main",
             "edl-tpu-register=edl_tpu.distill.registry:main",

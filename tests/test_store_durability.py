@@ -182,3 +182,192 @@ def test_register_survives_store_restart(tmp_path):
         s2.stop()
     finally:
         reg.stop()
+
+
+# -- warm standby / failover (VERDICT r3 missing #2) -----------------------
+
+
+def _wait(pred, timeout=15.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_standby_mirrors_permanent_keys_only():
+    from edl_tpu.coordination.standby import StandbyServer
+
+    primary = StoreServer(host="127.0.0.1").start()
+    c = CoordClient([primary.endpoint], root="ha")
+    c.set_server_permanent("cluster", "cluster", "v1")
+    c.set_server_with_lease("resource", "podA", "x", ttl=30)
+    sb = StandbyServer([primary.endpoint], host="127.0.0.1",
+                       auto_promote=False).start()
+    try:
+        assert _wait(sb.synced.is_set)
+        # live updates replicate
+        c.set_server_permanent("job_status", "job_status", "RUNNING")
+        c.set_server_permanent("cluster", "cluster", "v2")
+        key = c.server_key("cluster", "cluster")
+
+        def mirrored():
+            kv = sb.store.get(key)
+            return kv is not None and kv["value"] == "v2" and \
+                sb.store.get(c.server_key("job_status",
+                                          "job_status")) is not None
+        assert _wait(mirrored)
+        # the leased key is NOT mirrored (restart semantics: owners
+        # re-register after failover)
+        assert sb.store.get(c.server_key("resource", "podA")) is None
+        # deletes replicate
+        c.remove_server("job_status", "job_status")
+        assert _wait(lambda: sb.store.get(
+            c.server_key("job_status", "job_status")) is None)
+    finally:
+        sb.stop()
+        primary.stop()
+
+
+def test_standby_rejects_ops_until_promoted_and_client_rotates():
+    """A client configured with [standby, primary] must transparently
+    land every op on the primary while the standby is gated."""
+    from edl_tpu.coordination.standby import StandbyServer
+    from edl_tpu.utils import errors as errors_mod
+
+    primary = StoreServer(host="127.0.0.1").start()
+    sb = StandbyServer([primary.endpoint], host="127.0.0.1",
+                       auto_promote=False).start()
+    try:
+        # direct client pinned to the standby alone: refused
+        lone = CoordClient([sb.endpoint], root="ha", failover_grace=0.0)
+        try:
+            lone.set_server_permanent("svc", "k", "v")
+            assert False, "standby accepted a write while gated"
+        except errors_mod.ConnectError:
+            pass
+        # standby listed FIRST: rotation must find the primary
+        both = CoordClient([sb.endpoint, primary.endpoint], root="ha")
+        both.set_server_permanent("svc", "k", "v")
+        assert both.get_value("svc", "k") == "v"
+        direct = CoordClient([primary.endpoint], root="ha")
+        assert direct.get_value("svc", "k") == "v"
+    finally:
+        sb.stop()
+        primary.stop()
+
+
+def test_standby_promotes_on_primary_loss_and_control_plane_survives():
+    """Kill the primary; the standby auto-promotes within its window;
+    a client holding BOTH endpoints keeps working; permanent state is
+    intact; a watcher from the primary era gets reset and re-lists;
+    ephemeral owners re-register (the Register round-trips)."""
+    from edl_tpu.coordination.standby import StandbyServer
+
+    primary = StoreServer(host="127.0.0.1").start()
+    c = CoordClient([primary.endpoint], root="ha")
+    c.set_server_permanent("cluster", "cluster", "mapv1")
+    sb = StandbyServer([primary.endpoint], host="127.0.0.1",
+                       auto_promote=True, promote_after=1.0,
+                       sync_poll=0.5).start()
+    ha_client = CoordClient([primary.endpoint, sb.endpoint], root="ha",
+                            failover_grace=20.0)
+
+    seen = []
+    watcher = ha_client.watch_service(
+        "cluster", lambda a, r, al: seen.append(dict(al)),
+        poll_timeout=1.0)
+    reg = None
+    try:
+        assert _wait(sb.synced.is_set)
+        assert _wait(lambda: any("cluster" in s for s in seen))
+
+        primary.stop()  # the outage
+        assert _wait(lambda: sb.promoted, timeout=30)
+
+        # control-plane calls keep working through the SAME client
+        assert ha_client.get_value("cluster", "cluster") == "mapv1"
+        ha_client.set_server_permanent("job_status", "job_status",
+                                       "RUNNING")
+        assert ha_client.get_value("job_status", "job_status") \
+            == "RUNNING"
+
+        # ephemeral re-registration against the promoted standby
+        reg = Register(ha_client, "resource", "podZ", "zv", ttl=3)
+        assert _wait(lambda: ha_client.get_value("resource", "podZ")
+                     == "zv")
+
+        # the watcher survived: an update through the promoted server
+        # reaches it (reset -> re-list path)
+        ha_client.set_server_permanent("cluster", "cluster", "mapv2")
+        assert _wait(lambda: any(s.get("cluster") == "mapv2"
+                                 for s in seen), timeout=20)
+    finally:
+        watcher.stop()
+        if reg is not None:
+            reg.stop()
+        sb.stop()
+
+
+def test_primary_loss_mid_job_chaos(tmp_path):
+    """The north-star HA drill: a 2-pod launcher job running against
+    [primary, standby]; the primary is killed MID-JOB; the standby
+    promotes; leases, elections, barriers, and the job verdict all
+    continue on the survivor and the job completes SUCCEED."""
+    import os
+    import signal as signal_mod
+    import subprocess
+    import sys
+
+    from edl_tpu.controller import cluster as cluster_mod
+    from edl_tpu.controller import status
+    from edl_tpu.coordination.standby import StandbyServer
+
+    primary = StoreServer(host="127.0.0.1").start()
+    sb = StandbyServer([primary.endpoint], host="127.0.0.1",
+                       auto_promote=True, promote_after=1.5,
+                       sync_poll=0.5).start()
+    endpoints = "%s,%s" % (primary.endpoint, sb.endpoint)
+    job = "chaos_ha"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    trainer = os.path.join(repo, "tests", "fixtures", "dummy_trainer.py")
+    env = dict(os.environ)
+    env.update({"PYTHONPATH": repo, "EDL_TPU_POD_IP": "127.0.0.1",
+                "EDL_TPU_TTL": "3", "JAX_PLATFORMS": "cpu",
+                "PALLAS_AXON_POOL_IPS": ""})
+
+    def spawn(name):
+        log = open(str(tmp_path / (name + ".log")), "wb")
+        p = subprocess.Popen(
+            [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
+             "--job_id", job, "--store_endpoints", endpoints,
+             "--nodes_range", "1:2",
+             "--log_dir", str(tmp_path / (name + "_logs")),
+             trainer, "25", "0"],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            preexec_fn=os.setsid)
+        log.close()
+        return p
+
+    pods = [spawn("pod1"), spawn("pod2")]
+    ha_client = CoordClient(endpoints.split(","), root=job,
+                            failover_grace=25.0)
+    try:
+        # job is up: agreed cluster on the store
+        assert _wait(lambda: cluster_mod.load_from_store(ha_client)
+                     is not None, timeout=30)
+        time.sleep(3)  # trainers are mid-run
+        primary.stop()  # the outage
+        assert _wait(lambda: sb.promoted, timeout=30)
+        for p in pods:
+            assert p.wait(timeout=150) == 0, \
+                (tmp_path / "pod1.log").read_text()[-3000:]
+        assert status.load_job_status(ha_client) == status.Status.SUCCEED
+    finally:
+        for p in pods:
+            try:
+                os.killpg(os.getpgid(p.pid), signal_mod.SIGKILL)
+            except ProcessLookupError:
+                pass
+        sb.stop()
