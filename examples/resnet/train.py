@@ -62,7 +62,19 @@ def main(argv=None):
     p.add_argument("--loader", choices=["tf", "native"], default="tf",
                    help="host decode pipeline: tf.data (portable) or "
                         "the C++ native loader (production TPU-VM feed)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="graph-level tf.data augmentation seed "
+                        "(reproducible crops/flips for gating runs)")
     args = p.parse_args(argv)
+
+    if args.seed is not None:
+        if args.loader == "tf":
+            import tensorflow as tf
+            tf.random.set_seed(args.seed)
+        else:
+            print("WARNING: --seed only seeds the tf.data augmentation; "
+                  "--loader native uses its own per-item deterministic "
+                  "RNG and ignores it", flush=True)
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     total_steps = args.epochs * args.steps_per_epoch

@@ -114,3 +114,29 @@ def test_distillation_beats_label_only_student():
     # small labeled set leaves open
     assert dist_acc > plain_acc + 0.03, (plain_acc, dist_acc, t_acc)
     assert dist_acc > 0.85, (plain_acc, dist_acc, t_acc)
+
+
+def test_mnist_distill_example_end_to_end():
+    """The minimal single-file distill example (reference
+    example/distill/mnist_distill): in-process teacher -> TeacherServer
+    -> DistillReader -> student; the 32-unit student must recover the
+    256-unit teacher's accuracy through the served soft labels."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    from conftest import cpu_subprocess_env
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "examples", "distill", "mnist_distill.py")],
+        env=cpu_subprocess_env(1), capture_output=True, text=True,
+        timeout=280)
+    assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads([l for l in r.stdout.splitlines()
+                      if l.startswith("{")][-1])
+    assert out["teacher_acc"] > 0.95, out
+    assert out["student_acc"] > 0.9, out
+    assert out["steps"] == 60
