@@ -237,7 +237,7 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
 
 
 def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
-            tiny=False):
+            tiny=False, flash=False):
     """GPT causal-LM training throughput (tokens/s/chip), GPT-2-small
     shape by default (12L/768d/12h, vocab 32k). The reference had no LM
     benchmark, so vs_baseline is 0.0 — this is the framework's own
@@ -256,11 +256,20 @@ def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
 
     n_chips = jax.local_device_count()
     batch = batch_per_chip * n_chips
-    model = (gpt.gpt_tiny(dtype=jnp.bfloat16) if tiny
-             else gpt.Gpt(dtype=jnp.bfloat16, remat=True))
+    if flash and jax.devices()[0].platform != "tpu":
+        # the Pallas kernel only compiles natively on TPU; interpret
+        # mode would benchmark the interpreter
+        log("bench[gpt]: --flash ignored off-TPU (platform %s)"
+            % jax.devices()[0].platform)
+        flash = False
+    model = (gpt.gpt_tiny(dtype=jnp.bfloat16, use_flash=flash) if tiny
+             else gpt.Gpt(dtype=jnp.bfloat16, remat=True,
+                          use_flash=flash))
     seq_len = min(seq_len, model.max_len)
-    log("bench[gpt]: %d chip(s) (%s), global batch %d, seq %d, tiny=%s"
-        % (n_chips, jax.devices()[0].platform, batch, seq_len, tiny))
+    log("bench[gpt]: %d chip(s) (%s), global batch %d, seq %d, tiny=%s, "
+        "flash=%s"
+        % (n_chips, jax.devices()[0].platform, batch, seq_len, tiny,
+           flash))
     model, params, loss_fn = gpt.create_model_and_loss(
         model=model, dummy_seq=min(16, seq_len))
     mesh = make_mesh()
@@ -303,6 +312,8 @@ def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
     metric = "gpt2s_train_tokens_per_sec_per_chip"
     if tiny:
         metric = "gpt_tiny_train_tokens_per_sec_per_chip"
+    if flash:
+        metric += "_flash"
     if implied_tflops > 197.0 * 1.25:
         log("WARNING: implied TFLOP/s exceeds the v5e physical peak — "
             "marking metric _suspect")
@@ -317,7 +328,7 @@ def _oneshot(args):
     if args.model == "gpt":
         result = run_gpt(batch_per_chip=args.batch_per_chip,
                          seq_len=args.seq_len, iters=args.iters,
-                         tiny=args.gpt_tiny)
+                         tiny=args.gpt_tiny, flash=args.flash)
         print(json.dumps(result), flush=True)
         return
     kwargs = dict(batch_per_chip=args.batch_per_chip, iters=args.iters,
@@ -380,6 +391,9 @@ def _build_parser():
     ap.add_argument("--image_size", type=int, default=224)
     ap.add_argument("--seq_len", type=int, default=1024,
                     help="sequence length for --model gpt")
+    ap.add_argument("--flash", action="store_true",
+                    help="gpt: Pallas flash attention (TPU only; "
+                         "ignored off-TPU)")
     ap.add_argument("--gpt_tiny", action="store_true",
                     help=argparse.SUPPRESS)  # CPU-fallback size
     ap.add_argument("--s2d", dest="s2d", action="store_true")
@@ -455,6 +469,8 @@ def main():
         requested += ["--seq_len", str(args.seq_len)]
     if args.model == "gpt" and args.gpt_tiny:
         requested += ["--gpt_tiny"]
+    if args.model == "gpt" and args.flash:
+        requested += ["--flash"]
     if not args.s2d:
         requested += ["--no-s2d"]
     if args.feed != "device":
