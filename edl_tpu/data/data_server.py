@@ -17,6 +17,7 @@ All RPCs ride the in-tree framed-msgpack substrate.
 """
 
 import threading
+import time
 from collections import OrderedDict, deque
 
 from edl_tpu.rpc.server import RpcServer
@@ -28,31 +29,95 @@ END = "__END__"
 
 class LeaderDataService(object):
     """Lives on one process per job (the leader pod's rank-0 trainer or the
-    launcher); coordinates readers of one named reader group."""
+    launcher); coordinates readers of one named reader group.
 
-    def __init__(self, file_list):
+    Liveness: every reader runs a dedicated heartbeat thread (see
+    ElasticReader) and every data RPC also refreshes last-contact; a
+    reader silent for ``reader_ttl`` seconds is EVICTED — treated as
+    done, its unassigned production dropped (the batches died with its
+    server anyway). The DEDICATED heartbeat is what makes "silent" mean
+    "process dead or partitioned" rather than "busy in a long train
+    step": data RPCs alone pause while the consumer computes. Without
+    eviction, a SIGKILLed reader that never said reach_data_end would
+    leave every consumer spinning on an all_done that can never come
+    true until the cluster stage changes; with it the data plane
+    converges standalone and the lost records are re-read from the
+    data checkpoint on the next incarnation. An evicted reader that
+    was merely partitioned gets a LOUD DataAccessError on its next
+    report (it must restart and resume from the checkpoint, not keep
+    feeding an epoch that already ended without it)."""
+
+    def __init__(self, file_list, reader_ttl=30.0, clock=None):
         self._files = list(file_list)
         self._lock = threading.Lock()
-        self._readers = {}        # pod_id -> {"endpoint": str, "done": bool}
+        # pod_id -> {"endpoint", "done", "seen", "evicted"}
+        self._readers = {}
         self._file_cursor = 0
         # batch availability: pod_id -> deque of batch_id
         self._avail = {}
         # batch_id -> producer endpoint
         self._producer = {}
         self._consumed = set()
+        self._reader_ttl = reader_ttl
+        self._clock = clock or time.monotonic
+
+    # -- liveness (hold self._lock) ----------------------------------------
+
+    def _touch(self, pod_id):
+        r = self._readers.get(pod_id)
+        if r is not None:
+            r["seen"] = self._clock()
+
+    def _evict_silent(self):
+        now = self._clock()
+        for pod_id, r in self._readers.items():
+            if not r["done"] and now - r["seen"] > self._reader_ttl:
+                r["done"] = True
+                r["evicted"] = True
+                dropped = len(self._avail.get(pod_id, ()))
+                for b in self._avail.get(pod_id, ()):
+                    self._producer.pop(b, None)
+                self._avail[pod_id] = deque()
+                logger.warning(
+                    "data leader: reader %s silent > %.0fs — evicted "
+                    "(%d unassigned batches dropped; records return via "
+                    "the data checkpoint)", pod_id, self._reader_ttl,
+                    dropped)
+
+    def heartbeat(self, pod_id):
+        """Pure liveness ping from the reader's heartbeat thread."""
+        with self._lock:
+            self._touch(pod_id)
+            return True
 
     # -- registration / files -------------------------------------------------
 
     def register_reader(self, pod_id, endpoint):
+        """Returns the leader's liveness contract so readers derive
+        their heartbeat cadence from THE LEADER'S ttl — two processes
+        configuring the TTL independently would let a skewed follower
+        heartbeat slower than the leader evicts."""
         with self._lock:
-            self._readers[pod_id] = {"endpoint": endpoint, "done": False}
+            self._readers[pod_id] = {"endpoint": endpoint, "done": False,
+                                     "seen": self._clock(),
+                                     "evicted": False}
             self._avail.setdefault(pod_id, deque())
-            return True
+            return {"reader_ttl": self._reader_ttl}
 
     def get_file_list(self, pod_id):
         """Round-robin file slices, handed out incrementally so late joiners
         get the remaining work (elastic twist on the static split)."""
         with self._lock:
+            r = self._readers.get(pod_id)
+            if r is not None and r.get("evicted"):
+                # fail the zombie BEFORE handing it a file: records it
+                # would read get dropped at report time, losing a whole
+                # file a healthy reader could have taken
+                raise errors.DataAccessError(
+                    "reader %s was evicted (silent > %.0fs); restart "
+                    "and resume from the data checkpoint"
+                    % (pod_id, self._reader_ttl))
+            self._touch(pod_id)
             if self._file_cursor >= len(self._files):
                 return []
             out = [(self._file_cursor, self._files[self._file_cursor])]
@@ -63,6 +128,16 @@ class LeaderDataService(object):
 
     def report_batches(self, pod_id, batch_ids, endpoint):
         with self._lock:
+            r = self._readers.get(pod_id)
+            if r is not None and r.get("evicted"):
+                # a zombie (partitioned, then evicted) must fail loudly
+                # and restart via the data checkpoint — feeding batches
+                # into an epoch that ended without it would lose them
+                raise errors.DataAccessError(
+                    "reader %s was evicted (silent > %.0fs); restart "
+                    "and resume from the data checkpoint"
+                    % (pod_id, self._reader_ttl))
+            self._touch(pod_id)
             q = self._avail.setdefault(pod_id, deque())
             for b in batch_ids:
                 if b not in self._consumed and b not in self._producer:
@@ -84,6 +159,7 @@ class LeaderDataService(object):
         {batch_id, endpoint}; [END] when all data is consumed; [] means
         'retry later' (production still in flight)."""
         with self._lock:
+            self._touch(pod_id)
             out = []
             own = self._avail.get(pod_id)
             while own and len(out) < n:
@@ -97,6 +173,7 @@ class LeaderDataService(object):
                 out.append(self._take(richest))
             if out:
                 return out
+            self._evict_silent()  # a dead producer must not wedge END
             all_done = (self._file_cursor >= len(self._files)
                         and self._readers
                         and all(r["done"] for r in self._readers.values()))
@@ -166,6 +243,7 @@ class DataPlaneServer(object):
             self._rpc.register("ds_get_file_list", svc.get_file_list)
             self._rpc.register("ds_report_batches", svc.report_batches)
             self._rpc.register("ds_reach_data_end", svc.reach_data_end)
+            self._rpc.register("ds_heartbeat", svc.heartbeat)
             self._rpc.register("ds_get_assignment", svc.get_assignment)
             self._rpc.register("ds_stats", svc.stats)
 

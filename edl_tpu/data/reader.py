@@ -55,7 +55,7 @@ class ElasticReader(object):
     def __init__(self, pod_id, splitter, batch_size, file_list=(),
                  is_leader=False, leader_endpoint=None, coord=None,
                  reader_name="reader", cache_capacity=64, skip_record=None,
-                 fetch_ahead=2):
+                 fetch_ahead=2, reader_ttl=30.0):
         self._pod_id = pod_id
         self._splitter = splitter
         self._batch_size = batch_size
@@ -63,7 +63,9 @@ class ElasticReader(object):
         self._fetch_ahead = max(1, fetch_ahead)
 
         self._cache = BatchCache(capacity=cache_capacity)
-        leader_service = LeaderDataService(file_list) if is_leader else None
+        leader_service = (LeaderDataService(file_list,
+                                            reader_ttl=reader_ttl)
+                          if is_leader else None)
         self._server = DataPlaneServer(self._cache,
                                        leader_service=leader_service).start()
         if is_leader and coord is not None:
@@ -79,12 +81,43 @@ class ElasticReader(object):
         self._stop = threading.Event()
         self._gen_done = threading.Event()
         self._gen_error = []
-        self._leader.call("ds_register_reader", pod_id,
-                          self._server.endpoint)
+        reg = self._leader.call("ds_register_reader", pod_id,
+                                self._server.endpoint)
+        # the heartbeat cadence follows the LEADER'S ttl (returned at
+        # registration) — the local reader_ttl only matters when this
+        # process hosts the leader service
+        leader_ttl = (reg.get("reader_ttl", reader_ttl)
+                      if isinstance(reg, dict) else reader_ttl)
         self._gen_thread = threading.Thread(target=self._generate,
                                             daemon=True,
                                             name="reader-gen-%s" % pod_id)
         self._gen_thread.start()
+        # dedicated liveness heartbeat: data RPCs pause while the
+        # consumer sits in a long train step, so the leader's silent-
+        # reader eviction must key on THIS thread (dies with the
+        # process), not on data traffic
+        self._hb_interval = min(max(0.5, leader_ttl / 6.0), 10.0)
+        self._hb_client = RpcClient(leader_endpoint, timeout=10)
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True,
+                                           name="reader-hb-%s" % pod_id)
+        self._hb_thread.start()
+
+    def _heartbeat_loop(self):
+        misses = 0
+        while not self._stop.wait(self._hb_interval):
+            try:
+                self._hb_client.call("ds_heartbeat", self._pod_id)
+                misses = 0
+            except errors.EdlError as e:
+                # a quiet heartbeat failure is exactly how an eviction
+                # becomes undiagnosable from this side — log it, rate-
+                # limited to every ~4 consecutive misses
+                misses += 1
+                if misses % 4 == 1:
+                    logger.warning(
+                        "reader %s heartbeat to leader failing "
+                        "(%d consecutive): %r", self._pod_id, misses, e)
 
     # -- producer side ---------------------------------------------------------
 
@@ -197,6 +230,8 @@ class ElasticReader(object):
     def stop(self):
         self._stop.set()
         self._gen_thread.join(timeout=10)
+        self._hb_thread.join(timeout=self._hb_interval + 11)
         self._leader.close()
         self._leader_gen.close()
+        self._hb_client.close()
         self._server.stop()

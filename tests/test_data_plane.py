@@ -239,3 +239,104 @@ def test_exactly_once_across_resize(tmp_path, coord):
 
     assert sorted(phase1 + phase2) == sorted(total)
     assert not set(phase1) & set(phase2)
+
+
+def test_dead_reader_evicted_epoch_converges(tmp_path, coord):
+    """A reader that dies WITHOUT reach_data_end (SIGKILL model: its
+    threads and server vanish, no goodbye) must not wedge the epoch:
+    the leader evicts silent readers after reader_ttl and surviving
+    consumers still reach END. Its lost records return via the data
+    checkpoint on restart (exactly-once overall)."""
+    from edl_tpu.runtime.state import State
+
+    paths = _write_files(tmp_path, n_files=4, lines_per_file=20)  # 80
+    total = ["file%d_rec%d" % (f, j) for f in range(4) for j in range(20)]
+    state = State()
+
+    rA = ElasticReader("podA", TxtFileSplitter(), batch_size=8,
+                       file_list=paths, is_leader=True, coord=coord,
+                       reader_name="ev", reader_ttl=2.0)
+    ep = lookup_data_leader(coord, "ev")
+    rB = ElasticReader("podB", TxtFileSplitter(), batch_size=8,
+                       leader_endpoint=ep)
+
+    # podB produces (grabs files, reports batches), then DIES silently:
+    # kill its threads/server without any data-end report
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        with rB._cache._lock:
+            if rB._cache._data:
+                break
+        time.sleep(0.02)
+    rB._stop.set()          # stops generator AND heartbeat threads
+    rB._server.stop()       # its batches are unreachable now
+    # forge the silence: the generator's finally would normally report
+    # data-end; simulate a hard kill by marking it NOT done again
+    rB._gen_thread.join(timeout=20)
+    rB._hb_thread.join(timeout=20)
+    # both threads must be dead BEFORE the forged re-registration, or a
+    # late reach_data_end/heartbeat would undo it and the test would
+    # pass without exercising eviction at all
+    assert not rB._gen_thread.is_alive()
+    assert not rB._hb_thread.is_alive()
+    rA._leader.call("ds_register_reader", "podB", "127.0.0.1:1")
+
+    got = []
+    for batch in rA:
+        ElasticReader.mark_consumed(state, batch)
+        got.extend(batch["records"])
+    rA.stop()
+    assert len(got) == len(set(got))
+    assert len(got) < len(total)  # podB's work was genuinely lost
+
+    # completion pass sweeps the evicted reader's records exactly once
+    state2 = State().from_json(state.to_json())
+    rD = ElasticReader("podD", TxtFileSplitter(), batch_size=8,
+                       file_list=paths, is_leader=True, coord=coord,
+                       reader_name="ev2",
+                       skip_record=state2.data_checkpoint.is_processed)
+    rest = []
+    for batch in rD:
+        rest.extend(batch["records"])
+    rD.stop()
+    assert sorted(got + rest) == sorted(total)
+    assert not set(got) & set(rest)
+
+
+def test_heartbeat_protects_busy_reader_and_zombie_rejected():
+    """Liveness semantics at the unit level (injectable clock): a
+    heartbeating reader is never evicted no matter how long its data
+    RPCs pause (long train step); once evicted, a zombie's report is
+    rejected loudly so it restarts via the data checkpoint."""
+    from edl_tpu.utils import errors as errors_mod
+
+    now = [0.0]
+    svc = LeaderDataService(["f0", "f1"], reader_ttl=5.0,
+                            clock=lambda: now[0])
+    svc.register_reader("podA", "a:1")
+    svc.register_reader("podB", "b:1")
+    svc.get_file_list("podB")
+    svc.report_batches("podB", ["f0_b0"], "b:1")
+
+    # podB goes quiet on the data plane but its heartbeat thread lives
+    for t in (3.0, 6.0, 9.0):
+        now[0] = t
+        svc.heartbeat("podB")
+    now[0] = 10.0
+    assert svc.get_assignment("podA", 1)  # drains b's batch, no evict
+    assert svc.get_assignment("podA", 1) == []  # triggers evict check
+    assert not svc.stats()["readers"]["podB"]  # alive: not done
+
+    # now the process really dies: no heartbeats past the ttl
+    svc.report_batches("podB", ["f0_b1"], "b:1")
+    now[0] = 16.1
+    # available batches still drain first (the consumer's fetch failure
+    # handles a dead producer); the evict check runs on the next empty
+    assert svc.get_assignment("podA", 1)
+    assert svc.get_assignment("podA", 1) == []  # evicts B
+    assert svc.stats()["readers"]["podB"] is True
+    try:
+        svc.report_batches("podB", ["f0_b2"], "b:1")
+        raise AssertionError("zombie report must be rejected")
+    except errors_mod.DataAccessError as e:
+        assert "evicted" in str(e)
