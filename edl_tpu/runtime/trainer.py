@@ -470,6 +470,8 @@ class ElasticTrainer(object):
                                      root=self.env.job_id)
 
         self._jit_step = self._build_step()
+        self._example_batch_sds = None  # captured at the first step
+        self._prewarm_thread = None
         self._step_times = []
         # start-to-start wall intervals (NOT in-call durations: jit
         # dispatch returns in ~ms while the real cadence includes data
@@ -504,22 +506,241 @@ class ElasticTrainer(object):
 
     # -- the compiled step ---------------------------------------------------
 
-    def _build_step(self):
+    def _raw_step(self):
+        """The un-jitted step callable (shared by _build_step and the
+        resize-prewarm AOT compiles)."""
         if self._step_fn is not None:
-            step = self._step_fn
-        elif self._grad_accum > 1:
-            step = make_accum_step(self._loss_fn, self._tx,
+            return self._step_fn
+        if self._grad_accum > 1:
+            return make_accum_step(self._loss_fn, self._tx,
                                    self._grad_accum, self._has_aux,
                                    remat_policy=self._remat_policy)
-        else:
-            step = make_train_step(self._loss_fn, self._tx, self._has_aux,
-                                   remat_policy=self._remat_policy)
+        return make_train_step(self._loss_fn, self._tx, self._has_aux,
+                               remat_policy=self._remat_policy)
+
+    def _build_step(self):
         return jax.jit(
-            step,
+            self._raw_step(),
             in_shardings=(self._state_shardings, self._batch_sharding,
                           self._repl),
             out_shardings=(self._state_shardings, self._repl),
             donate_argnums=(0,))
+
+    # -- resize prewarm (AOT executables across restarts) --------------------
+    #
+    # SURVEY §7 names restart latency as THE metric for elastic TPU
+    # training: stop-resume pays tracing + XLA compile at every world-
+    # size change, dominating recovery. A running job already holds the
+    # devices any SMALLER world would use — so the step can be compiled
+    # for that sub-mesh NOW and carried to the restarted process. The
+    # persistent compilation cache cannot carry it (its key includes
+    # the platform topology, which differs between an 8-device process
+    # compiling for 4 devices and a genuine 4-device process — verified
+    # empirically); AOT executable serialization
+    # (jax.experimental.serialize_executable) can: the deserialized
+    # executable runs in the smaller process directly, skipping compile
+    # entirely. Staleness safety: files are keyed by a fingerprint of
+    # the lowered computation + shapes + jaxlib version, recomputed by
+    # the restarted process — a code or config change simply misses.
+
+    def _aot_dir(self):
+        base = os.environ.get("EDL_TPU_COMPILE_CACHE")
+        return os.path.join(base, "aot_steps") if base else None
+
+    def _step_lowered(self, world_n=None):
+        """Lower the train step for ``world_n`` devices (None = the
+        current mesh), returning (lowered, fingerprint)."""
+        import hashlib
+
+        if world_n is None:
+            state_sh = self._state_shardings
+            data_sh = self._batch_sharding
+            repl = self._repl
+        else:
+            axes = self.mesh.axis_names
+            devices = list(self.mesh.devices.flat)
+            shape_n = tuple(world_n if a == DATA_AXIS else 1
+                            for a in axes)
+            from jax.sharding import Mesh
+            mesh_n = Mesh(np.asarray(devices[:world_n]).reshape(shape_n),
+                          axes)
+            repl = NamedSharding(mesh_n, P())
+            data_sh = NamedSharding(mesh_n, self._batch_sharding.spec)
+            state_sh = jax.tree_util.tree_map(lambda _: repl,
+                                              self._state_shardings)
+        lowered = jax.jit(
+            self._raw_step(),
+            in_shardings=(state_sh, data_sh, repl),
+            out_shardings=(state_sh, repl),
+            donate_argnums=(0,)).lower(
+                jax.tree_util.tree_map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    self.train_state),
+                self._example_batch_sds,
+                jax.ShapeDtypeStruct((2,), np.uint32))
+        h = hashlib.sha256()
+        h.update(jax.version.__version__.encode())
+        h.update(lowered.as_text().encode())
+        return lowered, h.hexdigest()[:24]
+
+    def _prewarm_in_scope(self):
+        if self._example_batch_sds is None:
+            return "needs the batch structure (call after a train_step)"
+        if jax.process_count() > 1:
+            return "multi-process world"
+        sizes = dict(self.mesh.shape)
+        if any(sizes[a] != 1 for a in self.mesh.axis_names
+               if a != DATA_AXIS):
+            return "model-parallel mesh %s" % (dict(sizes),)
+        flat = jax.tree_util.tree_leaves(self._state_shardings)
+        if not all(getattr(s, "spec", None) == P() for s in flat):
+            return "non-replicated state sharding"
+        return None
+
+    def prewarm_resize_compiles(self, world_sizes, block=True):
+        """Compile the train step for OTHER world sizes and serialize
+        the executables under EDL_TPU_COMPILE_CACHE/aot_steps, so the
+        next resize restart LOADS its step instead of compiling it
+        (picked up automatically at the restarted trainer's first
+        train_step). Scope: single-process trainers on a pure-dp mesh
+        with replicated state — the stop-resume workhorse. Sizes out
+        of range or not dividing the batch are skipped with a log
+        line. ``block=False`` runs on a background thread. Returns the
+        target sizes (the compiled subset when blocking)."""
+        import pickle
+
+        why = self._prewarm_in_scope()
+        if why is not None:
+            logger.info("prewarm: %s — skipped", why)
+            return []
+        out_dir = self._aot_dir()
+        if out_dir is None:
+            logger.info("prewarm: EDL_TPU_COMPILE_CACHE unset — "
+                        "nowhere to persist, skipped")
+            return []
+        devices = list(self.mesh.devices.flat)
+        # the DATA-SHARDED axis of the example batch (under grad
+        # accumulation the leading axis is the microbatch count, and
+        # the rows sit on axis 1 — follow the sharding spec, not a
+        # hardcoded axis 0)
+        spec = tuple(self._batch_sharding.spec)
+        axis_index = 0
+        for i, s in enumerate(spec):
+            if s == DATA_AXIS or (isinstance(s, tuple) and DATA_AXIS in s):
+                axis_index = i
+                break
+        batch_dim = jax.tree_util.tree_leaves(
+            self._example_batch_sds)[0].shape[axis_index]
+        targets = []
+        for n in sorted(set(int(w) for w in world_sizes)):
+            if n == len(devices):
+                continue
+            if n < 1 or n > len(devices):
+                logger.info("prewarm: world %d outside this process's "
+                            "1..%d devices — skipped", n, len(devices))
+                continue
+            if batch_dim % n:
+                logger.info("prewarm: world %d does not divide the "
+                            "sharded batch dim %d — skipped", n,
+                            batch_dim)
+                continue
+            targets.append(n)
+
+        def compile_all():
+            from jax.experimental import serialize_executable as se
+            os.makedirs(out_dir, exist_ok=True)
+            done = []
+            for n in targets:
+                try:
+                    t0 = time.perf_counter()
+                    lowered, fp = self._step_lowered(n)
+                    payload, in_tree, out_tree = se.serialize(
+                        lowered.compile())
+                    path = os.path.join(out_dir,
+                                        "step_w%d_%s.pkl" % (n, fp))
+                    tmp = path + ".tmp.%d" % os.getpid()
+                    with open(tmp, "wb") as f:
+                        pickle.dump({"payload": payload,
+                                     "in_tree": in_tree,
+                                     "out_tree": out_tree}, f)
+                    os.replace(tmp, path)
+                    done.append(n)
+                    logger.info(
+                        "prewarm: world-%d step compiled + serialized "
+                        "in %.1fs (%s)", n,
+                        time.perf_counter() - t0, path)
+                except Exception:
+                    logger.exception("prewarm for world %d failed", n)
+            return done
+
+        if block:
+            return compile_all()
+        self._prewarm_thread = threading.Thread(
+            target=compile_all, daemon=True, name="resize-prewarm")
+        self._prewarm_thread.start()
+        return targets
+
+    def _try_load_prewarmed_step(self):
+        """At the first train_step: if a prior incarnation serialized
+        THIS world size's step executable, load it and skip the
+        compile. Returns a jit_step-compatible callable or None."""
+        import pickle
+
+        if self._prewarm_in_scope() is not None:
+            return None
+        aot = self._aot_dir()
+        if aot is None or not os.path.isdir(aot):
+            return None
+        n = len(list(self.mesh.devices.flat))
+        # any candidate for this world at all? — checked BEFORE paying
+        # a trace+lower just to compute the fingerprint (a miss here is
+        # the common case, e.g. a same-world restart)
+        import glob as glob_mod
+        if not glob_mod.glob(os.path.join(aot, "step_w%d_*.pkl" % n)):
+            return None
+        try:
+            _, fp = self._step_lowered()
+        except Exception:
+            logger.exception("prewarm load: lowering failed")
+            return None
+        path = os.path.join(aot, "step_w%d_%s.pkl" % (n, fp))
+        if not os.path.exists(path):
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+            t0 = time.perf_counter()
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            loaded = se.deserialize_and_load(
+                blob["payload"], blob["in_tree"], blob["out_tree"])
+            repl = self._repl
+            jit_fallback = self._jit_step
+
+            def step(state, batch, rng):
+                # loaded executables take committed inputs with the
+                # EXACT compiled signature; jax.jit would transparently
+                # recompile on a changed rng type or a ragged tail
+                # batch — mirror that by reverting to the jit path on
+                # an input mismatch (argument validation rejects before
+                # any buffer is donated, so the retry is safe)
+                try:
+                    return loaded(state, batch,
+                                  jax.device_put(rng, repl))
+                except Exception as e:  # noqa: BLE001
+                    logger.warning(
+                        "AOT step input mismatch (%r); reverting to "
+                        "the jit path for this and later steps", e)
+                    self._jit_step = jit_fallback
+                    return jit_fallback(state, batch, rng)
+
+            logger.info("resize prewarm HIT: world-%d step loaded from "
+                        "%s in %.2fs (compile skipped)", n, path,
+                        time.perf_counter() - t0)
+            return step
+        except Exception:
+            logger.exception("prewarm load failed (falling back to "
+                             "the normal compile)")
+            return None
 
     def local_batch_slice(self, full_batch):
         """Slice a FULL global batch down to the rows this process must
@@ -555,6 +776,12 @@ class ElasticTrainer(object):
                 lambda x: x.reshape((k, x.shape[0] // k) + x.shape[1:]),
                 host_batch)
         batch = self.shard_batch(host_batch)
+        if self._example_batch_sds is None:
+            self._example_batch_sds = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
+            loaded = self._try_load_prewarmed_step()
+            if loaded is not None:
+                self._jit_step = loaded
         self.train_state, loss = self._jit_step(self.train_state, batch, rng)
         self._host_step += 1
         self._step_times.append(time.perf_counter() - t0)

@@ -65,6 +65,11 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=None,
                    help="graph-level tf.data augmentation seed "
                         "(reproducible crops/flips for gating runs)")
+    p.add_argument("--prewarm_worlds", default="",
+                   help="comma list of chip counts to AOT-compile the "
+                        "step for (background, after epoch 0) so a "
+                        "resize restart loads its step instead of "
+                        "compiling; needs EDL_TPU_COMPILE_CACHE")
     args = p.parse_args(argv)
 
     if args.seed is not None:
@@ -193,6 +198,10 @@ def main(argv=None):
                              args.total_batch_size * (step + 1) / dt),
                           flush=True)
             trainer.end_epoch(save=True)
+            if epoch == start_epoch and args.prewarm_worlds:
+                trainer.prewarm_resize_compiles(
+                    [int(w) for w in args.prewarm_worlds.split(",")
+                     if w], block=False)
             if evaluator is not None:
                 # rank-0 eval, reference parity: train_with_fleet.py:573-610.
                 # device_get first: the train state is sharded over the GLOBAL

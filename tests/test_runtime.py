@@ -1029,3 +1029,76 @@ def test_resize_invariant_training_under_budget(tmp_path):
             jax.device_get(tr.train_state["params"])))
     for a, b in zip(*finals):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_prewarm_resize_aot_executable_cross_process(tmp_path):
+    """The restart-latency lever (SURVEY §7): an 8-device trainer
+    prewarns the 4-device step as a SERIALIZED AOT EXECUTABLE (the
+    persistent compile cache cannot carry it — its key includes the
+    platform topology); a FRESH 4-device process loads it at its first
+    train_step and skips the compile, and training still converges. A
+    2-device control — never prewarmed — must NOT report a hit."""
+    import json
+    import subprocess
+    import sys
+
+    from conftest import cpu_subprocess_env
+
+    cache = tmp_path / "xla_cache"
+    cache.mkdir()
+
+    script = r"""
+import json
+import sys
+import jax
+import numpy as np
+import optax
+from edl_tpu.models import linear
+from edl_tpu.runtime import trainer as trainer_mod
+from edl_tpu.runtime.trainer import ElasticTrainer
+
+hits = []
+orig = ElasticTrainer._try_load_prewarmed_step
+def spy(self):
+    out = orig(self)
+    hits.append(out is not None)
+    return out
+ElasticTrainer._try_load_prewarmed_step = spy
+
+trainer = ElasticTrainer(linear.loss_fn, linear.init_params(),
+                         optax.sgd(0.05), total_batch_size=16)
+w_true = np.arange(1, 14, dtype=np.float32) / 10
+rs = np.random.RandomState(0)
+loss = None
+for i in range(30):
+    x = rs.randn(16, 13).astype(np.float32)
+    batch = {"x": x, "y": x @ w_true}
+    loss = float(trainer.train_step(batch))
+if "--prewarm" in sys.argv:
+    done = trainer.prewarm_resize_compiles([4])
+    assert done == [4], done
+print(json.dumps({"hit": bool(hits and hits[0]), "loss": loss,
+                  "devices": jax.device_count()}))
+"""
+
+    def run(n_devices, *args):
+        env = cpu_subprocess_env(n_devices,
+                                 EDL_TPU_COMPILE_CACHE=str(cache))
+        r = subprocess.run([sys.executable, "-c", script] + list(args),
+                           env=env, capture_output=True, text=True,
+                           timeout=240)
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    a = run(8, "--prewarm")
+    assert not a["hit"]  # nothing to load the first time
+    aot = cache / "aot_steps"
+    assert aot.is_dir() and list(aot.glob("step_w4_*.pkl"))
+
+    b = run(4)
+    assert b["hit"], "4-device restart did not load the AOT step"
+    assert b["loss"] < 0.1, b  # the loaded executable really trains
+
+    c = run(2)  # control: never prewarmed -> no hit, still works
+    assert not c["hit"]
+    assert c["loss"] < 0.1, c
