@@ -19,6 +19,9 @@ setup(
             "edl-tpu-teacher=edl_tpu.distill.teacher_server:main",
             "edl-tpu-discovery=edl_tpu.distill.discovery_server:main",
             "edl-tpu-register=edl_tpu.distill.registry:main",
+            "edl-tpu-measure-distill=edl_tpu.tools.measure_distill:main",
+            "edl-tpu-measure-resize=edl_tpu.tools.measure_resize:main",
+            "edl-tpu-job-stats=edl_tpu.tools.job_stats:main",
             "edl-tpu-resize-driver=edl_tpu.tools.resize_driver:main",
             "edl-tpu-liveft=edl_tpu.liveft.launch:main",
             "edl-tpu-job-stats=edl_tpu.tools.job_stats:main",
