@@ -371,3 +371,34 @@ def test_primary_loss_mid_job_chaos(tmp_path):
             except ProcessLookupError:
                 pass
         sb.stop()
+
+
+def test_standby_replicates_from_native_primary(tmp_path):
+    """The standby's replication speaks the shared wire protocol, so a
+    C++ store can be the primary (the deployment mixes backends)."""
+    import pytest as _pytest
+
+    from edl_tpu.coordination.native import NativeStoreServer, ensure_binary
+    from edl_tpu.coordination.standby import StandbyServer
+
+    try:
+        ensure_binary()
+    except Exception as e:  # noqa: BLE001
+        _pytest.skip("native store unavailable: %r" % e)
+    with NativeStoreServer(data_dir=str(tmp_path / "wal")) as primary:
+        c = CoordClient([primary.endpoint], root="hax")
+        c.set_server_permanent("cluster", "cluster", "native-v1")
+        c.set_server_with_lease("resource", "podN", "x", ttl=30)
+        sb = StandbyServer([primary.endpoint], host="127.0.0.1",
+                           auto_promote=False).start()
+        try:
+            assert _wait(sb.synced.is_set)
+            key = c.server_key("cluster", "cluster")
+            assert _wait(lambda: (sb.store.get(key) or {}).get("value")
+                         == "native-v1")
+            assert sb.store.get(c.server_key("resource", "podN")) is None
+            c.set_server_permanent("cluster", "cluster", "native-v2")
+            assert _wait(lambda: (sb.store.get(key) or {}).get("value")
+                         == "native-v2")
+        finally:
+            sb.stop()
