@@ -236,13 +236,18 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
     }
 
 
-def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
-            tiny=False, flash=False):
-    """GPT causal-LM training throughput (tokens/s/chip), GPT-2-small
-    shape by default (12L/768d/12h, vocab 32k). The reference had no LM
-    benchmark, so vs_baseline is 0.0 — this is the framework's own
-    second headline surface (operator-run; the driver default stays the
-    resnet metric)."""
+# per-model CLI defaults, used both to FILL unset args and to decide
+# which values the parent forwards to attempt subprocesses — one table
+# so the two sites cannot drift
+MODEL_DEFAULT_BATCH = {"gpt": 8, "bert": 32, "resnet": 128}
+MODEL_DEFAULT_SEQ = {"gpt": 1024, "bert": 512}
+
+
+def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
+    """Shared LM/encoder train-throughput loop (tokens/s/chip) for
+    --model gpt and --model bert: same mesh/sharding/timing/physics
+    gate, parameterized by the model family and its batch contents.
+    vs_baseline 0.0: the reference published no LM/encoder number."""
     import jax
 
     _enable_compile_cache()
@@ -250,7 +255,6 @@ def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from edl_tpu.models import gpt
     from edl_tpu.runtime.mesh import DATA_AXIS, make_mesh
     from edl_tpu.runtime.trainer import make_train_state, make_train_step
 
@@ -259,18 +263,33 @@ def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
     if flash and jax.devices()[0].platform != "tpu":
         # the Pallas kernel only compiles natively on TPU; interpret
         # mode would benchmark the interpreter
-        log("bench[gpt]: --flash ignored off-TPU (platform %s)"
-            % jax.devices()[0].platform)
+        log("bench[%s]: --flash ignored off-TPU (platform %s)"
+            % (kind, jax.devices()[0].platform))
         flash = False
-    model = (gpt.gpt_tiny(dtype=jnp.bfloat16, use_flash=flash) if tiny
-             else gpt.Gpt(dtype=jnp.bfloat16, remat=True,
-                          use_flash=flash))
+    key = jax.random.PRNGKey(0)
+    if kind == "gpt":
+        from edl_tpu.models import gpt as family
+        model = (family.gpt_tiny(dtype=jnp.bfloat16, use_flash=flash)
+                 if tiny else family.Gpt(dtype=jnp.bfloat16, remat=True,
+                                         use_flash=flash))
+        prefix = "gpt_tiny" if tiny else "gpt2s"
+    else:
+        from edl_tpu.models import bert as family
+        model = (family.bert_tiny(dtype=jnp.bfloat16, use_flash=flash)
+                 if tiny else family.bert_base(dtype=jnp.bfloat16,
+                                               use_flash=flash,
+                                               remat=True))
+        prefix = "bert_tiny" if tiny else "bert_base"
+    requested_seq = seq_len
     seq_len = min(seq_len, model.max_len)
-    log("bench[gpt]: %d chip(s) (%s), global batch %d, seq %d, tiny=%s, "
+    if requested_seq != seq_len:
+        log("bench[%s]: seq_len %d clamped to the model max %d"
+            % (kind, requested_seq, seq_len))
+    log("bench[%s]: %d chip(s) (%s), global batch %d, seq %d, tiny=%s, "
         "flash=%s"
-        % (n_chips, jax.devices()[0].platform, batch, seq_len, tiny,
-           flash))
-    model, params, loss_fn = gpt.create_model_and_loss(
+        % (kind, n_chips, jax.devices()[0].platform, batch, seq_len,
+           tiny, flash))
+    model, params, loss_fn = family.create_model_and_loss(
         model=model, dummy_seq=min(16, seq_len))
     mesh = make_mesh()
     repl = NamedSharding(mesh, P())
@@ -280,38 +299,43 @@ def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
     jit_step = jax.jit(make_train_step(loss_fn, tx),
                        in_shardings=(repl, data_sh, repl),
                        out_shardings=(repl, repl), donate_argnums=(0,))
-    ids = jax.device_put(
-        jax.random.randint(jax.random.PRNGKey(0), (batch, seq_len), 0,
-                           model.vocab_size, jnp.int32), data_sh)
-    rng = jax.device_put(jax.random.PRNGKey(0), repl)
+    batch_dev = {"input_ids": jax.device_put(
+        jax.random.randint(key, (batch, seq_len), 0, model.vocab_size,
+                           jnp.int32), data_sh)}
+    if kind == "bert":
+        batch_dev["label"] = jax.device_put(
+            jax.random.randint(key, (batch,), 0, model.num_classes,
+                               jnp.int32), data_sh)
+    rng = jax.device_put(key, repl)
 
     log("compiling + warmup (%d steps)..." % warmup)
     t0 = time.perf_counter()
     for _ in range(warmup):
-        state, loss = jit_step(state, {"input_ids": ids}, rng)
+        state, loss = jit_step(state, batch_dev, rng)
     jax.block_until_ready(loss)
     log("warmup done in %.1fs (loss=%.3f)" % (time.perf_counter() - t0,
                                               float(loss)))
     t0 = time.perf_counter()
     for _ in range(iters):
-        state, loss = jit_step(state, {"input_ids": ids}, rng)
+        state, loss = jit_step(state, batch_dev, rng)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    tokens_per_sec = batch * seq_len * iters / dt
-    per_chip = tokens_per_sec / n_chips
-    log("throughput: %.0f tok/s total, %.0f tok/s per chip (%.1f ms/step)"
-        % (tokens_per_sec, per_chip, 1000 * dt / iters))
+    per_chip = batch * seq_len * iters / dt / n_chips
+    log("throughput: %.0f tok/s per chip (%.1f ms/step)"
+        % (per_chip, 1000 * dt / iters))
     # physics gate (NOTES.md bogus-fast-path): ~6*N per token + the
-    # attention term; N ~ 124M for gpt2-small
+    # attention term
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(
         state["params"]))
     flops_per_token = 6.0 * n_params + 12.0 * model.num_layers \
         * model.d_model * seq_len
     implied_tflops = per_chip * flops_per_token / 1e12
     log("implied %.1f TFLOP/s per chip" % implied_tflops)
-    metric = "gpt2s_train_tokens_per_sec_per_chip"
-    if tiny:
-        metric = "gpt_tiny_train_tokens_per_sec_per_chip"
+    metric = prefix + "_train_tokens_per_sec_per_chip"
+    if seq_len != min(MODEL_DEFAULT_SEQ[kind], model.max_len):
+        # a clamped or swept length must be visible in the metric name,
+        # or a seq-sweep log records duplicates as distinct results
+        metric += "_seq%d" % seq_len
     if flash:
         metric += "_flash"
     if implied_tflops > 197.0 * 1.25:
@@ -322,6 +346,22 @@ def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
             "unit": "tok/s/chip", "vs_baseline": 0.0}
 
 
+def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
+            tiny=False, flash=False):
+    """GPT causal-LM training throughput, GPT-2-small shape by default
+    (12L/768d/12h, vocab 32k) — see _run_lm."""
+    return _run_lm("gpt", batch_per_chip, seq_len, warmup, iters, tiny,
+                   flash)
+
+
+def run_bert(batch_per_chip=32, seq_len=512, warmup=3, iters=20,
+             tiny=False, flash=False):
+    """BERT-base encoder training throughput (classification head,
+    seq 512) — the flash-attention A/B vehicle; see _run_lm."""
+    return _run_lm("bert", batch_per_chip, seq_len, warmup, iters, tiny,
+                   flash)
+
+
 def _oneshot(args):
     """Run exactly one configuration and print its JSON line (no
     fallback chain — the parent orchestrator owns retries/timeouts)."""
@@ -329,6 +369,12 @@ def _oneshot(args):
         result = run_gpt(batch_per_chip=args.batch_per_chip,
                          seq_len=args.seq_len, iters=args.iters,
                          tiny=args.gpt_tiny, flash=args.flash)
+        print(json.dumps(result), flush=True)
+        return
+    if args.model == "bert":
+        result = run_bert(batch_per_chip=args.batch_per_chip,
+                          seq_len=args.seq_len, iters=args.iters,
+                          tiny=args.gpt_tiny, flash=args.flash)
         print(json.dumps(result), flush=True)
         return
     kwargs = dict(batch_per_chip=args.batch_per_chip, iters=args.iters,
@@ -381,18 +427,21 @@ def _attempt(argv, timeout_s, env=None, tag=""):
 
 def _build_parser():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=("resnet", "gpt"),
+    ap.add_argument("--model", choices=("resnet", "gpt", "bert"),
                     default="resnet",
                     help="resnet = the judged headline (img/s); gpt = "
-                         "the LM surface (tok/s, GPT-2-small shape)")
+                         "the LM surface (tok/s, GPT-2-small shape); "
+                         "bert = the encoder surface (tok/s, "
+                         "bert-base @ seq 512)")
     ap.add_argument("--batch_per_chip", type=int, default=None,
-                    help="default: 128 (resnet) / 8 (gpt)")
+                    help="default: 128 (resnet) / 8 (gpt) / 32 (bert)")
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--image_size", type=int, default=224)
-    ap.add_argument("--seq_len", type=int, default=1024,
-                    help="sequence length for --model gpt")
+    ap.add_argument("--seq_len", type=int, default=None,
+                    help="sequence length (default: 1024 gpt / "
+                         "512 bert)")
     ap.add_argument("--flash", action="store_true",
-                    help="gpt: Pallas flash attention (TPU only; "
+                    help="gpt/bert: Pallas flash attention (TPU only; "
                          "ignored off-TPU)")
     ap.add_argument("--gpt_tiny", action="store_true",
                     help=argparse.SUPPRESS)  # CPU-fallback size
@@ -422,7 +471,9 @@ def main():
     ap = _build_parser()
     args = ap.parse_args()
     if args.batch_per_chip is None:
-        args.batch_per_chip = 8 if args.model == "gpt" else 128
+        args.batch_per_chip = MODEL_DEFAULT_BATCH[args.model]
+    if args.seq_len is None:
+        args.seq_len = MODEL_DEFAULT_SEQ.get(args.model, 1024)
     # argument conflicts fail fast, OUTSIDE the device-failure fallback
     if args.steps_per_call < 1:
         ap.error("--steps_per_call must be >= 1")
@@ -458,18 +509,19 @@ def main():
     requested = []
     if args.model != "resnet":
         requested += ["--model", args.model]
-    default_batch = 8 if args.model == "gpt" else 128
+    default_batch = MODEL_DEFAULT_BATCH[args.model]
     if args.batch_per_chip != default_batch:
         requested += ["--batch_per_chip", str(args.batch_per_chip)]
     if args.iters != 20:
         requested += ["--iters", str(args.iters)]
     if args.image_size != 224:
         requested += ["--image_size", str(args.image_size)]
-    if args.model == "gpt" and args.seq_len != 1024:
+    if args.model in ("gpt", "bert") \
+            and args.seq_len != MODEL_DEFAULT_SEQ[args.model]:
         requested += ["--seq_len", str(args.seq_len)]
-    if args.model == "gpt" and args.gpt_tiny:
+    if args.model in ("gpt", "bert") and args.gpt_tiny:
         requested += ["--gpt_tiny"]
-    if args.model == "gpt" and args.flash:
+    if args.model in ("gpt", "bert") and args.flash:
         requested += ["--flash"]
     if not args.s2d:
         requested += ["--no-s2d"]
@@ -528,9 +580,10 @@ def main():
 
         log("device bench failed; CPU-fallback measurement")
         env = force_cpu_env(os.environ.copy(), 1)
-        if args.model == "gpt":
-            argv = ["--model", "gpt", "--gpt_tiny", "--batch_per_chip",
-                    "2", "--seq_len", "64", "--iters", "3"]
+        if args.model in ("gpt", "bert"):
+            argv = ["--model", args.model, "--gpt_tiny",
+                    "--batch_per_chip", "2", "--seq_len", "64",
+                    "--iters", "3"]
         else:
             argv = ["--batch_per_chip", "8", "--image_size", "64",
                     "--iters", "5", "--no-s2d"]

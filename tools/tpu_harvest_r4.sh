@@ -28,7 +28,8 @@ PYEOF
       "--bn_stats_every 4 --steps_per_call 4 --iters 28" \
       "--model gpt --iters 30" \
       "--model gpt --flash --iters 30" \
-      "--model gpt --seq_len 2048 --iters 20" \
+      "--model bert --iters 30" \
+      "--model bert --flash --iters 30" \
       "--bn_stats_every 4 --feed native --iters 30" \
       ; do
       echo "=== bench $cfg ===" >> "$OUT"
