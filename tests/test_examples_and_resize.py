@@ -674,3 +674,75 @@ def test_gpt_distill_example_with_lm_teacher():
         assert np.isfinite(out["final_loss"])
     finally:
         teacher.stop()
+
+
+@pytest.mark.integration
+def test_chaos_soak_resize_plus_store_failover(tmp_path):
+    """The combined reliability drill: elastic resize mutations AND a
+    coordination-store primary loss in one arc. Pods run against
+    [primary, standby]; a graceful scale-down lands, then the PRIMARY
+    is killed mid-job (standby promotes, leases/elections re-form),
+    then another resize mutation runs against the promoted store — and
+    the job still finishes SUCCEED. Every failure domain the framework
+    claims to survive, exercised together."""
+    import time as time_mod
+
+    from edl_tpu.coordination.server import StoreServer
+    from edl_tpu.coordination.standby import StandbyServer
+
+    primary = StoreServer(host="127.0.0.1").start()
+    sb = StandbyServer([primary.endpoint], host="127.0.0.1",
+                       auto_promote=True, promote_after=1.5,
+                       sync_poll=0.5).start()
+    endpoints = "%s,%s" % (primary.endpoint, sb.endpoint)
+    driver = ResizeDriver(
+        endpoints, "chaos_ha_job", "1:2",
+        [os.path.join(REPO, "examples", "fit_a_line", "train.py"),
+         "--epochs", "6", "--steps_per_epoch", "30",
+         "--step_sleep", "0.1"],
+        log_dir=str(tmp_path), stop_signal="term", grace=15.0,
+        env_extra={"PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu",
+                   "EDL_TPU_POD_IP": "127.0.0.1", "EDL_TPU_TTL": "3",
+                   "XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=2",
+                   "EDL_TPU_CHECKPOINT_PATH": str(tmp_path / "ckpt"),
+                   "PALLAS_AXON_POOL_IPS": ""})
+    from edl_tpu.coordination.client import CoordClient
+    coord = CoordClient(endpoints.split(","), root="chaos_ha_job",
+                        failover_grace=25.0)
+    try:
+        driver.set_target(2)
+        prev_stage = driver.wait_cluster(2)[0].stage
+        time_mod.sleep(2.0)
+        # mutation 1: graceful scale-down on the healthy primary
+        driver.set_target(1)
+        cluster, waited = driver.wait_cluster(1, prev_stage=prev_stage)
+        prev_stage = cluster.stage
+        assert waited < 120
+
+        # the store outage, mid-job
+        primary.stop()
+        deadline = time_mod.time() + 30
+        while time_mod.time() < deadline and not sb.promoted:
+            time_mod.sleep(0.2)
+        assert sb.promoted
+
+        # mutation 2: scale back out against the PROMOTED store (the
+        # driver's own client rides the failover via endpoint rotation;
+        # wait_cluster's own timeout enforces the bound)
+        time_mod.sleep(2.0)
+        driver.set_target(2)
+        driver.wait_cluster(2, prev_stage=prev_stage, timeout=180)
+
+        deadline = time_mod.time() + 300
+        while time_mod.time() < deadline:
+            if status.load_job_status(coord) == Status.SUCCEED:
+                break
+            assert status.load_job_status(coord) != Status.FAILED
+            time_mod.sleep(1.0)
+        assert status.load_job_status(coord) == Status.SUCCEED
+    finally:
+        driver.shutdown(kill=True)
+        sb.stop()
+        primary.stop()  # idempotent; without it a pre-outage failure
+        # leaks the primary's server threads into the pytest process
