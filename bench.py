@@ -260,9 +260,10 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
 
     n_chips = jax.local_device_count()
     batch = batch_per_chip * n_chips
-    if flash and jax.devices()[0].platform != "tpu":
-        # the Pallas kernel only compiles natively on TPU; interpret
-        # mode would benchmark the interpreter
+    if flash and jax.devices()[0].platform not in ("tpu", "axon"):
+        # the Pallas kernel only compiles natively on TPU ("axon" is
+        # the dev tunnel's name for a real TPU chip); interpret mode
+        # would benchmark the interpreter
         log("bench[%s]: --flash ignored off-TPU (platform %s)"
             % (kind, jax.devices()[0].platform))
         flash = False

@@ -1,0 +1,98 @@
+"""Kernel-level attention benchmark: Pallas flash vs XLA dense, across
+sequence lengths.
+
+Round-2 measured prose ("faster than dense at 2k/8k, runs 32k where
+dense fails to compile") becomes a recorded artifact: one JSON line per
+(seq_len, impl) with ms/call and achieved TFLOP/s, run fresh on
+whatever backend is up (the harvester runs it on the real chip).
+
+    python -m edl_tpu.tools.bench_flash --seqs 1024,2048,8192,32768
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench_one(impl, batch, heads, seq, dim, causal, iters, warmup):
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.ops.flash_attention import flash_attention
+
+    q, k, v = (jax.random.normal(jax.random.PRNGKey(i),
+                                 (batch, heads, seq, dim), jnp.bfloat16)
+               for i in range(3))
+
+    if impl == "flash":
+        fn = jax.jit(lambda q, k, v: flash_attention(q, k, v,
+                                                     causal=causal))
+    else:
+        def dense(q, k, v):
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                                preferred_element_type=jnp.float32)
+            scores = scores / (dim ** 0.5)
+            if causal:
+                s = scores.shape[-1]
+                mask = jnp.tril(jnp.ones((s, s), bool))
+                scores = jnp.where(mask, scores, -1e30)
+            return jnp.einsum("bhqk,bhkd->bhqd",
+                              jax.nn.softmax(scores, axis=-1
+                                             ).astype(q.dtype), v)
+        fn = jax.jit(dense)
+
+    for _ in range(warmup):
+        out = fn(q, k, v)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(q, k, v)
+    jax.block_until_ready(out)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    # 4*b*h*s^2*d multiply-adds fwd (qk + av), causal halves it
+    flops = 4.0 * batch * heads * seq * seq * dim * (0.5 if causal
+                                                     else 1.0)
+    return {"metric": "attention_fwd_ms", "impl": impl, "seq": seq,
+            "batch": batch, "heads": heads, "dim": dim,
+            "causal": causal, "value": round(ms, 2), "unit": "ms",
+            "tflops": round(flops / (ms / 1e3) / 1e12, 1)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("flash vs dense attention bench")
+    p.add_argument("--seqs", default="1024,2048,8192,32768")
+    p.add_argument("--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=12)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--causal", action="store_true", default=True)
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    args = p.parse_args(argv)
+    import jax
+    platform = jax.devices()[0].platform
+    # axon IS a TPU (the dev tunnel's platform name; Pallas compiles
+    # through PALLAS_AXON_REMOTE_COMPILE) — only true non-TPU backends
+    # lack the native kernel
+    tpu_like = platform in ("tpu", "axon")
+    for seq in [int(s) for s in args.seqs.split(",") if s]:
+        for impl in ("dense", "flash"):
+            if impl == "flash" and not tpu_like:
+                print(json.dumps({"impl": impl, "seq": seq,
+                                  "skipped": "flash needs TPU "
+                                  "(platform %s)" % platform}),
+                      flush=True)
+                continue
+            try:
+                out = bench_one(impl, args.batch, args.heads, seq,
+                                args.dim, args.causal, args.iters,
+                                args.warmup)
+                print(json.dumps(out), flush=True)
+            except Exception as e:  # noqa: BLE001 — dense OOMs at 32k
+                print(json.dumps({"impl": impl, "seq": seq,
+                                  "error": repr(e)[:300]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

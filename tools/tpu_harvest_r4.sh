@@ -38,6 +38,11 @@ PYEOF
     done
     echo "SWEEP_DONE $(date +%H:%M:%S)" >> "$OUT"
     cp "$OUT" /root/repo/BENCH_SWEEP_r4.txt
+    # kernel-level flash vs dense attention across sequence lengths
+    echo "=== bench_flash ===" >> "$OUT"
+    timeout 600 python -m edl_tpu.tools.bench_flash \
+      --seqs 1024,2048,8192,32768 --iters 10 >> "$OUT" 2>&1
+    cp "$OUT" /root/repo/BENCH_SWEEP_r4.txt
     # profile the winning config: where does the step time go post-bn4?
     echo "=== profile_bench bn4 ===" >> "$OUT"
     timeout 600 python -m edl_tpu.tools.profile_bench --s2d \
