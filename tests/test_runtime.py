@@ -1102,3 +1102,25 @@ print(json.dumps({"hit": bool(hits and hits[0]), "loss": loss,
     c = run(2)  # control: never prewarmed -> no hit, still works
     assert not c["hit"]
     assert c["loss"] < 0.1, c
+
+
+def test_prewarm_targets_respect_grad_accum_batch_axis(tmp_path,
+                                                       monkeypatch):
+    """Under grad accumulation the example batch is [k, rows/k, ...] —
+    the prewarm divisibility check must follow the SHARDED axis (axis 1
+    here), not axis 0 (code review r4): with k=2 and 32 rows, world 4
+    must be accepted (16 sharded rows % 4 == 0), not rejected because
+    2 % 4 != 0."""
+    from edl_tpu.models import linear
+
+    monkeypatch.setenv("EDL_TPU_COMPILE_CACHE", str(tmp_path / "cache"))
+    trainer = ElasticTrainer(linear.loss_fn, linear.init_params(),
+                             optax.sgd(0.01), total_batch_size=32,
+                             grad_accum=2)
+    batch = {"x": np.ones((32, 13), np.float32),
+             "y": np.ones((32,), np.float32)}
+    trainer.train_step(batch)
+    done = trainer.prewarm_resize_compiles([4])
+    assert done == [4], done
+    aot = tmp_path / "cache" / "aot_steps"
+    assert list(aot.glob("step_w4_*.pkl"))
