@@ -32,6 +32,7 @@ write may run from a background thread).
 
 import io
 import json
+import time
 import uuid
 import zlib
 
@@ -250,7 +251,6 @@ class CheckpointManager(object):
         return "%s@%s" % (key, _spans_str(_concrete_spans(index, shape)))
 
     def _fs_wait(self, predicate, what, timeout):
-        import time
         deadline = time.monotonic() + timeout
         delay = 0.02
         while not predicate():
@@ -364,7 +364,6 @@ class CheckpointManager(object):
             # so a matching commit proves our files belong to it) or the
             # sentinel's nonce changes (rank 0 reset the attempt we had
             # joined and deleted our files — rewrite under the new one).
-            import time as _time
 
             def manifest_attempt():
                 try:
@@ -373,13 +372,13 @@ class CheckpointManager(object):
                 except (IOError, OSError, ValueError):
                     return None
 
-            deadline = _time.monotonic() + timeout
+            deadline = time.monotonic() + timeout
             committed = False
             while not committed:
                 self._fs_wait(
                     lambda: read_sentinel() is not None,
                     "rank 0 STARTED sentinel (v%d)" % version,
-                    max(0.01, deadline - _time.monotonic()))
+                    max(0.01, deadline - time.monotonic()))
                 nonce = read_sentinel()
                 if nonce is None:
                     continue
@@ -395,7 +394,7 @@ class CheckpointManager(object):
                     # rank 0's delete_tree reset the dir under our open
                     # writes (we had joined a stale attempt): re-enter
                     # the loop and rewrite under the fresh nonce
-                    if _time.monotonic() > deadline:
+                    if time.monotonic() > deadline:
                         raise
                     continue
                 delay = 0.02
@@ -406,12 +405,12 @@ class CheckpointManager(object):
                     cur = read_sentinel()
                     if cur is not None and cur != nonce:
                         break  # superseded: retry under the new nonce
-                    if _time.monotonic() > deadline:
+                    if time.monotonic() > deadline:
                         raise IOError(
                             "sharded save v%d rank %d: no commit or "
                             "supersession for attempt %s"
                             % (version, rank, nonce))
-                    _time.sleep(delay)
+                    time.sleep(delay)
                     delay = min(delay * 1.5, 0.25)
 
         if barrier is not None:

@@ -46,6 +46,7 @@ elasticity depth for TPU pods, where preemption is routine.
 """
 
 import threading
+import time
 
 from edl_tpu.utils.logger import logger
 
@@ -122,7 +123,6 @@ class CoordinatedStop(object):
         long compile before the leader's watcher ever polls). The
         published step is clamped above min_step so the leader's
         staleness filter never discards a live request."""
-        import time
         now = time.monotonic()
         if self._requested and now - self._last_pub < min(2.0,
                                                           KEY_TTL / 3.0):
@@ -225,7 +225,6 @@ class CoordinatedStop(object):
         stop_at computation covers the furthest-ahead rank, not just
         requesters. One lease is granted once and refreshed; each
         interval costs refresh + leased put (no fsync)."""
-        import time
         now = time.monotonic()
         if now - self._last_hb < self._hb_interval:
             return

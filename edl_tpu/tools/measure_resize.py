@@ -30,11 +30,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def _spawn_store(tmp):
+def _spawn_store():
     from edl_tpu.coordination.server import StoreServer
-    s = StoreServer(host="127.0.0.1", port=0)
-    s.start()
-    return s
+    return StoreServer(host="127.0.0.1", port=0).start()
 
 
 def _spawn_pod(store_endpoint, job_id, log_dir, ckpt_dir, cache_dir,
@@ -102,7 +100,7 @@ def run_arc(tag, cache_dir, args):
     from edl_tpu.coordination.client import CoordClient
 
     tmp = tempfile.mkdtemp(prefix="measure_resize_%s_" % tag)
-    store = _spawn_store(tmp)
+    store = _spawn_store()
     job_id = "rz_%s_%d" % (tag, os.getpid())
     coord = CoordClient([store.endpoint], root=job_id)
     pod = None
