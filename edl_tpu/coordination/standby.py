@@ -27,7 +27,23 @@ over on primary loss, completing the story without importing raft:
 
 One-way door: a demoted primary must never rejoin with its old
 identity. Operational contract (docs/operations.md): wipe or restart
-the old primary as a NEW standby pointed at the promoted server.
+the old primary as a NEW standby pointed at the promoted server
+(``--rejoin-wipe`` automates the wipe).
+
+Split-brain fencing: "the standby cannot reach the primary" is NOT
+proof the primary is down — an asymmetric partition can cut the
+standby<->primary link while clients still reach the primary, and a
+promote then diverges the two stores (clients rotate endpoints on any
+ConnectError). Auto-promotion is therefore gated on corroboration:
+when ``witness_endpoints`` are configured, the standby asks each
+witness (a third vantage point running :class:`WitnessServer`) to
+probe the primary, and promotes only if NO witness can reach it
+either; an unreachable witness counts as no corroboration (fail
+safe: stay gated). Without witnesses the only guard is time, so the
+default ``promote_after`` is 30s — well past transient-blip scale —
+and production deployments should either run a witness or set
+``auto_promote=False`` and fail over by operator action
+(``promote()`` via the ``standby_promote`` RPC).
 
 Durability bound, stated honestly: writes are acked by the primary
 alone, so a failover can lose the last <= ``sync_poll`` seconds of
@@ -44,6 +60,7 @@ import time
 
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.coordination.store import Store
+from edl_tpu.rpc.client import RpcClient
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
@@ -53,6 +70,12 @@ from edl_tpu.utils.logger import logger
 # Store's own WAL restart path uses)
 _REV_MARGIN = 1 << 20
 
+# per-primary-endpoint connect budget a witness spends probing; the
+# standby's witness-call timeout is derived from this so a dead-primary
+# probe (which burns the FULL budget on every endpoint) still answers
+# inside the RPC deadline instead of counting as an unreachable witness
+_WITNESS_PROBE_TIMEOUT = 3.0
+
 
 class StandbyServer(object):
     """``primary_endpoints``: where the live primary serves.
@@ -61,13 +84,16 @@ class StandbyServer(object):
     ``promote()``)."""
 
     def __init__(self, primary_endpoints, host="0.0.0.0", port=0,
-                 wal_path=None, auto_promote=True, promote_after=5.0,
-                 sync_poll=2.0):
+                 wal_path=None, auto_promote=True, promote_after=30.0,
+                 sync_poll=2.0, witness_endpoints=None):
         self.store = Store(wal_path=wal_path)
+        self._primary_endpoints = list(primary_endpoints)
         self._primary = CoordClient(primary_endpoints, timeout=10.0)
         self._auto_promote = auto_promote
         self._promote_after = promote_after
         self._sync_poll = sync_poll
+        self._witness_endpoints = list(witness_endpoints or [])
+        self._lock = threading.Lock()  # serializes promote vs sync apply
         self._promoted = threading.Event()
         self._stop = threading.Event()
         self._last_primary_rev = 0
@@ -83,6 +109,7 @@ class StandbyServer(object):
             self._rpc.register("store_" + name,
                                self._guard(getattr(s, name)))
         self._rpc.register("standby_status", self.status)
+        self._rpc.register("standby_promote", self.promote)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="standby-sync")
 
@@ -133,20 +160,24 @@ class StandbyServer(object):
         state is tiny (a few KB), so a full snapshot per change beats
         replaying per-event semantics (no lease info on events)."""
         kvs, rev = self._primary.get_prefix_raw("")
-        if self._promoted.is_set():
-            # a concurrent promote() made the local store authoritative
-            return self._last_primary_rev
-        want = {kv["key"]: kv["value"] for kv in kvs
-                if kv["lease_id"] is None}
-        have, _ = self.store.get_prefix("")
-        for kv in have:
-            if kv["lease_id"] is None and kv["key"] not in want:
-                self.store.delete(kv["key"])
-        for key, value in want.items():
-            cur = self.store.get(key)
-            if cur is None or cur["value"] != value:
-                self.store.put(key, value)
-        self._last_primary_rev = max(self._last_primary_rev, rev)
+        # apply under the promote lock: an operator promote() between
+        # the fetch above and the loop below would otherwise let this
+        # (old-primary) snapshot clobber keys the newly-authoritative
+        # store has already accepted
+        with self._lock:
+            if self._promoted.is_set():
+                return self._last_primary_rev
+            want = {kv["key"]: kv["value"] for kv in kvs
+                    if kv["lease_id"] is None}
+            have, _ = self.store.get_prefix("")
+            for kv in have:
+                if kv["lease_id"] is None and kv["key"] not in want:
+                    self.store.delete(kv["key"])
+            for key, value in want.items():
+                cur = self.store.get(key)
+                if cur is None or cur["value"] != value:
+                    self.store.put(key, value)
+            self._last_primary_rev = max(self._last_primary_rev, rev)
         return rev
 
     def _run(self):
@@ -186,23 +217,114 @@ class StandbyServer(object):
                     # gated (and if the outage is a standby<->primary
                     # partition only, an empty promote is split-brain
                     # with nothing to show for it)
-                    self.promote()
-                    return
+                    if self._witnesses_corroborate_down():
+                        self.promote()
+                        return
+                    # a witness still reaches the primary (or none
+                    # answered): treat as asymmetric partition, stay
+                    # gated and restart the clock so we re-ask after
+                    # another full promote_after of silence
+                    logger.warning(
+                        "standby: primary unreachable for %.1fs but "
+                        "witness did not corroborate; NOT promoting",
+                        now - self._last_ok)
+                    self._last_ok = now
                 self._stop.wait(0.5)
             except Exception:
                 logger.exception("standby sync failed")
                 self._stop.wait(0.5)
 
+    def _witnesses_corroborate_down(self):
+        """True iff auto-promotion may proceed. With no witnesses
+        configured the timeout alone decides (legacy mode). With
+        witnesses, EVERY reachable witness must agree the primary is
+        down, and at least one must answer — an unreachable witness is
+        no evidence, and promoting on no evidence is the exact
+        asymmetric-partition hazard this gate exists to close."""
+        if not self._witness_endpoints:
+            return True
+        answers = 0
+        # worst case is a black-holed primary: the witness burns the
+        # full probe budget on EVERY primary endpoint before answering
+        call_timeout = (_WITNESS_PROBE_TIMEOUT
+                        * max(1, len(self._primary_endpoints)) + 4.0)
+        for ep in self._witness_endpoints:
+            try:
+                w = RpcClient(ep, timeout=call_timeout)
+                try:
+                    r = w.call("witness_probe", self._primary_endpoints)
+                finally:
+                    w.close()
+                answers += 1
+                if r.get("reachable"):
+                    return False
+            except errors.EdlError:
+                continue
+        return answers > 0
+
     def promote(self):
         """Take over: revision floor above anything the primary issued,
         then open the serving gate. Idempotent."""
-        if self._promoted.is_set():
-            return
-        self.store.seed_revision_above(self._last_primary_rev
-                                       + _REV_MARGIN)
-        self._promoted.set()
+        with self._lock:
+            if self._promoted.is_set():
+                return
+            self.store.seed_revision_above(self._last_primary_rev
+                                           + _REV_MARGIN)
+            self._promoted.set()
         logger.warning("standby PROMOTED (primary unreachable); serving "
                        "as primary on %s", self.endpoint)
+
+
+class WitnessServer(object):
+    """A third vantage point for failover fencing: answers
+    ``witness_probe(endpoints)`` with whether the primary is reachable
+    FROM HERE. Runs on a machine that is neither the primary's nor the
+    standby's, so a standby<->primary link cut does not silence it.
+    Stateless — safe to run anywhere, restart freely."""
+
+    def __init__(self, host="0.0.0.0", port=0):
+        self._rpc = RpcServer(host=host, port=port)
+        self._rpc.register("witness_probe", self.probe)
+
+    @staticmethod
+    def probe(endpoints):
+        for ep in endpoints:
+            try:
+                c = RpcClient(ep, timeout=_WITNESS_PROBE_TIMEOUT)
+                try:
+                    c.call("store_revision")
+                finally:
+                    c.close()
+                return {"reachable": True, "endpoint": ep}
+            except errors.EdlError:
+                continue
+        return {"reachable": False}
+
+    def start(self):
+        self._rpc.start()
+        logger.info("witness serving on %s", self.endpoint)
+        return self
+
+    def stop(self):
+        self._rpc.stop()
+
+    @property
+    def endpoint(self):
+        return self._rpc.endpoint
+
+
+def rejoin_wipe(data_dir):
+    """The re-arm half of the one-way door: an old primary rejoining as
+    a fresh standby must shed every trace of its former identity — its
+    stale WAL would otherwise replay state the promoted store has since
+    superseded and win conflicts it must lose."""
+    import os
+    if not os.path.isdir(data_dir):
+        return
+    for fn in os.listdir(data_dir):
+        if fn.endswith(".wal"):
+            os.unlink(os.path.join(data_dir, fn))
+            logger.warning("rejoin-wipe: removed stale WAL %s", fn)
 
 
 def main(argv=None):
@@ -213,17 +335,30 @@ def main(argv=None):
     p.add_argument("--port", type=int, default=2380)
     p.add_argument("--data_dir", default=None,
                    help="WAL dir (durable standby)")
-    p.add_argument("--promote_after", type=float, default=5.0)
+    p.add_argument("--promote_after", type=float, default=30.0)
     p.add_argument("--no-auto-promote", dest="auto_promote",
                    action="store_false")
+    p.add_argument("--witness", default=None,
+                   help="witness endpoints (comma-separated host:port) "
+                        "that must corroborate primary death before "
+                        "auto-promotion (see WitnessServer)")
+    p.add_argument("--rejoin-wipe", action="store_true",
+                   help="wipe any pre-existing WAL in --data_dir before "
+                        "starting: the re-arm path for an old primary "
+                        "rejoining as a fresh standby after a failover "
+                        "(its stale state must never win)")
     args = p.parse_args(argv)
     import os
     wal = (os.path.join(args.data_dir, "standby.wal")
            if args.data_dir else None)
+    if args.rejoin_wipe and args.data_dir:
+        rejoin_wipe(args.data_dir)
     s = StandbyServer(args.primary.split(","), host=args.host,
                       port=args.port, wal_path=wal,
                       auto_promote=args.auto_promote,
-                      promote_after=args.promote_after)
+                      promote_after=args.promote_after,
+                      witness_endpoints=(args.witness.split(",")
+                                         if args.witness else None))
     s.start()
     print("STANDBY_ENDPOINT=%s" % s.endpoint, flush=True)
     stop = threading.Event()
@@ -232,6 +367,22 @@ def main(argv=None):
     signal.signal(signal.SIGINT, lambda *_: stop.set())
     stop.wait()
     s.stop()
+    return 0
+
+
+def witness_main(argv=None):
+    p = argparse.ArgumentParser("edl_tpu failover witness")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=2381)
+    args = p.parse_args(argv)
+    w = WitnessServer(host=args.host, port=args.port).start()
+    print("WITNESS_ENDPOINT=%s" % w.endpoint, flush=True)
+    stop = threading.Event()
+    import signal
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    w.stop()
     return 0
 
 

@@ -726,7 +726,13 @@ class ElasticTrainer(object):
                 try:
                     return loaded(state, batch,
                                   jax.device_put(rng, repl))
-                except Exception as e:  # noqa: BLE001
+                except (TypeError, ValueError) as e:
+                    # ONLY argument-validation failures are safe to
+                    # retry: they reject before dispatch, so no buffer
+                    # has been donated yet. A post-dispatch failure
+                    # (XlaRuntimeError etc.) leaves state's donated
+                    # buffers deleted — retrying would mask the real
+                    # error with a use-after-donate; let it propagate.
                     logger.warning(
                         "AOT step input mismatch (%r); reverting to "
                         "the jit path for this and later steps", e)

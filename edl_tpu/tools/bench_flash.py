@@ -65,7 +65,8 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=1)
     p.add_argument("--heads", type=int, default=12)
     p.add_argument("--dim", type=int, default=64)
-    p.add_argument("--causal", action="store_true", default=True)
+    p.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                   default=True)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
     args = p.parse_args(argv)

@@ -24,7 +24,7 @@ setup(
             "edl-tpu-job-stats=edl_tpu.tools.job_stats:main",
             "edl-tpu-resize-driver=edl_tpu.tools.resize_driver:main",
             "edl-tpu-liveft=edl_tpu.liveft.launch:main",
-            "edl-tpu-job-stats=edl_tpu.tools.job_stats:main",
+            "edl-tpu-store-witness=edl_tpu.coordination.standby:witness_main",
             "edl-tpu-fake-gcs=edl_tpu.tools.fake_gcs:main",
             "edl-tpu-k8s-operator=edl_tpu.tools.k8s_operator:main",
         ],
