@@ -1,0 +1,363 @@
+"""Hardware-faithful static performance accounting — the TPU compiler's
+own cost model, WITHOUT a chip.
+
+Why this exists: every perf lever in this repo (BN subset statistics,
+flash attention, remat, fused multi-step, dp sharding) ultimately makes
+a claim about flops, HBM bytes, or live memory on a v5e. Measuring them
+needs the dev tunnel, which is frequently dead for whole sessions
+(NOTES.md). But libtpu ships the full production TPU compiler, and
+``jax.experimental.topologies.get_topology_desc("v5e:2x2", "tpu")``
+yields a deviceless topology that ``jit(step).lower(...).compile()``
+compiles against CLIENT-SIDE — the real XLA-TPU/Mosaic pipeline, whose
+``cost_analysis()`` (flops, bytes accessed) and ``memory_analysis()``
+(temp/argument/output bytes) ARE the hardware cost model. This converts
+"unmeasured because the tunnel is dead" into "statically accounted on
+the production compiler", and `tests/test_perf_accounting.py` pins the
+deltas so a lever cannot silently regress.
+
+Role parity: the reference publishes a measured perf table
+(/root/reference/README.md:81-85) as its performance contract; bench.py
+is this repo's live-measurement side, this tool is the static side.
+
+Run:  python -m edl_tpu.tools.perf_accounting --platform tpu \
+          --out PERF_ACCOUNTING.json
+(the module scrubs the axon plugin env itself; CPU fallback for smoke).
+"""
+
+import argparse
+import json
+import os
+import time
+import traceback
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def scrub_env_for_cli():
+    """CLI-only: the axon sitecustomize force-selects the (possibly
+    dead) tunnel platform whenever PALLAS_AXON_POOL_IPS is set, and a
+    hung backend would stall every compile below. Uses the one true
+    scrub recipe (utils/cpu_mesh) + the config override the
+    sitecustomize needs. Deliberately NOT run at import: importing this
+    module to reuse a helper must never reconfigure the host process."""
+    from edl_tpu.utils.cpu_mesh import force_cpu_env
+    force_cpu_env(os.environ, 1)
+    jax.config.update("jax_platforms", "cpu")
+
+# v5e single-chip physics, for mapping byte deltas to expected ms
+V5E_HBM_GBPS = 819.0
+V5E_BF16_TFLOPS = 197.0
+
+
+def spec_like(tree, sharding=None):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), x.dtype,
+                                       sharding=sharding), tree)
+
+
+def v5e_devices():
+    """Deviceless v5e devices from libtpu's own topology description —
+    no tunnel, no chips. v5e:2x2 is the smallest layout the default
+    host bounds accept; accounts slice what they need from the 4."""
+    from jax.experimental import topologies
+    td = topologies.get_topology_desc(topology_name="v5e:2x2",
+                                      platform="tpu")
+    return list(td.devices)
+
+
+def _analyze(compiled):
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):  # older jax returned [dict]
+        ca = ca[0]
+    ma = compiled.memory_analysis()
+    return {
+        "flops": float(ca.get("flops", 0.0)),
+        "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
+        "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+        "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+    }
+
+
+def compile_stats(fn, arg_specs, devices, in_shardings=None,
+                  out_shardings=None, donate_argnums=()):
+    """AOT-compile ``fn`` for ``devices`` and return the compiler's own
+    account of it. The devices may be topology (deviceless) devices."""
+    mesh = Mesh(np.array(devices).reshape(len(devices)), ("dp",))
+    repl = NamedSharding(mesh, P())
+    kw = {"in_shardings": (in_shardings(mesh) if in_shardings else
+                           jax.tree_util.tree_map(lambda _: repl,
+                                                  tuple(arg_specs)))}
+    if out_shardings is not None:
+        kw["out_shardings"] = out_shardings(mesh)
+    jitted = jax.jit(fn, donate_argnums=donate_argnums, **kw)
+    t0 = time.time()
+    compiled = jitted.lower(*arg_specs).compile()
+    out = _analyze(compiled)
+    out["compile_s"] = round(time.time() - t0, 1)
+    return out
+
+
+# -- account 1: BN subset statistics (jaxpr level, backend-free) ----------
+
+
+def bn_structural_account(bn_every, batch=128, image_size=224):
+    """Count the strided stats-subset gathers in the ACTUAL traced loss
+    (ops/batch_norm.py lowers ``x[::k]`` to a gather that shrinks the
+    batch axis by k) and account the bytes the statistics reductions no
+    longer read. Backend-free: derived from the jaxpr, so it pins the
+    implementation, not a compiler's fusion choices."""
+    from edl_tpu.models import resnet
+    _, params, extra, loss_fn = resnet.create_model_and_loss(
+        depth=50, num_classes=1000, vd=True, image_size=image_size,
+        dtype=jnp.bfloat16, space_to_depth=True, bn_stats_every=bn_every)
+    bspec = {"image": jax.ShapeDtypeStruct((batch, image_size, image_size, 3),
+                                           jnp.bfloat16),
+             "label": jax.ShapeDtypeStruct((batch,), jnp.int32)}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    jaxpr = jax.make_jaxpr(loss_fn)(params, extra, bspec, rng)
+    # a stats-subset gather shrinks ONLY the batch axis, by the stride.
+    # At bn_every=1 no subset gather should exist at all, so scan for
+    # ANY plausible stride (an identity-shaped gather from some future
+    # unrelated op must not count as a subset site).
+    ratios = ({bn_every} if bn_every > 1 else set(range(2, 9)))
+    sites = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "gather":
+                i, o = eqn.invars[0].aval, eqn.outvars[0].aval
+                if (i.ndim == o.ndim and i.ndim >= 2
+                        and i.shape[1:] == o.shape[1:]
+                        and any(o.shape[0] * r == i.shape[0]
+                                for r in ratios)):
+                    sites.append((i.shape, o.shape,
+                                  np.dtype(i.dtype).itemsize))
+            for v in eqn.params.values():
+                for u in (v if isinstance(v, (tuple, list)) else (v,)):
+                    if isinstance(u, jax.extend.core.ClosedJaxpr):
+                        walk(u.jaxpr)
+    walk(jaxpr.jaxpr)
+    full = float(sum(np.prod(i) * b for i, _, b in sites))
+    sub = float(sum(np.prod(o) * b for _, o, b in sites))
+    return {
+        "account": "bn_subset_stats_structural",
+        "bn_stats_every": bn_every, "batch": batch,
+        "image_size": image_size,
+        "stat_subset_sites": len(sites),
+        "stats_read_bytes_full": full,  # what bn1 reads for the stats
+        "stats_read_bytes_subset": sub,
+        "stats_bytes_saved": full - sub,
+        "est_ms_saved_at_hbm": round((full - sub) / (V5E_HBM_GBPS * 1e6),
+                                     3),
+    }
+
+
+def _resnet_step_specs(bn_every, batch, image_size, steps_per_call=1):
+    from edl_tpu.models import resnet
+    from edl_tpu.runtime.trainer import (make_multi_step,
+                                         make_train_state,
+                                         make_train_step)
+    _, params, extra, loss_fn = resnet.create_model_and_loss(
+        depth=50, num_classes=1000, vd=True, image_size=image_size,
+        dtype=jnp.bfloat16, space_to_depth=True, bn_stats_every=bn_every)
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = make_train_state(params, tx, extra)
+    if steps_per_call > 1:
+        step = make_multi_step(loss_fn, tx, steps_per_call, has_aux=True)
+        bshape = (steps_per_call, batch)
+    else:
+        step = make_train_step(loss_fn, tx, has_aux=True)
+        bshape = (batch,)
+    bspec = {"image": jax.ShapeDtypeStruct(bshape + (image_size,
+                                                     image_size, 3),
+                                           jnp.bfloat16),
+             "label": jax.ShapeDtypeStruct(bshape, jnp.int32)}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    return step, (spec_like(state), bspec, rng)
+
+
+def resnet_bn_account(devices, bn_every, batch=128, image_size=224,
+                      n_devices=1):
+    """The judged headline step (bench.py's exact construction), on the
+    TPU compiler: what does bn_stats_every actually change in flops /
+    bytes / live memory? With ``n_devices`` > 1 the same step is
+    dp-sharded over that many topology chips — static proof the
+    multi-chip sharding compiles on the real TPU compiler, and of its
+    per-chip cost."""
+    step, (state_spec, bspec, rng) = _resnet_step_specs(
+        bn_every, batch, image_size)
+
+    def in_sh(mesh):
+        repl = NamedSharding(mesh, P())
+        data = NamedSharding(mesh, P("dp"))
+        return (jax.tree_util.tree_map(lambda _: repl, state_spec),
+                {"image": data, "label": data}, repl)
+
+    def out_sh(mesh):
+        repl = NamedSharding(mesh, P())
+        return (jax.tree_util.tree_map(lambda _: repl, state_spec), repl)
+
+    out = compile_stats(step, (state_spec, bspec, rng),
+                        devices[:n_devices],
+                        in_shardings=in_sh, out_shardings=out_sh,
+                        donate_argnums=(0,))
+    out.update({"account": "resnet50_vd_train_step"
+                + ("_dp%d" % n_devices if n_devices > 1 else ""),
+                "bn_stats_every": bn_every, "batch": batch,
+                "image_size": image_size, "n_devices": n_devices})
+    return out
+
+
+# -- account 2: attention — dense vs flash/blockwise ----------------------
+
+
+def attention_account(devices, seq, impl, batch=1, heads=12, dim=64,
+                      grad=True):
+    """Forward(+backward) attention at GPT-2s head shape. ``impl``:
+    dense (materializes the s x s scores), flash (the Pallas kernel —
+    Mosaic compiles it AOT like any other op), block (the lax.scan
+    blockwise reference, the kernel's semantic twin that also runs on
+    CPU)."""
+    from edl_tpu.ops.attention import attention_context
+    from edl_tpu.ops.flash_attention import _blockwise_reference, mha
+
+    def fwd(q, k, v):
+        if impl == "dense":
+            return attention_context(q, k, v, causal=True, mask=None,
+                                     dtype=jnp.bfloat16)
+        if impl == "flash":
+            return mha(q, k, v, causal=True, interpret=False)
+        return _blockwise_reference(q, k, v, True, dim ** -0.5,
+                                    block_k=512)
+
+    if grad:
+        def fn(q, k, v):
+            return jax.grad(lambda t: jnp.sum(
+                fwd(t, k, v).astype(jnp.float32)))(q)
+    else:
+        fn = fwd
+    s = jax.ShapeDtypeStruct((batch, seq, heads, dim), jnp.bfloat16)
+    out = compile_stats(fn, (s, s, s), devices[:1])
+    out.update({"account": "attention_%s" % impl, "seq": seq,
+                "batch": batch, "heads": heads, "dim": dim,
+                "grad": grad})
+    return out
+
+
+# -- account 3: remat (jax.checkpoint trades flops for live memory) -------
+
+
+def remat_account(devices, policy, num_layers=8, d_model=512, seq=1024,
+                  batch=8):
+    from edl_tpu.models import gpt as gpt_mod
+    from edl_tpu.runtime.trainer import make_train_state, make_train_step
+    _, params, loss_fn = gpt_mod.create_model_and_loss(
+        num_layers=num_layers, d_model=d_model, num_heads=8,
+        mlp_dim=4 * d_model, vocab_size=512, max_len=seq)
+    tx = optax.sgd(0.1)
+    state = make_train_state(params, tx)
+    step = make_train_step(loss_fn, tx, remat_policy=policy)
+    bspec = {"input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32)}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    out = compile_stats(step, (spec_like(state), bspec, rng),
+                        devices[:1], donate_argnums=(0,))
+    out.update({"account": "gpt_remat", "remat_policy": policy or "none",
+                "num_layers": num_layers, "d_model": d_model,
+                "seq": seq, "batch": batch})
+    return out
+
+
+# -- account 4: fused multi-step (lax.scan over K train steps) ------------
+
+
+def multistep_account(devices, steps_per_call, batch=128, image_size=224):
+    step, (state_spec, bspec, rng) = _resnet_step_specs(
+        4, batch, image_size, steps_per_call=steps_per_call)
+    out = compile_stats(step, (state_spec, bspec, rng), devices[:1],
+                        donate_argnums=(0,))
+    out.update({"account": "resnet_multistep",
+                "steps_per_call": steps_per_call, "batch": batch,
+                "image_size": image_size})
+    return out
+
+
+ACCOUNTS = ("bn_structural", "resnet_bn", "attention", "remat",
+            "multistep", "sharded")
+
+
+def run_accounts(names, platform):
+    devices = v5e_devices() if platform == "tpu" else jax.devices("cpu")
+    results = []
+
+    def go(label, fn, *a, **kw):
+        try:
+            r = fn(*a, **kw)
+            print(json.dumps(r), flush=True)
+            results.append(r)
+        except Exception:
+            err = {"account": label, "error":
+                   traceback.format_exc(limit=3).splitlines()[-1]}
+            print(json.dumps(err), flush=True)
+            traceback.print_exc()
+            results.append(err)
+
+    if "bn_structural" in names:
+        for k in (1, 2, 4):
+            go("bn_structural", bn_structural_account, k)
+    if "resnet_bn" in names:
+        for k in (1, 2, 4):
+            go("resnet_bn", resnet_bn_account, devices, k)
+    if "attention" in names:
+        for seq in (2048, 8192):
+            for impl in (("dense", "flash") if platform == "tpu"
+                         else ("dense", "block")):
+                go("attention_%s" % impl, attention_account, devices,
+                   seq, impl)
+    if "remat" in names:
+        for pol in (None, "full", "dots"):
+            go("remat", remat_account, devices, pol)
+    if "multistep" in names:
+        for k in (1, 4):
+            go("multistep", multistep_account, devices, k)
+    if "sharded" in names and platform == "tpu":
+        go("sharded", resnet_bn_account, devices, 4, batch=512,
+           n_devices=len(devices))
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("static perf accounting")
+    p.add_argument("--platform", choices=("tpu", "cpu"), default="tpu")
+    p.add_argument("--accounts", default=",".join(ACCOUNTS))
+    p.add_argument("--out", default=None, help="write JSON list here")
+    args = p.parse_args(argv)
+    scrub_env_for_cli()
+    names = [n for n in args.accounts.split(",") if n]
+    unknown = sorted(set(names) - set(ACCOUNTS))
+    if unknown:
+        p.error("unknown accounts %s (valid: %s)"
+                % (",".join(unknown), ",".join(ACCOUNTS)))
+    results = run_accounts(names, args.platform)
+    doc = {"platform": args.platform,
+           "compiler": "libtpu AOT (deviceless v5e:2x2 topology)"
+           if args.platform == "tpu" else "XLA CPU",
+           "v5e_hbm_gbps": V5E_HBM_GBPS,
+           "v5e_bf16_tflops": V5E_BF16_TFLOPS,
+           "results": results}
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+    errs = sum(1 for r in results if "error" in r)
+    print("accounts: %d ok, %d failed" % (len(results) - errs, errs))
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

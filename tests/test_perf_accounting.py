@@ -1,0 +1,136 @@
+"""Static perf-accounting regression pins (VERDICT r4 item 2).
+
+Every perf lever claims something about flops / bytes / live memory.
+These tests pin the STATIC side of each claim so a lever cannot
+silently regress in a session where the TPU tunnel is dead:
+
+- BN subset statistics: pinned at the jaxpr level (backend-free) — the
+  traced loss must actually subsample the stats reads.
+- dense-vs-blockwise attention: pinned on compiled memory growth —
+  dense temp memory is quadratic in sequence length, blockwise (the
+  flash kernel's semantic twin) is linear.
+- fused multi-step: pinned on compiled memory — scanning K train steps
+  into one executable must not inflate live memory.
+- the TPU compiler itself: `tools/perf_accounting.py` AOT-compiles the
+  real steps against a deviceless v5e topology (libtpu's own compiler)
+  and writes PERF_ACCOUNTING.json; the pin here asserts that path stays
+  alive and that the hardware cost model still sees the bn win.
+
+Caveat recorded once: XLA's *CPU* cost model inverts some TPU claims
+(it materializes the strided BN subset, so bn4 shows MORE bytes on
+CPU), which is why the BN pin reads the jaxpr and the hardware pin
+uses the TPU AOT path rather than CPU `cost_analysis()`.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from edl_tpu.tools import perf_accounting as pa
+
+
+# -- BN subset statistics (jaxpr, backend-free) ---------------------------
+
+
+def test_bn_subset_stats_are_structural():
+    """bn_stats_every=4 must subsample EVERY BatchNorm's statistics
+    input by exactly 4x; full-batch mode must subsample nothing."""
+    acc4 = pa.bn_structural_account(4, batch=32, image_size=96)
+    # one stats gather per BN site; ResNet50_vd has 53 BNs (+2 from the
+    # stem path) — losing sites means some BN stopped subsampling
+    assert acc4["stat_subset_sites"] >= 50, acc4
+    assert acc4["stats_read_bytes_full"] > 0
+    # the saving is exactly 1 - 1/k of the stats reads, by construction
+    frac = acc4["stats_bytes_saved"] / acc4["stats_read_bytes_full"]
+    assert abs(frac - 0.75) < 1e-6, acc4
+
+    acc1 = pa.bn_structural_account(1, batch=32, image_size=96)
+    assert acc1["stat_subset_sites"] == 0, \
+        "full-batch stats must not emit subset gathers"
+
+
+def test_bn_subset_full_scale_account_matches_claim():
+    """At the bench shape (batch 128 @ 224) the structural account must
+    keep claiming a multi-ms HBM saving — this is the number
+    PERF_ACCOUNTING.json and NOTES map to the measured 15.8 ms BN
+    profile slice from round 3."""
+    acc = pa.bn_structural_account(4, batch=128, image_size=224)
+    # 2.29 GB of stats reads removed per step when this was pinned;
+    # allow drift down to 2.0 GB before calling it a regression
+    assert acc["stats_bytes_saved"] >= 2.0e9, acc
+    assert acc["est_ms_saved_at_hbm"] >= 2.4, acc
+
+
+# -- attention memory complexity (compiled, CPU) --------------------------
+
+
+def _attn_temp(seq, impl):
+    out = pa.attention_account(jax.devices("cpu"), seq, impl)
+    return out["temp_bytes"], out["flops"]
+
+
+def test_dense_attention_temp_is_quadratic_blockwise_linear():
+    d1, f1 = _attn_temp(512, "dense")
+    d2, f2 = _attn_temp(1024, "dense")
+    d4, f4 = _attn_temp(2048, "dense")
+    # doubling seq must ~4x the dense temp (the s x s scores) and flops
+    assert 3.0 < d2 / d1 < 5.5, (d1, d2)
+    assert 3.0 < d4 / d2 < 5.5, (d2, d4)
+    assert 3.4 < f2 / f1 < 4.6, (f1, f2)
+
+    b1, _ = _attn_temp(512, "block")
+    b2, _ = _attn_temp(1024, "block")
+    b4, _ = _attn_temp(2048, "block")
+    # blockwise live memory grows linearly: ~2x per doubling
+    assert b2 / b1 < 2.7, (b1, b2)
+    assert b4 / b2 < 2.7, (b2, b4)
+
+
+def test_dense_attention_memory_crossover_at_long_seq():
+    """By 8k tokens the s x s scores dominate everything else: the
+    dense forward needs several times the blockwise live memory (the
+    reason flash/blockwise is the long-context default)."""
+    dense = pa.attention_account(jax.devices("cpu"), 8192, "dense",
+                                 grad=False)
+    block = pa.attention_account(jax.devices("cpu"), 8192, "block",
+                                 grad=False)
+    assert dense["temp_bytes"] > 2.0 * block["temp_bytes"], \
+        (dense["temp_bytes"], block["temp_bytes"])
+
+
+# -- fused multi-step memory (compiled, CPU) ------------------------------
+
+
+@pytest.mark.integration
+def test_multistep_scan_adds_no_live_memory():
+    """lax.scan of 4 train steps in one executable must cost ~no extra
+    temp memory over a single step (the lever buys 4x fewer dispatches;
+    it must not pay for them in HBM headroom)."""
+    devs = jax.devices("cpu")
+    one = pa.multistep_account(devs, 1, batch=16, image_size=64)
+    four = pa.multistep_account(devs, 4, batch=16, image_size=64)
+    assert four["temp_bytes"] <= one["temp_bytes"] * 1.25, (one, four)
+
+
+# -- the TPU AOT accounting path itself -----------------------------------
+
+
+def _tpu_topology_or_skip():
+    try:
+        return pa.v5e_devices()
+    except Exception as e:  # noqa: BLE001
+        pytest.skip("no local libtpu AOT compiler: %r" % e)
+
+
+@pytest.mark.integration
+def test_tpu_compiler_sees_bn_subset_win():
+    """The REAL TPU compiler (libtpu AOT against a deviceless v5e
+    topology — no tunnel, no chips) must account fewer bytes for the
+    bn4 step than the bn1 step. This is the hardware-faithful version
+    of the bn pin; small shapes keep the two compiles ~a minute."""
+    devices = _tpu_topology_or_skip()
+    bn1 = pa.resnet_bn_account(devices, 1, batch=32, image_size=96)
+    bn4 = pa.resnet_bn_account(devices, 4, batch=32, image_size=96)
+    assert bn4["bytes_accessed"] < bn1["bytes_accessed"], (bn1, bn4)
+    # flops must not meaningfully grow (subsetting adds no compute)
+    assert bn4["flops"] < bn1["flops"] * 1.02, (bn1, bn4)
