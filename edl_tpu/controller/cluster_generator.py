@@ -12,6 +12,7 @@ the reference's any-count-in-[min,max].
 """
 
 import threading
+import time
 
 from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, status, train_status
@@ -23,7 +24,7 @@ from edl_tpu.utils.logger import logger
 
 class Generator(object):
     def __init__(self, coord, pod_id, min_nodes, max_nodes,
-                 topology_valid=None):
+                 topology_valid=None, below_min_grace=None):
         self._coord = coord
         self._pod_id = pod_id
         self._min = min_nodes
@@ -32,6 +33,16 @@ class Generator(object):
         self._stop = threading.Event()
         self._thread = None
         self._lock = threading.Lock()
+        # a below-min observation is NOT immediately fatal: a mass lease
+        # lapse (store failover, CPU starvation of every launcher's
+        # heartbeat thread at once) looks identical to mass pod death
+        # for up to a TTL, and live launchers re-register within one
+        # (controller/register.py self-heals). Only a below-min state
+        # that PERSISTS past the re-registration window is real.
+        self._below_min_since = None
+        self._below_min_grace = (below_min_grace if below_min_grace
+                                 is not None
+                                 else 2.0 * constants.ETCD_TTL)
 
     def start(self):
         with self._lock:
@@ -99,7 +110,21 @@ class Generator(object):
         logger.info("initial cluster: %d pods, stage %s", n, cluster.stage)
         return cluster
 
+    def _failover_hold(self):
+        """True while a store failover's settle window is open: the
+        promoted standby plants a leased guard key (standby.py), because
+        a failover drops EVERY ephemeral registration at once — reading
+        "missing from resources" as "dead" during the re-registration
+        window would evict live pods from their own cluster. Explicit
+        FAILED statuses still count; only absence is forgiven."""
+        try:
+            from edl_tpu.coordination.standby import FAILOVER_GUARD_KEY
+            return self._coord.get_key(FAILOVER_GUARD_KEY) is not None
+        except errors.EdlError:
+            return False
+
     def _next_cluster(self, current, resources, statuses):
+        hold = self._failover_hold()
         alive, gone, finished = [], [], []
         for pod in current.pods:
             if statuses.get(pod.id) == status.Status.SUCCEED:
@@ -107,12 +132,37 @@ class Generator(object):
                 # not count as a failure (its launcher has exited and can
                 # never answer a barrier again)
                 finished.append(pod.id)
-            elif pod.id not in resources:
-                gone.append(pod.id)
             elif statuses.get(pod.id) == status.Status.FAILED:
                 gone.append(pod.id)
+            elif pod.id not in resources:
+                if hold:
+                    logger.info("failover settle window: keeping pod %s "
+                                "despite missing registration",
+                                pod.id)
+                    alive.append(pod)
+                else:
+                    gone.append(pod.id)
             else:
                 alive.append(pod)
+
+        def reachable(n_hi):
+            n = n_hi
+            while n >= self._min:
+                if self._topology_valid(n):
+                    return True
+                n -= 1
+            return False
+
+        if reachable(len(alive)):
+            # healthy membership clears any pending below-min clock,
+            # INCLUDING the no-change early return below (a healed blip
+            # commits no new cluster, so the reset cannot live only on
+            # the cluster-forming path). "Healthy" must mean a VALID
+            # cluster is reachable, not merely alive >= min — when the
+            # topology hook rejects every size down to min, resetting
+            # here would re-arm the grace clock each pass and the job
+            # would livelock instead of failing.
+            self._below_min_since = None
 
         added = []
         if not finished and self._scale_out_allowed(statuses):
@@ -135,12 +185,24 @@ class Generator(object):
         while n >= self._min and not self._topology_valid(n):
             n -= 1
         if n < self._min:
+            now = time.monotonic()
+            if self._below_min_since is None:
+                self._below_min_since = now
+            waited = now - self._below_min_since
+            if waited < self._below_min_grace:
+                logger.warning(
+                    "below min_nodes: %d live pods < %d for %.1fs "
+                    "(grace %.1fs) — waiting for re-registration before "
+                    "declaring failure", len(candidates), self._min,
+                    waited, self._below_min_grace)
+                return None
             logger.error(
                 "no topology-valid cluster size in [%d,%d] reachable from "
-                "%d live pods; marking job FAILED", self._min, self._max,
-                len(candidates))
+                "%d live pods for %.1fs; marking job FAILED", self._min,
+                self._max, len(candidates), waited)
             status.save_job_status(self._coord, status.Status.FAILED)
             return None
+        self._below_min_since = None
         candidates = candidates[:n]
 
         new = Cluster()

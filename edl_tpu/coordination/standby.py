@@ -55,6 +55,7 @@ right cost/benefit against a full consensus log.
 """
 
 import argparse
+import os
 import threading
 import time
 
@@ -75,6 +76,13 @@ _REV_MARGIN = 1 << 20
 # probe (which burns the FULL budget on every endpoint) still answers
 # inside the RPC deadline instead of counting as an unreachable witness
 _WITNESS_PROBE_TIMEOUT = 3.0
+
+# planted (leased) by promote(): a failover nukes EVERY ephemeral
+# registration at once, so for one re-registration window the cluster
+# generator must not read "pod missing" as "pod dead" — live launchers
+# re-register within their TTL (controller/cluster_generator.py reads
+# this raw key and holds shrink decisions while it exists)
+FAILOVER_GUARD_KEY = "__edl_failover_guard__"
 
 
 class StandbyServer(object):
@@ -271,6 +279,18 @@ class StandbyServer(object):
             self.store.seed_revision_above(self._last_primary_rev
                                            + _REV_MARGIN)
             self._promoted.set()
+        try:
+            # the failover settle window: leased so it self-expires
+            # after the re-registration window without any writer
+            ttl = 2.0 * float(os.environ.get("EDL_TPU_TTL", "10"))
+            lease = self.store.lease_grant(ttl)
+            self.store.put(FAILOVER_GUARD_KEY,
+                           "promoted_by=%s" % self.endpoint,
+                           lease_id=lease)
+        except Exception:
+            logger.exception("failover guard publish failed (cluster "
+                             "generators may shrink before pods "
+                             "re-register)")
         logger.warning("standby PROMOTED (primary unreachable); serving "
                        "as primary on %s", self.endpoint)
 

@@ -5,8 +5,18 @@ Why (TPU): profiling the ResNet50_vd train step on v5e showed the convs
 running at ~87% MFU while ~15.8 ms of the 50 ms step went to BatchNorm
 statistic reductions (`convert_reduce_fusion` reading the full activation
 from HBM) — BN, not matmul, is the throughput ceiling. Computing the
-statistics from ``x[::stats_every]`` cuts that HBM traffic by the same
-factor while normalizing the full batch.
+statistics from every ``stats_every``-th row was built to cut that HBM
+traffic by the same factor while normalizing the full batch.
+
+PERF CAVEAT (r5 static accounting, PERF_ACCOUNTING.json): the TPU
+compiler's own cost model says the subset slice BREAKS the conv->stats
+fusion — full-batch stats fuse into the producing conv and read nothing
+extra, while the strided subset forces an extra materialized pass, so
+bn4 accounts MORE total bytes than bn1 (true for both the gather and
+the lax.slice lowering; slice is kept as the cheaper of the two). Until
+a live-hardware A/B says otherwise, ``stats_every`` is a STATISTICS
+knob (matching the reference's 32-per-accelerator stats batch), not a
+throughput lever; bench.py's default stays 1.
 
 Why it is faithful: the reference's headline run normalizes over 32
 images per accelerator (global batch 256 on 8 GPUs, per-GPU BatchNorm —
@@ -86,7 +96,19 @@ class SubsetBatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
         else:
             k = max(1, self.stats_every)
-            s = x[::k] if x.shape[0] >= k else x
+            if k > 1 and x.shape[0] >= k:
+                # lax.slice, NOT x[::k]: jnp's strided indexing lowers
+                # to iota+gather (and scatter-add in the backward),
+                # which XLA:TPU cannot fuse into the producing conv —
+                # the static account showed it ADDING ~65% bytes
+                # accessed to the step instead of cutting the stats
+                # reads (PERF_ACCOUNTING.json, r5). The slice primitive
+                # fuses, which is the entire point of subset stats.
+                s = jax.lax.slice(
+                    x, (0,) * x.ndim, x.shape,
+                    (k,) + (1,) * (x.ndim - 1))
+            else:
+                s = x
             axes = tuple(range(s.ndim - 1))
             # one pass over s: E[x] and E[x^2] reduce together (the flax
             # use_fast_variance formulation), accumulated in f32

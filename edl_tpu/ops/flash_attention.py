@@ -181,28 +181,48 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     return out.reshape(b, h, s, d)
 
 
-def _blockwise_reference(q, k, v, causal, sm_scale, block_k=512):
-    """O(seq)-memory attention via lax.scan over kv blocks — used for the
-    recompute backward (grad of this == grad of the pallas forward)."""
-    b, h, s, d = q.shape
-    sk = k.shape[2]
-    q32 = q.astype(jnp.float32) * sm_scale
+def _block_layout(k, v, block_k):
+    """Pad kv to a whole number of blocks and reshape for scanning:
+    (kb, vb) are [n_blocks, b, h, block_k, d] f32. ONE copy of the
+    layout shared by the blockwise forward and the recompute backward
+    so the two can never disagree on padding."""
+    b, h, sk, d = k.shape
     n_blocks = (sk + block_k - 1) // block_k
     pad = n_blocks * block_k - sk
     kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
     vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-    kb = kp.reshape(b, h, n_blocks, block_k, d).astype(jnp.float32)
-    vb = vp.reshape(b, h, n_blocks, block_k, d).astype(jnp.float32)
+    kb = kp.reshape(b, h, n_blocks, block_k, d).astype(
+        jnp.float32).transpose(2, 0, 1, 3, 4)
+    vb = vp.reshape(b, h, n_blocks, block_k, d).astype(
+        jnp.float32).transpose(2, 0, 1, 3, 4)
+    return kb, vb, n_blocks
+
+
+def _block_mask(ki, block_k, s, sk, causal):
+    """[s, block_k] validity mask for kv block ``ki``: ragged tail rows
+    beyond sk are invalid; under causal q may not attend ahead. The one
+    copy of the mask convention for forward AND backward."""
     q_pos = jnp.arange(s)[:, None]
+    k_pos = ki * block_k + jnp.arange(block_k)[None, :]
+    mask = k_pos < sk
+    if causal:
+        mask = jnp.logical_and(mask, q_pos >= k_pos)
+    return mask
+
+
+def _blockwise_reference(q, k, v, causal, sm_scale, block_k=512):
+    """O(seq)-memory attention via lax.scan over kv blocks — the
+    semantic twin of the pallas forward."""
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    q32 = q.astype(jnp.float32) * sm_scale
+    kb, vb, n_blocks = _block_layout(k, v, block_k)
 
     def body(carry, blk):
         acc, m, l = carry
         k_blk, v_blk, ki = blk
         scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk)
-        k_pos = ki * block_k + jnp.arange(block_k)[None, :]
-        mask = k_pos < sk
-        if causal:
-            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        mask = _block_mask(ki, block_k, s, sk, causal)
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
         m_new = jnp.maximum(m, scores.max(-1))
         p = jnp.exp(scores - m_new[..., None])
@@ -216,10 +236,8 @@ def _blockwise_reference(q, k, v, causal, sm_scale, block_k=512):
     acc0 = jnp.zeros((b, h, s, d), jnp.float32)
     m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, s), jnp.float32)
-    (acc, m, l), _ = lax.scan(
-        body, (acc0, m0, l0),
-        (kb.transpose(2, 0, 1, 3, 4), vb.transpose(2, 0, 1, 3, 4),
-         jnp.arange(n_blocks)))
+    (acc, m, l), _ = lax.scan(body, (acc0, m0, l0),
+                              (kb, vb, jnp.arange(n_blocks)))
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
@@ -233,23 +251,83 @@ def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
                       interpret)
 
 
+def _flash_bwd(q, k, v, out, g, causal, sm_scale, block_k=512):
+    """The FA2-style memory-efficient backward: recompute per-block
+    attention from saved (out) plus a cheap O(seq)-carry statistics
+    pass, then accumulate dq and emit per-block dk/dv under lax.scan.
+    Live memory is O(seq*(dim + block_k)) — LINEAR in sequence length.
+    (The previous implementation took jax.vjp of the blockwise forward,
+    whose scan residuals stash every block's scores: O(seq^2) — the
+    static account showed its temp memory EXCEEDING dense attention at
+    8k, PERF_ACCOUNTING.json r5.)"""
+    b, h, s, d = q.shape
+    sk = k.shape[2]
+    q32 = q.astype(jnp.float32) * sm_scale
+    g32 = g.astype(jnp.float32)
+    kb, vb, n_blocks = _block_layout(k, v, block_k)
+
+    # pass 1: row statistics (m, l) only — O(seq) carry, no O(s^2) stash
+    def stats_body(carry, blk):
+        m, l = carry
+        k_blk, ki = blk
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk)
+        mask = _block_mask(ki, block_k, s, sk, causal)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        m_new = jnp.maximum(m, scores.max(-1))
+        l = l * jnp.exp(m - m_new) + jnp.where(
+            mask[None, None],
+            jnp.exp(scores - m_new[..., None]), 0.0).sum(-1)
+        return (m_new, l), None
+
+    m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    (m, l), _ = lax.scan(stats_body, (m0, l0),
+                         (kb, jnp.arange(n_blocks)))
+    l = jnp.maximum(l, 1e-30)
+    # delta_i = sum_d g_i * out_i  (the softmax-jacobian row term)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # [b,h,s]
+
+    # pass 2: dq accumulates in the carry; dk/dv emit per block (the
+    # stacked outputs reassemble to full dk/dv — O(seq*dim) total)
+    def grad_body(dq, blk):
+        k_blk, v_blk, ki = blk
+        mask = _block_mask(ki, block_k, s, sk, causal)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk)
+        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+        p = jnp.exp(scores - m[..., None]) / l[..., None]
+        p = jnp.where(mask[None, None], p, 0.0)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v_blk)
+        ds = p * (dp - delta[..., None])
+        dq = dq + sm_scale * jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)
+        # q32 already carries one sm_scale factor, which is exactly
+        # dk_j = sm_scale * sum_i ds_ij q_i
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((b, h, s, d), jnp.float32)
+    dq, (dk_blocks, dv_blocks) = lax.scan(
+        grad_body, dq0, (kb, vb, jnp.arange(n_blocks)))
+    dk = dk_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h,
+                                                    n_blocks * block_k, d)
+    dv = dv_blocks.transpose(1, 2, 0, 3, 4).reshape(b, h,
+                                                    n_blocks * block_k, d)
+    return (dq.astype(q.dtype), dk[:, :, :sk].astype(k.dtype),
+            dv[:, :, :sk].astype(v.dtype))
+
+
 def _vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     out = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    return out, (q, k, v, out)
 
 
 def _vjp_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
+    q, k, v, out = res
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-
-    def ref(q, k, v):
-        return _blockwise_reference(q, k, v, causal, sm_scale)
-
-    _, vjp = jax.vjp(ref, q, k, v)
-    return vjp(g)
+    return _flash_bwd(q, k, v, out, g, causal, sm_scale)
 
 
 flash_attention.defvjp(_vjp_fwd, _vjp_bwd)

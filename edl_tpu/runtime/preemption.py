@@ -86,6 +86,12 @@ class CoordinatedStop(object):
         self._hb_interval = heartbeat_interval
         self._last_hb = 0.0
         self._hb_lease = None
+        # leader-side per-rank heartbeat history: key -> (step, t_seen).
+        # Lets the stop lead use each rank's ACTUAL heartbeat staleness
+        # (observed age of its current value) instead of a blanket
+        # worst-case hb_interval term, which ballooned the lead to ~30
+        # steps at fast cadences (r4) and forced tests onto long epochs.
+        self._hb_obs = {}
         self.stop_at = None
         # stop_at values at or below min_step are STALE (left by a prior
         # incarnation within the key TTL when the stage uuid did not
@@ -158,6 +164,27 @@ class CoordinatedStop(object):
         except (TypeError, ValueError):
             return None
 
+    @staticmethod
+    def _as_step_hb(value):
+        """Heartbeat value -> (step, step_time|None). Heartbeats carry
+        the rank's own measured step time ("<step>:<dt>") so the leader
+        can project each rank's position per-rank; bare ints (older
+        writers) decode with no rate."""
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", "replace")
+        if value is None:
+            return None, None
+        step_s, _, dt_s = str(value).partition(":")
+        try:
+            step = int(step_s)
+        except (TypeError, ValueError):
+            return None, None
+        try:
+            dt = float(dt_s) if dt_s else None
+        except ValueError:
+            dt = None
+        return step, (dt if dt and dt > 0 else None)
+
     def _read_stop_at(self):
         try:
             v = self._coord.get_value(self._service, "stop_at")
@@ -176,29 +203,61 @@ class CoordinatedStop(object):
         # (same stage uuid within the key TTL) — not a live preemption;
         # step_<rank> heartbeats widen the max to EVERY live rank's
         # counter so a fast non-requesting rank cannot already be past
-        # the stop when its watcher observes it
+        # the stop when its watcher observes it.
+        now = time.monotonic()
+        dt = float(self._step_time() or 0.0)
+        # Per-rank position PROJECTION: a heartbeat value is stale by
+        # its observed age (tracked across polls: a value first seen
+        # this poll was written within the last poll interval; on the
+        # leader's very first sighting the age is unknown — assume a
+        # full heartbeat period, it refines at the next beat). Project
+        # each rank forward by age/its-own-step-rate, so the stop
+        # clears where the rank IS, not where its last beat was. This
+        # replaces the old blanket worst-case hb_interval term in the
+        # lead, which at fast cadences ballooned the stop ~30 steps out.
+        hb_steps = []
+        for name, v in reqs:
+            if not name.startswith("step_"):
+                continue
+            s, rank_dt = self._as_step_hb(v)
+            if s is None or s <= self.min_step:
+                continue
+            prev = self._hb_obs.get(name)
+            if prev is None:
+                self._hb_obs[name] = (s, now - self._hb_interval)
+            elif prev[0] != s:
+                self._hb_obs[name] = (s, now)
+            age = now - self._hb_obs[name][1]
+            rate = rank_dt or dt
+            # floor, not ceil: the lead below already covers sub-step
+            # observation latency for every rank. CAPPED at
+            # grace_budget worth of stepping: an unchanged beat can
+            # mean a PAUSED rank (epoch save, eval, recompile) whose
+            # age grows without the rank advancing at all — an
+            # unbounded projection would push stop_at past anything
+            # reachable inside the kill grace and forfeit the save.
+            if rate > 0:
+                ahead = min(int((age + self._poll) / rate),
+                            max(1, int(self._grace_budget / rate)))
+            else:
+                ahead = 0
+            hb_steps.append(s + ahead)
         req_steps = [s for name, v in reqs
                      if name.startswith("req_")
                      and (s := self._as_step(v)) is not None
                      and s > self.min_step]
         if not req_steps:
             return
-        hb_steps = [s for name, v in reqs
-                    if name.startswith("step_")
-                    and (s := self._as_step(v)) is not None
-                    and s > self.min_step]
-        # the stop must land AHEAD of every rank's step counter when its
-        # watcher observes it: steps are fast (ms) while observation is
-        # poll-paced (100s of ms), so a fixed step margin would already
-        # be in the past — convert the observation latency (a few poll
-        # intervals plus one heartbeat period of staleness) into steps
-        # using the measured step time. With SLOW steps the lead is
-        # capped so margin*step_time stays inside the kill grace window.
-        dt = float(self._step_time() or 0.0)
+        # the stop must land AHEAD of every rank's (projected) counter
+        # when its watcher observes it: steps are fast (ms) while
+        # observation is poll-paced (100s of ms), so a fixed step margin
+        # would already be in the past — convert a few poll intervals of
+        # observation latency into steps using the measured step time.
+        # With SLOW steps the lead is capped so lead*step_time stays
+        # inside the kill grace window.
         lead = self._margin
         if dt > 0:
-            adaptive = int((4.0 * self._poll + self._hb_interval)
-                           / dt) + 1
+            adaptive = int(4.0 * self._poll / dt) + 1
             lead = max(self._margin, adaptive)
             max_lead = max(1, int(self._grace_budget / dt))
             lead = min(lead, max_lead)
@@ -229,7 +288,11 @@ class CoordinatedStop(object):
         if now - self._last_hb < self._hb_interval:
             return
         self._last_hb = now
-        value = str(max(int(self._current_step()), self.min_step + 1))
+        step = max(int(self._current_step()), self.min_step + 1)
+        dt = float(self._step_time() or 0.0)
+        # carry this rank's own step rate so the leader can project the
+        # beat's staleness per-rank (see _leader_maybe_publish)
+        value = ("%d:%.6f" % (step, dt)) if dt > 0 else str(step)
         key = self._coord.server_key(self._service,
                                      "step_%d" % self._rank)
         ttl = max(10.0, 4 * self._hb_interval)

@@ -1,19 +1,33 @@
-"""TPU resize-recovery measurement: seconds from SIGKILL to the first
-post-restore step, cold vs warm XLA compile cache.
+"""Resize-recovery measurement: seconds from SIGKILL to the first
+post-restore step.
 
 SURVEY.md §7 names restart latency as THE metric to engineer for
 elastic TPU training, and the reference's fault-tolerance story is
 judged in minutes (doc/edl_live_fault_tolerance.md:37, <5 min). This
-tool produces the repo's measured number on real hardware: one launcher
-pod (one chip) training the resnet example, hard-killed mid-run, then
-respawned; recovery is the wall time until the store-visible global
-step advances past the pre-kill step (i.e. the trainer re-initialized,
-re-compiled — or cache-hit — restored, and committed new progress).
+tool produces the repo's measured numbers: one launcher pod training
+the resnet example, hard-killed mid-run, then respawned; recovery is
+the wall time until the store-visible global step advances past the
+pre-kill step (i.e. the trainer re-initialized, re-compiled — or
+cache-hit / AOT-loaded — restored, and committed new progress).
+
+Arcs:
+- cold / warm: SAME-world restart, without / with the XLA persistent
+  compile cache. (warm = cache hit; the classic restart.)
+- resize_prewarm_on / resize_prewarm_off: WORLD-CHANGING restart
+  (n devices -> n//2), the arc the AOT resize prewarm exists for: the
+  persistent cache can never carry a compile across world sizes (its
+  key includes the platform topology), so without prewarm the shrunken
+  world pays a full compile, and with --prewarm_worlds the first
+  incarnation serialized the smaller world's step executable ahead of
+  time and the restart just loads it. Runs on a virtual CPU world by
+  default (--platform cpu, 2 -> 1 devices); the 8 -> 4 TPU run uses
+  the same arcs on a multi-chip host (tools/measure_resize_tpu.sh).
 
     python -m edl_tpu.tools.measure_resize --arcs cold,warm
+    python -m edl_tpu.tools.measure_resize --platform cpu \
+        --arcs resize_prewarm_on,resize_prewarm_off
 
-Each arc prints one JSON line; "warm" sets EDL_TPU_COMPILE_CACHE to a
-dir populated by the arc's initial launch, "cold" leaves it unset.
+Each arc prints one JSON line.
 """
 
 import argparse
@@ -36,8 +50,18 @@ def _spawn_store():
 
 
 def _spawn_pod(store_endpoint, job_id, log_dir, ckpt_dir, cache_dir,
-               args):
+               args, n_devices=None, prewarm_worlds=""):
     env = dict(os.environ)  # TPU env inherited
+    if n_devices is not None and args.platform == "cpu":
+        from edl_tpu.utils.cpu_mesh import force_cpu_env
+        force_cpu_env(env, n_devices)
+    elif n_devices is not None:
+        # real TPU VM: libtpu honours TPU_VISIBLE_DEVICES, so the
+        # shrunken incarnation actually sees fewer chips (without this
+        # the "resize" arcs restart into the same full world and the
+        # prewarm comparison is meaningless)
+        env["TPU_VISIBLE_DEVICES"] = ",".join(
+            str(i) for i in range(n_devices))
     env.update({
         "PYTHONPATH": REPO,
         "EDL_TPU_POD_IP": "127.0.0.1",
@@ -48,21 +72,23 @@ def _spawn_pod(store_endpoint, job_id, log_dir, ckpt_dir, cache_dir,
         env["EDL_TPU_COMPILE_CACHE"] = cache_dir
     os.makedirs(log_dir, exist_ok=True)
     log = open(os.path.join(log_dir, "pod.log"), "ab")
-    proc = subprocess.Popen(
-        [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
-         "--job_id", job_id,
-         "--store_endpoints", store_endpoint,
-         "--nodes_range", "1:1",
-         "--log_dir", os.path.join(log_dir, "trainers"),
-         os.path.join(REPO, "examples", "resnet", "train.py"),
-         "--epochs", "1000",
-         "--steps_per_epoch", str(args.steps_per_epoch),
-         "--total_batch_size", str(args.batch),
-         "--image_size", str(args.image_size),
-         "--num_classes", "100", "--dtype", "bf16",
-         "--fetch_steps", "1"],
-        env=env, stdout=log, stderr=subprocess.STDOUT,
-        preexec_fn=os.setsid)
+    cmd = [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
+           "--job_id", job_id,
+           "--store_endpoints", store_endpoint,
+           "--nodes_range", "1:1",
+           "--log_dir", os.path.join(log_dir, "trainers"),
+           os.path.join(REPO, "examples", "resnet", "train.py"),
+           "--epochs", "1000",
+           "--steps_per_epoch", str(args.steps_per_epoch),
+           "--total_batch_size", str(args.batch),
+           "--image_size", str(args.image_size),
+           "--num_classes", "100", "--dtype", args.dtype,
+           "--fetch_steps", "1"]
+    if prewarm_worlds:
+        cmd += ["--prewarm_worlds", prewarm_worlds]
+    proc = subprocess.Popen(cmd, env=env, stdout=log,
+                            stderr=subprocess.STDOUT,
+                            preexec_fn=os.setsid)
     log.close()
     return proc
 
@@ -113,16 +139,97 @@ def run_arc(tag, cache_dir, args):
                                  args.timeout, pod)
         t0 = time.monotonic()
         _kill_group(pod)
+        # baseline on the CURRENT store step (the key is permanent and
+        # survives the kill; steps kept committing after s0 was read)
+        base = _store_step(coord)
+        base = s0 if base is None else max(base, s0)
         pod = _spawn_pod(store.endpoint, job_id,
                          os.path.join(tmp, "logs2"),
                          os.path.join(tmp, "ckpt"), cache_dir, args)
-        s1, _ = _wait_step(coord, lambda s: s > s0, args.timeout, pod)
+        s1, _ = _wait_step(coord, lambda s: s > base, args.timeout, pod)
         recovery = time.monotonic() - t0
         return {
             "metric": "resize_recovery_s_%s_cache" % tag,
             "value": round(recovery, 1),
             "unit": "s",
             "initial_launch_to_first_epoch_s": round(t_first, 1),
+            "pre_kill_step": s0, "first_post_restore_step": s1,
+            "steps_per_epoch": args.steps_per_epoch,
+            "batch": args.batch, "image_size": args.image_size,
+        }
+    finally:
+        if pod is not None:
+            _kill_group(pod)
+        store.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _wait_aot_file(cache_dir, world, timeout):
+    import glob as glob_mod
+    pat = os.path.join(cache_dir, "aot_steps", "step_w%d_*.pkl" % world)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if glob_mod.glob(pat):
+            return time.monotonic() - t0
+        time.sleep(0.5)
+    raise TimeoutError("prewarm artifact %s not produced in %.0fs"
+                       % (pat, timeout))
+
+
+def run_resize_arc(prewarm, args):
+    """World-CHANGING restart: a pod on ``--from_devices`` devices is
+    SIGKILLed and respawned on half as many; with ``prewarm`` the first
+    incarnation AOT-compiled the smaller world's step ahead of time."""
+    from edl_tpu.coordination.client import CoordClient
+
+    tag = "resize_prewarm_%s" % ("on" if prewarm else "off")
+    n_hi = args.from_devices
+    n_lo = n_hi // 2
+    tmp = tempfile.mkdtemp(prefix="measure_%s_" % tag)
+    cache = os.path.join(tmp, "cache")
+    os.makedirs(cache)
+    store = _spawn_store()
+    job_id = "rz_%s_%d" % (tag, os.getpid())
+    coord = CoordClient([store.endpoint], root=job_id)
+    pod = None
+    try:
+        pod = _spawn_pod(store.endpoint, job_id,
+                         os.path.join(tmp, "logs"),
+                         os.path.join(tmp, "ckpt"), cache, args,
+                         n_devices=n_hi,
+                         prewarm_worlds=str(n_lo) if prewarm else "")
+        s0, t_first = _wait_step(coord,
+                                 lambda s: s >= args.steps_per_epoch,
+                                 args.timeout, pod)
+        prewarm_wait = None
+        if prewarm:
+            # the example kicks the prewarm thread after its first
+            # epoch; the measurement starts only once the artifact is
+            # durable (a real deployment prewarns during steady state)
+            prewarm_wait = round(_wait_aot_file(cache, n_lo,
+                                                args.timeout), 1)
+        t0 = time.monotonic()
+        _kill_group(pod)
+        # the store's global-step key is PERMANENT and survives the
+        # kill; training also kept committing during the prewarm wait
+        # above. Baseline on the step visible right now, not the stale
+        # s0, or the recovery "completes" the instant the store answers
+        base = _store_step(coord)
+        base = s0 if base is None else max(base, s0)
+        pod = _spawn_pod(store.endpoint, job_id,
+                         os.path.join(tmp, "logs2"),
+                         os.path.join(tmp, "ckpt"), cache, args,
+                         n_devices=n_lo)
+        s1, _ = _wait_step(coord, lambda s: s > base, args.timeout, pod)
+        recovery = time.monotonic() - t0
+        return {
+            "metric": "resize_recovery_s_%s" % tag[7:],  # prewarm_{on,off}
+            "value": round(recovery, 1),
+            "unit": "s",
+            "from_devices": n_hi, "to_devices": n_lo,
+            "platform": args.platform,
+            "initial_launch_to_first_epoch_s": round(t_first, 1),
+            "prewarm_artifact_wait_s": prewarm_wait,
             "pre_kill_step": s0, "first_post_restore_step": s1,
             "steps_per_epoch": args.steps_per_epoch,
             "batch": args.batch, "image_size": args.image_size,
@@ -141,6 +248,17 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=128)
     p.add_argument("--image_size", type=int, default=224)
     p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--dtype", default="bf16",
+                   help="bf16 on TPU; use f32 for CPU arcs (XLA CPU "
+                        "emulates bf16 an order of magnitude slower)")
+    p.add_argument("--platform", choices=("tpu", "cpu"), default="tpu",
+                   help="cpu = virtual-device worlds for the resize "
+                        "arcs (hermetic); tpu inherits the host's TPU "
+                        "env (the world-changing arcs then need a "
+                        "multi-chip host)")
+    p.add_argument("--from_devices", type=int, default=2,
+                   help="resize arcs shrink from this world to half "
+                        "of it (8 for the queued TPU run)")
     args = p.parse_args(argv)
     cache_dir = tempfile.mkdtemp(prefix="measure_resize_cache_")
     rc = 0
@@ -148,12 +266,16 @@ def main(argv=None):
         for tag in args.arcs.split(","):
             tag = tag.strip()
             try:
-                out = run_arc(tag,
-                              cache_dir if tag == "warm" else None, args)
+                if tag in ("resize_prewarm_on", "resize_prewarm_off"):
+                    out = run_resize_arc(tag.endswith("_on"), args)
+                else:
+                    out = run_arc(tag,
+                                  cache_dir if tag == "warm" else None,
+                                  args)
                 print(json.dumps(out), flush=True)
             except Exception as e:  # noqa: BLE001
-                print(json.dumps({"metric": "resize_recovery_s_%s_cache"
-                                  % tag, "error": repr(e)}), flush=True)
+                print(json.dumps({"metric": "resize_recovery_%s" % tag,
+                                  "error": repr(e)}), flush=True)
                 rc = 1
     finally:
         shutil.rmtree(cache_dir, ignore_errors=True)

@@ -107,11 +107,13 @@ def compile_stats(fn, arg_specs, devices, in_shardings=None,
 
 
 def bn_structural_account(bn_every, batch=128, image_size=224):
-    """Count the strided stats-subset gathers in the ACTUAL traced loss
-    (ops/batch_norm.py lowers ``x[::k]`` to a gather that shrinks the
-    batch axis by k) and account the bytes the statistics reductions no
-    longer read. Backend-free: derived from the jaxpr, so it pins the
-    implementation, not a compiler's fusion choices."""
+    """Count the strided stats-subset slices in the ACTUAL traced loss
+    and account the stats-input bytes they remove. Backend-free: derived
+    from the jaxpr, so it pins the implementation, not a compiler's
+    fusion choices. NOTE the est_ms field is the UPPER BOUND assuming
+    the subset fuses like full-batch stats do — the TPU compiler's cost
+    model says it does NOT (fusion breaks; see ops/batch_norm.py PERF
+    CAVEAT), so this account bounds the prize, not the outcome."""
     from edl_tpu.models import resnet
     _, params, extra, loss_fn = resnet.create_model_and_loss(
         depth=50, num_classes=1000, vd=True, image_size=image_size,
@@ -121,21 +123,22 @@ def bn_structural_account(bn_every, batch=128, image_size=224):
              "label": jax.ShapeDtypeStruct((batch,), jnp.int32)}
     rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
     jaxpr = jax.make_jaxpr(loss_fn)(params, extra, bspec, rng)
-    # a stats-subset gather shrinks ONLY the batch axis, by the stride.
-    # At bn_every=1 no subset gather should exist at all, so scan for
-    # ANY plausible stride (an identity-shaped gather from some future
-    # unrelated op must not count as a subset site).
+    # a stats subset is a batch-axis-strided `slice` (ops/batch_norm.py
+    # uses lax.slice — deliberately NOT x[::k], whose iota+gather
+    # lowering XLA:TPU cannot fuse into the producing conv). At
+    # bn_every=1 no strided batch slice should exist at all, so scan
+    # for ANY plausible stride.
     ratios = ({bn_every} if bn_every > 1 else set(range(2, 9)))
     sites = []
 
     def walk(jx):
         for eqn in jx.eqns:
-            if eqn.primitive.name == "gather":
+            if eqn.primitive.name == "slice":
+                st = eqn.params.get("strides")
                 i, o = eqn.invars[0].aval, eqn.outvars[0].aval
-                if (i.ndim == o.ndim and i.ndim >= 2
-                        and i.shape[1:] == o.shape[1:]
-                        and any(o.shape[0] * r == i.shape[0]
-                                for r in ratios)):
+                if (st and st[0] in ratios and st[0] > 1
+                        and all(s == 1 for s in st[1:])
+                        and i.shape[1:] == o.shape[1:]):
                     sites.append((i.shape, o.shape,
                                   np.dtype(i.dtype).itemsize))
             for v in eqn.params.values():
@@ -218,12 +221,13 @@ def resnet_bn_account(devices, bn_every, batch=128, image_size=224,
 
 
 def attention_account(devices, seq, impl, batch=1, heads=12, dim=64,
-                      grad=True):
+                      grad=True, interpret=False):
     """Forward(+backward) attention at GPT-2s head shape. ``impl``:
     dense (materializes the s x s scores), flash (the Pallas kernel —
-    Mosaic compiles it AOT like any other op), block (the lax.scan
-    blockwise reference, the kernel's semantic twin that also runs on
-    CPU)."""
+    Mosaic compiles it AOT like any other op; ``interpret=True`` for
+    CPU, where the custom-vjp backward still exercises the real
+    O(seq)-memory _flash_bwd), block (the lax.scan blockwise
+    reference, the kernel's semantic twin)."""
     from edl_tpu.ops.attention import attention_context
     from edl_tpu.ops.flash_attention import _blockwise_reference, mha
 
@@ -232,7 +236,7 @@ def attention_account(devices, seq, impl, batch=1, heads=12, dim=64,
             return attention_context(q, k, v, causal=True, mask=None,
                                      dtype=jnp.bfloat16)
         if impl == "flash":
-            return mha(q, k, v, causal=True, interpret=False)
+            return mha(q, k, v, causal=True, interpret=interpret)
         return _blockwise_reference(q, k, v, True, dim ** -0.5,
                                     block_k=512)
 
@@ -315,10 +319,9 @@ def run_accounts(names, platform):
             go("resnet_bn", resnet_bn_account, devices, k)
     if "attention" in names:
         for seq in (2048, 8192):
-            for impl in (("dense", "flash") if platform == "tpu"
-                         else ("dense", "block")):
+            for impl in ("dense", "flash"):
                 go("attention_%s" % impl, attention_account, devices,
-                   seq, impl)
+                   seq, impl, interpret=(platform != "tpu"))
     if "remat" in names:
         for pol in (None, "full", "dots"):
             go("remat", remat_account, devices, pol)

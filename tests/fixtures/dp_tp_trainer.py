@@ -1,0 +1,92 @@
+"""dp x tp elastic trainer fixture — the 4-host resize analogue.
+
+bert_tiny with Megatron tp rules on ``make_mesh(tp=...)`` over the
+launcher's multi-process jax world (each pod contributes its virtual
+CPU devices; tp shards params, dp spans pods). Batches derive from the
+trainer's OWN global_step — step g+1 consumes the deterministic record
+window [g*B, (g+1)*B) — so a committed step IS a consumed window, and
+the FEED lines rank 0 prints across every incarnation must cover
+1..final contiguously (duplicates only at preemption boundaries, where
+a fetched batch's step was stopped before executing): the exactly-once
+bar across world-size changes.
+
+Also engages the AOT resize prewarm each incarnation; in a
+multi-process world its scope guard must refuse cleanly
+(PREWARM_SCOPE line, asserted by the driving test) instead of
+corrupting anything.
+"""
+
+import argparse
+import json
+import sys
+
+import optax
+
+from edl_tpu.runtime.trainer import ElasticTrainer, maybe_init_distributed
+
+
+def main(argv=None):
+    maybe_init_distributed()
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import bert
+    from edl_tpu.runtime.mesh import make_mesh
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--steps_per_epoch", type=int, default=20)
+    p.add_argument("--total_batch_size", type=int, default=24)
+    p.add_argument("--tp", type=int, default=2)
+    p.add_argument("--step_sleep", type=float, default=0.05)
+    args = p.parse_args(argv)
+
+    model, params, loss_fn = bert.create_model_and_loss(
+        model=bert.bert_tiny(dtype=jnp.float32))
+    mesh = make_mesh(tp=args.tp)
+    trainer = ElasticTrainer(loss_fn, params, optax.adamw(1e-3),
+                             total_batch_size=args.total_batch_size,
+                             mesh=mesh,
+                             param_shardings=bert.bert_partition_rules())
+    rank = trainer.env.global_rank
+    prewarm_checked = []
+
+    def batches(epoch):
+        import time
+        for _ in range(args.steps_per_epoch):
+            g = trainer.global_step
+            print("FEED step=%d rank=%d world=%d epoch=%d"
+                  % (g + 1, rank, trainer.world_size, epoch), flush=True)
+            full = bert.synthetic_text_batch(args.total_batch_size,
+                                             seq_len=16, seed=g)
+            yield trainer.local_batch_slice(full)
+            if not prewarm_checked:
+                # engage the resize prewarm once a step has run (it
+                # needs the example batch); the multi-process scope
+                # guard must refuse with its reason
+                prewarm_checked.append(True)
+                why = trainer._prewarm_in_scope()
+                done = trainer.prewarm_resize_compiles([1, 2])
+                print("PREWARM_SCOPE rank=%d why=%r done=%r"
+                      % (rank, why, done), flush=True)
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+
+    if trainer.world_size > 1:
+        # tp/dp really cross the process boundary: no single process
+        # holds the full params
+        leaf = next(iter(jax.tree_util.tree_leaves(
+            trainer.train_state["params"])))
+        assert not leaf.is_fully_addressable, "params fully local?!"
+
+    result = trainer.fit(args.epochs, batches,
+                         log_fn=lambda m: print(
+                             m.replace("fit:", "dp_tp:"), flush=True))
+    print(json.dumps({"final_loss": result["final_loss"],
+                      "steps": result["steps"],
+                      "world": result["world"]}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

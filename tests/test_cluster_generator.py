@@ -41,7 +41,8 @@ def test_generator_initial_scale_out_and_shrink(coord):
     reg_b = ResourceRegister(coord, pod_b)
     coord.set_server_permanent(constants.SERVICE_LEADER,
                                constants.LEADER_SERVER, pod_a.id)
-    gen = Generator(coord, pod_a.id, min_nodes=2, max_nodes=3).start()
+    gen = Generator(coord, pod_a.id, min_nodes=2, max_nodes=3,
+                    below_min_grace=1.0).start()
     try:
         c1 = _wait(lambda: cluster_mod.load_from_store(coord))
         assert len(c1.pods) == 2
@@ -62,12 +63,49 @@ def test_generator_initial_scale_out_and_shrink(coord):
         assert c3.stage != c2.stage
         assert pod_c.id not in c3.pod_ids()
 
-        # below min: pod_b dies → job FAILED
+        # below min: pod_b dies → job FAILED (after the below-min grace)
         reg_b.stop()
         _wait(lambda: status.load_job_status(coord) == status.Status.FAILED)
     finally:
         gen.stop()
         reg_a.stop()
+
+
+def test_generator_below_min_blip_is_not_fatal(coord):
+    """A mass lease lapse (store failover / every launcher's heartbeat
+    starved at once) drops live pods below min for up to a TTL, but the
+    launchers are alive and re-register (register.py self-heals). The
+    generator must ride out a below-min state shorter than its grace
+    instead of instantly declaring the job FAILED."""
+    pod_a, pod_b = _pod(), _pod()
+    reg_a = ResourceRegister(coord, pod_a)
+    reg_b = ResourceRegister(coord, pod_b)
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, pod_a.id)
+    gen = Generator(coord, pod_a.id, min_nodes=2, max_nodes=2,
+                    below_min_grace=8.0).start()
+    reg_b2 = None
+    try:
+        c1 = _wait(lambda: (lambda c: c if c and len(c.pods) == 2
+                            else None)(cluster_mod.load_from_store(coord)))
+        # the blip: pod_b's registration vanishes...
+        reg_b.stop()
+        time.sleep(2.0)  # several generator periods inside the grace
+        assert status.load_job_status(coord) != status.Status.FAILED
+        # ...and self-heals within the grace: the cluster rides through
+        # UNCHANGED (no churn, no stage change, no failure)
+        reg_b2 = ResourceRegister(coord, pod_b)
+        time.sleep(3.0)  # well past the original grace expiry
+        c2 = cluster_mod.load_from_store(coord)
+        assert c2 is not None and len(c2.pods) == 2
+        assert c2.stage == c1.stage, "blip churned the cluster"
+        assert pod_b.id in c2.pod_ids()
+        assert status.load_job_status(coord) != status.Status.FAILED
+    finally:
+        gen.stop()
+        reg_a.stop()
+        if reg_b2 is not None:
+            reg_b2.stop()
 
 
 def test_generator_commit_requires_leadership(coord):
@@ -147,3 +185,38 @@ def test_barrier_all_pods_get_cluster(coord):
         server.stop()
         for r in regs:
             r.stop()
+
+
+def test_generator_failover_guard_holds_membership(coord):
+    """While the promoted standby's failover guard key exists, a pod
+    whose registration vanished (lease nuked by the failover, launcher
+    alive and about to re-register) must be KEPT in the cluster;
+    explicit FAILED still evicts; once the guard expires/clears, a
+    still-missing pod is genuinely gone."""
+    from edl_tpu.coordination.standby import FAILOVER_GUARD_KEY
+
+    pod_a, pod_b = _pod(), _pod()
+    reg_a = ResourceRegister(coord, pod_a)
+    reg_b = ResourceRegister(coord, pod_b)
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, pod_a.id)
+    gen = Generator(coord, pod_a.id, min_nodes=1, max_nodes=2,
+                    below_min_grace=1.0).start()
+    try:
+        c1 = _wait(lambda: (lambda c: c if c and len(c.pods) == 2
+                            else None)(cluster_mod.load_from_store(coord)))
+        # the failover: guard planted, pod_b's registration vanishes
+        coord.put(FAILOVER_GUARD_KEY, "promoted_by=test")
+        reg_b.stop()
+        time.sleep(2.0)
+        c2 = cluster_mod.load_from_store(coord)
+        assert c2.stage == c1.stage and len(c2.pods) == 2, \
+            "guarded membership churned"
+        # settle window ends with pod_b still missing: now it IS gone
+        coord.delete(FAILOVER_GUARD_KEY)
+        c3 = _wait(lambda: (lambda c: c if c and len(c.pods) == 1
+                            else None)(cluster_mod.load_from_store(coord)))
+        assert pod_b.id not in c3.pod_ids()
+    finally:
+        gen.stop()
+        reg_a.stop()

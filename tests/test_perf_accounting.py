@@ -51,12 +51,11 @@ def test_bn_subset_stats_are_structural():
 
 def test_bn_subset_full_scale_account_matches_claim():
     """At the bench shape (batch 128 @ 224) the structural account must
-    keep claiming a multi-ms HBM saving — this is the number
-    PERF_ACCOUNTING.json and NOTES map to the measured 15.8 ms BN
-    profile slice from round 3."""
+    keep finding the full 2.29 GB/step of stats-input bytes removed —
+    the UPPER BOUND of the lever if the subset fused (the TPU compiler
+    says it does not; see the bn-tradeoff pin below). A drop here means
+    some BN stopped subsetting, independent of the fusion question."""
     acc = pa.bn_structural_account(4, batch=128, image_size=224)
-    # 2.29 GB of stats reads removed per step when this was pinned;
-    # allow drift down to 2.0 GB before calling it a regression
     assert acc["stats_bytes_saved"] >= 2.0e9, acc
     assert acc["est_ms_saved_at_hbm"] >= 2.4, acc
 
@@ -123,14 +122,22 @@ def _tpu_topology_or_skip():
 
 
 @pytest.mark.integration
-def test_tpu_compiler_sees_bn_subset_win():
+def test_tpu_compiler_accounts_bn_tradeoff():
     """The REAL TPU compiler (libtpu AOT against a deviceless v5e
-    topology — no tunnel, no chips) must account fewer bytes for the
-    bn4 step than the bn1 step. This is the hardware-faithful version
-    of the bn pin; small shapes keep the two compiles ~a minute."""
+    topology — no tunnel, no chips) accounts the bn subset-stats
+    tradeoff. FINDING (r5, PERF_ACCOUNTING.json): the subset slice
+    BREAKS the conv->stats reduce fusion, so bn4 costs MORE bytes
+    accessed than bn1 (full-batch stats fuse into the conv and read
+    nothing extra) — the opposite of the r3 profile-era hypothesis,
+    and why bench.py's default stays bn1. This pin keeps the AOT
+    accounting path alive and bounds the regime: flops must not grow
+    (subsetting adds no compute), bytes must stay within 2.2x (a
+    runaway regression in either implementation trips it), and an
+    implementation that ever makes bn4 CHEAPER in bytes shows up as a
+    ratio < 1 here — re-evaluate the bench default then."""
     devices = _tpu_topology_or_skip()
     bn1 = pa.resnet_bn_account(devices, 1, batch=32, image_size=96)
     bn4 = pa.resnet_bn_account(devices, 4, batch=32, image_size=96)
-    assert bn4["bytes_accessed"] < bn1["bytes_accessed"], (bn1, bn4)
-    # flops must not meaningfully grow (subsetting adds no compute)
     assert bn4["flops"] < bn1["flops"] * 1.02, (bn1, bn4)
+    ratio = bn4["bytes_accessed"] / bn1["bytes_accessed"]
+    assert 0.3 < ratio < 2.2, (bn1, bn4)

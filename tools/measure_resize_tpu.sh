@@ -1,7 +1,16 @@
 #!/bin/bash
-# TPU resize recovery (VERDICT r3 next-round item 5): SIGKILL -> first
-# post-restore step on the real chip, cold vs warm XLA compile cache.
+# TPU resize recovery (VERDICT r3 item 5 / r4 item 4): SIGKILL -> first
+# post-restore step on the real chip.
 cd "$(dirname "$0")/.." || exit 1
+# same-world restart: cold vs warm XLA compile cache
 timeout 850 python -m edl_tpu.tools.measure_resize \
   --arcs cold,warm --steps_per_epoch 20 --batch 128 --image_size 224 \
   --timeout 400
+# world-CHANGING restart (the AOT prewarm's arc): needs >1 chip, so on
+# the single-chip tunnel this records an error line rather than a
+# number — the 8->4 run is queued for a multi-chip host where
+# --platform tpu sees 8 devices
+timeout 900 python -m edl_tpu.tools.measure_resize \
+  --platform tpu --from_devices 8 \
+  --arcs resize_prewarm_on,resize_prewarm_off \
+  --steps_per_epoch 20 --batch 128 --image_size 224 --timeout 400
