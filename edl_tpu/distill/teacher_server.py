@@ -28,6 +28,10 @@ from edl_tpu.utils.logger import logger
 class TeacherServer(object):
     """Wrap ``predict_fn(feed: dict[str, np.ndarray]) -> dict`` behind RPC.
 
+    Contract: ``predict_fn`` must treat the feed arrays as READ-ONLY
+    (they may be zero-copy views into the decoded request); copy first
+    to mutate in place.
+
     ``feed_specs``/``fetch_specs``: {name: (shape_without_batch, dtype_str)}.
     ``max_batch``: server-side compiled batch size; requests are padded up
     and sliced back, so any client batch <= max_batch reuses one program.
@@ -51,7 +55,13 @@ class TeacherServer(object):
                 "max_batch": self._max_batch}
 
     def _predict_rpc(self, feed_encoded):
-        feed = nd.decode_tree(feed_encoded)
+        # zero-copy decode: predict_fn receives READ-ONLY feed arrays
+        # (a full max_batch batch is the decoded view itself; padded
+        # batches happen to be fresh from np.concatenate, but the
+        # contract is uniform: treat feeds as immutable — copy first if
+        # an implementation must mutate). All in-tree teachers only
+        # convert onward (jnp/device upload).
+        feed = nd.decode_tree(feed_encoded, copy=False)
         missing = set(self._feed_specs) - set(feed)
         if missing:
             raise errors.DataAccessError("missing feeds: %s"

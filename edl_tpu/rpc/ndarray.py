@@ -23,13 +23,19 @@ def encode_tree(obj):
     return obj
 
 
-def decode_tree(obj):
+def decode_tree(obj, copy=True):
+    """``copy=False`` returns READ-ONLY views into the decoded message
+    bytes (zero-copy) — right for consumers that only feed the arrays
+    onward (device upload, jnp conversion); the distill teacher's feed
+    path saves a full batch-size memcpy per request this way. Default
+    stays copying (owned, writable arrays)."""
     if isinstance(obj, dict):
         if obj.get(_TAG):
-            return np.frombuffer(
+            arr = np.frombuffer(
                 obj["data"], dtype=np.dtype(obj["dtype"])
-            ).reshape(obj["shape"]).copy()
-        return {k: decode_tree(v) for k, v in obj.items()}
+            ).reshape(obj["shape"])
+            return arr.copy() if copy else arr
+        return {k: decode_tree(v, copy) for k, v in obj.items()}
     if isinstance(obj, list):
-        return [decode_tree(v) for v in obj]
+        return [decode_tree(v, copy) for v in obj]
     return obj

@@ -47,15 +47,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 resp["ok"] = False
                 resp["error"] = {"name": name, "detail": detail}
             try:
-                frame = framing.pack_frame(resp)
-            except (TypeError, ValueError, framing.FramingError) as e:
-                # result not wire-encodable → error envelope, keep connection
-                frame = framing.pack_frame({
-                    "id": resp.get("id"), "ok": False,
-                    "error": {"name": "RpcError",
-                              "detail": "unencodable response: %s" % e}})
-            try:
-                self.request.sendall(frame)
+                try:
+                    framing.write_frame(self.request, resp)
+                except (TypeError, ValueError, framing.FramingError) as e:
+                    # result not wire-encodable → error envelope, keep
+                    # the connection (packb fails before any byte is
+                    # sent, so the stream cannot be torn mid-frame)
+                    framing.write_frame(self.request, {
+                        "id": resp.get("id"), "ok": False,
+                        "error": {"name": "RpcError",
+                                  "detail": "unencodable response: %s"
+                                  % e}})
             except (ConnectionError, OSError):
                 return
 
