@@ -85,16 +85,25 @@ def _analyze(compiled):
 
 
 def compile_stats(fn, arg_specs, devices, in_shardings=None,
-                  out_shardings=None, donate_argnums=()):
+                  out_shardings=None, donate_argnums=(), mesh=None):
     """AOT-compile ``fn`` for ``devices`` and return the compiler's own
-    account of it. The devices may be topology (deviceless) devices."""
-    mesh = Mesh(np.array(devices).reshape(len(devices)), ("dp",))
+    account of it. The devices may be topology (deviceless) devices.
+    ``mesh`` overrides the default 1-D ("dp",) mesh for model-parallel
+    accounts; ``in_shardings``/``out_shardings`` are callables of the
+    mesh (or ready pytrees when ``mesh`` is given explicitly)."""
+    if mesh is None:
+        mesh = Mesh(np.array(devices).reshape(len(devices)), ("dp",))
     repl = NamedSharding(mesh, P())
-    kw = {"in_shardings": (in_shardings(mesh) if in_shardings else
+
+    def resolve(sh):
+        return sh(mesh) if callable(sh) else sh
+
+    kw = {"in_shardings": (resolve(in_shardings) if in_shardings
+                           is not None else
                            jax.tree_util.tree_map(lambda _: repl,
                                                   tuple(arg_specs)))}
     if out_shardings is not None:
-        kw["out_shardings"] = out_shardings(mesh)
+        kw["out_shardings"] = resolve(out_shardings)
     jitted = jax.jit(fn, donate_argnums=donate_argnums, **kw)
     t0 = time.time()
     compiled = jitted.lower(*arg_specs).compile()
@@ -291,8 +300,56 @@ def multistep_account(devices, steps_per_call, batch=128, image_size=224):
     return out
 
 
+def bert_tp_account(devices, dp=2, tp=2, num_layers=4, d_model=512,
+                    seq=512, batch=32, zero1=False):
+    """Megatron-rule tensor parallelism on the REAL TPU compiler: a
+    bert train step with params tp-sharded (bert_partition_rules) over
+    a dp x tp mesh of topology chips, optimizer state structurally
+    mirroring the param layout. Static proof the model-parallel path
+    is TPU-valid — the collectives XLA inserts for the tp layout show
+    up in bytes_accessed."""
+    from edl_tpu.models import bert
+    from edl_tpu.parallel.sharding import (match_partition_rules,
+                                           opt_state_shardings)
+    from edl_tpu.runtime.trainer import make_train_state, make_train_step
+
+    _, params, loss_fn = bert.create_model_and_loss(
+        model=bert.bert_tiny(num_layers=num_layers, d_model=d_model,
+                             num_heads=8, mlp_dim=4 * d_model,
+                             max_len=seq, dtype=jnp.bfloat16))
+    mesh = Mesh(np.array(devices[:dp * tp]).reshape(dp, tp),
+                ("dp", "tp"))
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("dp"))
+    pspecs = match_partition_rules(bert.bert_partition_rules(), params)
+    psh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), pspecs,
+        is_leaf=lambda x: isinstance(x, P))
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = make_train_state(params, tx)
+    osh = opt_state_shardings(tx, params, psh, repl,
+                              zero1_mesh=mesh if zero1 else None)
+    state_sh = {"params": psh, "opt_state": osh, "step": repl,
+                "extra": None}
+    step = make_train_step(loss_fn, tx)
+    bspec = {"input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32),
+             "label": jax.ShapeDtypeStruct((batch,), jnp.int32)}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    out = compile_stats(
+        step, (spec_like(state), bspec, rng), devices, mesh=mesh,
+        in_shardings=(state_sh, {"input_ids": data, "label": data},
+                      repl),
+        out_shardings=(state_sh, repl), donate_argnums=(0,))
+    out.update({"account": "bert_tp_train_step"
+                + ("_zero1" if zero1 else ""),
+                "dp": dp, "tp": tp, "zero1": zero1,
+                "num_layers": num_layers, "d_model": d_model,
+                "seq": seq, "batch": batch})
+    return out
+
+
 ACCOUNTS = ("bn_structural", "resnet_bn", "attention", "remat",
-            "multistep", "sharded")
+            "multistep", "sharded", "sharded_tp")
 
 
 def run_accounts(names, platform):
@@ -331,6 +388,9 @@ def run_accounts(names, platform):
     if "sharded" in names and platform == "tpu":
         go("sharded", resnet_bn_account, devices, 4, batch=512,
            n_devices=len(devices))
+    if "sharded_tp" in names and platform == "tpu":
+        go("sharded_tp", bert_tp_account, devices)
+        go("sharded_tp_zero1", bert_tp_account, devices, zero1=True)
     return results
 
 
