@@ -182,14 +182,24 @@ class Gpt(nn.Module):
         x = x + nn.Embed(self.max_len, self.d_model,
                          param_dtype=jnp.float32, dtype=self.dtype,
                          name="pos_embed")(pos_ids)
-        block_cls = nn.remat(GptBlock) if self.remat else GptBlock
+        # remat is a TRAINING lever; on the decode/prefill paths it is
+        # useless AND nn.remat would trace the boolean kwargs into
+        # abstract values (TracerBoolConversionError — caught by the
+        # r5 static accounting, which compiled remat=True for the
+        # first time; the tunnel had been down since the flag landed)
+        use_remat = self.remat and not decode and not prefill
+        block_cls = nn.remat(GptBlock) if use_remat else GptBlock
         for i in range(self.num_layers):
-            x = block_cls(self.num_heads, self.mlp_dim, self.max_len,
-                          self.dtype, self.use_ring, self.use_flash,
-                          self.mesh, ring_axis=self.ring_axis,
-                          name="block_%d" % i)(x, decode=decode,
-                                               decode_index=decode_index,
-                                               prefill=prefill)
+            block = block_cls(self.num_heads, self.mlp_dim,
+                              self.max_len, self.dtype, self.use_ring,
+                              self.use_flash, self.mesh,
+                              ring_axis=self.ring_axis,
+                              name="block_%d" % i)
+            if use_remat:
+                x = block(x)  # training defaults; no traced bools
+            else:
+                x = block(x, decode=decode, decode_index=decode_index,
+                          prefill=prefill)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         # weight-tied LM head (embed.attend = x @ embedding.T)

@@ -15,7 +15,8 @@ import sys
 import time
 
 
-def bench_one(impl, batch, heads, seq, dim, causal, iters, warmup):
+def bench_one(impl, batch, heads, seq, dim, causal, iters, warmup,
+              grad=False):
     import jax
     import jax.numpy as jnp
 
@@ -26,10 +27,10 @@ def bench_one(impl, batch, heads, seq, dim, causal, iters, warmup):
                for i in range(3))
 
     if impl == "flash":
-        fn = jax.jit(lambda q, k, v: flash_attention(q, k, v,
-                                                     causal=causal))
+        def fwd(q, k, v):
+            return flash_attention(q, k, v, causal=causal)
     else:
-        def dense(q, k, v):
+        def fwd(q, k, v):
             scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                                 preferred_element_type=jnp.float32)
             scores = scores / (dim ** 0.5)
@@ -40,20 +41,35 @@ def bench_one(impl, batch, heads, seq, dim, causal, iters, warmup):
             return jnp.einsum("bhqk,bhkd->bhqd",
                               jax.nn.softmax(scores, axis=-1
                                              ).astype(q.dtype), v)
-        fn = jax.jit(dense)
+    if grad:
+        # the TRAINING path: fwd + the attention backward (for flash,
+        # the FA2-style _flash_bwd via the custom vjp)
+        fn = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+            fwd(q, k, v).astype(jnp.float32)), argnums=(0, 1, 2)))
+    else:
+        fn = jax.jit(fwd)
 
+    out = None
     for _ in range(warmup):
         out = fn(q, k, v)
-    jax.block_until_ready(out)
+    if out is not None:
+        jax.block_until_ready(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(q, k, v)
     jax.block_until_ready(out)
     ms = (time.perf_counter() - t0) / iters * 1e3
-    # 4*b*h*s^2*d multiply-adds fwd (qk + av), causal halves it
+    # 4*b*h*s^2*d multiply-adds fwd (qk + av), causal halves it. The
+    # backward: dense keeps the probs as residuals (no recompute) —
+    # ~2x fwd of grad matmuls, 3x total; flash recomputes per block —
+    # ~2.5x fwd, 3.5x total.
     flops = 4.0 * batch * heads * seq * seq * dim * (0.5 if causal
                                                      else 1.0)
-    return {"metric": "attention_fwd_ms", "impl": impl, "seq": seq,
+    if grad:
+        flops *= 3.5 if impl == "flash" else 3.0
+    return {"metric": ("attention_fwdbwd_ms" if grad
+                       else "attention_fwd_ms"),
+            "impl": impl, "seq": seq,
             "batch": batch, "heads": heads, "dim": dim,
             "causal": causal, "value": round(ms, 2), "unit": "ms",
             "tflops": round(flops / (ms / 1e3) / 1e12, 1)}
@@ -69,6 +85,9 @@ def main(argv=None):
                    default=True)
     p.add_argument("--iters", type=int, default=10)
     p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--grad", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="also time fwd+bwd (the training path)")
     args = p.parse_args(argv)
     import jax
     platform = jax.devices()[0].platform
@@ -84,14 +103,18 @@ def main(argv=None):
                                   "(platform %s)" % platform}),
                       flush=True)
                 continue
-            try:
-                out = bench_one(impl, args.batch, args.heads, seq,
-                                args.dim, args.causal, args.iters,
-                                args.warmup)
-                print(json.dumps(out), flush=True)
-            except Exception as e:  # noqa: BLE001 — dense OOMs at 32k
-                print(json.dumps({"impl": impl, "seq": seq,
-                                  "error": repr(e)[:300]}), flush=True)
+            passes = (False, True) if args.grad else (False,)
+            for grad in passes:
+                try:
+                    out = bench_one(impl, args.batch, args.heads, seq,
+                                    args.dim, args.causal, args.iters,
+                                    args.warmup, grad=grad)
+                    print(json.dumps(out), flush=True)
+                except Exception as e:  # noqa: BLE001 — dense OOMs at 32k
+                    print(json.dumps({"impl": impl, "seq": seq,
+                                      "grad": grad,
+                                      "error": repr(e)[:300]}),
+                          flush=True)
     return 0
 
 

@@ -267,12 +267,17 @@ def attention_account(devices, seq, impl, batch=1, heads=12, dim=64,
 
 
 def remat_account(devices, policy, num_layers=8, d_model=512, seq=1024,
-                  batch=8):
+                  batch=8, per_layer=False):
+    """``policy`` exercises the trainer's whole-loss remat_policy knob;
+    ``per_layer=True`` instead exercises the models' per-layer
+    ``remat`` flag (layer-boundary jax.checkpoint — the bench LM
+    default), which is the memory lever that actually matters."""
     from edl_tpu.models import gpt as gpt_mod
     from edl_tpu.runtime.trainer import make_train_state, make_train_step
     _, params, loss_fn = gpt_mod.create_model_and_loss(
         num_layers=num_layers, d_model=d_model, num_heads=8,
-        mlp_dim=4 * d_model, vocab_size=512, max_len=seq)
+        mlp_dim=4 * d_model, vocab_size=512, max_len=seq,
+        remat=per_layer)
     tx = optax.sgd(0.1)
     state = make_train_state(params, tx)
     step = make_train_step(loss_fn, tx, remat_policy=policy)
@@ -280,7 +285,10 @@ def remat_account(devices, policy, num_layers=8, d_model=512, seq=1024,
     rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
     out = compile_stats(step, (spec_like(state), bspec, rng),
                         devices[:1], donate_argnums=(0,))
-    out.update({"account": "gpt_remat", "remat_policy": policy or "none",
+    out.update({"account": "gpt_remat"
+                + ("_per_layer" if per_layer else ""),
+                "remat_policy": policy or "none",
+                "per_layer": per_layer,
                 "num_layers": num_layers, "d_model": d_model,
                 "seq": seq, "batch": batch})
     return out
@@ -382,6 +390,8 @@ def run_accounts(names, platform):
     if "remat" in names:
         for pol in (None, "full", "dots"):
             go("remat", remat_account, devices, pol)
+        go("remat_per_layer", remat_account, devices, None,
+           per_layer=True)
     if "multistep" in names:
         for k in (1, 4):
             go("multistep", multistep_account, devices, k)

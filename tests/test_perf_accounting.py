@@ -85,6 +85,27 @@ def test_dense_attention_temp_is_quadratic_blockwise_linear():
     assert b4 / b2 < 2.7, (b2, b4)
 
 
+def test_flash_backward_memory_is_linear():
+    """The FA2-style _flash_bwd (r5) must stay O(seq) in live memory —
+    the previous backward (vjp of the blockwise forward) was O(seq^2)
+    and at 8k cost MORE temp than dense. 4x the sequence must cost
+    ~4x the temp (quadratic would be 16x)."""
+    import jax.numpy as jnp
+
+    from edl_tpu.ops import flash_attention as fa
+
+    def temp_at(seq):
+        s = jax.ShapeDtypeStruct((1, 12, seq, 64), jnp.bfloat16)
+
+        def bwd(q, k, v, out, g):
+            return fa._flash_bwd(q, k, v, out, g, True, 64 ** -0.5)
+        comp = jax.jit(bwd).lower(s, s, s, s, s).compile()
+        return comp.memory_analysis().temp_size_in_bytes
+
+    t2k, t8k = temp_at(2048), temp_at(8192)
+    assert t8k / t2k < 5.5, (t2k, t8k)
+
+
 def test_dense_attention_memory_crossover_at_long_seq():
     """By 8k tokens the s x s scores dominate everything else: the
     dense forward needs several times the blockwise live memory (the

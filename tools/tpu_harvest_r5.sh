@@ -55,10 +55,17 @@ GENEOF
     done
     echo "SWEEP_DONE $(date +%H:%M:%S)" >> "$OUT"
     cp "$OUT" /root/repo/BENCH_SWEEP_r5.txt
-    # kernel-level flash vs dense attention across sequence lengths
-    echo "=== bench_flash ===" >> "$OUT"
+    # kernel-level flash vs dense attention: fwd sweep first (incl.
+    # the 32k headline, where dense OOMs), then the TRAINING-path
+    # (fwd+bwd) sweep separately so its dense compiles/OOMs cannot
+    # eat the fwd sweep's timeout budget
+    echo "=== bench_flash fwd ===" >> "$OUT"
     timeout 600 python -m edl_tpu.tools.bench_flash \
-      --seqs 1024,2048,8192,32768 --iters 10 >> "$OUT" 2>&1
+      --seqs 1024,2048,8192,32768 --iters 10 --no-grad >> "$OUT" 2>&1
+    cp "$OUT" /root/repo/BENCH_SWEEP_r5.txt
+    echo "=== bench_flash fwd+bwd ===" >> "$OUT"
+    timeout 600 python -m edl_tpu.tools.bench_flash \
+      --seqs 1024,2048,8192 --iters 10 >> "$OUT" 2>&1
     cp "$OUT" /root/repo/BENCH_SWEEP_r5.txt
     # profile the winning config: where does the step time go post-bn4?
     echo "=== profile_bench bn4 ===" >> "$OUT"
