@@ -356,8 +356,62 @@ def bert_tp_account(devices, dp=2, tp=2, num_layers=4, d_model=512,
     return out
 
 
+def ring_sp_account(devices, sp=4, seq=8192, heads=12, dim=64, batch=1):
+    """Ring attention (sequence parallelism: shard_map + ppermute) on
+    the real TPU compiler, fwd+bwd — static proof the sp collectives
+    are TPU-valid at long context."""
+    from edl_tpu.parallel.ring_attention import ring_attention
+    from edl_tpu.runtime.mesh import make_mesh
+    mesh = make_mesh(dp=1, sp=sp, devices=devices[:sp])
+    seq_sh = NamedSharding(mesh, P("dp", "sp", None, None))
+    s = jax.ShapeDtypeStruct((batch, seq, heads, dim), jnp.bfloat16)
+
+    def loss(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True)
+                       .astype(jnp.float32))
+
+    def fn(q, k, v):
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    out = compile_stats(fn, (s, s, s), devices[:sp], mesh=mesh,
+                        in_shardings=(seq_sh,) * 3,
+                        out_shardings=(seq_sh,) * 3)
+    out.update({"account": "ring_attention_sp%d" % sp, "seq": seq,
+                "heads": heads, "dim": dim, "batch": batch,
+                "grad": True})
+    return out
+
+
+def pipeline_pp_account(devices, pp=4, num_layers=8, d_model=256,
+                        seq=512, batch=8, num_micro=4):
+    """The 1F1B pipeline schedule (shard_map stage handoffs) on the
+    real TPU compiler — static proof the pp schedule is TPU-valid."""
+    from edl_tpu.models import gpt as gpt_mod
+    from edl_tpu.parallel.pipeline import pipeline_value_and_grad
+    from edl_tpu.runtime.mesh import make_mesh
+    mesh = make_mesh(dp=1, pp=pp, devices=devices[:pp])
+    params, enc, stg, dec, _ = gpt_mod.create_gpt_pipeline(
+        pp=pp, num_layers=num_layers, d_model=d_model, num_heads=8,
+        mlp_dim=4 * d_model, vocab_size=512, max_len=seq, seq_len=seq)
+    x = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+    y = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
+
+    def fn(p, xb, yb):
+        return pipeline_value_and_grad(p, xb, yb, encode_fn=enc,
+                                       stage_fn=stg, decode_fn=dec,
+                                       mesh=mesh, num_micro=num_micro)
+
+    out = compile_stats(fn, (spec_like(params), x, y), devices[:pp],
+                        mesh=mesh)
+    out.update({"account": "gpt_1f1b_pp%d" % pp,
+                "num_layers": num_layers, "d_model": d_model,
+                "seq": seq, "batch": batch, "num_micro": num_micro})
+    return out
+
+
 ACCOUNTS = ("bn_structural", "resnet_bn", "attention", "remat",
-            "multistep", "sharded", "sharded_tp")
+            "multistep", "sharded", "sharded_tp", "sharded_sp",
+            "sharded_pp")
 
 
 def run_accounts(names, platform):
@@ -401,6 +455,10 @@ def run_accounts(names, platform):
     if "sharded_tp" in names and platform == "tpu":
         go("sharded_tp", bert_tp_account, devices)
         go("sharded_tp_zero1", bert_tp_account, devices, zero1=True)
+    if "sharded_sp" in names and platform == "tpu":
+        go("sharded_sp", ring_sp_account, devices)
+    if "sharded_pp" in names and platform == "tpu":
+        go("sharded_pp", pipeline_pp_account, devices)
     return results
 
 
