@@ -376,8 +376,8 @@ def ring_sp_account(devices, sp=4, seq=8192, heads=12, dim=64, batch=1):
     out = compile_stats(fn, (s, s, s), devices[:sp], mesh=mesh,
                         in_shardings=(seq_sh,) * 3,
                         out_shardings=(seq_sh,) * 3)
-    out.update({"account": "ring_attention_sp%d" % sp, "seq": seq,
-                "heads": heads, "dim": dim, "batch": batch,
+    out.update({"account": "ring_attention_sp%d" % sp, "sp": sp,
+                "seq": seq, "heads": heads, "dim": dim, "batch": batch,
                 "grad": True})
     return out
 
@@ -401,9 +401,23 @@ def pipeline_pp_account(devices, pp=4, num_layers=8, d_model=256,
                                        stage_fn=stg, decode_fn=dec,
                                        mesh=mesh, num_micro=num_micro)
 
+    # the REAL pp layout: stage params sharded over the pp axis
+    # (leading stacked-stage dim); ends + token batch replicated.
+    # Replicated-everything would make jit reshard before the schedule
+    # and the account would charge the pp layout for a full per-chip
+    # param copy it never holds.
+    repl = NamedSharding(mesh, P())
+    stages_sh = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P("pp")), params["stages"])
+    params_sh = {"encode": jax.tree_util.tree_map(lambda _: repl,
+                                                  params["encode"]),
+                 "stages": stages_sh,
+                 "decode": jax.tree_util.tree_map(lambda _: repl,
+                                                  params["decode"])}
     out = compile_stats(fn, (spec_like(params), x, y), devices[:pp],
-                        mesh=mesh)
-    out.update({"account": "gpt_1f1b_pp%d" % pp,
+                        mesh=mesh,
+                        in_shardings=(params_sh, repl, repl))
+    out.update({"account": "gpt_1f1b_pp%d" % pp, "pp": pp,
                 "num_layers": num_layers, "d_model": d_model,
                 "seq": seq, "batch": batch, "num_micro": num_micro})
     return out
