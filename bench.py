@@ -50,6 +50,71 @@ import time
 
 BASELINE_IMGS_PER_SEC_PER_CHIP = 1828.0 / 8.0
 
+# wall-clock anchor for the slow-step guard: the attempt subprocess
+# must finish inside the parent's kill-timeout, so the loop budget is
+# charged against time-since-process-start, not a fresh stopwatch
+_PROC_START = time.perf_counter()
+
+
+def _guarded_timed_loop(dispatch, block, iters):
+    """The timed measurement loop, with a slow-step pathology guard
+    (r5): through the tunneled backend the first real GPT-2s run's
+    steady-state step rate was ~100x its compute bound; 30 queued
+    dispatches blew the attempt budget, the kill landed mid-queue, and
+    the wedged tunnel took every later attempt down with it. A slow
+    step must become a MEASUREMENT, not a hang: time one blocked
+    dispatch, size the queued timed loop to what fits the remaining
+    attempt budget, then run it (queued-dispatch methodology preserved
+    inside the loop — both benches share this function so the
+    methodology cannot drift between them).
+
+    ``dispatch`` issues one step and returns the value to block on
+    (mutating the caller's train state via closure); ``block`` is
+    jax.block_until_ready. Returns (iters, dt, slowstep): the iters
+    actually measured, the loop wall time, and whether the sample is a
+    pathology report. The tag is decided from the MEASURED rate, not
+    the probe — a blocked probe pays a full tunnel round trip that the
+    queued loop amortizes away, so a truncated-but-healthy loop is
+    just fewer samples, while a probe-only measurement or a loop whose
+    measured rate would still blow the budget at the requested length
+    is genuinely slow.
+    """
+    t0 = time.perf_counter()
+    block(dispatch())
+    probe_s = time.perf_counter() - t0
+    # budget what's actually left of the attempt timeout (compile +
+    # warmup already spent some), not a fixed constant that could
+    # itself overshoot the parent's kill
+    remaining_s = ATTEMPT_TIMEOUT_S * 0.80 - (time.perf_counter()
+                                              - _PROC_START)
+    loop_budget_s = min(
+        float(os.environ.get("BENCH_LOOP_BUDGET", "150")),
+        max(remaining_s, 0.0))
+    requested_iters = iters
+    truncated = probe_s * iters > loop_budget_s
+    if truncated:
+        slow_iters = int(loop_budget_s / probe_s)
+        log("probe dispatch took %.2fs — %d iters would blow the %.0fs "
+            "loop budget; %s"
+            % (probe_s, iters, loop_budget_s,
+               "reporting the probe step as the measurement"
+               if slow_iters < 2
+               else "measuring %d iters instead" % slow_iters))
+        if slow_iters < 2:
+            # the blocked probe IS the measurement; never queue
+            # dispatches the parent's kill could land in the middle of
+            return 1, probe_s, True
+        iters = slow_iters
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = dispatch()
+    block(out)
+    dt = time.perf_counter() - t0
+    slowstep = truncated and \
+        (dt / iters) * requested_iters > loop_budget_s
+    return iters, dt, slowstep
+
 # Per-attempt kill timeouts (seconds). Round 2's judged bench run timed
 # out (rc=124) because the axon backend took ~25 minutes to FAIL to
 # initialize and the in-process retry then hung past the driver's
@@ -189,11 +254,13 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
         log("warmup done in %.1fs (loss=%.3f)" % (time.perf_counter() - t0,
                                                   float(loss)))
 
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            state, loss = jit_step(state, next_batch(), rng)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+        def dispatch():
+            nonlocal state
+            state, loss_ = jit_step(state, next_batch(), rng)
+            return loss_
+
+        iters, dt, guard_fired = _guarded_timed_loop(
+            dispatch, jax.block_until_ready, iters)
         ms_per_step = 1000 * dt / (iters * steps_per_call)
     finally:
         # a failed run must not leave the prefetch thread holding
@@ -228,6 +295,10 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
         metric += "_scan%d" % steps_per_call
     if bn_stats_every > 1:
         metric += "_bn%d" % bn_stats_every
+    if guard_fired:
+        # a guard-truncated run is a pathology report, not a healthy
+        # throughput sample (_r1cfg/_cpufallback/_suspect convention)
+        metric += "_slowstep"
     return {
         "metric": metric,
         "value": round(per_chip, 1),
@@ -316,11 +387,13 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
     jax.block_until_ready(loss)
     log("warmup done in %.1fs (loss=%.3f)" % (time.perf_counter() - t0,
                                               float(loss)))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = jit_step(state, batch_dev, rng)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    def dispatch():
+        nonlocal state
+        state, loss_ = jit_step(state, batch_dev, rng)
+        return loss_
+
+    iters, dt, guard_fired = _guarded_timed_loop(
+        dispatch, jax.block_until_ready, iters)
     per_chip = batch * seq_len * iters / dt / n_chips
     log("throughput: %.0f tok/s per chip (%.1f ms/step)"
         % (per_chip, 1000 * dt / iters))
@@ -339,6 +412,11 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
         metric += "_seq%d" % seq_len
     if flash:
         metric += "_flash"
+    if guard_fired:
+        # a guard-truncated run is a pathology report, not a healthy
+        # throughput sample — mark it like every other substituted
+        # config (_r1cfg/_cpufallback/_suspect convention)
+        metric += "_slowstep"
     if implied_tflops > 197.0 * 1.25:
         log("WARNING: implied TFLOP/s exceeds the v5e physical peak — "
             "marking metric _suspect")
@@ -404,6 +482,11 @@ def _attempt(argv, timeout_s, env=None, tag=""):
     cmd = [sys.executable, os.path.abspath(__file__), "--_oneshot"] + argv
     log("bench attempt%s: %s (timeout %ds)"
         % (tag and " [%s]" % tag, " ".join(argv) or "<default>", timeout_s))
+    # the child's slow-step guard budgets against the attempt timeout;
+    # tell it the ACTUAL kill deadline (budget-clipped attempts and the
+    # 240s CPU fallback run well under the 420s default)
+    env = dict(os.environ if env is None else env,
+               BENCH_ATTEMPT_TIMEOUT=str(int(timeout_s)))
     try:
         proc = subprocess.run(cmd, env=env, timeout=timeout_s,
                               stdout=subprocess.PIPE, stderr=sys.stderr)
