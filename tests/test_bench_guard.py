@@ -1,0 +1,110 @@
+"""Regression pins for bench._guarded_timed_loop (the r5 slow-step
+guard): the first real-TPU LM bench run found a ~100x-slow steady
+state, queued 30 dispatches anyway, and the attempt kill wedged the
+tunnel for the rest of the sweep. These tests lock the guard's three
+behaviors — healthy untouched, truncated-but-amortized untagged,
+pathological tagged / probe-only — against a FAKE clock (dispatches
+advance virtual time), so they are exact and immune to host load.
+No jax needed: bench.py's top level is import-clean and the guard only
+touches time/env.
+"""
+
+import pytest
+
+import bench
+
+
+class FakeClock:
+    """Stands in for bench's ``time`` module inside the guard."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def perf_counter(self):
+        return self.t
+
+
+@pytest.fixture()
+def clock(monkeypatch):
+    fake = FakeClock()
+    monkeypatch.setattr(bench, "time", fake)
+    monkeypatch.setattr(bench, "_PROC_START", 0.0)
+    # plenty of attempt budget remaining by default; the loop budget is
+    # then the env knob alone
+    monkeypatch.setattr(bench, "ATTEMPT_TIMEOUT_S", 10_000)
+    return fake
+
+
+def _dispatcher(clock, delays):
+    """Returns (dispatch, calls): call i advances the fake clock by
+    delays[min(i, last)]."""
+    calls = []
+
+    def dispatch():
+        i = len(calls)
+        calls.append(i)
+        clock.t += delays[min(i, len(delays) - 1)]
+        return i
+
+    return dispatch, calls
+
+
+def test_healthy_run_untouched(monkeypatch, clock):
+    monkeypatch.setenv("BENCH_LOOP_BUDGET", "60")
+    dispatch, calls = _dispatcher(clock, [0.05])
+    iters, dt, slowstep = bench._guarded_timed_loop(
+        dispatch, lambda x: x, 10)
+    assert iters == 10
+    assert not slowstep
+    assert dt == pytest.approx(0.5)
+    assert len(calls) == 11  # probe + 10 timed
+
+
+def test_truncated_but_amortized_is_not_tagged(monkeypatch, clock):
+    # the probe pays a one-off cost (tunnel RTT analogue) but steady
+    # state is fast: the loop shrinks, the sample stays untagged
+    monkeypatch.setenv("BENCH_LOOP_BUDGET", "1.0")
+    dispatch, calls = _dispatcher(clock, [0.4, 0.005])
+    iters, dt, slowstep = bench._guarded_timed_loop(
+        dispatch, lambda x: x, 50)
+    assert iters == 2  # int(1.0 / 0.4)
+    assert not slowstep  # measured rate would NOT blow the budget
+    assert len(calls) == 1 + iters
+
+
+def test_pathological_rate_is_tagged(monkeypatch, clock):
+    monkeypatch.setenv("BENCH_LOOP_BUDGET", "0.5")
+    dispatch, calls = _dispatcher(clock, [0.2])
+    iters, dt, slowstep = bench._guarded_timed_loop(
+        dispatch, lambda x: x, 10)
+    assert iters == 2
+    assert slowstep  # 0.2s/step * 10 requested >> 0.5s budget
+    assert dt == pytest.approx(0.4)
+    assert len(calls) == 1 + iters
+
+
+def test_probe_becomes_the_measurement(monkeypatch, clock):
+    # a single dispatch consumes the whole budget: report it, and
+    # NEVER queue dispatches a parent kill could land in the middle of
+    monkeypatch.setenv("BENCH_LOOP_BUDGET", "0.5")
+    dispatch, calls = _dispatcher(clock, [0.6])
+    iters, dt, slowstep = bench._guarded_timed_loop(
+        dispatch, lambda x: x, 10)
+    assert (iters, slowstep) == (1, True)
+    assert dt == pytest.approx(0.6)
+    assert len(calls) == 1  # the probe and nothing else
+
+
+def test_remaining_attempt_budget_clips_the_loop(monkeypatch, clock):
+    # compile/warmup already burned most of the attempt: the guard must
+    # budget against what is LEFT, not the env constant
+    monkeypatch.setenv("BENCH_LOOP_BUDGET", "60")
+    monkeypatch.setattr(bench, "ATTEMPT_TIMEOUT_S", 10)
+    clock.t = 7.6  # pretend compile+warmup spent 7.6s of the attempt
+    # after the 0.3s probe: remaining = 10*0.8 - 7.9 = 0.1s < probe
+    dispatch, calls = _dispatcher(clock, [0.3])
+    iters, dt, slowstep = bench._guarded_timed_loop(
+        dispatch, lambda x: x, 10)
+    assert (iters, slowstep) == (1, True)
+    assert dt == pytest.approx(0.3)
+    assert len(calls) == 1
