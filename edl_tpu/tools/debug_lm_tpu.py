@@ -35,10 +35,25 @@ import traceback
 
 
 def _build(variant):
+    import os
+
     import jax
     import jax.numpy as jnp
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # Same persistent compile cache as bench.py: a cold GPT-2s compile
+    # is ~30-60 s of the variant's kill budget; later variants (and
+    # bench attempts in the same window) then start in seconds.
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("EDL_TPU_COMPILE_CACHE",
+                           "/tmp/edl_tpu_xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:
+        pass
 
     from edl_tpu.models import gpt as family
     from edl_tpu.runtime.mesh import DATA_AXIS, make_mesh
@@ -74,6 +89,27 @@ def _build(variant):
     return jit_step, state, batch_dev, rng, state_mb
 
 
+def _probe_ok(timeout_s=90):
+    """Cheap matmul probe in a subprocess. A wedged tunnel hangs at
+    device init; probing BEFORE each variant stops the tool instead of
+    letting per-variant kill-timeouts fire into a dead device — a kill
+    that lands mid-dispatch is itself what wedges the tunnel (observed
+    twice in round 5), so killing against a wedge both produces false
+    "pathology" verdicts for every remaining variant and prolongs the
+    outage."""
+    code = ("import jax, jax.numpy as jnp;"
+            "assert jax.devices()[0].platform in ('tpu', 'axon'), "
+            "jax.devices()[0].platform;"
+            "x = jnp.ones((512, 512), jnp.bfloat16);"
+            "(x @ x).block_until_ready();print('OK')")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, timeout=timeout_s)
+        return out.returncode == 0 and b"OK" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def run_variant(variant, steps, deadline):
     import jax
 
@@ -98,8 +134,11 @@ def run_variant(variant, steps, deadline):
 
 def main():
     ap = argparse.ArgumentParser()
+    # cheap/robust first: a wedge mid-tool then costs the least signal
+    # (and bench --model gpt already measures the adamw+donate config
+    # end to end — 59,158 tok/s/chip when the tunnel is healthy)
     ap.add_argument("--variants", default=(
-        "adamw+donate,sgd+donate,adamw+nodonate,adamw+b1,noremat,tiny"))
+        "tiny,adamw+b1,noremat,adamw+nodonate,sgd+donate,adamw+donate"))
     ap.add_argument("--steps", type=int, default=4)
     ap.add_argument("--budget_s", type=float, default=900.0,
                     help="global wall budget across all variants")
@@ -123,6 +162,18 @@ def main():
             print("[%s] skipped: global budget exhausted" % variant,
                   flush=True)
             continue
+        if not _probe_ok():
+            print("[%s] TUNNEL WEDGED (pre-variant probe hung) — "
+                  "stopping; remaining variants would only produce "
+                  "false kill verdicts" % variant, flush=True)
+            return
+        # re-clock after the probe so the child's budget cannot
+        # overrun --budget_s by the probe's wall time
+        remaining = deadline - time.monotonic()
+        if remaining <= 30:
+            print("[%s] skipped: global budget exhausted" % variant,
+                  flush=True)
+            continue
         timeout_s = min(args.variant_timeout_s, remaining)
         try:
             subprocess.run(
@@ -131,9 +182,10 @@ def main():
                  "--budget_s", str(timeout_s * 0.9)],
                 timeout=timeout_s)
         except subprocess.TimeoutExpired:
-            print("[%s] KILLED after %.0fs (hung dispatch — this "
-                  "variant exhibits the pathology)" % (variant, timeout_s),
-                  flush=True)
+            print("[%s] KILLED after %.0fs (hung dispatch or starved "
+                  "compile — NOTE the kill itself can wedge the "
+                  "tunnel; the next probe decides)"
+                  % (variant, timeout_s), flush=True)
 
 
 if __name__ == "__main__":

@@ -162,3 +162,29 @@ def test_tpu_compiler_accounts_bn_tradeoff():
     assert bn4["flops"] < bn1["flops"] * 1.02, (bn1, bn4)
     ratio = bn4["bytes_accessed"] / bn1["bytes_accessed"]
     assert 0.3 < ratio < 2.2, (bn1, bn4)
+
+
+# -- bandwidth roofline (pure arithmetic, r5 measured profile) ------------
+
+
+def test_roofline_account_is_internally_consistent():
+    """Pin the roofline artifact (tools/roofline_resnet.py): the
+    activation-pass accounting that closes the MFU question (r5:
+    measured 52.4 ms step is within ~10% of the v5e bandwidth+MXU
+    roofline) must stay arithmetically coherent — 55 BN input maps on
+    resnet50_vd, a ~3 GB streaming pass at batch 128, a non-conv
+    tail measured in single-digit pass counts, and a roofline the
+    measured wall time can never legally undercut. Asserts the tool's
+    OWN account() (one derivation, no formula drift between the
+    artifact and this pin)."""
+    from edl_tpu.tools import roofline_resnet as rl
+
+    a = rl.account()
+    assert a["n_bn"] == 55
+    assert 2.5 < a["one_pass_gb"] < 3.5, a["one_pass_gb"]
+    assert 5.0 < a["nonconv_passes"] < 12.0, a["nonconv_passes"]
+    assert a["conv_floor_ms"] < a["conv_ms"]
+    assert 50.0 < a["mxu_during_conv_pct"] < 100.0
+    assert rl.MEASURED_WALL_MS >= a["roofline_ms"], (
+        "wall time undercuts the roofline — re-derive the account")
+    assert 0.0 <= a["headroom_pct"] < 25.0, a["headroom_pct"]
