@@ -682,6 +682,19 @@ def main():
         unit = "tok/s/chip" if args.model == "gpt" else "img/s/chip"
         result = {"metric": "%s_bench_failed_all_attempts" % name,
                   "value": 0.0, "unit": unit, "vs_baseline": 0.0}
+    if ("_cpufallback" in result["metric"]
+            or result["value"] == 0.0):
+        # a dead-tunnel artifact should still point the reader at the
+        # last REAL measurement of this surface (committed sweep logs)
+        try:
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)),
+                    "BENCH_BEST_TPU.json")) as f:
+                best = json.load(f).get(args.model)
+            if best:
+                result["last_tpu_measured"] = best
+        except Exception as e:
+            log("last-tpu pointer unavailable: %r" % e)
     print(json.dumps(result), flush=True)
 
 

@@ -102,11 +102,23 @@ def _probe_ok(timeout_s=90):
             "jax.devices()[0].platform;"
             "x = jnp.ones((512, 512), jnp.bfloat16);"
             "(x @ x).block_until_ready();print('OK')")
+    # Teardown order matters: SIGTERM first so the JAX client can
+    # attempt an orderly disconnect — an outright SIGKILL mid-dispatch
+    # is itself a wedge trigger (NOTES r5). Only escalate if the child
+    # ignores the TERM for 10 s.
+    proc = subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL)
     try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, timeout=timeout_s)
-        return out.returncode == 0 and b"OK" in out.stdout
+        out, _ = proc.communicate(timeout=timeout_s)
+        return proc.returncode == 0 and b"OK" in out
     except subprocess.TimeoutExpired:
+        proc.terminate()
+        try:
+            proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
         return False
 
 

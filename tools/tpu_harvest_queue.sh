@@ -1,0 +1,89 @@
+#!/bin/bash
+# Generic stage-resumable TPU harvester (consolidates the r5b/r5c/r5d
+# copies; r5b was mid-queue when this landed and still runs its own
+# copy — new queues use this).
+#
+#   tools/tpu_harvest_queue.sh NAME STAGES_FILE [AFTER]
+#
+# NAME        queue id; state in /tmp/tpu_harvest_NAME.{txt,idx},
+#             published to /root/repo/BENCH_SWEEP_NAME.txt after every
+#             stage (resumable: the idx file survives restarts).
+# STAGES_FILE text file, one shell command per line (# comments ok).
+# AFTER       optional comma list of queue names to wait for: this
+#             queue sleeps while any "tools/tpu_harvest_<name>" (or a
+#             same-named queue instance) process is alive, so queues
+#             never contend for the one chip.
+#
+# Each stage is preceded by a cheap matmul probe; a failed probe just
+# waits for the next healthy window. Probes and stages use
+# `timeout -k 10` so a hung child gets SIGTERM + 10 s of grace before
+# SIGKILL — an outright kill mid-dispatch is itself a wedge trigger
+# (NOTES r5).
+set -u
+NAME="$1"
+STAGES_FILE="$2"
+AFTER="${3:-}"
+cd /root/repo
+OUT="/tmp/tpu_harvest_${NAME}.txt"
+IDX_FILE="/tmp/tpu_harvest_${NAME}.idx"
+[ -f "$IDX_FILE" ] || echo 0 > "$IDX_FILE"
+
+mapfile -t STAGES < <(grep -v '^\s*#' "$STAGES_FILE" | grep -v '^\s*$')
+
+others_running() {
+  local n
+  IFS=',' read -ra names <<< "$AFTER"
+  for n in "${names[@]}"; do
+    [ -z "$n" ] && continue
+    if pgrep -f "tools/tpu_harvest_${n}.sh" > /dev/null 2>&1; then
+      return 0
+    fi
+    if pgrep -f "tpu_harvest_queue.sh ${n} " > /dev/null 2>&1; then
+      return 0
+    fi
+  done
+  return 1
+}
+
+probe() {
+  local pf="/tmp/tpu_probe_${NAME}.txt"
+  timeout -k 10 90 python - > "$pf" 2>&1 <<'PYEOF'
+import jax, time
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+t0 = time.time()
+(x @ x).block_until_ready()
+assert d[0].platform in ("tpu", "axon"), d[0].platform
+print("PROBE_OK platform=%s matmul=%.2fs" % (d[0].platform, time.time()-t0))
+PYEOF
+  local rc=$?
+  cat "$pf" >> "$OUT"
+  [ $rc -eq 0 ] && grep -q PROBE_OK "$pf"
+}
+
+for i in $(seq 1 2000); do
+  if [ -n "$AFTER" ] && others_running; then
+    sleep 180
+    continue
+  fi
+  IDX=$(cat "$IDX_FILE")
+  if [ "$IDX" -ge "${#STAGES[@]}" ]; then
+    echo "ALL_DONE $(date +%H:%M:%S)" >> "$OUT"
+    cp "$OUT" "/root/repo/BENCH_SWEEP_${NAME}.txt"
+    exit 0
+  fi
+  echo "[probe $i $(date +%H:%M:%S) next-stage=$IDX]" >> "$OUT"
+  if probe; then
+    STAGE="${STAGES[$IDX]}"
+    echo "=== stage $IDX: $STAGE [$(date +%H:%M:%S)] ===" >> "$OUT"
+    eval "$STAGE" >> "$OUT" 2>&1
+    echo "=== stage $IDX rc=$? [$(date +%H:%M:%S)] ===" >> "$OUT"
+    echo $((IDX + 1)) > "$IDX_FILE"
+    cp "$OUT" "/root/repo/BENCH_SWEEP_${NAME}.txt"
+  else
+    sleep 240
+  fi
+done
+echo "GAVE_UP $(date +%H:%M:%S)" >> "$OUT"
+cp "$OUT" "/root/repo/BENCH_SWEEP_${NAME}.txt"
