@@ -188,3 +188,23 @@ def test_roofline_account_is_internally_consistent():
     assert rl.MEASURED_WALL_MS >= a["roofline_ms"], (
         "wall time undercuts the roofline — re-derive the account")
     assert 0.0 <= a["headroom_pct"] < 25.0, a["headroom_pct"]
+
+
+def test_bench_best_tpu_pointer_file_is_valid():
+    """BENCH_BEST_TPU.json feeds bench.py's dead-tunnel fallback JSON
+    (last_tpu_measured) — keep it parseable, keyed by bench model
+    names, and shaped like a bench record so the embedded pointer is
+    directly comparable with the live metric line."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "BENCH_BEST_TPU.json")
+    with open(path) as f:
+        best = json.load(f)
+    assert best, "pointer file is empty — the fallback embed is dead"
+    assert set(best) <= {"resnet", "gpt", "bert"}, set(best)
+    for model, rec in best.items():
+        for key in ("metric", "value", "unit", "measured", "source"):
+            assert key in rec, (model, key)
+        assert rec["value"] > 0
