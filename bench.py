@@ -295,6 +295,15 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
         metric += "_scan%d" % steps_per_call
     if bn_stats_every > 1:
         metric += "_bn%d" % bn_stats_every
+    if batch_per_chip != MODEL_DEFAULT_BATCH["resnet"] \
+            and not (image_size != 224 and batch_per_chip == 8):
+        # sweep hygiene: the r5b sweep recorded batch 128 and 256 under
+        # ONE metric name — a non-default batch must be visible. The
+        # one exemption is the historic CPU-fallback shape (batch 8 at
+        # a small image size), whose `_smallcfg_cpufallback` name
+        # (_oneshot appends _smallcfg) must stay byte-identical with
+        # earlier rounds' artifacts.
+        metric += "_b%d" % batch_per_chip
     if guard_fired:
         # a guard-truncated run is a pathology report, not a healthy
         # throughput sample (_r1cfg/_cpufallback/_suspect convention)
@@ -410,6 +419,11 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
         # a clamped or swept length must be visible in the metric name,
         # or a seq-sweep log records duplicates as distinct results
         metric += "_seq%d" % seq_len
+    if batch_per_chip != (2 if tiny else MODEL_DEFAULT_BATCH[kind]):
+        # same sweep hygiene for batch scaling (r5e LM batch sweep);
+        # tiny's exempt batch is 2 — the historic CPU-fallback config,
+        # whose metric name must stay continuous across rounds
+        metric += "_b%d" % batch_per_chip
     if flash:
         metric += "_flash"
     if guard_fired:
