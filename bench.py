@@ -296,13 +296,14 @@ def run(batch_per_chip=128, image_size=224, warmup=3, iters=20,
     if bn_stats_every > 1:
         metric += "_bn%d" % bn_stats_every
     if batch_per_chip != MODEL_DEFAULT_BATCH["resnet"] \
-            and not (image_size != 224 and batch_per_chip == 8):
+            and not (image_size == 64 and batch_per_chip == 8):
         # sweep hygiene: the r5b sweep recorded batch 128 and 256 under
         # ONE metric name — a non-default batch must be visible. The
-        # one exemption is the historic CPU-fallback shape (batch 8 at
-        # a small image size), whose `_smallcfg_cpufallback` name
-        # (_oneshot appends _smallcfg) must stay byte-identical with
-        # earlier rounds' artifacts.
+        # one exemption is the EXACT historic CPU-fallback shape
+        # (batch 8 @ 64px, the argv hardcoded in main()'s fallback),
+        # whose `_smallcfg_cpufallback` name (_oneshot appends
+        # _smallcfg) must stay byte-identical with earlier rounds'
+        # artifacts.
         metric += "_b%d" % batch_per_chip
     if guard_fired:
         # a guard-truncated run is a pathology report, not a healthy

@@ -294,6 +294,43 @@ def remat_account(devices, policy, num_layers=8, d_model=512, seq=1024,
     return out
 
 
+def lm_batch_account(devices, batch, num_layers=12, d_model=768,
+                     seq=1024, vocab=32000):
+    """Static basis for the LM batch-scaling sweep (stages_r5e.txt).
+    Compiles the bench's exact train-step shape (remat'd GPT-2s,
+    adamw, donated state) at a given batch on the real TPU compiler
+    and records flops, bytes and their ratio.
+
+    MEASURED CONCLUSION (r5, PERF_ACCOUNTING.json): the pre-run
+    hypothesis — "optimizer state is constant in batch, so batch
+    scaling multiplies arithmetic intensity" — is WRONG at seq 1024.
+    Activation/remat traffic dominates (adamw m/v is 1.3 GB of the
+    94.7 GB/step at batch 8) and scales with batch: 4x batch = 4.0x
+    flops but 3.62x bytes, so flops/byte rises only ~10% (80.5 ->
+    88.8). Both batches sit near the HBM bandwidth floor; the r5e
+    sweep's expected win is the floor ratio (~+27-32%), not 4x."""
+    from edl_tpu.models import gpt as gpt_mod
+    from edl_tpu.runtime.trainer import make_train_state, make_train_step
+    _, params, loss_fn = gpt_mod.create_model_and_loss(
+        num_layers=num_layers, d_model=d_model,
+        num_heads=max(1, d_model // 64), mlp_dim=4 * d_model,
+        vocab_size=vocab, max_len=seq, remat=True)
+    tx = optax.adamw(1e-4)
+    state = make_train_state(params, tx)
+    step = make_train_step(loss_fn, tx)
+    bspec = {"input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32)}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    out = compile_stats(step, (spec_like(state), bspec, rng),
+                        devices[:1], donate_argnums=(0,))
+    if out.get("flops") and out.get("bytes_accessed"):
+        out["flops_per_byte"] = round(out["flops"]
+                                      / out["bytes_accessed"], 2)
+    out.update({"account": "lm_batch", "batch": batch,
+                "num_layers": num_layers, "d_model": d_model,
+                "seq": seq})
+    return out
+
+
 # -- account 4: fused multi-step (lax.scan over K train steps) ------------
 
 
@@ -425,7 +462,7 @@ def pipeline_pp_account(devices, pp=4, num_layers=8, d_model=256,
 
 ACCOUNTS = ("bn_structural", "resnet_bn", "attention", "remat",
             "multistep", "sharded", "sharded_tp", "sharded_sp",
-            "sharded_pp")
+            "sharded_pp", "lm_batch")
 
 
 def run_accounts(names, platform):
@@ -473,6 +510,9 @@ def run_accounts(names, platform):
         go("sharded_sp", ring_sp_account, devices)
     if "sharded_pp" in names and platform == "tpu":
         go("sharded_pp", pipeline_pp_account, devices)
+    if "lm_batch" in names and platform == "tpu":
+        for b in (8, 32):
+            go("lm_batch", lm_batch_account, devices, b)
     return results
 
 

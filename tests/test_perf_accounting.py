@@ -208,3 +208,21 @@ def test_bench_best_tpu_pointer_file_is_valid():
         for key in ("metric", "value", "unit", "measured", "source"):
             assert key in rec, (model, key)
         assert rec["value"] > 0
+
+
+@pytest.mark.integration
+def test_lm_batch_arithmetic_intensity_rises_with_batch():
+    """The static basis of the r5e LM batch sweep: growing the batch
+    multiplies activation flops while the adamw state traffic stays
+    constant, so flops-per-byte must rise — the compiler-accounted
+    reason batch 8 (the measured 59k tok/s config) sits at low MFU.
+    Pinned at a small GPT config to keep the AOT compile cheap; the
+    full-size account lives in PERF_ACCOUNTING.json."""
+    devices = _tpu_topology_or_skip()
+    b2 = pa.lm_batch_account(devices, 2, num_layers=4, d_model=256,
+                             seq=256, vocab=1024)
+    b8 = pa.lm_batch_account(devices, 8, num_layers=4, d_model=256,
+                             seq=256, vocab=1024)
+    assert 3.0 < b8["flops"] / b2["flops"] < 5.0, (b2, b8)
+    assert b8["bytes_accessed"] < b2["bytes_accessed"] * 3.5, (b2, b8)
+    assert b8["flops_per_byte"] > b2["flops_per_byte"] * 1.15, (b2, b8)
