@@ -324,7 +324,8 @@ MODEL_DEFAULT_BATCH = {"gpt": 8, "bert": 32, "resnet": 128}
 MODEL_DEFAULT_SEQ = {"gpt": 1024, "bert": 512}
 
 
-def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
+def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash,
+            remat=True):
     """Shared LM/encoder train-throughput loop (tokens/s/chip) for
     --model gpt and --model bert: same mesh/sharding/timing/physics
     gate, parameterized by the model family and its batch contents.
@@ -352,7 +353,8 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
     if kind == "gpt":
         from edl_tpu.models import gpt as family
         model = (family.gpt_tiny(dtype=jnp.bfloat16, use_flash=flash)
-                 if tiny else family.Gpt(dtype=jnp.bfloat16, remat=True,
+                 if tiny else family.Gpt(dtype=jnp.bfloat16,
+                                         remat=remat,
                                          use_flash=flash))
         prefix = "gpt_tiny" if tiny else "gpt2s"
     else:
@@ -360,7 +362,7 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
         model = (family.bert_tiny(dtype=jnp.bfloat16, use_flash=flash)
                  if tiny else family.bert_base(dtype=jnp.bfloat16,
                                                use_flash=flash,
-                                               remat=True))
+                                               remat=remat))
         prefix = "bert_tiny" if tiny else "bert_base"
     requested_seq = seq_len
     seq_len = min(seq_len, model.max_len)
@@ -425,6 +427,8 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
         # tiny's exempt batch is 2 — the historic CPU-fallback config,
         # whose metric name must stay continuous across rounds
         metric += "_b%d" % batch_per_chip
+    if not remat and not tiny:
+        metric += "_noremat"
     if flash:
         metric += "_flash"
     if guard_fired:
@@ -441,19 +445,19 @@ def _run_lm(kind, batch_per_chip, seq_len, warmup, iters, tiny, flash):
 
 
 def run_gpt(batch_per_chip=8, seq_len=1024, warmup=3, iters=20,
-            tiny=False, flash=False):
+            tiny=False, flash=False, remat=True):
     """GPT causal-LM training throughput, GPT-2-small shape by default
     (12L/768d/12h, vocab 32k) — see _run_lm."""
     return _run_lm("gpt", batch_per_chip, seq_len, warmup, iters, tiny,
-                   flash)
+                   flash, remat=remat)
 
 
 def run_bert(batch_per_chip=32, seq_len=512, warmup=3, iters=20,
-             tiny=False, flash=False):
+             tiny=False, flash=False, remat=True):
     """BERT-base encoder training throughput (classification head,
     seq 512) — the flash-attention A/B vehicle; see _run_lm."""
     return _run_lm("bert", batch_per_chip, seq_len, warmup, iters, tiny,
-                   flash)
+                   flash, remat=remat)
 
 
 def _oneshot(args):
@@ -462,13 +466,15 @@ def _oneshot(args):
     if args.model == "gpt":
         result = run_gpt(batch_per_chip=args.batch_per_chip,
                          seq_len=args.seq_len, iters=args.iters,
-                         tiny=args.gpt_tiny, flash=args.flash)
+                         tiny=args.gpt_tiny, flash=args.flash,
+                         remat=args.remat)
         print(json.dumps(result), flush=True)
         return
     if args.model == "bert":
         result = run_bert(batch_per_chip=args.batch_per_chip,
                           seq_len=args.seq_len, iters=args.iters,
-                          tiny=args.gpt_tiny, flash=args.flash)
+                          tiny=args.gpt_tiny, flash=args.flash,
+                          remat=args.remat)
         print(json.dumps(result), flush=True)
         return
     kwargs = dict(batch_per_chip=args.batch_per_chip, iters=args.iters,
@@ -542,6 +548,12 @@ def _build_parser():
     ap.add_argument("--flash", action="store_true",
                     help="gpt/bert: Pallas flash attention (TPU only; "
                          "ignored off-TPU)")
+    ap.add_argument("--remat", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="gpt/bert non-tiny: per-layer activation "
+                    "recompute. The static account says --no-remat "
+                    "cuts both flops and HBM traffic when the batch "
+                    "fits (PERF_ACCOUNTING lm_batch) — A/B it")
     ap.add_argument("--gpt_tiny", action="store_true",
                     help=argparse.SUPPRESS)  # CPU-fallback size
     ap.add_argument("--s2d", dest="s2d", action="store_true")
@@ -622,6 +634,8 @@ def main():
         requested += ["--gpt_tiny"]
     if args.model in ("gpt", "bert") and args.flash:
         requested += ["--flash"]
+    if args.model in ("gpt", "bert") and not args.remat:
+        requested += ["--no-remat"]
     if not args.s2d:
         requested += ["--no-s2d"]
     if args.feed != "device":
