@@ -296,7 +296,7 @@ def remat_account(devices, policy, num_layers=8, d_model=512, seq=1024,
 
 def lm_batch_account(devices, batch, num_layers=12, d_model=768,
                      seq=1024, vocab=32000, remat=True,
-                     use_flash=False):
+                     use_flash=False, kind="gpt"):
     """Static basis for the LM batch-scaling sweep (stages_r5e.txt).
     Compiles the bench's exact train-step shape (GPT-2s, adamw,
     donated state; ``remat`` parameterized — True is the bench
@@ -311,24 +311,54 @@ def lm_batch_account(devices, batch, num_layers=12, d_model=768,
     flops but 3.62x bytes, so flops/byte rises only ~10% (80.5 ->
     88.8). Both batches sit near the HBM bandwidth floor; the r5e
     sweep's expected win is the floor ratio (~+27-32%), not 4x."""
-    from edl_tpu.models import gpt as gpt_mod
     from edl_tpu.runtime.trainer import make_train_state, make_train_step
-    _, params, loss_fn = gpt_mod.create_model_and_loss(
-        num_layers=num_layers, d_model=d_model,
-        num_heads=max(1, d_model // 64), mlp_dim=4 * d_model,
-        vocab_size=vocab, max_len=seq, remat=remat,
-        use_flash=use_flash)
+    bspec = {"input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32)}
+    if kind == "gpt":
+        from edl_tpu.models import gpt as family
+        _, params, loss_fn = family.create_model_and_loss(
+            num_layers=num_layers, d_model=d_model,
+            num_heads=max(1, d_model // 64), mlp_dim=4 * d_model,
+            vocab_size=vocab, max_len=seq, remat=remat,
+            use_flash=use_flash)
+    elif kind == "bert":
+        # mirror the bench's bert config: bert_base defaults + the
+        # bench's dtype/remat/flash knobs, classification batch. The
+        # size params are gpt-branch-only — recording caller-passed
+        # sizes against bert_base's hardwired shape would stamp
+        # metadata that doesn't match the compiled model.
+        from edl_tpu.models import bert as family
+        model = family.bert_base(dtype=jnp.bfloat16, remat=remat,
+                                 use_flash=use_flash)
+        passed = (num_layers, d_model, vocab)
+        actual = (model.num_layers, model.d_model, model.vocab_size)
+        if passed not in ((12, 768, 32000), actual):
+            # (12, 768, 32000) = the untouched gpt-branch defaults
+            raise ValueError(
+                "kind='bert' uses bert_base's own shape %r; "
+                "num_layers/d_model/vocab are not configurable here"
+                % (actual,))
+        num_layers, d_model, vocab = actual
+        if seq > model.max_len:
+            # bench.py clamps for the same reason: position indices
+            # past max_len would gather out of bounds (XLA clamps
+            # silently — the row would describe an impossible model)
+            raise ValueError("seq %d > bert_base max_len %d"
+                             % (seq, model.max_len))
+        _, params, loss_fn = family.create_model_and_loss(
+            model=model, dummy_seq=16)
+        bspec["label"] = jax.ShapeDtypeStruct((batch,), jnp.int32)
+    else:
+        raise ValueError("kind must be 'gpt' or 'bert', got %r" % kind)
     tx = optax.adamw(1e-4)
     state = make_train_state(params, tx)
     step = make_train_step(loss_fn, tx)
-    bspec = {"input_ids": jax.ShapeDtypeStruct((batch, seq), jnp.int32)}
     rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
     out = compile_stats(step, (spec_like(state), bspec, rng),
                         devices[:1], donate_argnums=(0,))
     if out.get("flops") and out.get("bytes_accessed"):
         out["flops_per_byte"] = round(out["flops"]
                                       / out["bytes_accessed"], 2)
-    out.update({"account": "lm_batch", "batch": batch,
+    out.update({"account": "lm_batch", "kind": kind, "batch": batch,
                 "num_layers": num_layers, "d_model": d_model,
                 "seq": seq, "remat": remat, "use_flash": use_flash})
     return out
@@ -543,6 +573,11 @@ def run_accounts(names, platform):
         for b in (8, 32):
             go("lm_batch", lm_batch_account, devices, batch=b,
                use_flash=True)
+        # bert-base at the bench config (seq 512, batch 32), dense vs
+        # flash — predictions for the queued bert stages
+        for fl in (False, True):
+            go("lm_batch", lm_batch_account, devices, batch=32,
+               seq=512, kind="bert", use_flash=fl)
     return results
 
 
