@@ -49,8 +49,11 @@ class _TeacherConn(object):
         outs = []
         for lo in range(0, n, self.max_batch):
             chunk = {k: v[lo:lo + self.max_batch] for k, v in feed.items()}
+            # raw arrays ride the v2 tensor frame (out-of-band
+            # zero-copy segments); decode_tree is a no-op on the
+            # already-decoded reply but keeps pre-v2 peers working
             outs.append(nd.decode_tree(
-                self._rpc.call("predict", nd.encode_tree(chunk))))
+                self._rpc.call("predict", chunk)))
         return {k: np.concatenate([o[k] for o in outs], axis=0)
                 for k in outs[0]}
 

@@ -55,12 +55,11 @@ class TeacherServer(object):
                 "max_batch": self._max_batch}
 
     def _predict_rpc(self, feed_encoded):
-        # zero-copy decode: predict_fn receives READ-ONLY feed arrays
-        # (a full max_batch batch is the decoded view itself; padded
-        # batches happen to be fresh from np.concatenate, but the
-        # contract is uniform: treat feeds as immutable — copy first if
-        # an implementation must mutate). All in-tree teachers only
-        # convert onward (jnp/device upload).
+        # v2 tensor frames deliver feeds as owned arrays recv'd
+        # straight off the socket (framing.py MAGIC_V2); decode_tree
+        # is then a no-op but keeps pre-v2 senders (tagged-dict
+        # payloads) working. Contract stays uniform: treat feeds as
+        # immutable — copy first if an implementation must mutate.
         feed = nd.decode_tree(feed_encoded, copy=False)
         missing = set(self._feed_specs) - set(feed)
         if missing:
@@ -87,7 +86,9 @@ class TeacherServer(object):
             padded[name] = arr
         with self._lock:
             out = self._fn(padded)
-        return nd.encode_tree({k: np.asarray(v)[:n] for k, v in out.items()})
+        # raw arrays: the v2 tensor frame ships them out-of-band with
+        # no tobytes()/msgpack-bin copies (framing.py MAGIC_V2)
+        return {k: np.asarray(v)[:n] for k, v in out.items()}
 
     def start(self):
         self._rpc.start()

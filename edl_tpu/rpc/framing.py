@@ -14,8 +14,18 @@ import socket
 import msgpack
 
 MAGIC = b"\xed\x17\x00\x01"
+# v2 "tensor frame": ndarrays are stripped out of the msgpack body and
+# shipped as RAW out-of-band segments vectored into the same sendmsg
+# call, straight from the numpy buffers; the receiver recv_into()s
+# preallocated arrays. No tobytes() copy, no 38 MB msgpack bin pack, no
+# unpack copy — the single-teacher distill feed ceiling measured 243
+# MB/s through v1 (r5 microbench) against a ~1.5 GB/s kernel loopback.
+# Emitted ONLY when a payload contains ndarrays, so array-free peers
+# (the C++ store pins v1's magic) never see it.
+MAGIC_V2 = b"\xed\x17\x00\x02"
 _HEADER = struct.Struct("!4sI")
 MAX_FRAME = 1 << 30  # 1 GB, matching the reference pod server's max message
+_ND_REF = "__ndref__"
 
 
 class FramingError(Exception):
@@ -44,32 +54,192 @@ def recv_exact(sock, n):
     return bytes(buf)
 
 
+def _recv_into(sock, view):
+    while len(view):
+        n = sock.recv_into(view)
+        if n == 0:
+            raise ConnectionError("peer closed connection")
+        view = view[n:]
+
+
 def read_frame(sock):
     header = recv_exact(sock, _HEADER.size)
     magic, length = _HEADER.unpack(header)
-    if magic != MAGIC:
+    if magic not in (MAGIC, MAGIC_V2):
         raise FramingError("bad magic %r" % magic)
     if length > MAX_FRAME:
         raise FramingError("frame too large: %d" % length)
     body = recv_exact(sock, length)
-    return msgpack.unpackb(body, raw=False)
+    obj = msgpack.unpackb(body, raw=False)
+    if magic == MAGIC:
+        return obj
+    # v2: body was only the meta; raw array payloads follow in order.
+    # recv straight into owned, writable arrays — zero user-space
+    # copies beyond the kernel's.
+    import numpy as np
+
+    refs = []
+
+    def collect(o):
+        if isinstance(o, dict):
+            if _ND_REF in o and isinstance(o[_ND_REF], int):
+                refs.append(o)
+                return
+            for v in o.values():
+                collect(v)
+        elif isinstance(o, list):
+            for v in o:
+                collect(v)
+
+    # every malformed-meta path must surface as FramingError BEFORE any
+    # payload byte is read or allocation happens — the RPC client only
+    # treats FramingError/ConnectionError as close-the-socket errors,
+    # and sizes are validated with python ints (no int64 overflow)
+    try:
+        tree, lens = obj["tree"], obj["lens"]
+        collect(tree)
+        refs.sort(key=lambda r: r[_ND_REF])
+        if [r[_ND_REF] for r in refs] != list(range(len(lens))):
+            raise FramingError(
+                "tensor frame meta mismatch: refs %r vs %d payloads"
+                % ([r[_ND_REF] for r in refs], len(lens)))
+        total = 0
+        plan = []
+        for ref, nbytes in zip(refs, lens):
+            dtype = np.dtype(ref["dtype"])
+            shape = tuple(int(d) for d in ref["shape"])
+            if any(d < 0 for d in shape) or not isinstance(nbytes, int):
+                raise FramingError("bad tensor meta: %r" % (ref,))
+            want = dtype.itemsize
+            for d in shape:
+                want *= d  # python ints: no overflow wraparound
+            if want != nbytes:
+                raise FramingError(
+                    "tensor frame shape/size mismatch: %r x %s = %d "
+                    "!= %d" % (shape, dtype, want, nbytes))
+            total += nbytes
+            plan.append((dtype, shape))
+        if total > MAX_FRAME:
+            raise FramingError("tensor payload too large")
+    except FramingError:
+        raise
+    except Exception as e:  # KeyError/TypeError/ValueError/...
+        raise FramingError("malformed tensor frame meta: %r" % e)
+    arrays = []
+    for dtype, shape in plan:
+        # datetime64/timedelta64 lack the buffer protocol: receive
+        # into an i8 view and reinterpret (mirrors the send side)
+        if dtype.kind in "mM":
+            arr = np.empty(shape, "i8")
+            _recv_into(sock, memoryview(arr).cast("B"))
+            arr = arr.view(dtype)
+        else:
+            arr = np.empty(shape, dtype)
+            _recv_into(sock, memoryview(arr).cast("B"))
+        arrays.append(arr)
+    return _fill_arrays(obj["tree"], arrays)
+
+
+def _has_arrays(obj):
+    """Short-circuit probe so array-free control RPCs skip the
+    stripping rebuild entirely."""
+    import numpy as np
+
+    if isinstance(obj, (np.ndarray, np.generic)):
+        return True
+    if isinstance(obj, dict):
+        return any(_has_arrays(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return any(_has_arrays(v) for v in obj)
+    return False
+
+
+def _strip_arrays(obj, bufs):
+    """Replace every ndarray in the pytree with a {_ND_REF, dtype,
+    shape} stub and append its (contiguous) buffer to ``bufs``.
+    datetime64/timedelta64 have no buffer protocol — ship their bytes
+    as an i8 view; the recorded dtype restores them on receive."""
+    import numpy as np
+
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        ref = {_ND_REF: len(bufs), "dtype": arr.dtype.str,
+               "shape": list(arr.shape)}
+        bufs.append(arr.view("i8") if arr.dtype.kind in "mM" else arr)
+        return ref
+    if isinstance(obj, np.generic):
+        return _strip_arrays(np.asarray(obj), bufs)
+    if isinstance(obj, dict):
+        if _ND_REF in obj:
+            # the sentinel is reserved on the wire: a colliding user
+            # key would be misparsed as an array stub by the receiver
+            raise FramingError(
+                "payload dict uses the reserved key %r" % _ND_REF)
+        return {k: _strip_arrays(v, bufs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_strip_arrays(v, bufs) for v in obj]
+    return obj
+
+
+def _fill_arrays(obj, arrays):
+    if isinstance(obj, dict):
+        if _ND_REF in obj and isinstance(obj[_ND_REF], int):
+            return arrays[obj[_ND_REF]]
+        return {k: _fill_arrays(v, arrays) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_fill_arrays(v, arrays) for v in obj]
+    return obj
+
+
+def _drain(sock, segments, sent):
+    """Finish a short sendmsg write without re-concatenating."""
+    for seg in segments:
+        if sent >= len(seg):
+            sent -= len(seg)
+            continue
+        sock.sendall(memoryview(seg)[sent:])
+        sent = 0
+
+
+# escape hatch for mixed fleets: a pre-v2 receiver hard-fails on
+# MAGIC_V2 ("bad magic"), so during a rolling upgrade set this on the
+# NEW senders until every receiver is current. In-tree deployments
+# upgrade atomically; the env var exists for anyone who doesn't.
+import os as _os
+_DISABLE_V2 = bool(_os.environ.get("EDL_TPU_DISABLE_TENSOR_FRAMES"))
+
+# Linux IOV_MAX is 1024: sendmsg rejects longer segment vectors with
+# EMSGSIZE, so wide pytrees (one segment per array) go out in groups.
+_IOV_CAP = 1000
 
 
 def write_frame(sock, obj):
     # vectored send: concatenating header+body (pack_frame) copies the
     # whole body, which for tensor batches is tens of MB per call —
     # measurable on the distill feed path (NOTES r5 distill curve).
-    # sendmsg ships both buffers in ONE syscall/segment with no copy;
-    # it may short-write, so drain any remainder without re-copying.
-    body = _pack_body(obj)
-    header = _HEADER.pack(MAGIC, len(body))
-    sent = sock.sendmsg([header, body])
-    total = len(header) + len(body)
-    if sent < len(header):
-        sock.sendall(header[sent:])
-        sock.sendall(body)
-    elif sent < total:
-        sock.sendall(memoryview(body)[sent - len(header):])
+    # sendmsg ships all segments in ONE syscall with no copy; it may
+    # short-write, so drain any remainder without re-copying.
+    bufs = []
+    if not _DISABLE_V2 and _has_arrays(obj):
+        stripped = _strip_arrays(obj, bufs)
+    if not bufs:
+        if _DISABLE_V2 and _has_arrays(obj):
+            from .ndarray import encode_tree
+            obj = encode_tree(obj)  # v1 tagged form, pre-v2 compatible
+        body = _pack_body(obj)
+        segments = [_HEADER.pack(MAGIC, len(body)), body]
+    else:
+        meta = _pack_body({"tree": stripped,
+                           "lens": [b.nbytes for b in bufs]})
+        if sum(b.nbytes for b in bufs) > MAX_FRAME:
+            raise FramingError("tensor payload too large")
+        segments = [_HEADER.pack(MAGIC_V2, len(meta)), meta]
+        segments += [memoryview(b).cast("B") for b in bufs]
+    for lo in range(0, len(segments), _IOV_CAP):
+        group = segments[lo:lo + _IOV_CAP]
+        sent = sock.sendmsg(group)
+        if sent < sum(len(s) for s in group):
+            _drain(sock, group, sent)
 
 
 def set_keepalive(sock):
