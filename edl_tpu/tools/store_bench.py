@@ -1,0 +1,153 @@
+"""Coordination-store throughput benchmark: Python vs C++ backend.
+
+Both servers speak the same wire protocol (framed msgpack, WAL+fsync
+durability) — this tool puts a number on the native component's value,
+the way the reference leaned on etcd's published performance. Ops are
+measured per backend over the real client/socket path:
+
+  put         durable write (fsync-bound; group commit amortizes)
+  get         point read
+  put4        4 concurrent writer PROCESSES (on a single-core host
+              this measures scheduler ping-pong, not server capacity —
+              read it only on multi-core machines)
+  lease       grant+refresh pairs (the TTL-heartbeat hot path)
+  watch_lat   put -> watcher-callback latency (control-plane signal
+              propagation; the launcher/generator/watcher loops ride it)
+
+Caveat recorded from the r5 runs (single shared core): absolute ops/s
+swing +-40% run to run under core contention; treat them as floors.
+Across 3 runs the native server led every single-client op (put up to
+44.3k vs 24.8k ops/s, watch latency 0.05-0.11 ms vs 0.2-0.5 ms).
+
+Run: python -m edl_tpu.tools.store_bench [--n 2000]
+"""
+
+import argparse
+import json
+import statistics
+import threading
+import time
+
+
+def _bench_backend(name, endpoint, n):
+    from edl_tpu.coordination.client import CoordClient, Watcher
+
+    c = CoordClient([endpoint], root="bench")
+    val = b"x" * 64
+
+    t0 = time.perf_counter()
+    for i in range(n):
+        c.put("k%d" % i, val)
+    put_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for i in range(n):
+        c.get_key("k%d" % i)
+    get_s = time.perf_counter() - t0
+
+    # 4 concurrent writers as PROCESSES (threads would share this
+    # client's GIL and measure python, not the server)
+    import subprocess
+    import sys
+
+    code = ("import sys;"
+            "from edl_tpu.coordination.client import CoordClient;"
+            "c = CoordClient([sys.argv[1]], root='bench');"
+            "v = b'x' * 64;"
+            "print('READY', flush=True);"
+            "sys.stdin.readline();"  # go signal: excludes interp startup
+            "[c.put('t%s_%d' % (sys.argv[2], i), v)"
+            " for i in range(int(sys.argv[3]))]")
+    procs = [subprocess.Popen([sys.executable, "-c", code, endpoint,
+                               str(t), str(n // 4)],
+                              stdin=subprocess.PIPE,
+                              stdout=subprocess.PIPE)
+             for t in range(4)]
+    for p in procs:
+        assert p.stdout.readline().strip() == b"READY"
+    t0 = time.perf_counter()
+    for p in procs:
+        p.stdin.write(b"\n")
+        p.stdin.flush()
+    for p in procs:
+        p.wait()
+    put4_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for _ in range(n // 4):
+        lease = c.lease_grant(10)
+        c.lease_refresh(lease)
+    lease_s = time.perf_counter() - t0
+
+    # watch latency: a watcher polls events; measure put -> callback
+    lats = []
+    seen = threading.Event()
+
+    def cb(added, removed, snapshot):
+        if added:
+            lats.append(time.perf_counter() - t_put)
+            seen.set()
+
+    w = Watcher(c, "watched", cb, poll_timeout=1.0)
+    time.sleep(0.2)
+    for i in range(20):
+        seen.clear()
+        t_put = time.perf_counter()
+        c.set_server_permanent("watched", "s%d" % i, "v")
+        seen.wait(5.0)
+    w.stop()
+
+    rows = []
+    for op, secs, count in (("put", put_s, n), ("get", get_s, n),
+                            ("put4", put4_s, 4 * (n // 4)),
+                            ("lease", lease_s, n // 4)):
+        rows.append({"metric": "store_%s_ops_per_sec" % op,
+                     "backend": name, "value": round(count / secs, 1),
+                     "unit": "ops/s"})
+    if lats:
+        rows.append({"metric": "store_watch_latency_ms",
+                     "backend": name,
+                     "value": round(
+                         statistics.median(lats) * 1e3, 2),
+                     "unit": "ms (median, put->callback)"})
+    for r in rows:
+        print(json.dumps(r), flush=True)
+    return rows
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("store benchmark")
+    p.add_argument("--n", type=int, default=2000)
+    p.add_argument("--backends", default="py,native")
+    args = p.parse_args(argv)
+
+    names = [b for b in args.backends.split(",") if b]
+    unknown = sorted(set(names) - {"py", "native"})
+    if unknown:
+        p.error("unknown backends %s (valid: py,native)"
+                % ",".join(unknown))
+    if args.n < 4:
+        p.error("--n must be >= 4")
+    for name in names:
+        if name == "py":
+            from edl_tpu.coordination.embedded import EmbeddedStore
+            with EmbeddedStore() as s:
+                _bench_backend("py", s.endpoint, args.n)
+        else:
+            from edl_tpu.coordination.native import (NativeStoreServer,
+                                                     ensure_binary)
+            try:
+                ensure_binary()
+            except Exception as e:  # no toolchain: report, don't die
+                print(json.dumps({"backend": "native",
+                                  "skipped": repr(e)[:200]}),
+                      flush=True)
+                continue
+            with NativeStoreServer() as s:
+                _bench_backend("native", s.endpoint, args.n)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

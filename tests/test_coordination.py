@@ -129,3 +129,22 @@ def test_clean_root_isolates_namespaces(store):
     c1.clean_root()
     assert c1.get_service("svc") == []
     assert c2.get_service("svc") == [("a", "2")]
+
+
+def test_store_bench_tool_runs():
+    """The store benchmark tool (tools/store_bench.py) must stay
+    runnable: one tiny py-backend pass, every metric line present."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.tools.store_bench",
+         "--n", "40", "--backends", "py"],
+        capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-800:]
+    metrics = {json.loads(l)["metric"]
+               for l in out.stdout.decode().splitlines() if l}
+    for op in ("put", "get", "put4", "lease"):
+        assert "store_%s_ops_per_sec" % op in metrics, metrics
+    assert "store_watch_latency_ms" in metrics, metrics
