@@ -129,14 +129,11 @@ def read_frame(sock):
     for dtype, shape in plan:
         # datetime64/timedelta64 lack the buffer protocol: receive
         # into an i8 view and reinterpret (mirrors the send side)
-        if dtype.kind in "mM":
-            arr = np.empty(shape, "i8")
+        wire = np.dtype("i8") if dtype.kind in "mM" else dtype
+        arr = np.empty(shape, wire)
+        if arr.nbytes:  # memoryview.cast refuses zero-in-shape views
             _recv_into(sock, memoryview(arr).cast("B"))
-            arr = arr.view(dtype)
-        else:
-            arr = np.empty(shape, dtype)
-            _recv_into(sock, memoryview(arr).cast("B"))
-        arrays.append(arr)
+        arrays.append(arr.view(dtype) if wire is not dtype else arr)
     return _fill_arrays(obj["tree"], arrays)
 
 
@@ -234,7 +231,10 @@ def write_frame(sock, obj):
         if sum(b.nbytes for b in bufs) > MAX_FRAME:
             raise FramingError("tensor payload too large")
         segments = [_HEADER.pack(MAGIC_V2, len(meta)), meta]
-        segments += [memoryview(b).cast("B") for b in bufs]
+        # memoryview.cast refuses zero-in-shape views; empty arrays
+        # contribute zero wire bytes anyway
+        segments += [memoryview(b).cast("B") for b in bufs
+                     if b.nbytes]
     for lo in range(0, len(segments), _IOV_CAP):
         group = segments[lo:lo + _IOV_CAP]
         sent = sock.sendmsg(group)
