@@ -5,11 +5,37 @@ errors re-raise by class name (edl/utils/exceptions.py:93-103).
 """
 
 import itertools
+import os
 import socket
 import threading
 
 from edl_tpu.rpc import framing
 from edl_tpu.utils import errors
+
+_LOCAL_HOSTS = None
+_LOCAL_LOCK = threading.Lock()
+
+
+def _local_hosts():
+    """Addresses that mean "this machine" — loopback plus this host's
+    own IP (a same-host peer usually advertises the real IP). Cached
+    only once the real IP resolves: get_host_ip falls back to loopback
+    before the network settles, and freezing that would silently
+    disable the fast path for real-IP endpoints forever."""
+    global _LOCAL_HOSTS
+    with _LOCAL_LOCK:
+        if _LOCAL_HOSTS is not None:
+            return _LOCAL_HOSTS
+        hosts = {"127.0.0.1", "localhost", "::1", "0.0.0.0"}
+        try:
+            from edl_tpu.utils.network import get_host_ip
+            ip = get_host_ip()
+        except Exception:  # noqa: BLE001 — fast path is optional
+            ip = None
+        if ip and not ip.startswith("127."):
+            hosts.add(ip)
+            _LOCAL_HOSTS = hosts  # resolved: safe to freeze
+        return hosts
 
 
 class RpcClient(object):
@@ -21,13 +47,51 @@ class RpcClient(object):
         self._sock = None
         self._ids = itertools.count()
         self._lock = threading.Lock()
+        self.transport = None  # "uds" | "tcp" after connect
+
+    def _try_uds(self):
+        """Same-host fast path (r5: 1381 vs 997 MB/s on tensor
+        frames): dial the server's conventional AF_UNIX path if it
+        exists, is OURS (0600 + uid check — /tmp is world-writable,
+        a squatter must not receive our payloads), and answers.
+        Any failure falls back to TCP silently."""
+        if os.environ.get("EDL_TPU_DISABLE_UDS") \
+                or not hasattr(socket, "AF_UNIX") \
+                or self._addr[0] not in _local_hosts():
+            return None
+        import stat as stat_mod
+
+        from edl_tpu.rpc.server import uds_path_for_port
+        path = uds_path_for_port(self._addr[1])
+        s = None
+        try:
+            # lstat + S_ISSOCK: a symlink planted in world-writable
+            # /tmp must not redirect us (stat would follow it)
+            st = os.lstat(path)
+            if st.st_uid != os.getuid() \
+                    or not stat_mod.S_ISSOCK(st.st_mode):
+                return None
+            s = socket.socket(socket.AF_UNIX)
+            s.settimeout(self._timeout)
+            s.connect(path)
+            return s
+        except OSError:
+            if s is not None:
+                s.close()  # no fd leak on stale-file fallback
+            return None
 
     def _connect(self):
         if self._sock is None:
+            sock = self._try_uds()
+            if sock is not None:
+                self._sock = sock
+                self.transport = "uds"
+                return
             try:
                 self._sock = socket.create_connection(
                     self._addr, timeout=self._timeout)
                 framing.set_keepalive(self._sock)
+                self.transport = "tcp"
             except OSError as e:
                 self._sock = None
                 raise errors.ConnectError(

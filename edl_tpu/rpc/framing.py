@@ -243,5 +243,7 @@ def write_frame(sock, obj):
 
 
 def set_keepalive(sock):
+    if sock.family == getattr(socket, "AF_UNIX", object()):
+        return  # no TCP options on unix sockets; liveness is kernel-local
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)

@@ -7,6 +7,7 @@ class name so clients re-raise the same type (reference parity:
 edl/utils/exceptions.py:93-114 serialize/deserialize).
 """
 
+import os
 import socket
 import socketserver
 import threading
@@ -14,6 +15,14 @@ import threading
 from edl_tpu.rpc import framing
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
+
+
+def uds_path_for_port(port):
+    """Conventional AF_UNIX path for a server's TCP port: same-host
+    clients auto-dial it (kernel loopback TCP measured 997 MB/s vs UDS
+    1381 MB/s on the v2 tensor-frame path, r5). uid-scoped so multiple
+    users can't collide; the file itself is chmod 0600."""
+    return "/tmp/edl_tpu_rpc_%d_%d.sock" % (os.getuid(), port)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -72,6 +81,18 @@ class _TCPServer(socketserver.ThreadingTCPServer):
         self.connections = set()
 
 
+if hasattr(socketserver, "ThreadingUnixStreamServer"):
+    class _UDSServer(socketserver.ThreadingUnixStreamServer):
+        daemon_threads = True
+        request_queue_size = 128
+
+        def __init__(self, *a, **k):
+            super().__init__(*a, **k)
+            self.connections = set()
+else:  # non-POSIX: TCP only
+    _UDSServer = None
+
+
 class RpcServer(object):
     """Register callables by name, serve them on host:port.
 
@@ -108,7 +129,45 @@ class RpcServer(object):
             target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
             daemon=True, name="rpc-server")
         self._thread.start()
+        self._start_uds()
         return self
+
+    def _start_uds(self):
+        """Best-effort same-host fast path: a second listener on the
+        conventional AF_UNIX path for our TCP port. Safe to unlink a
+        stale file first — we own the TCP port, so no live server can
+        own this path. Failure never blocks the TCP server."""
+        self._uds_server = None
+        self._uds_path = None
+        if _UDSServer is None or os.environ.get("EDL_TPU_DISABLE_UDS"):
+            return
+        path = uds_path_for_port(self.port)
+        srv = None
+        # umask, not post-bind chmod: the listener accepts connections
+        # the moment bind+listen complete inside __init__, so the file
+        # must never exist with permissive bits
+        old_umask = os.umask(0o177)
+        try:
+            if os.path.lexists(path):
+                os.unlink(path)
+            srv = _UDSServer(path, _Handler)
+            srv.methods = self.methods
+            self._uds_thread = threading.Thread(
+                target=srv.serve_forever, kwargs={"poll_interval": 0.1},
+                daemon=True, name="rpc-server-uds")
+            self._uds_thread.start()
+            self._uds_server = srv
+            self._uds_path = path
+        except Exception as e:  # noqa: BLE001 — fast path is optional
+            logger.warning("uds listener unavailable (%r); tcp only", e)
+            if srv is not None:  # bound but thread never started
+                try:
+                    srv.server_close()
+                    os.unlink(path)
+                except OSError:
+                    pass
+        finally:
+            os.umask(old_umask)
 
     @property
     def port(self):
@@ -120,6 +179,23 @@ class RpcServer(object):
         return "%s:%d" % (host, self.port)
 
     def stop(self):
+        # UDS teardown FIRST: once TCP server_close releases the port,
+        # a rapid successor can bind it and recreate the same socket
+        # path — unlinking after that would delete the successor's
+        # live fast-path file
+        if getattr(self, "_uds_server", None) is not None:
+            self._uds_server.shutdown()
+            for sock in list(self._uds_server.connections):
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            self._uds_server.server_close()
+            self._uds_server = None
+            try:
+                os.unlink(self._uds_path)
+            except OSError:
+                pass
         if self._server is not None:
             self._server.shutdown()
             # sever live connections so a stop behaves like a real process
