@@ -142,6 +142,22 @@ class RpcServer(object):
         if _UDSServer is None or os.environ.get("EDL_TPU_DISABLE_UDS"):
             return
         path = uds_path_for_port(self.port)
+        # A LIVE listener may own this path even though we own the TCP
+        # port: distinct specific bind addresses (127.0.0.1 vs a real
+        # IP) can share a port number across services. Probe-connect
+        # first — only a dead (stale) socket may be unlinked and taken.
+        if os.path.lexists(path):
+            probe = socket.socket(socket.AF_UNIX)
+            try:
+                probe.settimeout(1.0)
+                probe.connect(path)
+                logger.warning("uds path %s owned by a live server; "
+                               "tcp only", path)
+                return
+            except OSError:
+                pass  # stale — safe to take
+            finally:
+                probe.close()
         srv = None
         # umask, not post-bind chmod: the listener accepts connections
         # the moment bind+listen complete inside __init__, so the file

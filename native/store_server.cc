@@ -18,6 +18,7 @@
 #include <signal.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -759,9 +760,78 @@ int main(int argc, char** argv) {
             << ntohs(addr.sin_port) << std::endl;
 
   Store store(data_dir.empty() ? "" : data_dir + "/store.wal");
+
+  // Same-host fast path, mirroring the Python RpcServer's convention
+  // (rpc/server.py uds_path_for_port): a uid-scoped 0600 AF_UNIX
+  // listener at /tmp/edl_tpu_rpc_<uid>_<port>.sock. Safe to unlink a
+  // stale file — owning the TCP port proves no live server owns the
+  // path. Best-effort: any failure leaves the TCP listener as-is.
+  {
+    char uds_path[108];
+    std::snprintf(uds_path, sizeof(uds_path),
+                  "/tmp/edl_tpu_rpc_%d_%d.sock",
+                  static_cast<int>(getuid()),
+                  static_cast<int>(ntohs(addr.sin_port)));
+    if (std::getenv("EDL_TPU_DISABLE_UDS") == nullptr) {
+      sockaddr_un uaddr{};
+      uaddr.sun_family = AF_UNIX;
+      std::strncpy(uaddr.sun_path, uds_path, sizeof(uaddr.sun_path) - 1);
+      // A LIVE listener may own this path even though we own the TCP
+      // port: distinct specific bind addresses (127.0.0.1 vs a real
+      // IP) can share a port number across services. Probe-connect
+      // first — only a dead (stale) socket may be unlinked and taken.
+      bool live = false;
+      int probe = socket(AF_UNIX, SOCK_STREAM, 0);
+      if (probe >= 0) {
+        if (connect(probe, reinterpret_cast<sockaddr*>(&uaddr),
+                    sizeof(uaddr)) == 0)
+          live = true;
+        close(probe);
+      }
+      if (live) {
+        std::cerr << "uds path " << uds_path
+                  << " owned by a live server; tcp only" << std::endl;
+      } else {
+        ::unlink(uds_path);
+        int usrv = socket(AF_UNIX, SOCK_STREAM, 0);
+        if (usrv >= 0) {
+          mode_t old_umask = umask(0177);  // 0600 from birth: the
+          // listener accepts as soon as bind+listen land
+          bool bound = bind(usrv, reinterpret_cast<sockaddr*>(&uaddr),
+                            sizeof(uaddr)) == 0;
+          bool ok = bound && listen(usrv, 128) == 0;
+          umask(old_umask);
+          if (ok) {
+            std::cerr << "edl_tpu_store (C++) also on " << uds_path
+                      << std::endl;
+            std::thread([usrv, &store]() {
+              while (true) {
+                int fd = accept(usrv, nullptr, nullptr);
+                if (fd < 0) {
+                  if (errno == EMFILE || errno == ENFILE ||
+                      errno == EBADF)
+                    usleep(50 * 1000);  // fd exhaustion: don't spin hot
+                  continue;
+                }
+                std::thread(ServeConnection, &store, fd).detach();
+              }
+            }).detach();
+          } else {
+            close(usrv);
+            if (bound) ::unlink(uds_path);  // bind created the file
+          }
+        }
+      }
+    }
+  }
+
   while (true) {
     int fd = accept(srv, nullptr, nullptr);
-    if (fd < 0) continue;
+    if (fd < 0) {
+      if (errno == EMFILE || errno == ENFILE || errno == EBADF)
+        usleep(50 * 1000);  // fd exhaustion: don't spin hot
+      continue;
+    }
     std::thread(ServeConnection, &store, fd).detach();
   }
 }
