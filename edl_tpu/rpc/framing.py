@@ -107,6 +107,12 @@ def read_frame(sock):
         plan = []
         for ref, nbytes in zip(refs, lens):
             dtype = np.dtype(ref["dtype"])
+            if dtype.hasobject or dtype.kind not in "biufcmMSUV":
+                # an object dtype would recv_into() attacker bytes
+                # straight into PyObject pointer slots — only plain-
+                # old-data dtypes may cross the wire
+                raise FramingError(
+                    "non-POD tensor dtype refused: %r" % ref["dtype"])
             shape = tuple(int(d) for d in ref["shape"])
             if any(d < 0 for d in shape) or not isinstance(nbytes, int):
                 raise FramingError("bad tensor meta: %r" % (ref,))
@@ -125,16 +131,26 @@ def read_frame(sock):
         raise
     except Exception as e:  # KeyError/TypeError/ValueError/...
         raise FramingError("malformed tensor frame meta: %r" % e)
-    arrays = []
-    for dtype, shape in plan:
-        # datetime64/timedelta64 lack the buffer protocol: receive
-        # into an i8 view and reinterpret (mirrors the send side)
-        wire = np.dtype("i8") if dtype.kind in "mM" else dtype
-        arr = np.empty(shape, wire)
-        if arr.nbytes:  # memoryview.cast refuses zero-in-shape views
-            _recv_into(sock, memoryview(arr).cast("B"))
-        arrays.append(arr.view(dtype) if wire is not dtype else arr)
-    return _fill_arrays(obj["tree"], arrays)
+    # the allocation/recv loop: any non-OSError failure here (a stray
+    # ValueError from a hostile shape, a MemoryError) leaves unread
+    # payload bytes on the socket — surface it as FramingError so the
+    # RPC client closes the desynced connection instead of misparsing
+    # stale bytes on its next call
+    try:
+        arrays = []
+        for dtype, shape in plan:
+            # datetime64/timedelta64 lack the buffer protocol: receive
+            # into an i8 view and reinterpret (mirrors the send side)
+            wire = np.dtype("i8") if dtype.kind in "mM" else dtype
+            arr = np.empty(shape, wire)
+            if arr.nbytes:  # memoryview.cast refuses zero-in-shape views
+                _recv_into(sock, memoryview(arr).cast("B"))
+            arrays.append(arr.view(dtype) if wire is not dtype else arr)
+        return _fill_arrays(obj["tree"], arrays)
+    except (FramingError, OSError):  # ConnectionError is an OSError
+        raise
+    except Exception as e:
+        raise FramingError("tensor frame recv failed: %r" % e)
 
 
 def _has_arrays(obj):
@@ -202,8 +218,13 @@ def _drain(sock, segments, sent):
 # MAGIC_V2 ("bad magic"), so during a rolling upgrade set this on the
 # NEW senders until every receiver is current. In-tree deployments
 # upgrade atomically; the env var exists for anyone who doesn't.
+# Read PER CALL (like the UDS knob) so a long-lived process can be
+# flipped without a restart.
 import os as _os
-_DISABLE_V2 = bool(_os.environ.get("EDL_TPU_DISABLE_TENSOR_FRAMES"))
+
+
+def _v2_disabled():
+    return bool(_os.environ.get("EDL_TPU_DISABLE_TENSOR_FRAMES"))
 
 # Linux IOV_MAX is 1024: sendmsg rejects longer segment vectors with
 # EMSGSIZE, so wide pytrees (one segment per array) go out in groups.
@@ -217,10 +238,11 @@ def write_frame(sock, obj):
     # sendmsg ships all segments in ONE syscall with no copy; it may
     # short-write, so drain any remainder without re-copying.
     bufs = []
-    if not _DISABLE_V2 and _has_arrays(obj):
+    disabled = _v2_disabled()
+    if not disabled and _has_arrays(obj):
         stripped = _strip_arrays(obj, bufs)
     if not bufs:
-        if _DISABLE_V2 and _has_arrays(obj):
+        if disabled and _has_arrays(obj):
             from .ndarray import encode_tree
             obj = encode_tree(obj)  # v1 tagged form, pre-v2 compatible
         body = _pack_body(obj)

@@ -28,13 +28,34 @@ count — the Orbax role); the commit stays manifest-last, with the
 manifest recording every rank file's crc. Rank synchronization is by
 filesystem visibility on the shared store (no device collectives — the
 write may run from a background thread).
+
+Layout (stream — the async snapshot-then-persist engine):
+    <dir>/v_00000012/a0000.bin        one raw chunk-streamed file per
+                                      array entry (r<k>_a<j>.bin sharded)
+    <dir>/v_00000012/meta.json        user metadata + dtype tags
+    <dir>/v_00000012/MANIFEST         written last: per-entry spans,
+                                      files, crcs ("format": "stream")
+
+The stream layout exists for the ASYNC save path (save_async /
+save_sharded_async): phase 1 ("snapshot", on the training thread)
+starts non-blocking device->host transfers for every owned shard and
+copies them into reused host buffers, then returns a SaveHandle; phase
+2 ("persist", a background writer pool) streams each entry straight to
+its own file in fixed-size chunks — no monolithic npz BytesIO double
+copy — computing crc32 incrementally over the stream, and commits the
+MANIFEST only after every writer finishes. max_inflight is 1: a new
+save first drains the previous one (which also makes the host-buffer
+reuse safe). Crashed async attempts leave no MANIFEST and are removed
+by clean_uncommitted() like any other uncommitted dir.
 """
 
 import io
 import json
+import threading
 import time
 import uuid
 import zlib
+from concurrent.futures import ThreadPoolExecutor
 
 import jax
 import numpy as np
@@ -137,13 +158,148 @@ def _parse_spans(s):
                  for a, b in [part.split(":")])
 
 
+# -- stream-format plumbing (the async snapshot/persist engine) -----------
+
+_CHUNK = 4 << 20  # fixed-size streaming chunk for entry files
+
+
+def _wire_entry(arr):
+    """(wire_array, dtype_tag|None): dtypes without the buffer protocol
+    ship as a POD view — bfloat16 as uint16, datetime/timedelta as
+    int64 — and the tag restores the view on read."""
+    if _BFLOAT16 is not None and arr.dtype == _BFLOAT16:
+        return arr.view(np.uint16), "bfloat16"
+    if arr.dtype.kind in "mM":
+        return arr.view(np.int64), arr.dtype.str
+    return arr, None
+
+
+def _untag_array(arr, tag):
+    """Inverse of _wire_entry's tagging (also decodes the legacy npz
+    layout's bfloat16 tag)."""
+    if not tag:
+        return arr
+    if tag == "bfloat16":
+        if _BFLOAT16 is None:  # pragma: no cover
+            raise IOError("bfloat16 checkpoint needs ml_dtypes")
+        return arr.view(_BFLOAT16)
+    return arr.view(np.dtype(tag))
+
+
+def _start_host_transfers(tree):
+    """Kick off non-blocking device->host DMAs for every addressable
+    shard of every jax leaf, so the per-shard np.asarray fetches that
+    follow overlap instead of serializing (phase 1 of the async save)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        for s in getattr(leaf, "addressable_shards", ()):
+            start = getattr(s.data, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:  # pragma: no cover — best-effort
+                    return
+
+
+class _HostBufferPool(object):
+    """Reusable host staging buffers for snapshots, keyed by entry key.
+    Reuse across versions avoids a fresh multi-GB allocation per save;
+    it is safe exactly because max_inflight=1 — the previous persist is
+    drained before a new snapshot touches the buffers."""
+
+    def __init__(self):
+        self._bufs = {}
+
+    def copy_in(self, key, arr):
+        arr = np.asarray(arr)
+        buf = self._bufs.get(key)
+        if buf is None or buf.shape != arr.shape or buf.dtype != arr.dtype:
+            buf = np.empty(arr.shape, arr.dtype)
+            self._bufs[key] = buf
+        np.copyto(buf, arr)
+        return buf
+
+
+class SaveHandle(object):
+    """Completion handle for an async checkpoint save.
+
+    ``blocked_s`` is the training-thread (snapshot) time; ``persist_s``
+    the background write time, set once the persist finishes. wait()
+    blocks without raising; result() re-raises any persist failure."""
+
+    def __init__(self, version):
+        self.version = version
+        self.blocked_s = 0.0
+        self.persist_s = None
+        self._evt = threading.Event()
+        self._vdir = None
+        self._exc = None
+
+    def done(self):
+        return self._evt.is_set()
+
+    def wait(self, timeout=None):
+        return self._evt.wait(timeout)
+
+    def exception(self):
+        return self._exc
+
+    def result(self, timeout=None):
+        if not self._evt.wait(timeout):
+            raise TimeoutError("checkpoint v%d persist still running"
+                               % self.version)
+        if self._exc is not None:
+            raise self._exc
+        return self._vdir
+
+    def _finish(self, vdir, exc=None, persist_s=None):
+        self._vdir = vdir
+        self._exc = exc
+        self.persist_s = persist_s
+        self._evt.set()
+
+
 class CheckpointManager(object):
-    def __init__(self, directory, keep=3, fs=None):
+    def __init__(self, directory, keep=3, fs=None, workers=4):
         self._dir = str(directory)
         self._fs = fs or get_fs(directory)
         self._keep = keep
+        self._workers = max(1, int(workers))
+        self._pool = None           # lazy writer/reader thread pool
+        self._host_bufs = _HostBufferPool()
+        self._inflight = None       # the (single) in-flight SaveHandle
+        self._async_lock = threading.Lock()
 
     # -- helpers -------------------------------------------------------------
+
+    def _io_pool(self):
+        """The shared writer/reader pool: persist fan-out AND the
+        parallel restore reads ride the same threads."""
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers, thread_name_prefix="ckpt-io")
+        return self._pool
+
+    def drain(self):
+        """Block until the in-flight async save (if any) finishes;
+        returns its SaveHandle or None. A persist failure is logged, not
+        raised (the manifest-last invariant already keeps the failed
+        version invisible) — callers that must see the exception hold
+        the handle and call result()."""
+        with self._async_lock:
+            h, self._inflight = self._inflight, None
+        if h is not None:
+            h.wait()
+            if h.exception() is not None:
+                logger.error("async checkpoint v%d failed: %r",
+                             h.version, h.exception())
+        return h
+
+    def close(self):
+        """Drain the in-flight save and shut the writer pool down."""
+        self.drain()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
 
     def _vdir(self, version):
         return "%s/v_%08d" % (self._dir, version)
@@ -232,6 +388,165 @@ class CheckpointManager(object):
         for v in versions[:-self._keep] if self._keep else []:
             self._fs.delete_tree(self._vdir(v))
 
+    # -- async save: snapshot phase ------------------------------------------
+
+    def _snapshot_dense(self, tree):
+        """Phase-1 snapshot of a full tree: {span_key: host ndarray}
+        (wire dtypes) + dtype tags, copied into the reused buffer pool
+        so later steps may donate/mutate the originals."""
+        _start_host_transfers(tree)
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        entries = {}
+        dtypes = {}
+        for path, leaf in flat:
+            key = _path_key(path)
+            if not getattr(leaf, "is_fully_addressable", True):
+                from jax.experimental import multihost_utils
+                leaf = multihost_utils.process_allgather(leaf, tiled=True)
+            arr, tag = _wire_entry(np.asarray(leaf))
+            if tag:
+                dtypes[key] = tag
+            skey = self._shard_key(key, tuple(slice(0, d)
+                                              for d in arr.shape),
+                                   arr.shape)
+            entries[skey] = self._host_bufs.copy_in(skey, arr)
+        return entries, dtypes
+
+    def _snapshot_sharded(self, tree, rank):
+        """Phase-1 snapshot of this rank's OWNED shards (replica_id 0
+        dedup; host/replicated-only leaves land on rank 0), mirroring
+        what the sync sharded writer persists."""
+        _start_host_transfers(tree)
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        entries = {}
+        dtypes = {}
+
+        def add(key, index, shape, arr):
+            arr, tag = _wire_entry(np.asarray(arr))
+            if tag:
+                dtypes[key] = tag
+            skey = self._shard_key(key, index, shape)
+            entries[skey] = self._host_bufs.copy_in(skey, arr)
+
+        for path, leaf in flat:
+            key = _path_key(path)
+            if hasattr(leaf, "addressable_shards") \
+                    and hasattr(leaf, "sharding"):
+                for s in leaf.addressable_shards:
+                    if s.replica_id == 0:
+                        add(key, s.index, leaf.shape, s.data)
+            elif rank == 0:
+                arr = np.asarray(leaf)
+                add(key, tuple(slice(0, d) for d in arr.shape),
+                    arr.shape, arr)
+        return entries, dtypes
+
+    # -- async save: persist phase -------------------------------------------
+
+    def _write_entry_file(self, path, arr):
+        """Stream one (contiguous, wire-dtype) array to its own file in
+        fixed-size chunks with an incremental crc — no whole-payload
+        BytesIO staging. Returns (nbytes, crc)."""
+        arr = np.ascontiguousarray(arr)
+        if arr.nbytes == 0:
+            return self._fs.write_chunks(path, ())
+        view = memoryview(arr).cast("B")
+        return self._fs.write_chunks(
+            path, (view[off:off + _CHUNK]
+                   for off in range(0, len(view), _CHUNK)))
+
+    def _read_entry_file(self, path, entry):
+        """Read one stream entry back (chunked, incremental crc check),
+        returning the wire-dtype array."""
+        dtype = np.dtype(entry["dtype"])
+        arr = np.empty(tuple(entry["shape"]), dtype)
+        nbytes = int(entry["nbytes"])
+        if arr.nbytes != nbytes:
+            raise IOError("entry %s: %d bytes recorded vs %d expected"
+                          % (path, nbytes, arr.nbytes))
+        crc = 0
+        got = 0
+        view = memoryview(arr).cast("B") if nbytes else None
+        with self._fs.open(path, "rb") as f:
+            while got < nbytes:
+                chunk = f.read(min(_CHUNK, nbytes - got))
+                if not chunk:
+                    raise IOError("entry %s truncated at %d/%d bytes"
+                                  % (path, got, nbytes))
+                view[got:got + len(chunk)] = chunk
+                crc = zlib.crc32(chunk, crc)
+                got += len(chunk)
+        if crc != int(entry["crc"]):
+            raise IOError("checksum mismatch in %s" % path)
+        return arr
+
+    def _write_entries(self, vdir, prefix, entries):
+        """Fan the entry files out across the writer pool; returns the
+        manifest entry table {span_key: {file, dtype, shape, crc,
+        nbytes}} and the total byte count."""
+        pool = self._io_pool()
+        futs = []
+        for i, skey in enumerate(sorted(entries)):
+            fname = "%sa%04d.bin" % (prefix, i)
+            arr = entries[skey]
+            futs.append((skey, fname, arr,
+                         pool.submit(self._write_entry_file,
+                                     "%s/%s" % (vdir, fname), arr)))
+        table = {}
+        total = 0
+        for skey, fname, arr, fut in futs:
+            nbytes, crc = fut.result()
+            table[skey] = {"file": fname, "dtype": arr.dtype.str,
+                           "shape": list(arr.shape), "crc": crc,
+                           "nbytes": nbytes}
+            total += nbytes
+        return table, total
+
+    def save_async(self, version, tree, meta=None, on_commit=None):
+        """Two-phase async save. Snapshot runs HERE (fast device->host
+        copies into pooled buffers), then control returns while a
+        background driver streams the entries to per-array files and
+        commits the MANIFEST last. Returns a SaveHandle; max_inflight
+        is 1 — this call first drains any previous async save.
+        ``on_commit`` (optional) runs on the driver thread right after
+        the manifest commit."""
+        self.drain()
+        t0 = time.perf_counter()
+        entries, dtypes = self._snapshot_dense(tree)
+        handle = SaveHandle(version)
+        handle.blocked_s = time.perf_counter() - t0
+
+        def persist():
+            p0 = time.perf_counter()
+            try:
+                vdir = self._vdir(version)
+                self._fs.delete_tree(vdir)
+                self._fs.makedirs(vdir)
+                table, total = self._write_entries(vdir, "", entries)
+                with self._fs.open(vdir + "/meta.json", "w") as f:
+                    json.dump({"meta": meta or {}, "dtypes": dtypes}, f)
+                # the commit point:
+                with self._fs.open(vdir + "/MANIFEST", "w") as f:
+                    json.dump({"version": version, "format": "stream",
+                               "entries": table, "nbytes": total}, f)
+                logger.info("checkpoint v%d committed async (%d entries,"
+                            " %.1f MB)", version, len(table),
+                            total / 1e6)
+                self._gc()
+                if on_commit is not None:
+                    on_commit()
+                handle._finish(vdir,
+                               persist_s=time.perf_counter() - p0)
+            except BaseException as e:  # noqa: BLE001 — surfaces via result()
+                handle._finish(None, exc=e,
+                               persist_s=time.perf_counter() - p0)
+
+        with self._async_lock:
+            self._inflight = handle
+        threading.Thread(target=persist, daemon=False,
+                         name="ckpt-persist-%d" % version).start()
+        return handle
+
     # -- sharded save --------------------------------------------------------
 
     @staticmethod
@@ -291,24 +606,6 @@ class CheckpointManager(object):
         version dirs never carry live protocol state; trainers still
         call clean_uncommitted() at process start for crashed attempts."""
         vdir = self._vdir(version)
-        use_sentinel = barrier is None and nranks > 1
-        nonce = None
-        if rank == 0:
-            self._fs.delete_tree(vdir)
-            self._fs.makedirs(vdir)
-            if use_sentinel:
-                nonce = uuid.uuid4().hex
-                with self._fs.open(vdir + "/STARTED", "w") as f:
-                    f.write(nonce)
-        if barrier is not None:
-            barrier()  # rank0's directory reset must precede any write
-
-        def read_sentinel():
-            try:
-                with self._fs.open(vdir + "/STARTED", "r") as f:
-                    return f.read() or None
-            except (IOError, OSError):
-                return None
 
         def write_rank_files():
             flat, _ = jax.tree_util.tree_flatten_with_path(tree)
@@ -348,6 +645,55 @@ class CheckpointManager(object):
                                "w") as f:
                 json.dump({"crc": zlib.crc32(payload), "dtypes": dtypes,
                            "nbytes": len(payload)}, f)
+
+        def commit(nonce):
+            crcs = {}
+            dtypes_all = {}
+            for r in range(nranks):
+                with self._fs.open("%s/shardmeta.r%d.json" % (vdir, r),
+                                   "r") as f:
+                    sm = json.load(f)
+                crcs[str(r)] = sm["crc"]
+                dtypes_all.update(sm["dtypes"])
+            with self._fs.open(vdir + "/meta.json", "w") as f:
+                json.dump({"meta": meta or {}, "dtypes": dtypes_all}, f)
+            with self._fs.open(vdir + "/MANIFEST", "w") as f:
+                json.dump({"version": version, "sharded": True,
+                           "ranks": nranks, "crcs": crcs,
+                           "attempt": nonce}, f)
+
+        return self._sharded_protocol(version, rank, nranks, barrier,
+                                      timeout, write_rank_files, commit)
+
+    def _sharded_protocol(self, version, rank, nranks, barrier, timeout,
+                          write_rank_files, commit):
+        """The sentinel/nonce commit protocol shared by the npz (sync)
+        and stream (async) sharded writers. ``write_rank_files()``
+        writes this rank's data + shardmeta files (idempotent: it may
+        run again under a fresh nonce after a stale-attempt reset);
+        ``commit(nonce)`` is rank 0's manifest assembly, run only once
+        every done marker carries the current nonce. The MANIFEST the
+        commit writes MUST record ``attempt: nonce`` — the non-rank-0
+        resolution loop keys on it."""
+        vdir = self._vdir(version)
+        use_sentinel = barrier is None and nranks > 1
+        nonce = None
+        if rank == 0:
+            self._fs.delete_tree(vdir)
+            self._fs.makedirs(vdir)
+            if use_sentinel:
+                nonce = uuid.uuid4().hex
+                with self._fs.open(vdir + "/STARTED", "w") as f:
+                    f.write(nonce)
+        if barrier is not None:
+            barrier()  # rank0's directory reset must precede any write
+
+        def read_sentinel():
+            try:
+                with self._fs.open(vdir + "/STARTED", "r") as f:
+                    return f.read() or None
+            except (IOError, OSError):
+                return None
 
         if rank == 0 or not use_sentinel:
             write_rank_files()
@@ -428,20 +774,7 @@ class CheckpointManager(object):
                     lambda: all(done_current(r) for r in range(nranks)),
                     "all %d rank done markers (v%d, attempt %s)"
                     % (nranks, version, nonce), timeout)
-            crcs = {}
-            dtypes_all = {}
-            for r in range(nranks):
-                with self._fs.open("%s/shardmeta.r%d.json" % (vdir, r),
-                                   "r") as f:
-                    sm = json.load(f)
-                crcs[str(r)] = sm["crc"]
-                dtypes_all.update(sm["dtypes"])
-            with self._fs.open(vdir + "/meta.json", "w") as f:
-                json.dump({"meta": meta or {}, "dtypes": dtypes_all}, f)
-            with self._fs.open(vdir + "/MANIFEST", "w") as f:
-                json.dump({"version": version, "sharded": True,
-                           "ranks": nranks, "crcs": crcs,
-                           "attempt": nonce}, f)
+            commit(nonce)
             if use_sentinel:
                 # retire the attempt's protocol state so a later save
                 # at this version can never pair with this one
@@ -456,6 +789,70 @@ class CheckpointManager(object):
             self._gc()
         return vdir
 
+    def save_sharded_async(self, version, tree, meta=None, rank=0,
+                           nranks=1, barrier=None, timeout=120.0,
+                           on_commit=None):
+        """Async sharded save: phase-1 snapshot of this rank's owned
+        shards runs here, then the whole sentinel/nonce protocol —
+        including rank 0's directory reset and manifest commit — runs on
+        a background driver, streaming per-shard entry files through the
+        writer pool. Same visibility rules as save_sharded; the stream
+        shardmeta/MANIFEST carry ``format: "stream"`` with the per-file
+        entry tables instead of per-rank npz crcs."""
+        self.drain()
+        t0 = time.perf_counter()
+        entries, dtypes = self._snapshot_sharded(tree, rank)
+        handle = SaveHandle(version)
+        handle.blocked_s = time.perf_counter() - t0
+        vdir = self._vdir(version)
+
+        def write_rank_files():
+            table, total = self._write_entries(vdir, "r%d_" % rank,
+                                               entries)
+            with self._fs.open("%s/shardmeta.r%d.json" % (vdir, rank),
+                               "w") as f:
+                json.dump({"format": "stream", "entries": table,
+                           "dtypes": dtypes, "nbytes": total}, f)
+
+        def commit(nonce):
+            entries_all = {}
+            dtypes_all = {}
+            total = 0
+            for r in range(nranks):
+                with self._fs.open("%s/shardmeta.r%d.json" % (vdir, r),
+                                   "r") as f:
+                    sm = json.load(f)
+                entries_all.update(sm["entries"])
+                dtypes_all.update(sm["dtypes"])
+                total += sm["nbytes"]
+            with self._fs.open(vdir + "/meta.json", "w") as f:
+                json.dump({"meta": meta or {}, "dtypes": dtypes_all}, f)
+            with self._fs.open(vdir + "/MANIFEST", "w") as f:
+                json.dump({"version": version, "sharded": True,
+                           "format": "stream", "ranks": nranks,
+                           "entries": entries_all, "nbytes": total,
+                           "attempt": nonce}, f)
+
+        def persist():
+            p0 = time.perf_counter()
+            try:
+                out = self._sharded_protocol(version, rank, nranks,
+                                             barrier, timeout,
+                                             write_rank_files, commit)
+                if on_commit is not None:
+                    on_commit()
+                handle._finish(out, persist_s=time.perf_counter() - p0)
+            except BaseException as e:  # noqa: BLE001 — surfaces via result()
+                handle._finish(None, exc=e,
+                               persist_s=time.perf_counter() - p0)
+
+        with self._async_lock:
+            self._inflight = handle
+        threading.Thread(target=persist, daemon=False,
+                         name="ckpt-persist-%d.r%d" % (version, rank)
+                         ).start()
+        return handle
+
     def _restore_sharded(self, vdir, manifest, meta_blob, target):
         if target is None:
             raise IOError("sharded checkpoint restore needs a target "
@@ -467,29 +864,43 @@ class CheckpointManager(object):
                                       np.dtype(leaf.dtype))
         buffers = {}
         filled = {k: 0 for k in specs}
-        for r in range(int(manifest["ranks"])):
-            with self._fs.open("%s/arrays.r%d.npz" % (vdir, r),
-                               "rb") as f:
-                payload = f.read()
-            if zlib.crc32(payload) != manifest["crcs"][str(r)]:
-                raise IOError("checksum mismatch in %s rank %d"
-                              % (vdir, r))
-            npz = np.load(io.BytesIO(payload))
-            for skey in npz.files:
-                key, _, spans = skey.rpartition("@")
-                if key not in specs:
-                    continue
-                shape, dtype = specs[key]
-                arr = npz[skey]
-                if meta_blob["dtypes"].get(key) == "bfloat16":
-                    if _BFLOAT16 is None:  # pragma: no cover
-                        raise IOError("bfloat16 checkpoint needs ml_dtypes")
-                    arr = arr.view(_BFLOAT16)
-                if key not in buffers:
-                    buffers[key] = np.zeros(shape, dtype)
-                idx = tuple(slice(a, b) for a, b in _parse_spans(spans))
-                buffers[key][idx] = arr
-                filled[key] += arr.size
+
+        def paste(skey, arr):
+            key, _, spans = skey.rpartition("@")
+            shape, dtype = specs[key]
+            arr = _untag_array(arr, meta_blob["dtypes"].get(key))
+            if key not in buffers:
+                buffers[key] = np.zeros(shape, dtype)
+            idx = tuple(slice(a, b) for a, b in _parse_spans(spans))
+            buffers[key][idx] = arr
+            filled[key] += arr.size
+
+        if manifest.get("format") == "stream":
+            pool = self._io_pool()
+            futs = [(skey, pool.submit(self._read_entry_file,
+                                       "%s/%s" % (vdir, entry["file"]),
+                                       entry))
+                    for skey, entry in manifest["entries"].items()
+                    if skey.rpartition("@")[0] in specs]
+            for skey, fut in futs:
+                paste(skey, fut.result())
+        else:
+            def read_rank(r):
+                with self._fs.open("%s/arrays.r%d.npz" % (vdir, r),
+                                   "rb") as f:
+                    payload = f.read()
+                if zlib.crc32(payload) != manifest["crcs"][str(r)]:
+                    raise IOError("checksum mismatch in %s rank %d"
+                                  % (vdir, r))
+                return payload
+            payloads = list(self._io_pool().map(
+                read_rank, range(int(manifest["ranks"]))))
+            for payload in payloads:
+                npz = np.load(io.BytesIO(payload))
+                for skey in npz.files:
+                    if skey.rpartition("@")[0] not in specs:
+                        continue
+                    paste(skey, npz[skey])
         missing = {k for k in specs if filled[k] < int(np.prod(
             specs[k][0], dtype=np.int64))}
         # scalars: prod(())==1, filled must be >= 1
@@ -571,10 +982,7 @@ class CheckpointManager(object):
 
         def paste(key, entry_spans, arr):
             _, dtype, _, blocks, _ = need[key]
-            if meta_blob["dtypes"].get(key) == "bfloat16":
-                if _BFLOAT16 is None:  # pragma: no cover
-                    raise IOError("bfloat16 checkpoint needs ml_dtypes")
-                arr = arr.view(_BFLOAT16)
+            arr = _untag_array(arr, meta_blob["dtypes"].get(key))
             for spans, blk in blocks.items():
                 buf = blk[0]
                 # intersect the saved entry with this device block
@@ -593,14 +1001,37 @@ class CheckpointManager(object):
                 blk[1] += int(np.prod([y - x for x, y in zip(lo, hi)],
                                       dtype=np.int64))
 
-        if manifest.get("sharded"):
-            for r in range(int(manifest["ranks"])):
+        if manifest.get("format") == "stream":
+            # stream layout (dense OR sharded): bounds-check every entry
+            # from the manifest table, then read ONLY the overlapping
+            # files, in parallel across the io pool
+            pool = self._io_pool()
+            todo = []
+            for skey, entry in manifest["entries"].items():
+                key, _, spans_s = skey.rpartition("@")
+                if key not in need:
+                    continue
+                entry_spans = _parse_spans(spans_s)
+                check_bounds(key, entry_spans)
+                if not overlaps_local(key, entry_spans):
+                    continue  # skip the file read entirely
+                todo.append((key, entry_spans,
+                             pool.submit(self._read_entry_file,
+                                         "%s/%s" % (vdir, entry["file"]),
+                                         entry)))
+            for key, entry_spans, fut in todo:
+                paste(key, entry_spans, fut.result())
+        elif manifest.get("sharded"):
+            def read_rank(r):
                 with self._fs.open("%s/arrays.r%d.npz" % (vdir, r),
                                    "rb") as f:
                     payload = f.read()
                 if zlib.crc32(payload) != manifest["crcs"][str(r)]:
                     raise IOError("checksum mismatch in %s rank %d"
                                   % (vdir, r))
+                return payload
+            for payload in self._io_pool().map(
+                    read_rank, range(int(manifest["ranks"]))):
                 npz = np.load(io.BytesIO(payload))
                 for skey in npz.files:
                     key, _, spans_s = skey.rpartition("@")
@@ -668,6 +1099,11 @@ class CheckpointManager(object):
                 meta_blob = json.load(f)
             tree = self._restore_sharded(vdir, manifest, meta_blob, target)
             return version, tree, meta_blob["meta"]
+        if manifest.get("format") == "stream":
+            with self._fs.open(vdir + "/meta.json", "r") as f:
+                meta_blob = json.load(f)
+            tree = self._restore_stream(vdir, manifest, meta_blob, target)
+            return version, tree, meta_blob["meta"]
         with self._fs.open(vdir + "/arrays.npz", "rb") as f:
             payload = f.read()
         if zlib.crc32(payload) != manifest["crc"]:
@@ -694,6 +1130,29 @@ class CheckpointManager(object):
             tree = jax.tree_util.tree_unflatten(treedef,
                                                 [arrays[k] for k in keys])
         return version, tree, meta_blob["meta"]
+
+    def _restore_stream(self, vdir, manifest, meta_blob, target):
+        """Restore a dense stream-format version: every entry file is
+        read (and CRC-checked) in parallel across the io pool. Dense
+        stream entries are single full-span entries per key."""
+        pool = self._io_pool()
+        futs = [(skey, pool.submit(self._read_entry_file,
+                                   "%s/%s" % (vdir, entry["file"]),
+                                   entry))
+                for skey, entry in manifest["entries"].items()]
+        arrays = {}
+        for skey, fut in futs:
+            key, _, _ = skey.rpartition("@")
+            arrays[key] = _untag_array(fut.result(),
+                                       meta_blob["dtypes"].get(key))
+        if target is None:
+            return _unflatten_to_nested(arrays)
+        keys, treedef = _paths(target)
+        missing = set(keys) - set(arrays)
+        if missing:
+            raise MissingKeysError(missing)
+        return jax.tree_util.tree_unflatten(treedef,
+                                            [arrays[k] for k in keys])
 
 
 def _unflatten_to_nested(arrays):

@@ -19,6 +19,7 @@ import shutil
 import urllib.error
 import urllib.parse
 import urllib.request
+import zlib
 
 
 class FileSystem(object):
@@ -30,6 +31,21 @@ class FileSystem(object):
 
     def open(self, path, mode):
         raise NotImplementedError
+
+    def write_chunks(self, path, chunks):
+        """Stream an iterable of byte chunks to ``path``, computing
+        zlib.crc32 incrementally; returns (nbytes, crc). The streaming
+        write primitive of the async checkpoint engine — backends that
+        can pipeline (resumable uploads, O_DIRECT) override this; the
+        default rides open()."""
+        nbytes = 0
+        crc = 0
+        with self.open(path, "wb") as f:
+            for chunk in chunks:
+                f.write(chunk)
+                crc = zlib.crc32(chunk, crc)
+                nbytes += len(chunk)
+        return nbytes, crc
 
     def listdir(self, path):
         raise NotImplementedError

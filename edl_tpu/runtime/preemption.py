@@ -53,6 +53,46 @@ from edl_tpu.utils.logger import logger
 KEY_TTL = 120.0
 
 
+class PreemptionGuard(object):
+    """Async-signal-safe preemption flag + checkpoint drain hook.
+
+    The handler only flips ``preempted`` (no I/O, no locks — the only
+    things legal in a signal context); the trainer polls the flag at
+    step boundaries. ``drain()`` runs the supplied callable (the async
+    checkpoint engine's drain) and is called on EVERY preemption exit
+    path — including the ones that save nothing — so a SIGTERM can
+    never lose the in-flight async checkpoint version."""
+
+    def __init__(self, drain=None):
+        self._drain = drain
+        self.preempted = False
+        self.installed = False
+
+    def install(self, signals=None):
+        """Arm the flag-only handler (idempotent; main thread only —
+        CPython restricts signal.signal to it). Default: SIGTERM."""
+        import signal as signal_mod
+        if signals is None:
+            signals = (signal_mod.SIGTERM,)
+        for s in signals:
+            signal_mod.signal(s, self._on_signal)
+        self.installed = True
+        return self
+
+    def _on_signal(self, signum, frame):
+        self.preempted = True
+
+    def drain(self):
+        """Wait out the in-flight async checkpoint persist (best-effort:
+        a drain failure must not mask the PreemptedError being raised)."""
+        if self._drain is None:
+            return
+        try:
+            self._drain()
+        except Exception:
+            logger.exception("preemption drain failed")
+
+
 class CoordinatedStop(object):
     """One per trainer process. ``stop_at`` becomes the agreed stop step
     (read it each boundary); ``request(step)`` publishes this rank's

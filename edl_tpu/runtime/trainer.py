@@ -487,8 +487,10 @@ class ElasticTrainer(object):
         # preemption event, not the one being waited on
         self._resumed_version = -1
         self._async_save = async_save
-        self._save_thread = None
-        self._preempted = False
+        # flag-only SIGTERM handler + drain hook: every preemption exit
+        # path drains the checkpoint engine's in-flight async persist
+        from edl_tpu.runtime.preemption import PreemptionGuard
+        self._guard = PreemptionGuard(drain=self.wait_for_save)
         self._preempt_armed = False
         self._coord_stop = None
         self._preempt_t0 = None
@@ -912,11 +914,7 @@ class ElasticTrainer(object):
         SHARDED state the save is skipped and the restart falls back to
         the last epoch-end checkpoint.
         """
-        import signal as signal_mod
-        if signals is None:
-            signals = (signal_mod.SIGTERM,)
-        for s in signals:
-            signal_mod.signal(s, self._on_preempt_signal)
+        self._guard.install(signals)
         self._preempt_armed = True
         if coordinated is None:
             coordinated = jax.process_count() > 1 and self.coord is not None
@@ -948,7 +946,15 @@ class ElasticTrainer(object):
         return sum(tail) / len(tail) if tail else 0.0
 
     def _on_preempt_signal(self, signum, frame):
-        self._preempted = True
+        self._guard._on_signal(signum, frame)
+
+    @property
+    def _preempted(self):
+        return self._guard.preempted
+
+    @_preempted.setter
+    def _preempted(self, value):
+        self._guard.preempted = bool(value)
 
     @property
     def preempted(self):
@@ -968,6 +974,10 @@ class ElasticTrainer(object):
         epoch-end checkpoint."""
         from edl_tpu.utils.errors import PreemptedError
 
+        # FIRST drain the in-flight async persist: every coordinated
+        # exit below (including the non-saving "missed" one) must leave
+        # the previously started version committed, not lost
+        self._guard.drain()
         self._coord_stop.stop()
         if missed:
             logger.warning("coordinated stop step %s observed late at "
@@ -1042,6 +1052,10 @@ class ElasticTrainer(object):
         to the last epoch-end checkpoint."""
         from edl_tpu.utils.errors import PreemptedError
 
+        # drain before ANY exit below — the no-save paths (no ckpt dir,
+        # cross-host sharded skip, non-rank-0 wait) must still land the
+        # in-flight async version before the process dies
+        self._guard.drain()
         if self._ckpt is None:
             raise PreemptedError(
                 "preempted at step %d; no checkpoint dir configured — "
@@ -1198,10 +1212,13 @@ class ElasticTrainer(object):
         shared store, not device collectives. For replicated leaves the
         replica-0 dedup means rank 0 writes them once.
 
-        With ``async_save=True`` the write overlaps training: the state is
-        copied ON DEVICE first (so later steps may donate the originals),
-        then a background thread fetches and writes it; the manifest-last
-        commit keeps partial writes invisible."""
+        With ``async_save=True`` the write rides the checkpoint engine's
+        two-phase path (save_async/save_sharded_async): a fast host-side
+        snapshot into pooled buffers runs here — later steps may donate
+        the originals — and a background writer pool streams the entries
+        out, committing the manifest last so partial writes stay
+        invisible. The engine's max_inflight=1 back-pressure drains the
+        previous save first."""
         if self._ckpt is None:
             return
         version = self.global_step
@@ -1211,56 +1228,52 @@ class ElasticTrainer(object):
         state_snapshot = json.loads(self.state.to_json())
         meta = {"state": state_snapshot}
 
+        self.wait_for_save()
         if not self._state_fully_addressable():
             # per-host sharded write; every rank participates
             rank = jax.process_index()
             nranks = jax.process_count()
-
-            def write(tree):
-                self._ckpt.save_sharded(version, tree, meta=meta,
-                                        rank=rank, nranks=nranks)
-                if rank == 0:
-                    self._save_state_to_store(state_snapshot)
-        else:
-            if self.env.global_rank != 0:
+            on_commit = ((lambda: self._save_state_to_store(state_snapshot))
+                         if rank == 0 else None)
+            if self._async_save:
+                self._ckpt.save_sharded_async(
+                    version, dict(self.train_state), meta=meta,
+                    rank=rank, nranks=nranks, on_commit=on_commit)
                 return
-
-            def write(tree):
-                self._ckpt.save(version, checkpoint_mod.to_host_tree(tree),
-                                meta=meta)
-                self._save_state_to_store(state_snapshot)
-
-        self.wait_for_save()
-        if not self._async_save:
-            write(dict(self.train_state))
+            self._ckpt.save_sharded(version, dict(self.train_state),
+                                    meta=meta, rank=rank, nranks=nranks)
+            if on_commit is not None:
+                on_commit()
             return
-        # immutable snapshot, independent of donated buffers: a
-        # device-side copy later steps cannot touch
-        snapshot = jax.tree_util.tree_map(jnp.copy,
-                                          dict(self.train_state))
-
-        def _bg():
-            try:
-                write(snapshot)
-            except Exception:
-                logger.exception("async checkpoint v%d failed", version)
-
-        self._save_thread = threading.Thread(
-            target=_bg, daemon=False, name="ckpt-save-%d" % version)
-        self._save_thread.start()
+        if self.env.global_rank != 0:
+            return
+        if self._async_save:
+            self._ckpt.save_async(
+                version, dict(self.train_state), meta=meta,
+                on_commit=lambda: self._save_state_to_store(
+                    state_snapshot))
+            return
+        self._ckpt.save(version,
+                        checkpoint_mod.to_host_tree(
+                            dict(self.train_state)), meta=meta)
+        self._save_state_to_store(state_snapshot)
 
     def wait_for_save(self):
-        """Block until any in-flight async checkpoint write finishes."""
-        if self._save_thread is not None:
-            self._save_thread.join()
-            self._save_thread = None
+        """Block until any in-flight async checkpoint persist finishes
+        (the engine's drain; a persist failure is logged there, and the
+        manifest-last commit keeps the failed version invisible)."""
+        if self._ckpt is not None:
+            self._ckpt.drain()
 
     def close(self):
-        """Release background resources: join any in-flight async save
-        and stop the preemption watcher thread. Idempotent; the trainer
-        remains usable for reads afterwards (notebooks constructing
-        several trainers should close the ones they drop)."""
+        """Release background resources: drain any in-flight async save,
+        shut the checkpoint engine's writer pool down, and stop the
+        preemption watcher thread. Idempotent; the trainer remains
+        usable for reads afterwards (notebooks constructing several
+        trainers should close the ones they drop)."""
         self.wait_for_save()
+        if self._ckpt is not None:
+            self._ckpt.close()
         if self._coord_stop is not None:
             self._coord_stop.stop()
 

@@ -95,6 +95,26 @@ def test_probe_becomes_the_measurement(monkeypatch, clock):
     assert len(calls) == 1  # the probe and nothing else
 
 
+def test_ckpt_bench_tiny_cpu_schema(tmp_path):
+    """The checkpoint bench must keep working in a tiny CPU config
+    under tier-1 and honor its JSON contract (schema ckpt_bench/v1) —
+    the guard that keeps the tool from bit-rotting."""
+    import json
+
+    from edl_tpu.tools import ckpt_bench
+
+    out = ckpt_bench.run(tree_mb=2, workers=2,
+                         directory=str(tmp_path), repeats=1)
+    assert out["schema"] == "ckpt_bench/v1"
+    assert out["roundtrip_ok"] is True
+    assert out["tree_mb"] == pytest.approx(2.0, rel=0.1)
+    assert out["sync"]["wall_ms"] > 0 and out["sync"]["mb_s"] > 0
+    assert out["async"]["blocked_ms"] > 0
+    assert out["async"]["persist_ms"] > 0 and out["async"]["mb_s"] > 0
+    assert out["blocked_frac_of_sync"] > 0
+    json.dumps(out)  # the whole report is JSON-serializable
+
+
 def test_remaining_attempt_budget_clips_the_loop(monkeypatch, clock):
     # compile/warmup already burned most of the attempt: the guard must
     # budget against what is LEFT, not the env constant

@@ -161,6 +161,87 @@ def test_tensor_frame_rejects_meta_mismatch():
         b.close()
 
 
+def test_tensor_frame_rejects_object_dtype():
+    """A v2 meta claiming dtype "O" must be refused before any array is
+    allocated: recv_into() on an object array would write attacker
+    bytes straight into PyObject pointer slots."""
+    import numpy as np
+
+    a, b = _socketpair()
+    try:
+        # itemsize of "O" is 8, so 4 x 8 = 32 passes the size check —
+        # only the POD-dtype gate stands between the wire and memory
+        meta = framing._pack_body(
+            {"tree": {framing._ND_REF: 0, "dtype": "O", "shape": [4]},
+             "lens": [4 * np.dtype("O").itemsize]})
+        a.sendall(framing._HEADER.pack(framing.MAGIC_V2, len(meta))
+                  + meta)
+        with pytest.raises(framing.FramingError, match="non-POD"):
+            framing.read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_tensor_frame_recv_failure_surfaces_as_framing_error(monkeypatch):
+    """A non-OSError failure inside the v2 allocation/recv loop leaves
+    unread payload bytes on the socket: it must surface as FramingError
+    (the close-the-socket class) so the connection is never reused
+    desynced."""
+    import numpy as np
+
+    a, b = _socketpair()
+    try:
+        meta = framing._pack_body(
+            {"tree": {framing._ND_REF: 0, "dtype": "<f4", "shape": [4]},
+             "lens": [16]})
+        a.sendall(framing._HEADER.pack(framing.MAGIC_V2, len(meta))
+                  + meta + b"\x00" * 16)
+        monkeypatch.setattr(
+            np, "empty",
+            lambda *a_, **k: (_ for _ in ()).throw(
+                ValueError("allocator hiccup")))
+        with pytest.raises(framing.FramingError, match="recv failed"):
+            framing.read_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_disable_tensor_frames_env_is_read_per_call(monkeypatch):
+    """EDL_TPU_DISABLE_TENSOR_FRAMES is consulted on every write_frame
+    (like the UDS knob), so a long-lived process can be flipped to the
+    v1 wire form — and back — without a restart."""
+    import msgpack
+    import numpy as np
+
+    from edl_tpu.rpc.ndarray import decode_tree
+
+    a, b = _socketpair()
+    try:
+        obj = {"x": np.arange(4, dtype=np.float32)}
+        monkeypatch.setenv("EDL_TPU_DISABLE_TENSOR_FRAMES", "1")
+        t = threading.Thread(target=lambda: framing.write_frame(a, obj))
+        t.start()
+        hdr = framing.recv_exact(b, 8)
+        assert hdr[:4] == framing.MAGIC  # v1 on the wire, post-import
+        body = framing.recv_exact(b, framing._HEADER.unpack(hdr)[1])
+        t.join()
+        out = decode_tree(msgpack.unpackb(body, raw=False))
+        np.testing.assert_array_equal(out["x"], obj["x"])
+
+        monkeypatch.delenv("EDL_TPU_DISABLE_TENSOR_FRAMES")
+        # same process, knob cleared: v2 frames resume immediately
+        t = threading.Thread(target=lambda: framing.write_frame(a, obj))
+        t.start()
+        out = framing.read_frame(b)
+        t.join()
+        np.testing.assert_array_equal(out["x"], obj["x"])
+    finally:
+        a.close()
+        b.close()
+
+
 def test_rpc_call_carries_raw_ndarrays():
     """End to end through RpcServer/RpcClient: raw numpy in, raw numpy
     out (the distill feed path's transport after the r5 v2 upgrade)."""
