@@ -12,10 +12,10 @@ import threading
 from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, leader
 from edl_tpu.controller.resource_pods import load_resource_pods
+from edl_tpu.robustness.policy import Deadline, RetryPolicy
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import errors
-from edl_tpu.utils.errors import handle_errors_until_timeout
 
 
 class BarrierServicer(object):
@@ -143,16 +143,20 @@ class _BarrierSession(object):
 BarrierSession = _BarrierSession
 
 
+# a barrier attempt failing is the EXPECTED state while peers trickle
+# in, so the cadence is a jittered ~fixed interval (multiplier 1), not
+# an exponential backoff that would slow convergence right when the
+# last pod arrives
+_BARRIER_RETRY = RetryPolicy(base_delay=0.5, max_delay=0.75,
+                             multiplier=1.0, jitter=0.4)
+
+
 def barrier_wait(coord, pod_id, timeout=constants.BARRIER_TIMEOUT):
     """Block until every pod of the current cluster has checked in; returns
     the agreed Cluster. Raises TimeoutError_ after ``timeout`` seconds."""
     session = _BarrierSession(coord, pod_id)
-
-    @handle_errors_until_timeout
-    def _once():
-        return session.attempt()
-
     try:
-        return _once(timeout=timeout, interval=0.5)
+        return _BARRIER_RETRY.call(session.attempt,
+                                   deadline=Deadline(timeout))
     finally:
         session.close()

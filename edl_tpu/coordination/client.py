@@ -7,8 +7,8 @@ with add/remove diffing — but speaks to the in-tree Store over framed RPC.
 """
 
 import threading
-import time
 
+from edl_tpu.robustness.policy import Deadline, RetryPolicy
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
@@ -108,6 +108,11 @@ class CoordClient(object):
         # lease-refresh heartbeats issued from other threads
         self._local = threading.local()
         self._ep_lock = threading.Lock()
+        # jittered backoff between rotation rounds: desyncs the herd of
+        # control-plane clients that would otherwise re-dial a dead
+        # primary in lockstep every 0.5s
+        self._retry = RetryPolicy(base_delay=0.25, max_delay=2.0,
+                                  multiplier=2.0, jitter=0.5)
 
     # -- key namespace ------------------------------------------------------
 
@@ -128,8 +133,10 @@ class CoordClient(object):
     # -- transport ----------------------------------------------------------
 
     def _call(self, method, *args, **kwargs):
+        deadline = kwargs.pop("deadline", None)  # caller's Deadline budget
         last = None
-        deadline = None
+        grace = None
+        rounds = 0
         while True:
             # +1: a stale cached connection (severed by a server restart)
             # costs one attempt; the fresh reconnect deserves its own
@@ -141,7 +148,8 @@ class CoordClient(object):
                     rpc = self._local.rpc = RpcClient(
                         endpoint, timeout=self._timeout)
                 try:
-                    return rpc.call(method, *args, **kwargs)
+                    return rpc.call(method, *args, deadline=deadline,
+                                    **kwargs)
                 except errors.ConnectError as e:
                     last = e
                     rpc.close()
@@ -156,12 +164,12 @@ class CoordClient(object):
             # still answers ConnectError. Retrying rotation rounds for
             # a bounded grace keeps control-plane calls alive across
             # the takeover instead of surfacing a transient outage.
-            now = time.monotonic()
-            if deadline is None:
-                deadline = now + self._failover_grace
-            if now >= deadline:
+            rounds += 1
+            if grace is None:
+                grace = Deadline(self._failover_grace)
+            budget = grace if deadline is None else grace.union(deadline)
+            if not self._retry.sleep(rounds, budget):
                 raise last
-            time.sleep(0.5)
 
     # -- raw KV -------------------------------------------------------------
 

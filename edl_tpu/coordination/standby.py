@@ -61,6 +61,8 @@ import time
 
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.coordination.store import Store
+from edl_tpu.robustness import faults
+from edl_tpu.robustness.policy import Deadline, RetryPolicy
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import errors
@@ -101,6 +103,10 @@ class StandbyServer(object):
         self._promote_after = promote_after
         self._sync_poll = sync_poll
         self._witness_endpoints = list(witness_endpoints or [])
+        # one transient witness hiccup must not read as "no
+        # corroboration" and hold back a legitimate promotion forever
+        self._witness_retry = RetryPolicy(max_attempts=2, base_delay=0.2,
+                                          max_delay=0.5, jitter=0.5)
         self._lock = threading.Lock()  # serializes promote vs sync apply
         self._promoted = threading.Event()
         self._stop = threading.Event()
@@ -256,19 +262,30 @@ class StandbyServer(object):
         # full probe budget on EVERY primary endpoint before answering
         call_timeout = (_WITNESS_PROBE_TIMEOUT
                         * max(1, len(self._primary_endpoints)) + 4.0)
+        # one shared budget for the whole corroboration pass so a slow
+        # (or chaos-delayed) witness cannot stall the sync loop for
+        # retries x witnesses x timeout
+        budget = Deadline((call_timeout + 1.0)
+                          * max(1, len(self._witness_endpoints)))
         for ep in self._witness_endpoints:
             try:
-                w = RpcClient(ep, timeout=call_timeout)
-                try:
-                    r = w.call("witness_probe", self._primary_endpoints)
-                finally:
-                    w.close()
+                r = self._witness_retry.call(
+                    self._probe_witness, ep, call_timeout, deadline=budget)
                 answers += 1
                 if r.get("reachable"):
                     return False
             except errors.EdlError:
                 continue
         return answers > 0
+
+    def _probe_witness(self, ep, call_timeout):
+        if faults.PLANE is not None:
+            faults.PLANE.fire("standby.witness.probe", endpoint=ep)
+        w = RpcClient(ep, timeout=call_timeout)
+        try:
+            return w.call("witness_probe", self._primary_endpoints)
+        finally:
+            w.close()
 
     def promote(self):
         """Take over: revision floor above anything the primary issued,

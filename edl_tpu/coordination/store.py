@@ -19,6 +19,7 @@ import threading
 import time
 from collections import deque
 
+from edl_tpu.robustness import faults
 from edl_tpu.utils.logger import logger
 
 
@@ -206,6 +207,12 @@ class Store(object):
                     _, _, keys = self._leases.pop(lid)
                     for k in list(keys):
                         self._delete_locked(k)
+            if dead and faults.PLANE is not None:
+                # observation/delay point (fired OUTSIDE the lock: a
+                # delay here models a slow expiry sweep, not a wedged
+                # store)
+                faults.PLANE.fire("store.lease.expire", lease_ids=dead)
+            with self._lock:
                 # watermark the current revision so a restart can seed
                 # above it even when recent ops were unlogged lease traffic
                 if self._wal is not None and self._rev > self._wal_watermark:
@@ -243,6 +250,8 @@ class Store(object):
                 self._sync_locked()
 
     def lease_grant(self, ttl):
+        if faults.PLANE is not None:
+            faults.PLANE.fire("store.lease.grant", ttl=ttl)
         with self._lock:
             lid = self._next_lease
             self._next_lease += 1
@@ -251,6 +260,13 @@ class Store(object):
 
     def lease_refresh(self, lease_id):
         """Extend the lease by its ttl; False if already expired/unknown."""
+        if faults.PLANE is not None:
+            f = faults.PLANE.fire("store.lease.refresh", lease_id=lease_id)
+            if f is not None and f.kind == "drop":
+                # the refresh vanishes: the owner is told its lease is
+                # gone and must re-register (the expiry drill), while
+                # the sweeper will still expire the keys on schedule
+                return False
         with self._lock:
             lease = self._leases.get(lease_id)
             if lease is None:
@@ -359,6 +375,12 @@ class Store(object):
         since_rev has fallen out of the history window, returns a single
         synthetic {"type": "reset"} event — the watcher should re-list.
         """
+        if faults.PLANE is not None:
+            f = faults.PLANE.fire("store.watch.deliver", prefix=prefix)
+            if f is not None and f.kind == "drop":
+                # delivery dropped: look like a timed-out long-poll; the
+                # watcher keeps its position and polls again
+                return [], since_rev
         deadline = time.monotonic() + timeout
         with self._lock:
             while True:

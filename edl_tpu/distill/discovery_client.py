@@ -8,10 +8,11 @@ client uuid :184).
 
 import os
 import threading
-import time
 import uuid
 
 from edl_tpu.distill import discovery_server as ds
+from edl_tpu.robustness import faults
+from edl_tpu.robustness.policy import Deadline, RetryPolicy
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
@@ -36,6 +37,15 @@ class DiscoveryClient(object):
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
+        # backoff for re-register attempts after a failed heartbeat;
+        # capped at the heartbeat interval so a recovered discovery
+        # server is re-joined within one period
+        self._reconnect = RetryPolicy(base_delay=min(0.5,
+                                                     heartbeat_interval),
+                                      max_delay=heartbeat_interval,
+                                      jitter=0.5)
+        self._poll = RetryPolicy(base_delay=0.2, max_delay=1.0,
+                                 multiplier=1.5, jitter=0.5)
 
     # -- wire helpers -----------------------------------------------------------
 
@@ -74,11 +84,13 @@ class DiscoveryClient(object):
         return self
 
     def _heartbeat_loop(self):
+        failures = 0
         while not self._stop.wait(self._interval):
             try:
                 resp = self._rpc.call("heartbeat", self.client_id,
                                       self._service, self._version)
                 code = resp.get("code")
+                failures = 0
                 if code == ds.CODE_REDIRECT:
                     self._connect(resp["endpoint"])
                     self._register()
@@ -96,21 +108,31 @@ class DiscoveryClient(object):
                 try:
                     self._register()
                 except errors.EdlError:
-                    time.sleep(self._interval)
+                    failures += 1
+                    self._reconnect.sleep(failures)
 
     def get_servers(self):
+        if faults.PLANE is not None:
+            # chaos: a "drop" here makes the whole teacher fleet vanish
+            # from this client's view (endpoint flap drills)
+            f = faults.PLANE.fire("distill.discovery",
+                                  service=self._service)
+            if f is not None:
+                return []
         with self._lock:
             return list(self._servers)
 
     def wait_for_servers(self, timeout=60):
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = Deadline(timeout)
+        attempt = 0
+        while True:
             servers = self.get_servers()
             if servers:
                 return servers
-            time.sleep(0.2)
-        raise errors.TimeoutError_("no teachers discovered within %ss"
-                                   % timeout)
+            attempt += 1
+            if not self._poll.sleep(attempt, deadline):
+                raise errors.TimeoutError_(
+                    "no teachers discovered within %ss" % timeout)
 
     def stop(self):
         self._stop.set()
@@ -136,6 +158,12 @@ class FixedDiscover(object):
         return self
 
     def get_servers(self):
+        if faults.PLANE is not None:
+            # same flap drill as the dynamic client: fixed fleets are
+            # what chaos tests usually stand up
+            f = faults.PLANE.fire("distill.discovery", service="fixed")
+            if f is not None:
+                return []
         return list(self._endpoints)
 
     def wait_for_servers(self, timeout=0):

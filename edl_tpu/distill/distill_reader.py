@@ -27,6 +27,7 @@ import time
 import numpy as np
 
 from edl_tpu.distill.discovery_client import DiscoveryClient, FixedDiscover
+from edl_tpu.robustness.policy import CircuitBreaker
 from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors, timeline
@@ -67,7 +68,6 @@ class DistillReader(object):
         self._ins = list(ins)
         self._predicts = list(predicts)
         self._max_in_flight = max_in_flight
-        self._backoff = teacher_backoff
 
         self._gen = None
         self._gen_kind = None
@@ -78,7 +78,12 @@ class DistillReader(object):
         self._results_cond = threading.Condition()
         self._stop = threading.Event()
         self._workers = {}          # endpoint -> (thread, stop_event)
-        self._recent_failures = {}  # endpoint -> timestamp
+        # per-teacher circuit breaker (replaces an ad-hoc timestamp map
+        # that grew without bound as teacher endpoints churned): one
+        # failure opens the circuit for ``teacher_backoff`` seconds,
+        # then a single half-open probe worker decides recovery
+        self._breaker = CircuitBreaker(failure_threshold=1,
+                                       reset_timeout=teacher_backoff)
         self._inflight = {}         # endpoint -> task currently being predicted
         self._inflight_lock = threading.Lock()
         self._manager = None
@@ -133,7 +138,9 @@ class DistillReader(object):
 
     def _sync_workers(self):
         want = set(self._discover.get_servers())
-        now = time.monotonic()
+        # breaker state only for teachers that still exist: endpoint
+        # churn must not grow the map without bound
+        self._breaker.prune(want)
         # drop workers whose teacher disappeared; requeue anything a dead
         # worker was still holding so no task is ever lost
         for ep in list(self._workers):
@@ -148,11 +155,12 @@ class DistillReader(object):
                     logger.warning("requeueing task %d orphaned by dead "
                                    "worker %s", orphan[1], ep)
                     self._in_q.put(orphan)
-        # start workers for new teachers (respecting failure backoff)
+        # start workers for new teachers; an open circuit (recent
+        # failure) gates the endpoint until its half-open probe window
         for ep in want:
             if ep in self._workers:
                 continue
-            if now - self._recent_failures.get(ep, -1e9) < self._backoff:
+            if not self._breaker.allow(ep):
                 continue
             stop_ev = threading.Event()
             thread = threading.Thread(
@@ -166,7 +174,7 @@ class DistillReader(object):
             conn = _TeacherConn(endpoint)
         except errors.EdlError as e:
             logger.warning("teacher %s unreachable: %r", endpoint, e)
-            self._recent_failures[endpoint] = time.monotonic()
+            self._breaker.record_failure(endpoint)
             return
         logger.info("distill worker up for teacher %s", endpoint)
         tl = timeline.get_timeline()
@@ -189,10 +197,11 @@ class DistillReader(object):
                 logger.warning("teacher %s failed task %d (%r); requeueing",
                                endpoint, task_id, e)
                 self._in_q.put(task)
-                self._recent_failures[endpoint] = time.monotonic()
+                self._breaker.record_failure(endpoint)
                 break
             with self._inflight_lock:
                 self._inflight.pop(endpoint, None)
+            self._breaker.record_success(endpoint)
             with self._results_cond:
                 self._results[(epoch, task_id)] = (payload, preds)
                 self._results_cond.notify_all()

@@ -14,6 +14,7 @@ only membership/rank agreement is needed.
 import threading
 import time
 
+from edl_tpu.robustness.policy import Deadline, RetryPolicy
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
@@ -49,6 +50,11 @@ class ElasticManager(object):
         # a change against THIS (the initial registration listing would
         # otherwise race wait() and fire a spurious RESTART)
         self._agreed_hosts = None
+        # jittered membership poll: on a full pod restart every node
+        # enters wait() at once, and a fixed interval would hammer the
+        # store in lockstep
+        self._poll = RetryPolicy(base_delay=0.2, max_delay=1.0,
+                                 multiplier=1.5, jitter=0.5)
 
         if self._coord.get_value(SERVICE_CONF, NP_KEY) is None:
             self._coord.set_server_permanent(SERVICE_CONF, NP_KEY,
@@ -96,8 +102,18 @@ class ElasticManager(object):
 
     def _on_conf(self, added, removed, all_servers):
         np_val = all_servers.get(NP_KEY)
-        if np_val is not None and int(np_val) != self._np:
-            self._np = int(np_val)
+        if np_val is None:
+            return
+        try:
+            np_int = int(np_val)
+        except (TypeError, ValueError):
+            # a malformed np must not raise here: the exception would
+            # silently kill the watch thread and freeze the scale signal
+            logger.warning("liveft: ignoring malformed np value %r",
+                           np_val)
+            return
+        if np_int != self._np:
+            self._np = np_int
             self._np_changed.set()
 
     # -- the public protocol ----------------------------------------------
@@ -109,16 +125,19 @@ class ElasticManager(object):
     def wait(self, timeout=600):
         """Block until the registered host count equals np; returns ranked
         host list (this host's rank = index)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        deadline = Deadline(timeout)
+        attempt = 0
+        while True:
             hosts = self.hosts()
             if len(hosts) == self._np:
                 self._agreed_hosts = hosts
                 self._hosts_changed.clear()
                 return hosts
-            time.sleep(0.5)
-        raise errors.TimeoutError_("liveft: %d/%d hosts after %ss"
-                                   % (len(self.hosts()), self._np, timeout))
+            attempt += 1
+            if not self._poll.sleep(attempt, deadline):
+                raise errors.TimeoutError_(
+                    "liveft: %d/%d hosts after %ss"
+                    % (len(self.hosts()), self._np, timeout))
 
     def set_np(self, np_target):
         """Scale signal: update the shared world-size target."""

@@ -9,6 +9,7 @@ import os
 import socket
 import threading
 
+from edl_tpu.robustness import faults
 from edl_tpu.rpc import framing
 from edl_tpu.utils import errors
 
@@ -39,11 +40,16 @@ def _local_hosts():
 
 
 class RpcClient(object):
-    def __init__(self, endpoint, timeout=60.0):
+    def __init__(self, endpoint, timeout=60.0, retry=None):
+        """``retry``: an optional robustness.policy.RetryPolicy; when
+        set, calls marked ``idempotent=True`` (and any call that failed
+        before its request hit the wire) reconnect and retry with
+        jittered backoff instead of failing fast."""
         host, port = endpoint.rsplit(":", 1)
         self._addr = (host, int(port))
         self.endpoint = endpoint
         self._timeout = timeout
+        self._retry = retry
         self._sock = None
         self._ids = itertools.count()
         self._lock = threading.Lock()
@@ -82,6 +88,14 @@ class RpcClient(object):
 
     def _connect(self):
         if self._sock is None:
+            if faults.PLANE is not None:
+                # partition/error/delay on the dial path (site kinds
+                # degrade to "unreachable")
+                f = faults.PLANE.fire("rpc.client.connect",
+                                      endpoint=self.endpoint)
+                if f is not None:
+                    raise errors.ConnectError(
+                        "fault: connect to %s cut" % self.endpoint)
             sock = self._try_uds()
             if sock is not None:
                 self._sock = sock
@@ -108,15 +122,69 @@ class RpcClient(object):
         with self._lock:
             self._close_locked()
 
-    def call(self, method, *args, timeout=None, **kwargs):
-        """Invoke ``method`` remotely; one in-flight request per client."""
+    def call(self, method, *args, timeout=None, deadline=None,
+             idempotent=False, **kwargs):
+        """Invoke ``method`` remotely; one in-flight request per client.
+
+        ``deadline``: an optional robustness.policy.Deadline — the
+        caller's remaining budget caps this call's socket timeout, so a
+        nested call chain can never outlive its outermost budget.
+        ``idempotent``: with a retry policy configured, lets this call
+        be re-sent after a transport failure even though the original
+        request may have reached the server.
+        """
+        if self._retry is None:
+            return self._call_once(method, args, kwargs, timeout, deadline)
+        attempt = 0
+        while True:
+            attempt += 1
+            if deadline is not None:
+                deadline.check("rpc %s to %s" % (method, self.endpoint))
+            wrote = [False]
+            try:
+                return self._call_once(method, args, kwargs, timeout,
+                                       deadline, wrote)
+            except errors.ConnectError as e:
+                # a request that never hit the wire is always safe to
+                # retry; one that did is only safe if idempotent
+                if not (idempotent or not wrote[0]):
+                    raise
+                if not self._retry.sleep(attempt, deadline):
+                    if deadline is not None and deadline.expired():
+                        raise errors.DeadlineExceededError(
+                            "rpc %s to %s: deadline exceeded after %d "
+                            "attempts; last error: %r"
+                            % (method, self.endpoint, attempt, e)) from e
+                    raise
+
+    def _call_once(self, method, args, kwargs, timeout, deadline,
+                   wrote=None):
         with self._lock:
             self._connect()
+            if faults.PLANE is not None:
+                f = faults.PLANE.fire("rpc.client.call",
+                                      endpoint=self.endpoint, method=method)
+                if f is not None:
+                    # a dropped request manifests to the caller as a
+                    # timed-out connection
+                    self._close_locked()
+                    raise errors.ConnectError(
+                        "rpc %s to %s failed: fault: request dropped"
+                        % (method, self.endpoint))
             req = {"id": next(self._ids), "method": method,
                    "args": list(args), "kwargs": kwargs}
             try:
-                self._sock.settimeout(timeout or self._timeout)
+                budget = timeout or self._timeout
+                if deadline is not None:
+                    budget = deadline.remaining(cap=budget)
+                    if budget is not None and budget <= 0:
+                        raise errors.DeadlineExceededError(
+                            "rpc %s to %s: no budget left"
+                            % (method, self.endpoint))
+                self._sock.settimeout(budget)
                 framing.write_frame(self._sock, req)
+                if wrote is not None:
+                    wrote[0] = True
                 resp = framing.read_frame(self._sock)
             except (OSError, ConnectionError, framing.FramingError) as e:
                 # already holding self._lock — must NOT re-enter close()

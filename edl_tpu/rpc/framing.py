@@ -13,6 +13,8 @@ import socket
 
 import msgpack
 
+from edl_tpu.robustness import faults
+
 MAGIC = b"\xed\x17\x00\x01"
 # v2 "tensor frame": ndarrays are stripped out of the msgpack body and
 # shipped as RAW out-of-band segments vectored into the same sendmsg
@@ -62,7 +64,31 @@ def _recv_into(sock, view):
         view = view[n:]
 
 
+def _apply_write_fault(fault, sock):
+    """Site handler for rpc.frame.write chaos; True = frame consumed."""
+    if fault.kind == "drop":
+        return True  # silently swallowed: the peer waits until timeout
+    if fault.kind == "corrupt":
+        # a garbage magic makes the receiver fail the frame cleanly
+        # (FramingError) instead of misparsing bytes
+        sock.sendall(_HEADER.pack(b"\xde\xad\x00\x00", 0))
+        return True
+    if fault.kind == "half_close":
+        try:
+            sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        return True
+    return False
+
+
 def read_frame(sock):
+    if faults.PLANE is not None:
+        f = faults.PLANE.fire("rpc.frame.read")
+        if f is not None:
+            # every site kind on the read side degrades to "this
+            # connection just died under us"
+            raise ConnectionError("fault: frame lost at rpc.frame.read")
     header = recv_exact(sock, _HEADER.size)
     magic, length = _HEADER.unpack(header)
     if magic not in (MAGIC, MAGIC_V2):
@@ -237,6 +263,10 @@ def write_frame(sock, obj):
     # measurable on the distill feed path (NOTES r5 distill curve).
     # sendmsg ships all segments in ONE syscall with no copy; it may
     # short-write, so drain any remainder without re-copying.
+    if faults.PLANE is not None:
+        f = faults.PLANE.fire("rpc.frame.write")
+        if f is not None and _apply_write_fault(f, sock):
+            return
     bufs = []
     disabled = _v2_disabled()
     if not disabled and _has_arrays(obj):

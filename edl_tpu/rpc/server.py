@@ -12,6 +12,7 @@ import socket
 import socketserver
 import threading
 
+from edl_tpu.robustness import faults
 from edl_tpu.rpc import framing
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
@@ -34,6 +35,12 @@ class _Handler(socketserver.BaseRequestHandler):
 
     def handle(self):
         framing.set_keepalive(self.request)
+        if faults.PLANE is not None:
+            # accept-path chaos: a drop here severs the fresh connection
+            # before any request is served (error/delay act in fire())
+            f = faults.PLANE.fire("rpc.server.conn")
+            if f is not None:
+                return
         while True:
             try:
                 req = framing.read_frame(self.request)
@@ -42,6 +49,13 @@ class _Handler(socketserver.BaseRequestHandler):
             resp = {"id": req.get("id")}
             try:
                 method = req["method"]
+                if faults.PLANE is not None:
+                    # inside the try: an injected error comes back to the
+                    # client as a typed error envelope for that method
+                    f = faults.PLANE.fire("rpc.server.request",
+                                          method=method)
+                    if f is not None and f.kind == "drop":
+                        continue  # swallow: the client waits until timeout
                 fn = self.server.methods.get(method)
                 if fn is None:
                     raise errors.RpcError("no such method: %s" % method)

@@ -5,7 +5,6 @@ by class name) and edl/utils/error_utils.py:22-39 (@handle_errors_until_timeout)
 """
 
 import functools
-import time
 
 
 class EdlError(Exception):
@@ -85,6 +84,16 @@ class TimeoutError_(EdlError):
     """Raised when handle_errors_until_timeout gives up."""
 
 
+class DeadlineExceededError(TimeoutError_):
+    """A Deadline budget (edl_tpu.robustness.policy) ran out. Subclass
+    of TimeoutError_ so existing timeout handling catches it."""
+
+
+class CircuitOpenError(EdlError):
+    """A CircuitBreaker is open for the target endpoint; the call was
+    refused without touching the wire."""
+
+
 class PreemptedError(EdlError):
     """The trainer was preempted (SIGTERM) and saved an emergency
     checkpoint; the process should exit so the restart resumes from it."""
@@ -127,21 +136,27 @@ def handle_errors_until_timeout(func):
 
     @functools.wraps(func)
     def wrapper(*args, **kwargs):
+        # local import: robustness.policy imports this module
+        from edl_tpu.robustness.policy import Deadline, RetryPolicy
         timeout = kwargs.pop("timeout")
         interval = kwargs.pop("interval", 1.0)
-        deadline = time.monotonic() + timeout
-        last = None
+        policy = RetryPolicy(base_delay=interval, max_delay=interval,
+                             multiplier=1.0, jitter=0.25)
+        deadline = Deadline(timeout)
+        attempt = 0
         while True:
+            attempt += 1
             try:
                 return func(*args, **kwargs)
             except StopError:
                 raise
             except EdlError as e:
-                last = e
-                if time.monotonic() >= deadline:
+                if deadline.expired():
                     raise TimeoutError_(
                         "%s timed out after %ss; last error: %r"
-                        % (func.__name__, timeout, last))
-                time.sleep(min(interval, max(0.0, deadline - time.monotonic())))
+                        % (func.__name__, timeout, e))
+                # clipped to the remaining budget; one final attempt
+                # runs after the last (possibly shortened) backoff
+                policy.sleep(attempt, deadline)
 
     return wrapper

@@ -1,0 +1,36 @@
+"""Tier-1 wiring for tools/check_no_ad_hoc_retries.py: a NEW raw
+``time.sleep`` retry loop in a control-plane module fails the build —
+edl_tpu.robustness.policy (RetryPolicy/Deadline) is the sanctioned way
+to wait for anything that can fail."""
+
+import ast
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_no_ad_hoc_retries.py")
+
+
+def test_no_new_ad_hoc_retry_loops():
+    out = subprocess.run([sys.executable, TOOL], capture_output=True,
+                         text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_lint_actually_detects_retry_loops():
+    """The lint must not be a rubber stamp: it flags a synthetic
+    hand-rolled retry loop in both spelling variants."""
+    sys.path.insert(0, os.path.dirname(TOOL))
+    try:
+        import check_no_ad_hoc_retries as lint
+    finally:
+        sys.path.pop(0)
+    f = lint._Finder("x.py")
+    f.visit(ast.parse(
+        "import time\ndef f():\n    while True:\n        time.sleep(1)\n"))
+    assert f.hits == [("x.py", "f", 4)]
+    g = lint._Finder("y.py")
+    g.visit(ast.parse(
+        "from time import sleep as zz\nfor i in range(3):\n    zz(1)\n"))
+    assert g.hits == [("y.py", "<module>", 3)]

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Lint: no NEW ad-hoc retry loops in the control plane.
+
+A raw ``time.sleep`` inside a ``while``/``for`` body in a control-plane
+module is almost always a hand-rolled retry/poll loop — exactly the
+pattern ``edl_tpu.robustness.policy`` (RetryPolicy + Deadline) exists to
+replace: unjittered sleeps synchronize across a fleet, and loops without
+a shared budget produce unbounded total latency.
+
+Pre-existing sites are grandfathered in ALLOWLIST, keyed by
+``(relative path, enclosing function)`` so ordinary line drift does not
+churn the list. Adding a NEW raw sleep-in-loop fails this lint (it runs
+as a tier-1 test, tests/test_no_ad_hoc_retries.py); either use
+RetryPolicy/Deadline, or — for a genuine non-retry pause (shutdown
+grace, subprocess startup) — add the site to ALLOWLIST with a short
+justification.
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGES = ("rpc", "coordination", "distill", "liveft", "controller")
+
+# (relpath, enclosing function) -> why the raw sleep-in-loop is OK
+ALLOWLIST = {
+    ("edl_tpu/controller/launcher.py", "_join_cluster"):
+        "scale-in wait ticking at GENERATE_INTERVAL; paced by the "
+        "generator's publish cadence, not by error recovery",
+    ("edl_tpu/controller/launcher.py", "_barrier_sliced"):
+        "abortable barrier slice: the poll IS the contract (checks the "
+        "job verdict between slices); jitter would delay abort detection",
+    ("edl_tpu/controller/launcher.py", "_supervise"):
+        "supervision tick at SUPERVISE_INTERVAL, not a retry",
+    ("edl_tpu/controller/launcher.py", "_leader_wait_and_finalize"):
+        "verdict-collection poll with a hard outer deadline",
+    ("edl_tpu/coordination/native.py", "start"):
+        "one-shot binary startup wait with its own hard deadline",
+    ("edl_tpu/liveft/launch.py", "stop"):
+        "SIGTERM->SIGKILL shutdown grace period, not a retry",
+    ("edl_tpu/distill/registry.py", "main"):
+        "CLI keep-alive loop (sleeps forever by design)",
+}
+
+
+def _is_time_sleep(call, time_aliases, sleep_aliases):
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "sleep" \
+            and isinstance(f.value, ast.Name) \
+            and f.value.id in time_aliases:
+        return True
+    return isinstance(f, ast.Name) and f.id in sleep_aliases
+
+
+class _Finder(ast.NodeVisitor):
+    def __init__(self, relpath):
+        self.relpath = relpath
+        self.hits = []  # (relpath, func, lineno)
+        self._func = ["<module>"]
+        self._loops = 0
+        self.time_aliases = {"time"}
+        self.sleep_aliases = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            if a.name == "time":
+                self.time_aliases.add(a.asname or "time")
+
+    def visit_ImportFrom(self, node):
+        if node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    self.sleep_aliases.add(a.asname or "sleep")
+
+    def _in_func(self, node):
+        self._func.append(node.name)
+        self.generic_visit(node)
+        self._func.pop()
+
+    visit_FunctionDef = _in_func
+    visit_AsyncFunctionDef = _in_func
+
+    def _in_loop(self, node):
+        self._loops += 1
+        self.generic_visit(node)
+        self._loops -= 1
+
+    visit_While = _in_loop
+    visit_For = _in_loop
+
+    def visit_Call(self, node):
+        if self._loops and _is_time_sleep(node, self.time_aliases,
+                                          self.sleep_aliases):
+            self.hits.append((self.relpath, self._func[-1], node.lineno))
+        self.generic_visit(node)
+
+
+def scan():
+    hits = []
+    for pkg in PACKAGES:
+        root = os.path.join(REPO, "edl_tpu", pkg)
+        for dirpath, _, files in os.walk(root):
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                relpath = os.path.relpath(path, REPO)
+                with open(path) as f:
+                    tree = ast.parse(f.read(), filename=relpath)
+                finder = _Finder(relpath)
+                finder.visit(tree)
+                hits.extend(finder.hits)
+    return hits
+
+
+def main():
+    violations = [(rel, func, line) for rel, func, line in scan()
+                  if (rel, func) not in ALLOWLIST]
+    stale = sorted(set(ALLOWLIST)
+                   - {(rel, func) for rel, func, _ in scan()})
+    if stale:
+        print("stale ALLOWLIST entries (site no longer exists — remove "
+              "them):")
+        for rel, func in stale:
+            print("  %s :: %s" % (rel, func))
+    if violations:
+        print("ad-hoc retry loops (raw time.sleep inside a loop) in "
+              "control-plane modules:")
+        for rel, func, line in violations:
+            print("  %s:%d in %s()" % (rel, line, func))
+        print("use edl_tpu.robustness.policy (RetryPolicy/Deadline) "
+              "instead, or allowlist a genuine non-retry pause in "
+              "tools/check_no_ad_hoc_retries.py with a justification.")
+    if violations or stale:
+        return 1
+    print("ok: no ad-hoc retry loops outside the allowlist")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
