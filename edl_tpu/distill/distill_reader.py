@@ -18,8 +18,19 @@ threads overlap fine):
   (:435-506) is expressed here with per-epoch fed/done counters;
 - results are re-ordered by task id so the student sees its batches in the
   original order (reference fetch_out :720-769).
+
+Pipelining: each worker keeps up to ``pipeline_depth`` predicts in
+flight on its connection via ``RpcClient.call_async`` (and an oversized
+batch's max_batch chunks ride the same pipeline), so the wire streams
+the next feeds while the teacher's device computes the current batch —
+the overlap the zero-copy v2 tensor frames were built for. Depth falls
+back to 1 against a teacher that does not advertise ``rpc.pipeline`` in
+``get_feed_fetch``. On a connection failure every in-flight task is
+requeued (the per-endpoint in-flight registry holds the full set, not
+just one task), so the delivery guarantee is unchanged.
 """
 
+import collections
 import queue
 import threading
 import time
@@ -33,41 +44,82 @@ from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors, timeline
 from edl_tpu.utils.logger import logger
 
+#: sentinel payload marking a result slot that carries a permanent
+#: per-task error instead of predictions (raised to the consumer in
+#: order, so a poisoned batch cannot requeue forever)
+_TASK_ERROR = object()
+
+
+class _PredictFuture(object):
+    """All chunk replies of one logical predict; ``result()`` joins."""
+
+    __slots__ = ("_futs",)
+
+    def __init__(self, futs):
+        self._futs = futs
+
+    def result(self):
+        # raw arrays rode the v2 tensor frame (out-of-band zero-copy
+        # segments); decode_tree is a no-op on the already-decoded
+        # reply but keeps pre-v2 peers working
+        outs = [nd.decode_tree(f.result()) for f in self._futs]
+        if len(outs) == 1:
+            return outs[0]
+        return {k: np.concatenate([o[k] for o in outs], axis=0)
+                for k in outs[0]}
+
 
 class _TeacherConn(object):
     """One connection to one teacher; splits oversized batches to the
     teacher's compiled max_batch."""
 
-    def __init__(self, endpoint):
+    def __init__(self, endpoint, timeout=60.0):
         self.endpoint = endpoint
-        self._rpc = RpcClient(endpoint, timeout=60)
+        self._rpc = RpcClient(endpoint, timeout=timeout)
         spec = self._rpc.call("get_feed_fetch")
         self.max_batch = spec.get("max_batch", 64)
         self.fetch_names = list(spec.get("fetch", {}))
+        self.features = tuple(spec.get("features", ()))
+        self.pipelined = "rpc.pipeline" in self.features
 
-    def predict(self, feed):
+    def predict_async(self, feed):
+        """Issue one logical predict; oversized feeds are split into
+        max_batch chunks that are ALL sent before any reply is awaited,
+        so a 4-chunk batch costs ~1 round trip instead of 4."""
+        if not feed:
+            raise errors.DataAccessError("empty feed: no input arrays")
         n = len(next(iter(feed.values())))
-        outs = []
+        if n == 0:
+            # fail fast client-side: the teacher would reject it anyway,
+            # and an empty chunk list used to IndexError in the join
+            raise errors.DataAccessError("empty feed: zero-row batch")
+        futs = []
         for lo in range(0, n, self.max_batch):
             chunk = {k: v[lo:lo + self.max_batch] for k, v in feed.items()}
-            # raw arrays ride the v2 tensor frame (out-of-band
-            # zero-copy segments); decode_tree is a no-op on the
-            # already-decoded reply but keeps pre-v2 peers working
-            outs.append(nd.decode_tree(
-                self._rpc.call("predict", chunk)))
-        return {k: np.concatenate([o[k] for o in outs], axis=0)
-                for k in outs[0]}
+            futs.append(self._rpc.call_async("predict", chunk))
+        return _PredictFuture(futs)
+
+    def predict(self, feed):
+        return self.predict_async(feed).result()
 
     def close(self):
         self._rpc.close()
 
 
 class DistillReader(object):
+    """``pipeline_depth``: predicts kept in flight per teacher
+    connection (1 = the pre-pipelining lockstep behavior; also forced
+    to 1 when the teacher doesn't advertise ``rpc.pipeline``).
+    ``predict_timeout``: per-RPC deadline for one predict chunk."""
+
     def __init__(self, ins, predicts, max_in_flight=8,
-                 teacher_backoff=5.0):
+                 teacher_backoff=5.0, pipeline_depth=4,
+                 predict_timeout=60.0):
         self._ins = list(ins)
         self._predicts = list(predicts)
         self._max_in_flight = max_in_flight
+        self._pipeline_depth = max(1, int(pipeline_depth))
+        self._predict_timeout = predict_timeout
 
         self._gen = None
         self._gen_kind = None
@@ -84,7 +136,7 @@ class DistillReader(object):
         # then a single half-open probe worker decides recovery
         self._breaker = CircuitBreaker(failure_threshold=1,
                                        reset_timeout=teacher_backoff)
-        self._inflight = {}         # endpoint -> task currently being predicted
+        self._inflight = {}         # endpoint -> [tasks being predicted]
         self._inflight_lock = threading.Lock()
         self._manager = None
         self._started = False
@@ -150,8 +202,8 @@ class DistillReader(object):
             if not thread.is_alive():
                 del self._workers[ep]
                 with self._inflight_lock:
-                    orphan = self._inflight.pop(ep, None)
-                if orphan is not None:
+                    orphans = self._inflight.pop(ep, None) or []
+                for orphan in orphans:
                     logger.warning("requeueing task %d orphaned by dead "
                                    "worker %s", orphan[1], ep)
                     self._in_q.put(orphan)
@@ -169,42 +221,106 @@ class DistillReader(object):
             thread.start()
             self._workers[ep] = (thread, stop_ev)
 
+    # -- the per-teacher worker --------------------------------------------------
+
+    def _track(self, endpoint, task, add):
+        with self._inflight_lock:
+            tasks = self._inflight.setdefault(endpoint, [])
+            if add:
+                tasks.append(task)
+            else:
+                try:
+                    tasks.remove(task)
+                except ValueError:
+                    pass  # already handed to _sync_workers' requeue
+
+    def _post_result(self, epoch, task_id, payload, preds):
+        with self._results_cond:
+            self._results[(epoch, task_id)] = (payload, preds)
+            self._results_cond.notify_all()
+
+    def _fill_pipeline(self, conn, endpoint, pending, depth):
+        """Issue predicts until ``depth`` are in flight or the task
+        queue is (momentarily) empty. Returns False when the
+        connection failed and the worker must retire."""
+        while len(pending) < depth:
+            try:
+                # block only when idle; with work in flight just top up
+                task = self._in_q.get(timeout=0.0 if pending else 0.2)
+            except queue.Empty:
+                return True
+            epoch, task_id, feed, payload = task
+            if epoch != self._epoch:  # stale task from an abandoned epoch
+                continue
+            self._track(endpoint, task, add=True)
+            try:
+                fut = conn.predict_async(feed)
+            except errors.DataAccessError as e:
+                # the task itself is poisoned (empty/malformed feed):
+                # requeueing would ping-pong it between teachers forever,
+                # so surface it to the consumer in order
+                self._track(endpoint, task, add=False)
+                self._post_result(epoch, task_id, _TASK_ERROR, e)
+            except Exception as e:  # noqa: BLE001 — transport: requeue
+                self._track(endpoint, task, add=False)
+                logger.warning("teacher %s failed task %d (%r); "
+                               "requeueing", endpoint, task_id, e)
+                self._in_q.put(task)
+                self._breaker.record_failure(endpoint)
+                return False
+            else:
+                pending.append((task, fut))
+        return True
+
     def _predict_loop(self, endpoint, stop_ev):
         try:
-            conn = _TeacherConn(endpoint)
+            conn = _TeacherConn(endpoint, timeout=self._predict_timeout)
         except errors.EdlError as e:
             logger.warning("teacher %s unreachable: %r", endpoint, e)
             self._breaker.record_failure(endpoint)
             return
-        logger.info("distill worker up for teacher %s", endpoint)
+        # feature negotiation: a pre-pipelining teacher gets lockstep
+        # depth 1 — exactly the old strict call/response traffic
+        depth = self._pipeline_depth if conn.pipelined else 1
+        logger.info("distill worker up for teacher %s (depth=%d)",
+                    endpoint, depth)
         tl = timeline.get_timeline()
+        pending = collections.deque()  # (task, _PredictFuture) in flight
+        ok = True
         while not (stop_ev.is_set() or self._stop.is_set()):
-            try:
-                task = self._in_q.get(timeout=0.2)
-            except queue.Empty:
+            if not self._fill_pipeline(conn, endpoint, pending, depth):
+                ok = False
+                break
+            if not pending:
                 continue
+            task, fut = pending.popleft()
             epoch, task_id, feed, payload = task
-            if epoch != self._epoch:  # stale task from an abandoned epoch
-                continue
-            with self._inflight_lock:
-                self._inflight[endpoint] = task
             try:
                 with tl.span("predict@%s" % endpoint):
-                    preds = conn.predict(feed)
-            except Exception as e:  # noqa: BLE001 — ANY failure requeues
-                with self._inflight_lock:
-                    self._inflight.pop(endpoint, None)
+                    preds = fut.result()
+            except errors.DataAccessError as e:
+                self._track(endpoint, task, add=False)
+                self._post_result(epoch, task_id, _TASK_ERROR, e)
+                continue
+            except Exception as e:  # noqa: BLE001 — transport: requeue
+                self._track(endpoint, task, add=False)
                 logger.warning("teacher %s failed task %d (%r); requeueing",
                                endpoint, task_id, e)
                 self._in_q.put(task)
                 self._breaker.record_failure(endpoint)
+                ok = False
                 break
-            with self._inflight_lock:
-                self._inflight.pop(endpoint, None)
+            self._track(endpoint, task, add=False)
             self._breaker.record_success(endpoint)
-            with self._results_cond:
-                self._results[(epoch, task_id)] = (payload, preds)
-                self._results_cond.notify_all()
+            self._post_result(epoch, task_id, payload, preds)
+        # a dead connection fails every in-flight future, so anything
+        # still pending is requeued here, not lost (requeue-safe drain)
+        for task, _ in pending:
+            self._track(endpoint, task, add=False)
+            if ok:
+                logger.warning("requeueing task %d in flight at worker "
+                               "%s retirement", task[1], endpoint)
+            self._in_q.put(task)
         conn.close()
         logger.info("distill worker for %s retired", endpoint)
 
@@ -253,7 +369,7 @@ class DistillReader(object):
         with self._results_cond:
             self._results.clear()
         sem = threading.Semaphore(self._max_in_flight)
-        fed = {"n": 0, "done_feeding": False}
+        fed = {"n": 0, "done_feeding": False, "error": None}
 
         def feeder():
             try:
@@ -264,6 +380,10 @@ class DistillReader(object):
                     sem.acquire()
                     fed["n"] = task_id + 1
                     self._in_q.put((epoch, task_id, feed, payload))
+            except BaseException as e:  # noqa: BLE001 — re-raised in __call__
+                # a generator that raises mid-epoch must NOT look like a
+                # clean completion to the consumer (silent data loss)
+                fed["error"] = e
             finally:
                 fed["done_feeding"] = True
                 with self._results_cond:
@@ -280,6 +400,8 @@ class DistillReader(object):
                 while (epoch, next_id) not in self._results:
                     if (fed["done_feeding"] and next_id >= fed["n"]):
                         feeder_thread.join(timeout=5)
+                        if fed["error"] is not None:
+                            raise fed["error"]
                         return
                     self._results_cond.wait(timeout=0.5)
                     if self._stop.is_set():
@@ -294,6 +416,8 @@ class DistillReader(object):
                 payload, preds = self._results.pop((epoch, next_id))
             sem.release()
             last_progress = time.monotonic()
+            if payload is _TASK_ERROR:
+                raise preds  # the per-task DataAccessError, in order
             yield self._assemble(payload, preds)
             next_id += 1
 

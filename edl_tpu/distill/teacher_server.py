@@ -7,6 +7,18 @@ Serves a jitted model function over the framed-RPC substrate:
   the reference client discovered from serving conf files);
 - ``predict(feed)`` — feed dict of ndarrays → fetch dict of ndarrays.
   Inputs are padded to a fixed batch size so XLA compiles once.
+- ``stats()`` — device-batch occupancy counters for the bench/ops planes.
+
+Adaptive batching (Clipper/ORCA style): handler threads no longer run
+the model themselves behind one device lock — they enqueue (feed,
+future) items and a single device thread coalesces queued requests from
+ANY client into one compiled-batch program execution, copying rows into
+a preallocated feed buffer (no per-request ``np.concatenate``) and
+scattering row slices of the output back to each waiter. A half-full
+student batch therefore shares its program execution with other
+requests instead of burning a full-batch run alone; single-request
+behavior, the read-only feed contract, and the wire protocol are
+unchanged.
 
 A teacher registers itself into the coordination store via
 edl_tpu.distill.registry and is matched to students by the discovery/
@@ -14,53 +26,115 @@ balance layer.
 """
 
 import argparse
+import queue
 import signal
 import threading
+import time
 
 import numpy as np
 
 from edl_tpu.rpc import ndarray as nd
+from edl_tpu.rpc.server import FEATURES as _RPC_FEATURES
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
+
+
+class _ItemFuture(object):
+    """Rendezvous between a handler thread and the device thread."""
+
+    __slots__ = ("_event", "_value", "_error")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def set(self, value=None, error=None):
+        self._value, self._error = value, error
+        self._event.set()
+
+    def result(self, timeout):
+        if not self._event.wait(timeout):
+            raise errors.RpcError("device thread never served the batch")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _BatchItem(object):
+    __slots__ = ("feed", "n", "future")
+
+    def __init__(self, feed, n):
+        self.feed = feed
+        self.n = n
+        self.future = _ItemFuture()
 
 
 class TeacherServer(object):
     """Wrap ``predict_fn(feed: dict[str, np.ndarray]) -> dict`` behind RPC.
 
     Contract: ``predict_fn`` must treat the feed arrays as READ-ONLY
-    (they may be zero-copy views into the decoded request); copy first
-    to mutate in place.
+    (they may be zero-copy views into the decoded request, or — under
+    adaptive batching — slices of a reused staging buffer that is only
+    valid for the duration of the call); copy first to keep or mutate.
 
     ``feed_specs``/``fetch_specs``: {name: (shape_without_batch, dtype_str)}.
     ``max_batch``: server-side compiled batch size; requests are padded up
     and sliced back, so any client batch <= max_batch reuses one program.
+    ``adaptive_batch``: coalesce concurrent requests into shared device
+    batches on a single device thread (default). False restores the
+    serial pad-and-lock path (the bench baseline / escape hatch).
+    ``batch_timeout_ms``: how long the device thread may wait for more
+    requests when a batch is still short of ``max_batch``. The default
+    0 never delays — it coalesces whatever is already queued (pipelined
+    clients keep the queue full), so a lone request pays no latency tax.
     """
 
     def __init__(self, predict_fn, feed_specs, fetch_specs, max_batch=128,
-                 host="0.0.0.0", port=0):
+                 host="0.0.0.0", port=0, adaptive_batch=True,
+                 batch_timeout_ms=0.0):
         self._fn = predict_fn
         self._feed_specs = {k: (list(s), d) for k, (s, d)
                             in feed_specs.items()}
         self._fetch_specs = {k: (list(s), d) for k, (s, d)
                              in fetch_specs.items()}
         self._max_batch = max_batch
-        self._lock = threading.Lock()  # serialize device access
+        self._adaptive = bool(adaptive_batch)
+        self._batch_timeout = max(0.0, float(batch_timeout_ms)) / 1000.0
+        self._lock = threading.Lock()  # serializes device access (sync path)
+        self._queue = queue.Queue()
+        self._stop_ev = threading.Event()
+        self._device_thread = None
+        self._bufs = {}  # group key -> {name: staging array}
+        self._stats_lock = threading.Lock()
+        self._batches = 0   # device executions
+        self._rows = 0      # real (unpadded) rows served
         self._rpc = RpcServer(host=host, port=port)
         self._rpc.register("get_feed_fetch", self.get_feed_fetch)
         self._rpc.register("predict", self._predict_rpc)
+        self._rpc.register("stats", self.stats)
 
     def get_feed_fetch(self):
+        features = list(_RPC_FEATURES)
+        if self._adaptive:
+            features.append("adaptive_batch")
         return {"feed": self._feed_specs, "fetch": self._fetch_specs,
-                "max_batch": self._max_batch}
+                "max_batch": self._max_batch, "features": features,
+                "batch_timeout_ms": self._batch_timeout * 1000.0}
 
-    def _predict_rpc(self, feed_encoded):
-        # v2 tensor frames deliver feeds as owned arrays recv'd
-        # straight off the socket (framing.py MAGIC_V2); decode_tree
-        # is then a no-op but keeps pre-v2 senders (tagged-dict
-        # payloads) working. Contract stays uniform: treat feeds as
-        # immutable — copy first if an implementation must mutate.
-        feed = nd.decode_tree(feed_encoded, copy=False)
+    def stats(self):
+        """Batch-occupancy counters: ``occupancy`` is the fraction of
+        compiled-batch rows that carried real requests (1.0 = every
+        device execution ran completely full)."""
+        with self._stats_lock:
+            batches, rows = self._batches, self._rows
+        cap = batches * self._max_batch
+        return {"batches": batches, "rows": rows,
+                "max_batch": self._max_batch,
+                "occupancy": (rows / cap) if cap else 0.0}
+
+    def _validate(self, feed):
         missing = set(self._feed_specs) - set(feed)
         if missing:
             raise errors.DataAccessError("missing feeds: %s"
@@ -76,9 +150,31 @@ class TeacherServer(object):
         if n > self._max_batch:
             raise errors.DataAccessError(
                 "batch %d exceeds max_batch %d" % (n, self._max_batch))
+        return n
+
+    def _predict_rpc(self, feed_encoded):
+        # v2 tensor frames deliver feeds as owned arrays recv'd
+        # straight off the socket (framing.py MAGIC_V2); decode_tree
+        # is then a no-op but keeps pre-v2 senders (tagged-dict
+        # payloads) working. Contract stays uniform: treat feeds as
+        # immutable — copy first if an implementation must mutate.
+        feed = nd.decode_tree(feed_encoded, copy=False)
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        n = self._validate(feed)
+        if not self._adaptive:
+            return self._predict_serial(feed, n)
+        item = _BatchItem(feed, n)
+        self._queue.put(item)
+        # generous rendezvous bound: the device thread always resolves
+        # every item it dequeues (success, error, or shutdown drain)
+        return item.future.result(timeout=600.0)
+
+    def _predict_serial(self, feed, n):
+        """The pre-batching path: pad this request alone to max_batch
+        behind the device lock. Kept as the bench baseline and the
+        ``adaptive_batch=False`` escape hatch."""
         padded = {}
         for name, arr in feed.items():
-            arr = np.asarray(arr)
             if n < self._max_batch:
                 pad = np.zeros((self._max_batch - n,) + arr.shape[1:],
                                arr.dtype)
@@ -86,14 +182,125 @@ class TeacherServer(object):
             padded[name] = arr
         with self._lock:
             out = self._fn(padded)
+            with self._stats_lock:
+                self._batches += 1
+                self._rows += n
         # raw arrays: the v2 tensor frame ships them out-of-band with
         # no tobytes()/msgpack-bin copies (framing.py MAGIC_V2)
         return {k: np.asarray(v)[:n] for k, v in out.items()}
 
+    # -- the device thread -------------------------------------------------
+
+    @staticmethod
+    def _group_key(feed):
+        """Requests may only share a device batch when their feeds
+        agree on everything but the row count."""
+        return tuple(sorted((name, arr.shape[1:], arr.dtype.str)
+                            for name, arr in feed.items()))
+
+    def _buffers(self, key):
+        bufs = self._bufs.get(key)
+        if bufs is None:
+            if len(self._bufs) >= 8:  # bound staging memory under churn
+                self._bufs.pop(next(iter(self._bufs)))
+            bufs = self._bufs[key] = {
+                name: np.zeros((self._max_batch,) + tuple(trail),
+                               np.dtype(dt))
+                for name, trail, dt in key}
+        return bufs
+
+    def _device_loop(self):
+        carry = None
+        while not self._stop_ev.is_set():
+            if carry is not None:
+                item, carry = carry, None
+            else:
+                try:
+                    item = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+            key = self._group_key(item.feed)
+            group, rows = [item], item.n
+            deadline = time.monotonic() + self._batch_timeout
+            while rows < self._max_batch:
+                # timeout 0 = drain only what is already queued; a
+                # positive budget waits for stragglers but a full
+                # batch always flushes immediately
+                try:
+                    nxt = self._queue.get(
+                        timeout=max(0.0, deadline - time.monotonic()))
+                except queue.Empty:
+                    break
+                if (self._group_key(nxt.feed) != key
+                        or rows + nxt.n > self._max_batch):
+                    carry = nxt  # incompatible: heads the next batch
+                    break
+                group.append(nxt)
+                rows += nxt.n
+            self._run_group(key, group, rows)
+        # shutdown drain: never leave a handler thread parked forever
+        pending = [carry] if carry is not None else []
+        while True:
+            try:
+                pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for item in pending:
+            item.future.set(error=errors.StopError("teacher stopping"))
+
+    def _run_group(self, key, group, rows):
+        try:
+            if len(group) == 1 and rows == self._max_batch:
+                feed = group[0].feed  # already full: run it in place
+            else:
+                bufs = self._buffers(key)
+                lo = 0
+                for item in group:
+                    for name, arr in item.feed.items():
+                        bufs[name][lo:lo + item.n] = arr
+                    lo += item.n
+                if rows < self._max_batch:
+                    # zero the pad tail: stale rows from the previous
+                    # batch must not leak into this execution (keeps
+                    # outputs bit-identical with the serial zero-pad)
+                    for name in bufs:
+                        bufs[name][rows:] = 0
+                feed = bufs
+            out = self._fn(feed)
+            outs = {}
+            for k, v in out.items():
+                v = np.asarray(v)
+                if any(np.may_share_memory(v, b) for b in feed.values()):
+                    # a passthrough fn returned (a view of) the staging
+                    # buffer; the next batch would overwrite it while
+                    # responses are still being serialized
+                    v = v.copy()
+                outs[k] = v
+            with self._stats_lock:
+                self._batches += 1
+                self._rows += rows
+        except Exception as e:  # noqa: BLE001 — fail every waiter, keep serving
+            for item in group:
+                item.future.set(error=e)
+            return
+        lo = 0
+        for item in group:
+            item.future.set(value={k: v[lo:lo + item.n]
+                                   for k, v in outs.items()})
+            lo += item.n
+
+    # -- lifecycle ---------------------------------------------------------
+
     def start(self):
+        if self._adaptive and self._device_thread is None:
+            self._stop_ev.clear()
+            self._device_thread = threading.Thread(
+                target=self._device_loop, daemon=True,
+                name="teacher-device")
+            self._device_thread.start()
         self._rpc.start()
-        logger.info("teacher serving on %s (max_batch=%d)",
-                    self._rpc.endpoint, self._max_batch)
+        logger.info("teacher serving on %s (max_batch=%d, adaptive=%s)",
+                    self._rpc.endpoint, self._max_batch, self._adaptive)
         return self
 
     @property
@@ -106,10 +313,14 @@ class TeacherServer(object):
 
     def stop(self):
         self._rpc.stop()
+        if self._device_thread is not None:
+            self._stop_ev.set()
+            self._device_thread.join(timeout=5)
+            self._device_thread = None
 
 
 def nop_teacher(fetch_specs, max_batch=128, host="0.0.0.0", port=0,
-                feed_specs=None):
+                feed_specs=None, **kwargs):
     """A fake teacher returning zeros — the test backend (reference parity:
     _TestNopPaddlePredictServer, distill_worker.py:324-333)."""
     feed_specs = feed_specs or {"ins": ([1], "<f4")}
@@ -120,7 +331,8 @@ def nop_teacher(fetch_specs, max_batch=128, host="0.0.0.0", port=0,
                 for name, (shape, dtype) in fetch_specs.items()}
 
     return TeacherServer(predict, feed_specs, fetch_specs,
-                         max_batch=max_batch, host=host, port=port)
+                         max_batch=max_batch, host=host, port=port,
+                         **kwargs)
 
 
 def resnet_teacher(depth=50, num_classes=1000, image_size=224,
@@ -167,7 +379,7 @@ def resnet_teacher(depth=50, num_classes=1000, image_size=224,
 
 def gpt_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
                 vocab_size=256, seq_len=32, max_batch=64, host="0.0.0.0",
-                port=0, params=None):
+                port=0, params=None, **kwargs):
     """A causal-LM teacher: per-position next-token logits + probs —
     sequence-level knowledge distillation (the LM counterpart of the
     reference's ERNIE→BOW soft-label serving). Fixed ``seq_len`` so XLA
@@ -203,7 +415,7 @@ def gpt_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
         feed_specs={"input_ids": ([seq_len], "<i4")},
         fetch_specs={"logits": ([seq_len, vocab_size], "<f4"),
                      "probs": ([seq_len, vocab_size], "<f4")},
-        max_batch=max_batch, host=host, port=port)
+        max_batch=max_batch, host=host, port=port, **kwargs)
 
 
 def main():

@@ -1,13 +1,30 @@
-"""Blocking RPC client with reconnect, per-endpoint channel cache.
+"""Pipelined RPC client with reconnect and per-endpoint channel cache.
 
-Reference parity: edl/utils/client.py + data_server_client.py channel cache;
-errors re-raise by class name (edl/utils/exceptions.py:93-103).
+One connection now carries MANY requests in flight: the send path is
+serialized by a lock, a per-connection reader thread matches response
+frames back to callers by the envelope ``id``, and :meth:`RpcClient.
+call_async` hands the caller an :class:`RpcFuture`. The blocking
+:meth:`RpcClient.call` is ``call_async(...).result()`` with the exact
+pre-pipelining semantics (per-call timeout, deadline budget capping,
+retry-on-ConnectError with idempotency gating, fault points).
+
+Ordering/compat: responses are matched by id, never by arrival order,
+so this client interoperates with both the pooled out-of-order server
+and a strict request-reply peer (which simply answers in order).
+Requests sent via ``call_async`` carry ``"pl": 1`` so the server knows
+the sender tolerates out-of-order responses; plain ``call`` requests
+omit it and are served inline exactly as before.
+
+Reference parity: edl/utils/client.py + data_server_client.py channel
+cache; errors re-raise by class name (edl/utils/exceptions.py:93-103).
 """
 
 import itertools
 import os
+import select
 import socket
 import threading
+import time
 
 from edl_tpu.robustness import faults
 from edl_tpu.rpc import framing
@@ -39,6 +56,91 @@ def _local_hosts():
         return hosts
 
 
+class RpcFuture(object):
+    """The pending response of one pipelined call.
+
+    ``result(timeout)`` keeps the old blocking-call contract: a typed
+    server error re-raises as its class; a transport failure (or a
+    response that never arrives within the budget) tears the connection
+    down and raises ConnectError, failing every other call in flight on
+    the same connection — exactly what a died socket did before.
+    """
+
+    __slots__ = ("_client", "_conn", "method", "_budget", "_sent_at",
+                 "_event", "_value", "_error")
+
+    def __init__(self, client, conn, method, budget):
+        self._client = client
+        self._conn = conn
+        self.method = method
+        self._budget = budget
+        self._sent_at = time.monotonic()
+        self._event = threading.Event()
+        self._value = None
+        self._error = None
+
+    def _resolve(self, value=None, error=None):
+        if self._event.is_set():
+            return
+        self._value, self._error = value, error
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        """Non-destructive wait; True iff the response has arrived."""
+        return self._event.wait(timeout)
+
+    def remaining(self):
+        """Seconds left of this call's send-time budget (None = unbounded)."""
+        if self._budget is None:
+            return None
+        return self._budget - (time.monotonic() - self._sent_at)
+
+    def result(self, timeout=-1):
+        """Block for the response. ``timeout=-1`` (default) means "the
+        budget computed at send time", mirroring what the socket
+        timeout enforced for serial calls."""
+        if timeout == -1:
+            timeout = self.remaining()
+        if not self._event.wait(timeout):
+            # no response within budget: the connection is torn down
+            # (same observable behavior as the old per-call socket
+            # timeout) unless the response raced the teardown in
+            self._client._kill_conn(
+                self._conn,
+                errors.ConnectError(
+                    "rpc %s to %s failed: no response within %.1fs"
+                    % (self.method, self._client.endpoint,
+                       timeout if timeout is not None else -1.0)))
+            if not self._event.is_set():
+                raise errors.ConnectError(
+                    "rpc %s to %s timed out after %.1fs"
+                    % (self.method, self._client.endpoint,
+                       timeout if timeout is not None else -1.0))
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Conn(object):
+    """One live connection: the socket, the pending-by-id map, and the
+    reader thread."""
+
+    __slots__ = ("sock", "transport", "wlock", "plock",
+                 "pending", "dead", "reader")
+
+    def __init__(self, sock, transport):
+        self.sock = sock
+        self.transport = transport
+        self.wlock = threading.Lock()   # serializes write_frame
+        self.plock = threading.Lock()   # guards pending/dead
+        self.pending = {}               # id -> RpcFuture
+        self.dead = False
+        self.reader = None
+
+
 class RpcClient(object):
     def __init__(self, endpoint, timeout=60.0, retry=None):
         """``retry``: an optional robustness.policy.RetryPolicy; when
@@ -50,9 +152,9 @@ class RpcClient(object):
         self.endpoint = endpoint
         self._timeout = timeout
         self._retry = retry
-        self._sock = None
+        self._conn = None
         self._ids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()   # guards _conn (re)creation
         self.transport = None  # "uds" | "tcp" after connect
 
     def _try_uds(self):
@@ -86,8 +188,12 @@ class RpcClient(object):
                 s.close()  # no fd leak on stale-file fallback
             return None
 
-    def _connect(self):
-        if self._sock is None:
+    def _ensure_conn(self):
+        """Dial if needed; returns the live _Conn. Caller holds no locks."""
+        with self._lock:
+            conn = self._conn
+            if conn is not None:
+                return conn
             if faults.PLANE is not None:
                 # partition/error/delay on the dial path (site kinds
                 # degrade to "unreachable")
@@ -98,33 +204,177 @@ class RpcClient(object):
                         "fault: connect to %s cut" % self.endpoint)
             sock = self._try_uds()
             if sock is not None:
-                self._sock = sock
-                self.transport = "uds"
-                return
-            try:
-                self._sock = socket.create_connection(
-                    self._addr, timeout=self._timeout)
-                framing.set_keepalive(self._sock)
-                self.transport = "tcp"
-            except OSError as e:
-                self._sock = None
-                raise errors.ConnectError(
-                    "connect %s:%s failed: %s" % (*self._addr, e))
+                transport = "uds"
+            else:
+                try:
+                    sock = socket.create_connection(
+                        self._addr, timeout=self._timeout)
+                    framing.set_keepalive(sock)
+                    transport = "tcp"
+                except OSError as e:
+                    raise errors.ConnectError(
+                        "connect %s:%s failed: %s" % (*self._addr, e))
+            conn = _Conn(sock, transport)
+            conn.reader = threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True,
+                name="rpc-reader-%s" % self.endpoint)
+            self._conn = conn
+            self.transport = transport
+            conn.reader.start()
+            return conn
 
-    def _close_locked(self):
-        if self._sock is not None:
+    def _read_loop(self, conn):
+        """Match response frames to pending futures by envelope id.
+        Any transport failure fails EVERY call in flight — the peer is
+        a stream, so one torn frame desyncs all of them.
+
+        The reader polls for readability before touching the socket:
+        the socket's timeout is owned by the SEND path (per-call
+        budget), and an idle connection must not be torn down just
+        because no response arrived within one call's budget. A
+        timeout that fires mid-frame, by contrast, really is a dead
+        peer and kills the connection like any transport error."""
+        poller = select.poll()
+        poller.register(conn.sock.fileno(), select.POLLIN)
+        try:
+            while True:
+                try:
+                    events = poller.poll(1000)  # ms; idle wakeup only
+                    if not events:
+                        continue
+                    if events[0][1] & select.POLLNVAL:
+                        raise ConnectionError("connection closed")
+                    resp = framing.read_frame(conn.sock)
+                except (OSError, ConnectionError, ValueError,
+                        framing.FramingError) as e:
+                    self._kill_conn(conn, errors.ConnectError(
+                        "rpc to %s failed: %s" % (self.endpoint, e)))
+                    return
+                with conn.plock:
+                    fut = conn.pending.pop(resp.get("id"), None)
+                if fut is None:
+                    continue  # response for a call that already timed out
+                if resp.get("ok"):
+                    fut._resolve(value=resp.get("result"))
+                else:
+                    err = resp.get("error", {})
+                    fut._resolve(error=errors.deserialize_error(
+                        err.get("name", "RpcError"), err.get("detail", "")))
+        finally:
+            # the reader owns the fd's lifetime: closing it anywhere
+            # else would race this thread's poll() against fd-number
+            # reuse (kill only shuts the connection down)
             try:
-                self._sock.close()
-            finally:
-                self._sock = None
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _kill_conn(self, conn, exc):
+        """Tear down ``conn`` and fail everything pending on it with
+        ``exc``. Idempotent; callable from any thread (reader, a timed
+        -out caller, close())."""
+        if conn is None:
+            return
+        with self._lock:
+            if self._conn is conn:
+                self._conn = None
+        with conn.plock:
+            if conn.dead:
+                return
+            conn.dead = True
+            pending = list(conn.pending.values())
+            conn.pending.clear()
+        try:
+            # shutdown, NOT close: the reader thread polls this fd and
+            # closes it on exit; closing here would race fd reuse
+            conn.sock.shutdown(socket.SHUT_RDWR)  # wakes a blocked reader
+        except OSError:
+            pass
+        for fut in pending:
+            fut._resolve(error=exc)
 
     def close(self):
-        with self._lock:
-            self._close_locked()
+        self._kill_conn(self._conn,
+                        errors.ConnectError("client for %s closed"
+                                            % self.endpoint))
+
+    # -- the call surface --------------------------------------------------
+
+    def call_async(self, method, *args, timeout=None, deadline=None,
+                   **kwargs):
+        """Send ``method`` without waiting; returns an :class:`RpcFuture`.
+
+        Many calls may be in flight on one connection; responses are
+        matched by id, so completion order is whatever the server
+        chooses. The request carries ``"pl": 1`` (pipelined) so a
+        feature-aware server may dispatch it to its worker pool and
+        answer out of order; a strict request-reply server just answers
+        in order — both are correct for this client.
+        """
+        return self._send(method, args, kwargs, timeout, deadline,
+                          pipelined=True)
+
+    def server_features(self):
+        """The peer's advertised feature set (empty for pre-pipelining
+        servers, which lack the ``__features__`` method)."""
+        try:
+            return tuple(self.call("__features__"))
+        except errors.RpcError:
+            return ()
+
+    def _send(self, method, args, kwargs, timeout, deadline,
+              pipelined, wrote=None):
+        conn = self._ensure_conn()
+        budget = timeout or self._timeout
+        if deadline is not None:
+            budget = deadline.remaining(cap=budget)
+            if budget is not None and budget <= 0:
+                raise errors.DeadlineExceededError(
+                    "rpc %s to %s: no budget left"
+                    % (method, self.endpoint))
+        with conn.wlock:
+            if faults.PLANE is not None:
+                f = faults.PLANE.fire("rpc.client.call",
+                                      endpoint=self.endpoint, method=method)
+                if f is not None:
+                    # a dropped request manifests to the caller as a
+                    # timed-out connection
+                    self._kill_conn(conn, errors.ConnectError(
+                        "rpc %s to %s failed: fault: request dropped"
+                        % (method, self.endpoint)))
+                    raise errors.ConnectError(
+                        "rpc %s to %s failed: fault: request dropped"
+                        % (method, self.endpoint))
+            call_id = next(self._ids)
+            req = {"id": call_id, "method": method,
+                   "args": list(args), "kwargs": kwargs}
+            if pipelined:
+                req["pl"] = 1
+            fut = RpcFuture(self, conn, method, budget)
+            with conn.plock:
+                if conn.dead:
+                    raise errors.ConnectError(
+                        "rpc %s to %s failed: connection died"
+                        % (method, self.endpoint))
+                # registered BEFORE the write: the response can arrive
+                # the instant the last request byte hits the wire
+                conn.pending[call_id] = fut
+            try:
+                conn.sock.settimeout(budget)
+                framing.write_frame(conn.sock, req)
+                if wrote is not None:
+                    wrote[0] = True
+            except (OSError, ConnectionError, framing.FramingError) as e:
+                self._kill_conn(conn, errors.ConnectError(
+                    "rpc %s to %s failed: %s"
+                    % (method, self.endpoint, e)))
+                raise errors.ConnectError(
+                    "rpc %s to %s failed: %s" % (method, self.endpoint, e))
+        return fut
 
     def call(self, method, *args, timeout=None, deadline=None,
              idempotent=False, **kwargs):
-        """Invoke ``method`` remotely; one in-flight request per client.
+        """Invoke ``method`` remotely and block for its result.
 
         ``deadline``: an optional robustness.policy.Deadline — the
         caller's remaining budget caps this call's socket timeout, so a
@@ -159,43 +409,11 @@ class RpcClient(object):
 
     def _call_once(self, method, args, kwargs, timeout, deadline,
                    wrote=None):
-        with self._lock:
-            self._connect()
-            if faults.PLANE is not None:
-                f = faults.PLANE.fire("rpc.client.call",
-                                      endpoint=self.endpoint, method=method)
-                if f is not None:
-                    # a dropped request manifests to the caller as a
-                    # timed-out connection
-                    self._close_locked()
-                    raise errors.ConnectError(
-                        "rpc %s to %s failed: fault: request dropped"
-                        % (method, self.endpoint))
-            req = {"id": next(self._ids), "method": method,
-                   "args": list(args), "kwargs": kwargs}
-            try:
-                budget = timeout or self._timeout
-                if deadline is not None:
-                    budget = deadline.remaining(cap=budget)
-                    if budget is not None and budget <= 0:
-                        raise errors.DeadlineExceededError(
-                            "rpc %s to %s: no budget left"
-                            % (method, self.endpoint))
-                self._sock.settimeout(budget)
-                framing.write_frame(self._sock, req)
-                if wrote is not None:
-                    wrote[0] = True
-                resp = framing.read_frame(self._sock)
-            except (OSError, ConnectionError, framing.FramingError) as e:
-                # already holding self._lock — must NOT re-enter close()
-                self._close_locked()
-                raise errors.ConnectError(
-                    "rpc %s to %s failed: %s" % (method, self.endpoint, e))
-            if resp.get("ok"):
-                return resp.get("result")
-            err = resp.get("error", {})
-            raise errors.deserialize_error(
-                err.get("name", "RpcError"), err.get("detail", ""))
+        # pipelined=False: a plain blocking call asks for the server's
+        # strict inline dispatch (lowest latency, pre-pipelining order)
+        fut = self._send(method, args, kwargs, timeout, deadline,
+                         pipelined=False, wrote=wrote)
+        return fut.result()
 
 
 def call(endpoint, method, *args, **kwargs):

@@ -8,10 +8,12 @@ This replaces both gRPC and the reference's hand-rolled epoll TCP protocol
 msgpack is used instead of JSON so tensor batches can ride the same frames.
 """
 
+import os
 import struct
 import socket
 
 import msgpack
+import numpy as np
 
 from edl_tpu.robustness import faults
 
@@ -102,8 +104,6 @@ def read_frame(sock):
     # v2: body was only the meta; raw array payloads follow in order.
     # recv straight into owned, writable arrays — zero user-space
     # copies beyond the kernel's.
-    import numpy as np
-
     refs = []
 
     def collect(o):
@@ -182,8 +182,6 @@ def read_frame(sock):
 def _has_arrays(obj):
     """Short-circuit probe so array-free control RPCs skip the
     stripping rebuild entirely."""
-    import numpy as np
-
     if isinstance(obj, (np.ndarray, np.generic)):
         return True
     if isinstance(obj, dict):
@@ -198,8 +196,6 @@ def _strip_arrays(obj, bufs):
     shape} stub and append its (contiguous) buffer to ``bufs``.
     datetime64/timedelta64 have no buffer protocol — ship their bytes
     as an i8 view; the recorded dtype restores them on receive."""
-    import numpy as np
-
     if isinstance(obj, np.ndarray):
         arr = np.ascontiguousarray(obj)
         ref = {_ND_REF: len(bufs), "dtype": arr.dtype.str,
@@ -246,11 +242,8 @@ def _drain(sock, segments, sent):
 # upgrade atomically; the env var exists for anyone who doesn't.
 # Read PER CALL (like the UDS knob) so a long-lived process can be
 # flipped without a restart.
-import os as _os
-
-
 def _v2_disabled():
-    return bool(_os.environ.get("EDL_TPU_DISABLE_TENSOR_FRAMES"))
+    return bool(os.environ.get("EDL_TPU_DISABLE_TENSOR_FRAMES"))
 
 # Linux IOV_MAX is 1024: sendmsg rejects longer segment vectors with
 # EMSGSIZE, so wide pytrees (one segment per array) go out in groups.

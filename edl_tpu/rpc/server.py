@@ -5,17 +5,37 @@ DiscoveryService — protos/*.proto) and its raw epoll server with one
 substrate. Handlers raise EdlError subclasses; the error envelope carries the
 class name so clients re-raise the same type (reference parity:
 edl/utils/exceptions.py:93-114 serialize/deserialize).
+
+Pipelining: a request whose envelope carries ``"pl": 1`` announces that
+its sender matches responses by id and tolerates out-of-order replies.
+Those requests are dispatched to a bounded worker pool and their
+responses written whenever they finish, under a per-connection write
+lock so frames never interleave. Requests without the flag (every
+pre-pipelining client) are served inline on the connection thread —
+strict request-reply order, byte-for-byte the old behavior. Servers
+advertise the capability via the auto-registered ``__features__``
+method (and the teacher server mirrors it into ``get_feed_fetch``).
 """
 
 import os
 import socket
 import socketserver
 import threading
+from concurrent.futures import ThreadPoolExecutor
 
 from edl_tpu.robustness import faults
 from edl_tpu.rpc import framing
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
+
+#: capabilities every in-tree server advertises through __features__
+FEATURES = ("rpc.pipeline",)
+
+# per-connection cap on pooled requests in flight: when a client
+# pipelines deeper than this the read loop stops pulling frames and TCP
+# backpressure does the rest — one flooding connection cannot occupy
+# the whole worker pool
+MAX_CONN_INFLIGHT = 32
 
 
 def uds_path_for_port(port):
@@ -24,6 +44,13 @@ def uds_path_for_port(port):
     1381 MB/s on the v2 tensor-frame path, r5). uid-scoped so multiple
     users can't collide; the file itself is chmod 0600."""
     return "/tmp/edl_tpu_rpc_%d_%d.sock" % (os.getuid(), port)
+
+
+def _default_workers():
+    env = os.environ.get("EDL_TPU_RPC_WORKERS")
+    if env is not None:
+        return int(env)
+    return min(16, (os.cpu_count() or 4) * 2)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -41,35 +68,60 @@ class _Handler(socketserver.BaseRequestHandler):
             f = faults.PLANE.fire("rpc.server.conn")
             if f is not None:
                 return
+        wlock = threading.Lock()  # at most one frame mid-write per conn
+        sem = threading.BoundedSemaphore(MAX_CONN_INFLIGHT)
+        pool = self.server.pool
         while True:
             try:
                 req = framing.read_frame(self.request)
             except (ConnectionError, OSError, framing.FramingError):
                 return
-            resp = {"id": req.get("id")}
-            try:
-                method = req["method"]
-                if faults.PLANE is not None:
-                    # inside the try: an injected error comes back to the
-                    # client as a typed error envelope for that method
-                    f = faults.PLANE.fire("rpc.server.request",
-                                          method=method)
-                    if f is not None and f.kind == "drop":
-                        continue  # swallow: the client waits until timeout
-                fn = self.server.methods.get(method)
-                if fn is None:
-                    raise errors.RpcError("no such method: %s" % method)
-                resp["ok"] = True
-                resp["result"] = fn(*req.get("args", []),
-                                    **req.get("kwargs", {}))
-            except Exception as e:  # noqa: BLE001 — envelope every failure
-                if not isinstance(e, errors.EdlError):
-                    logger.exception("rpc handler %s failed",
-                                     req.get("method"))
-                name, detail = errors.serialize_error(e)
-                resp["ok"] = False
-                resp["error"] = {"name": name, "detail": detail}
-            try:
+            if req.get("pl") and pool is not None:
+                sem.acquire()
+                try:
+                    pool.submit(self._serve_pooled, req, wlock, sem)
+                    continue
+                except RuntimeError:  # pool shut down mid-stop
+                    sem.release()
+            if not self._serve_one(req, wlock):
+                return
+
+    def _serve_pooled(self, req, wlock, sem):
+        try:
+            # a dead connection surfaces as a write failure inside
+            # _serve_one; the read loop notices on its own recv
+            self._serve_one(req, wlock)
+        finally:
+            sem.release()
+
+    def _serve_one(self, req, wlock):
+        """Execute one request and write its response; False means the
+        connection is gone and the read loop should exit."""
+        resp = {"id": req.get("id")}
+        try:
+            method = req["method"]
+            if faults.PLANE is not None:
+                # inside the try: an injected error comes back to the
+                # client as a typed error envelope for that method
+                f = faults.PLANE.fire("rpc.server.request",
+                                      method=method)
+                if f is not None and f.kind == "drop":
+                    return True  # swallow: the client waits until timeout
+            fn = self.server.methods.get(method)
+            if fn is None:
+                raise errors.RpcError("no such method: %s" % method)
+            resp["ok"] = True
+            resp["result"] = fn(*req.get("args", []),
+                                **req.get("kwargs", {}))
+        except Exception as e:  # noqa: BLE001 — envelope every failure
+            if not isinstance(e, errors.EdlError):
+                logger.exception("rpc handler %s failed",
+                                 req.get("method"))
+            name, detail = errors.serialize_error(e)
+            resp["ok"] = False
+            resp["error"] = {"name": name, "detail": detail}
+        try:
+            with wlock:
                 try:
                     framing.write_frame(self.request, resp)
                 except (TypeError, ValueError, framing.FramingError) as e:
@@ -81,8 +133,9 @@ class _Handler(socketserver.BaseRequestHandler):
                         "error": {"name": "RpcError",
                                   "detail": "unencodable response: %s"
                                   % e}})
-            except (ConnectionError, OSError):
-                return
+        except (ConnectionError, OSError):
+            return False
+        return True
 
 
 class _TCPServer(socketserver.ThreadingTCPServer):
@@ -93,6 +146,7 @@ class _TCPServer(socketserver.ThreadingTCPServer):
     def __init__(self, *a, **k):
         super().__init__(*a, **k)
         self.connections = set()
+        self.pool = None
 
 
 if hasattr(socketserver, "ThreadingUnixStreamServer"):
@@ -103,6 +157,7 @@ if hasattr(socketserver, "ThreadingUnixStreamServer"):
         def __init__(self, *a, **k):
             super().__init__(*a, **k)
             self.connections = set()
+            self.pool = None
 else:  # non-POSIX: TCP only
     _UDSServer = None
 
@@ -113,14 +168,22 @@ class RpcServer(object):
     port=0 picks a free port; the bound port is available as ``.port`` after
     ``start()`` (reference parity: pod_server started on port 0 then wrote the
     real port back into the pod — edl/utils/pod_server.py:130-147).
+
+    ``workers``: size of the pooled-dispatch executor for pipelined
+    requests (default: EDL_TPU_RPC_WORKERS or 2×cores capped at 16;
+    0 disables pooling — every request is served inline in strict
+    request-reply order, the pre-pipelining behavior).
     """
 
-    def __init__(self, host="0.0.0.0", port=0):
+    def __init__(self, host="0.0.0.0", port=0, workers=None):
         self._host = host
         self._port = port
         self._server = None
         self._thread = None
+        self._pool = None
+        self._workers = _default_workers() if workers is None else workers
         self.methods = {}
+        self.register("__features__", lambda: list(FEATURES))
 
     def register(self, name, fn):
         self.methods[name] = fn
@@ -137,8 +200,13 @@ class RpcServer(object):
         return self
 
     def start(self):
+        if self._workers > 0:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._workers,
+                thread_name_prefix="rpc-worker")
         self._server = _TCPServer((self._host, self._port), _Handler)
         self._server.methods = self.methods
+        self._server.pool = self._pool
         self._thread = threading.Thread(
             target=self._server.serve_forever, kwargs={"poll_interval": 0.1},
             daemon=True, name="rpc-server")
@@ -182,6 +250,7 @@ class RpcServer(object):
                 os.unlink(path)
             srv = _UDSServer(path, _Handler)
             srv.methods = self.methods
+            srv.pool = self._pool
             self._uds_thread = threading.Thread(
                 target=srv.serve_forever, kwargs={"poll_interval": 0.1},
                 daemon=True, name="rpc-server-uds")
@@ -237,3 +306,6 @@ class RpcServer(object):
                     pass
             self._server.server_close()
             self._server = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None

@@ -128,3 +128,29 @@ def test_remaining_attempt_budget_clips_the_loop(monkeypatch, clock):
     assert (iters, slowstep) == (1, True)
     assert dt == pytest.approx(0.3)
     assert len(calls) == 1
+
+
+def test_distill_bench_tiny_cpu_schema():
+    """The distill data-plane bench must keep working in a tiny CPU
+    config under tier-1 and honor its JSON contract (schema
+    distill_bench/v1): both modes report throughput + occupancy, the
+    two paths return byte-identical predictions, and the whole report
+    serializes. No speedup assertion here — CI boxes are too noisy for
+    a timing gate; the acceptance run does that offline."""
+    import json
+
+    from edl_tpu.tools import distill_bench
+
+    out = distill_bench.run(model="linear", students=2, batches=6,
+                            batch_size=4, feed_dim=16, fetch_dim=16,
+                            max_batch=8, depth=3)
+    assert out["schema"] == "distill_bench/v1"
+    assert out["identical_ok"] is True
+    for mode in ("serial", "pipelined"):
+        assert out[mode]["wall_ms"] > 0
+        assert out[mode]["predicts_s"] > 0
+        assert out[mode]["goodput_mb_s"] > 0
+        assert out[mode]["device_batches"] > 0
+        assert 0 < out[mode]["occupancy_pct"] <= 100
+    assert out["speedup_predicts_s"] > 0
+    json.dumps(out)  # the whole report is JSON-serializable

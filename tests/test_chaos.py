@@ -511,3 +511,80 @@ def test_chaos_witness_probe_timeouts_fail_safe_then_promote(plane):
     finally:
         sb.stop()
         witness.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: rpc.frame.* faults against a pipelined connection
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_frame_corrupt_fails_all_pipelined_inflight(plane):
+    """A corrupted frame on a pipelined connection desyncs the whole
+    stream: every call in flight fails with ConnectError (no silent
+    loss, no misparse) and the next call dials a clean connection."""
+    from edl_tpu.rpc.client import RpcClient
+    from edl_tpu.rpc.server import RpcServer
+
+    gate = threading.Event()
+    srv = RpcServer(host="127.0.0.1", port=0)
+    srv.register("echo", lambda x: x)
+    srv.register("gated", lambda x: (gate.wait(10), x)[1])
+    srv.start()
+    c = RpcClient("127.0.0.1:%d" % srv.port, timeout=10)
+    try:
+        assert c.call("echo", 0) == 0  # connection warmed, fault unarmed
+        # unlimited while armed: the point is process-global, so a
+        # stray writer from another component must not eat the only
+        # firing before our request goes out
+        corrupt = plane.inject("rpc.frame.write", "corrupt")
+        futs = [c.call_async("gated", i) for i in range(4)]
+        gate.set()
+        # the armed write replaced request 0 with a garbage magic: the
+        # server kills the stream, so EVERY in-flight future fails
+        for fut in futs:
+            with pytest.raises(errors.ConnectError):
+                fut.result(timeout=10)
+        assert corrupt.fired >= 1
+        plane.clear("rpc.frame.write")
+        assert c.call("echo", "recovered") == "recovered"  # fresh dial
+    finally:
+        c.close()
+        srv.stop()
+        gate.set()
+
+
+def test_chaos_frame_faults_during_pipelined_distill(plane):
+    """rpc.frame.write corruption under a pipelined DistillReader with
+    an adaptive-batching teacher: in-flight tasks are requeued, the
+    epoch still delivers every batch exactly once, in order."""
+    def fn(feed):
+        return {"soft_label": feed["img"] * 2.0}
+
+    teacher = TeacherServer(fn, {"img": ([2], "<f4")},
+                            {"soft_label": ([2], "<f4")},
+                            max_batch=16, host="127.0.0.1").start()
+
+    def gen():
+        for i in range(20):
+            yield np.full((4, 2), i, np.float32),
+
+    dr = DistillReader(ins=["img"], predicts=["soft_label"],
+                       max_in_flight=8, pipeline_depth=4,
+                       teacher_backoff=0.5, predict_timeout=10)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([teacher.endpoint])
+    # arm only after the reader's discovery/get_feed_fetch calls by
+    # matching nothing until the data plane is live would be racy —
+    # instead allow the first few frames through with after=
+    corrupt = plane.inject("rpc.frame.write", "corrupt", after=4,
+                           times=2)
+    try:
+        seen = []
+        for img, soft in dr():
+            np.testing.assert_allclose(soft, img * 2.0)
+            seen.append(int(img[0, 0]))
+        assert seen == list(range(20))  # exactly once, in order
+        assert corrupt.fired == 2, "frame faults never fired"
+    finally:
+        dr.stop()
+        teacher.stop()

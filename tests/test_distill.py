@@ -361,3 +361,97 @@ def test_resnext_teacher_serves_soft_labels():
         conn.close()
     finally:
         server.stop()
+
+
+def test_distill_reader_feeder_exception_reraised():
+    """A generator that raises mid-epoch must surface to the consumer,
+    not masquerade as a clean (truncated) epoch."""
+    teacher = _echo_teacher(2.0)
+
+    def gen():
+        for i in range(5):
+            yield np.full((2, 2), i, np.float32),
+        raise RuntimeError("source storage went away")
+
+    dr = DistillReader(ins=["img"], predicts=["soft_label"],
+                       max_in_flight=4)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([teacher.endpoint])
+    try:
+        seen = 0
+        with pytest.raises(RuntimeError, match="source storage"):
+            for batch in dr():
+                seen += 1
+        assert seen == 5  # everything fed before the failure is delivered
+    finally:
+        dr.stop()
+        teacher.stop()
+
+
+def test_teacher_conn_empty_feed_typed_error():
+    """Empty feeds fail client-side with a typed DataAccessError before
+    any RPC (used to IndexError joining zero chunks)."""
+    from edl_tpu.distill.distill_reader import _TeacherConn
+    from edl_tpu.utils import errors
+
+    teacher = _echo_teacher(2.0)
+    try:
+        conn = _TeacherConn(teacher.endpoint)
+        with pytest.raises(errors.DataAccessError):
+            conn.predict({})
+        with pytest.raises(errors.DataAccessError):
+            conn.predict({"img": np.zeros((0, 2), np.float32)})
+        conn.close()
+    finally:
+        teacher.stop()
+
+
+def test_teacher_conn_pipelines_oversized_batch():
+    """A feed bigger than max_batch is split into chunks that are all
+    in flight together; the join preserves row order."""
+    from edl_tpu.distill.distill_reader import _TeacherConn
+
+    teacher = _echo_teacher(2.0)  # max_batch=16
+    try:
+        conn = _TeacherConn(teacher.endpoint)
+        assert conn.pipelined
+        x = np.arange(40 * 2, dtype=np.float32).reshape(40, 2)
+        out = conn.predict({"img": x})
+        np.testing.assert_allclose(out["soft_label"], x * 2.0)
+        conn.close()
+    finally:
+        teacher.stop()
+
+
+def test_distill_reader_with_pre_pipelining_teacher():
+    """A teacher that advertises no features negotiates down to
+    lockstep depth 1 and still serves a full epoch."""
+    from edl_tpu.rpc.server import RpcServer
+
+    srv = RpcServer(host="127.0.0.1", port=0, workers=0)
+    srv.register("get_feed_fetch",
+                 lambda: {"feed": {"img": ([2], "<f4")},
+                          "fetch": {"soft_label": ([2], "<f4")},
+                          "max_batch": 16})  # no "features" key
+    srv.register("predict",
+                 lambda feed: {"soft_label":
+                               np.asarray(feed["img"]) * 4.0})
+    srv.start()
+
+    def gen():
+        for i in range(8):
+            yield np.full((3, 2), i, np.float32),
+
+    dr = DistillReader(ins=["img"], predicts=["soft_label"],
+                       pipeline_depth=4)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher(["127.0.0.1:%d" % srv.port])
+    try:
+        n = 0
+        for img, soft in dr():
+            np.testing.assert_allclose(soft, img * 4.0)
+            n += 1
+        assert n == 8
+    finally:
+        dr.stop()
+        srv.stop()

@@ -1,0 +1,419 @@
+"""Pipelined RPC + teacher adaptive batching.
+
+Covers the distill data-plane concurrency work: out-of-order response
+matching by envelope id, whole-connection failure semantics (one dead
+socket fails every call in flight), retry/idempotency interaction with
+pipelining, strict-peer interop in both directions, and the teacher's
+cross-request batch coalescing (occupancy, timeout flush, latency
+floor, scatter correctness vs the serial pad-and-lock path).
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.distill.teacher_server import TeacherServer
+from edl_tpu.robustness.faults import FaultPlane
+from edl_tpu.robustness.policy import RetryPolicy
+from edl_tpu.rpc import framing
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import FEATURES, RpcServer
+from edl_tpu.utils import errors
+
+
+@pytest.fixture()
+def server():
+    gate = threading.Event()
+
+    def wait_then(x):
+        gate.wait(10)
+        return x
+
+    srv = RpcServer(host="127.0.0.1", port=0)
+    srv.register("echo", lambda x: x)
+    srv.register("sleepy", lambda s, x: (time.sleep(s), x)[1])
+    srv.register("gated", wait_then)
+    srv.register("boom", lambda: (_ for _ in ()).throw(
+        errors.DataAccessError("boom")))
+    srv.start()
+    srv.gate = gate
+    yield srv
+    gate.set()
+    srv.stop()
+
+
+def _client(srv, **kw):
+    return RpcClient("127.0.0.1:%d" % srv.port, **kw)
+
+
+# -- pipelined client ------------------------------------------------------
+
+
+def test_out_of_order_responses(server):
+    """A slow request must not block a fast one behind it: the fast
+    response arrives (and resolves) while the slow one is still gated
+    server-side — response order is completion order, matched by id."""
+    c = _client(server)
+    try:
+        slow = c.call_async("gated", "slow")
+        fast = c.call_async("echo", "fast")
+        assert fast.result(timeout=5) == "fast"
+        assert not slow.done()  # still parked on the gate
+        server.gate.set()
+        assert slow.result(timeout=5) == "slow"
+    finally:
+        c.close()
+
+
+def test_many_async_calls_interleaved(server):
+    c = _client(server)
+    try:
+        futs = [c.call_async("sleepy", 0.01 * (9 - i), i)
+                for i in range(10)]
+        assert [f.result(timeout=10) for f in futs] == list(range(10))
+    finally:
+        c.close()
+
+
+def test_async_error_envelope_is_typed(server):
+    c = _client(server)
+    try:
+        fut = c.call_async("boom")
+        with pytest.raises(errors.DataAccessError):
+            fut.result(timeout=5)
+        # the connection survives a typed error (it's an envelope, not
+        # a transport failure)
+        assert c.call("echo", 1) == 1
+    finally:
+        c.close()
+
+
+def test_inflight_failure_fails_all_pending(server):
+    """One torn connection must fail EVERY call in flight on it — a
+    byte stream cannot be resynchronized past a lost frame."""
+    c = _client(server)
+    try:
+        futs = [c.call_async("gated", i) for i in range(5)]
+        # sever the transport under the client (server keeps running)
+        c._conn.sock.shutdown(socket.SHUT_RDWR)
+        for fut in futs:
+            with pytest.raises(errors.ConnectError):
+                fut.result(timeout=5)
+        server.gate.set()
+        # next call dials a fresh connection
+        assert c.call("echo", "back") == "back"
+    finally:
+        c.close()
+
+
+def test_result_timeout_kills_connection(server):
+    c = _client(server)
+    try:
+        slow = c.call_async("gated", 1)
+        other = c.call_async("echo", 2)
+        assert other.result(timeout=5) == 2
+        with pytest.raises(errors.ConnectError):
+            slow.result(timeout=0.2)  # gate still closed
+        server.gate.set()
+        assert c.call("echo", 3) == 3  # reconnects
+    finally:
+        c.close()
+
+
+def test_retry_idempotent_interaction(server):
+    """A request dropped server-side AFTER it hit the wire is only
+    retried when the caller marked the call idempotent."""
+    plane = FaultPlane(seed=7).install()
+    try:
+        drop = plane.inject("rpc.server.request", "drop", times=1,
+                            method="echo")
+        c = _client(server, timeout=0.5,
+                    retry=RetryPolicy(max_attempts=3, base_delay=0.05,
+                                      jitter=0.0, seed=1))
+        try:
+            with pytest.raises(errors.ConnectError):
+                c.call("echo", 1)  # not idempotent: no resend allowed
+            assert drop.fired == 1
+        finally:
+            c.close()
+        drop2 = plane.inject("rpc.server.request", "drop", times=1,
+                             method="echo")
+        c = _client(server, timeout=0.5,
+                    retry=RetryPolicy(max_attempts=3, base_delay=0.05,
+                                      jitter=0.0, seed=1))
+        try:
+            assert c.call("echo", 2, idempotent=True) == 2
+            assert drop2.fired == 1  # first send swallowed, retry served
+        finally:
+            c.close()
+    finally:
+        plane.uninstall()
+
+
+def test_retry_before_wire_always_safe(server):
+    """A connect-path failure precedes the write, so even a
+    non-idempotent call retries."""
+    plane = FaultPlane(seed=7).install()
+    try:
+        cut = plane.inject("rpc.client.connect", "partition", times=1)
+        c = _client(server, retry=RetryPolicy(max_attempts=3,
+                                              base_delay=0.05,
+                                              jitter=0.0, seed=1))
+        try:
+            assert c.call("echo", 5) == 5
+            assert cut.fired == 1
+        finally:
+            c.close()
+    finally:
+        plane.uninstall()
+
+
+def test_features_advertised(server):
+    c = _client(server)
+    try:
+        assert "rpc.pipeline" in c.server_features()
+        assert set(FEATURES) <= set(c.server_features())
+    finally:
+        c.close()
+
+
+# -- interop with strict (pre-pipelining) peers ----------------------------
+
+
+def test_pipelined_client_vs_inline_server(server):
+    """workers=0 serves every request inline in strict order — the old
+    server behavior. call_async must still be correct against it."""
+    srv = RpcServer(host="127.0.0.1", port=0, workers=0)
+    srv.register("echo", lambda x: x)
+    srv.start()
+    try:
+        c = RpcClient("127.0.0.1:%d" % srv.port)
+        try:
+            futs = [c.call_async("echo", i) for i in range(8)]
+            assert [f.result(timeout=5) for f in futs] == list(range(8))
+        finally:
+            c.close()
+    finally:
+        srv.stop()
+
+
+def test_strict_client_vs_pipelined_server(server):
+    """A pre-pipelining peer (no ``pl`` flag, reads exactly one response
+    per request) gets strict request-reply ordering from the new
+    server: requests without the flag are served inline on the
+    connection thread."""
+    sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    try:
+        for i in range(5):
+            framing.write_frame(sock, {"id": i, "method": "echo",
+                                       "args": [i], "kwargs": {}})
+        for i in range(5):
+            resp = framing.read_frame(sock)
+            assert resp["id"] == i  # in order, one per request
+            assert resp["ok"] and resp["result"] == i
+    finally:
+        sock.close()
+
+
+def test_plain_call_is_served_inline(server):
+    c = _client(server)
+    try:
+        assert c.call("echo", "x") == "x"
+    finally:
+        c.close()
+
+
+# -- teacher adaptive batching ---------------------------------------------
+
+
+def _echo_server(max_batch=8, **kw):
+    calls = []
+
+    def fn(feed):
+        calls.append(int(len(feed["x"])))
+        return {"y": feed["x"] * 2.0 + 1.0}
+
+    t = TeacherServer(fn, feed_specs={"x": ([3], "<f4")},
+                      fetch_specs={"y": ([3], "<f4")},
+                      max_batch=max_batch, host="127.0.0.1", **kw)
+    t.start()
+    t.calls = calls
+    return t
+
+
+def test_batcher_coalesces_two_clients():
+    """Two concurrent single-row requests share one device execution
+    when the batch window is open."""
+    t = _echo_server(batch_timeout_ms=300)
+    try:
+        feeds = [np.full((1, 3), float(i), np.float32) for i in range(2)]
+        outs = [None, None]
+
+        def one(i):
+            c = RpcClient(t.endpoint)
+            try:
+                outs[i] = c.call("predict", {"x": feeds[i]})
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=10)
+        for i in range(2):
+            np.testing.assert_array_equal(outs[i]["y"],
+                                          feeds[i] * 2.0 + 1.0)
+        stats = RpcClient(t.endpoint).call("stats")
+        assert stats["batches"] == 1  # coalesced, not two executions
+        assert stats["rows"] == 2
+        assert stats["occupancy"] == pytest.approx(2 / 8)
+        assert t.calls == [8]  # one padded max_batch execution
+    finally:
+        t.stop()
+
+
+def test_batcher_timeout_flush():
+    """A lone short request flushes after batch_timeout_ms, not never."""
+    t = _echo_server(batch_timeout_ms=100)
+    try:
+        c = RpcClient(t.endpoint)
+        try:
+            x = np.ones((2, 3), np.float32)
+            t0 = time.monotonic()
+            out = c.call("predict", {"x": x})
+            took = time.monotonic() - t0
+            np.testing.assert_array_equal(out["y"], x * 2.0 + 1.0)
+            assert took < 5.0  # flushed by the timeout, not the 600s bound
+        finally:
+            c.close()
+    finally:
+        t.stop()
+
+
+def test_batcher_single_request_latency_floor():
+    """With the default batch_timeout_ms=0 a lone request pays no
+    artificial coalescing delay."""
+    t = _echo_server(batch_timeout_ms=0)
+    try:
+        c = RpcClient(t.endpoint)
+        try:
+            x = np.ones((1, 3), np.float32)
+            c.call("predict", {"x": x})  # warm the path
+            t0 = time.monotonic()
+            for _ in range(5):
+                c.call("predict", {"x": x})
+            assert (time.monotonic() - t0) / 5 < 0.5
+        finally:
+            c.close()
+    finally:
+        t.stop()
+
+
+def test_batcher_scatter_matches_serial_path():
+    """Byte-identical outputs between the adaptive scatter path and the
+    serial pad-and-lock path, for every sub-max_batch size."""
+    rng = np.random.default_rng(0)
+    feeds = [rng.standard_normal((n, 3)).astype(np.float32)
+             for n in (1, 3, 8, 5)]
+    t_adaptive = _echo_server(batch_timeout_ms=0)
+    t_serial = _echo_server(adaptive_batch=False)
+    try:
+        ca = RpcClient(t_adaptive.endpoint)
+        cs = RpcClient(t_serial.endpoint)
+        try:
+            for x in feeds:
+                a = ca.call("predict", {"x": x})["y"]
+                s = cs.call("predict", {"x": x})["y"]
+                assert a.dtype == s.dtype and a.shape == s.shape
+                assert a.tobytes() == s.tobytes()  # byte-identical
+        finally:
+            ca.close()
+            cs.close()
+    finally:
+        t_adaptive.stop()
+        t_serial.stop()
+
+
+def test_batcher_passthrough_fn_no_buffer_aliasing():
+    """A predict fn that returns (a view of) its input must not have its
+    result clobbered by the next batch reusing the staging buffer."""
+    def fn(feed):
+        return {"y": feed["x"]}  # worst case: alias the staging buffer
+
+    t = TeacherServer(fn, feed_specs={"x": ([2], "<f4")},
+                      fetch_specs={"y": ([2], "<f4")},
+                      max_batch=4, host="127.0.0.1", batch_timeout_ms=0)
+    t.start()
+    try:
+        c = RpcClient(t.endpoint)
+        try:
+            a = np.full((2, 2), 1.0, np.float32)
+            b = np.full((2, 2), 9.0, np.float32)
+            out_a = c.call("predict", {"x": a})["y"]
+            out_b = c.call("predict", {"x": b})["y"]
+            np.testing.assert_array_equal(out_a, a)
+            np.testing.assert_array_equal(out_b, b)
+        finally:
+            c.close()
+    finally:
+        t.stop()
+
+
+def test_batcher_error_fails_only_that_group():
+    calls = {"n": 0}
+
+    def fn(feed):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise errors.DataAccessError("device hiccup")
+        return {"y": feed["x"]}
+
+    t = TeacherServer(fn, feed_specs={"x": ([1], "<f4")},
+                      fetch_specs={"y": ([1], "<f4")},
+                      max_batch=4, host="127.0.0.1", batch_timeout_ms=0)
+    t.start()
+    try:
+        c = RpcClient(t.endpoint)
+        try:
+            x = np.ones((1, 1), np.float32)
+            with pytest.raises(errors.DataAccessError):
+                c.call("predict", {"x": x})
+            out = c.call("predict", {"x": x})  # server kept serving
+            np.testing.assert_array_equal(out["y"], x)
+        finally:
+            c.close()
+    finally:
+        t.stop()
+
+
+def test_batcher_rejects_bad_feeds_before_queueing():
+    t = _echo_server()
+    try:
+        c = RpcClient(t.endpoint)
+        try:
+            with pytest.raises(errors.DataAccessError):
+                c.call("predict", {"x": np.ones((0, 3), np.float32)})
+            with pytest.raises(errors.DataAccessError):
+                c.call("predict", {"wrong": np.ones((1, 3), np.float32)})
+            with pytest.raises(errors.DataAccessError):
+                c.call("predict",
+                       {"x": np.ones((t._max_batch + 1, 3), np.float32)})
+        finally:
+            c.close()
+    finally:
+        t.stop()
+
+
+def test_teacher_advertises_adaptive_features():
+    t = _echo_server()
+    try:
+        spec = RpcClient(t.endpoint).call("get_feed_fetch")
+        assert "rpc.pipeline" in spec["features"]
+        assert "adaptive_batch" in spec["features"]
+    finally:
+        t.stop()
