@@ -15,6 +15,9 @@ SERVICE_READER = "reader"
 SERVICE_STATE = "state"
 SERVICE_JOB_FLAG = "job_flag"
 SERVICE_METRICS = "metrics"
+# peer-served restore plane: each trainer's StateServer endpoint +
+# published snapshot version (edl_tpu/runtime/state_server.py)
+SERVICE_STATE_SERVER = "state_server"
 
 LEADER_SERVER = "0"          # the single leader key
 CLUSTER_SERVER = "cluster"   # the single cluster-map key

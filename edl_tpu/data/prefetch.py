@@ -31,6 +31,7 @@ class DevicePrefetcher(object):
         self._stop = threading.Event()
         self._err = None
         self._exhausted = False
+        self._closed = False
 
         def pump():
             try:
@@ -74,11 +75,26 @@ class DevicePrefetcher(object):
         if item is _END:
             self._exhausted = True
             if self._err is not None:
-                raise self._err
+                # re-raise on the CONSUMER thread as the same type,
+                # explicitly chained so the pump's traceback (the real
+                # failure site inside host_iter / transform /
+                # device_put) survives into the report instead of
+                # pointing here
+                err = self._err
+                try:
+                    wrapper = type(err)(*err.args)
+                except TypeError:
+                    # exotic __init__ signature: wrap rather than lose it
+                    wrapper = RuntimeError(
+                        "device prefetch pump failed: %r" % (err,))
+                raise wrapper from err
             raise StopIteration
         return item
 
     def close(self):
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         # drain so the pump's blocked put wakes up
         try:
@@ -86,6 +102,10 @@ class DevicePrefetcher(object):
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        # pump's put/get waits are all 0.2s-bounded and re-check _stop,
+        # so this join converges; bounded anyway so a wedged device_put
+        # cannot hang teardown (the thread is a daemon)
+        self._thread.join(timeout=5.0)
 
     def __enter__(self):
         return self

@@ -28,6 +28,10 @@ store.lease.expire       after the sweeper expired leases (ctx: lease_ids)
 store.watch.deliver      before wait_events blocks (ctx: prefix)
 distill.discovery        when a discovery client lists teachers
 standby.witness.probe    before the standby asks a witness (ctx: endpoint)
+peer_restore.connect     before a restorer dials a peer StateServer
+                         (ctx: endpoint, rank)
+peer_restore.read        before each peer span fetch (ctx: endpoint,
+                         key)
 ======================== ===============================================
 
 Fault kinds:

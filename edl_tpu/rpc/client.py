@@ -182,11 +182,42 @@ class RpcClient(object):
             s = socket.socket(socket.AF_UNIX)
             s.settimeout(self._timeout)
             s.connect(path)
+            if not self._verify_uds_identity(s):
+                s.close()
+                return None
             return s
         except OSError:
             if s is not None:
                 s.close()  # no fd leak on stale-file fallback
             return None
+
+    def _verify_uds_identity(self, sock):
+        """The UDS path is keyed by port NUMBER alone, but two servers
+        bound to distinct specific addresses (127.0.0.1 vs the real IP)
+        can legitimately share a port number — whichever started first
+        owns the socket path, and it may not be the server we dialed.
+        Ask who answers before trusting the fast path; any failure or
+        mismatch means "use TCP", which always reaches the right peer."""
+        try:
+            framing.write_frame(sock, {"id": -1,
+                                       "method": "__identity__"})
+            resp = framing.read_frame(sock)
+            if not resp.get("ok"):
+                return False  # pre-identity server: can't verify
+            ident = resp.get("result") or {}
+            if int(ident.get("port", -1)) != self._addr[1]:
+                return False
+            bind = str(ident.get("host", ""))
+            if bind in ("0.0.0.0", "::"):
+                return True  # wildcard bind answers every local address
+            loop = {"127.0.0.1", "localhost", "::1"}
+            # dialing 0.0.0.0 over TCP lands on loopback, so a
+            # loopback-bound server is the right peer for it too
+            if bind in loop and self._addr[0] in (loop | {"0.0.0.0"}):
+                return True
+            return bind == self._addr[0]
+        except (OSError, ValueError, TypeError, framing.FramingError):
+            return False
 
     def _ensure_conn(self):
         """Dial if needed; returns the live _Conn. Caller holds no locks."""

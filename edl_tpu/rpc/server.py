@@ -184,6 +184,15 @@ class RpcServer(object):
         self._workers = _default_workers() if workers is None else workers
         self.methods = {}
         self.register("__features__", lambda: list(FEATURES))
+        self.register("__identity__", self._identity)
+
+    def _identity(self):
+        """Who answers on this listener: the bind host + bound TCP
+        port. UDS paths are keyed by port number alone, so two servers
+        bound to distinct addresses sharing a port number collide on
+        the socket path — clients probe this after a UDS connect and
+        fall back to TCP when the answer isn't the server they dialed."""
+        return {"host": self._host, "port": self.port}
 
     def register(self, name, fn):
         self.methods[name] = fn
@@ -216,18 +225,37 @@ class RpcServer(object):
 
     def _start_uds(self):
         """Best-effort same-host fast path: a second listener on the
-        conventional AF_UNIX path for our TCP port. Safe to unlink a
-        stale file first — we own the TCP port, so no live server can
-        own this path. Failure never blocks the TCP server."""
+        conventional AF_UNIX path for our TCP port. Failure never
+        blocks the TCP server."""
         self._uds_server = None
         self._uds_path = None
+        self._uds_lock_fd = None
         if _UDSServer is None or os.environ.get("EDL_TPU_DISABLE_UDS"):
             return
         path = uds_path_for_port(self.port)
-        # A LIVE listener may own this path even though we own the TCP
-        # port: distinct specific bind addresses (127.0.0.1 vs a real
-        # IP) can share a port number across services. Probe-connect
-        # first — only a dead (stale) socket may be unlinked and taken.
+        # Sidecar lockfile closes the probe→unlink→bind TOCTOU: two
+        # servers can legitimately race for one path (distinct bind
+        # addresses share a port number), and between our liveness
+        # probe and our bind the other could unlink the file we just
+        # created. flock is advisory but both racers are THIS code, so
+        # whoever holds the lock owns the path for its lifetime. The
+        # lockfile is never unlinked (unlink+recreate would hand out a
+        # second lockable inode and resurrect the race).
+        lock_fd = None
+        try:
+            import fcntl
+            lock_fd = os.open(path + ".lock",
+                              os.O_CREAT | os.O_RDWR, 0o600)
+            fcntl.flock(lock_fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except (OSError, ImportError) as e:
+            if lock_fd is not None:
+                os.close(lock_fd)
+            logger.warning("uds path %s lock held elsewhere (%r); "
+                           "tcp only", path, e)
+            return
+        # A LIVE listener may still own the path without holding the
+        # lock (pre-lockfile server generations). Probe-connect —
+        # only a dead (stale) socket may be unlinked and taken.
         if os.path.lexists(path):
             probe = socket.socket(socket.AF_UNIX)
             try:
@@ -235,6 +263,7 @@ class RpcServer(object):
                 probe.connect(path)
                 logger.warning("uds path %s owned by a live server; "
                                "tcp only", path)
+                os.close(lock_fd)
                 return
             except OSError:
                 pass  # stale — safe to take
@@ -257,6 +286,7 @@ class RpcServer(object):
             self._uds_thread.start()
             self._uds_server = srv
             self._uds_path = path
+            self._uds_lock_fd = lock_fd  # held until stop()
         except Exception as e:  # noqa: BLE001 — fast path is optional
             logger.warning("uds listener unavailable (%r); tcp only", e)
             if srv is not None:  # bound but thread never started
@@ -265,6 +295,7 @@ class RpcServer(object):
                     os.unlink(path)
                 except OSError:
                     pass
+            os.close(lock_fd)
         finally:
             os.umask(old_umask)
 
@@ -295,6 +326,11 @@ class RpcServer(object):
                 os.unlink(self._uds_path)
             except OSError:
                 pass
+        if getattr(self, "_uds_lock_fd", None) is not None:
+            # releases the flock; the lockfile itself stays (see
+            # _start_uds — unlinking it would reopen the bind race)
+            os.close(self._uds_lock_fd)
+            self._uds_lock_fd = None
         if self._server is not None:
             self._server.shutdown()
             # sever live connections so a stop behaves like a real process

@@ -258,6 +258,138 @@ class SaveHandle(object):
         self._evt.set()
 
 
+class PlacedTarget(object):
+    """The per-process fill plan of a placed (locality-aware) restore.
+
+    Built from (target, shardings); holds, per leaf, the UNIQUE device
+    blocks this process must fill (replicated leaves map every device to
+    the same span — one shared host buffer, not one per device) plus the
+    device -> span mapping for final assembly. Both the shared-FS path
+    (CheckpointManager.restore_placed / fill_placed_from_fs) and the
+    peer restore plane (runtime/state_server.PeerRestorer) paste saved
+    extents into the SAME instance, which is what lets a partial peer
+    fetch be completed span-by-span from the FS instead of starting
+    over. Callers untag wire dtypes before paste()."""
+
+    def __init__(self, target, shardings):
+        flat_t, treedef = jax.tree_util.tree_flatten_with_path(target)
+        flat_s = jax.tree_util.tree_leaves(shardings)
+        if len(flat_s) != len(flat_t):
+            raise ValueError("shardings tree does not match target")
+        self._flat_t = flat_t
+        self._treedef = treedef
+        # key -> (shape, dtype, sharding, {spans: [buffer, filled]},
+        #         {device: spans})
+        self.need = {}
+        for (path, leaf), sharding in zip(flat_t, flat_s):
+            key = _path_key(path)
+            shape = tuple(leaf.shape)
+            dtype = np.dtype(leaf.dtype)
+            dev_map = sharding.addressable_devices_indices_map(shape)
+            blocks = {}
+            dev_spans = {}
+            for dev, index in dev_map.items():
+                spans = _concrete_spans(index, shape)
+                dev_spans[dev] = spans
+                if spans not in blocks:
+                    bshape = tuple(e - s for s, e in spans)
+                    blocks[spans] = [np.zeros(bshape, dtype), 0]
+            self.need[key] = (shape, dtype, sharding, blocks, dev_spans)
+
+    def check_bounds(self, key, entry_spans):
+        """A saved extent beyond the target shape must raise, even when
+        the offending entry overlaps none of our blocks — otherwise
+        in-bounds entries can complete coverage and the restore silently
+        truncates the stored tensor."""
+        shape = self.need[key][0]
+        if len(entry_spans) != len(shape) or any(
+                b > dim or a < 0
+                for (a, b), dim in zip(entry_spans, shape)):
+            raise IOError(
+                "checkpoint shape mismatch for %r: saved spans %s "
+                "vs target shape %s" % (key, entry_spans, shape))
+
+    def overlaps_local(self, key, entry_spans):
+        blocks = self.need[key][3]
+        return any(
+            all(max(a, c) < min(b, d)
+                for (a, b), (c, d) in zip(entry_spans, spans))
+            for spans in blocks)
+
+    def needed_rows(self, key, entry_spans):
+        """The entry-local contiguous leading-axis row hull [r0, r1)
+        this process needs from an entry saved at ``entry_spans``, or
+        None when the entry overlaps no local block. The hull may cover
+        rows between disjoint blocks — over-read, never under-read.
+        Scalars (rank-0 entries) return (0, 1): whole-entry reads."""
+        blocks = self.need[key][3]
+        lo = hi = None
+        for spans in blocks:
+            if not all(max(a, c) < min(b, d)
+                       for (a, b), (c, d) in zip(entry_spans, spans)):
+                continue
+            if not entry_spans:
+                return (0, 1)
+            (a0, b0), (c0, d0) = entry_spans[0], spans[0]
+            lo0, hi0 = max(a0, c0) - a0, min(b0, d0) - a0
+            lo = lo0 if lo is None else min(lo, lo0)
+            hi = hi0 if hi is None else max(hi, hi0)
+        return None if lo is None else (lo, hi)
+
+    def paste(self, key, entry_spans, arr):
+        """Paste an (already untagged) saved extent into every
+        overlapping local block (scalars: all spans empty -> full
+        overlap)."""
+        _, dtype, _, blocks, _ = self.need[key]
+        for spans, blk in blocks.items():
+            buf = blk[0]
+            # intersect the saved entry with this device block
+            lo = [max(a, c) for (a, _), (c, _) in
+                  zip(entry_spans, spans)]
+            hi = [min(b, d) for (_, b), (_, d) in
+                  zip(entry_spans, spans)]
+            if any(x >= y for x, y in zip(lo, hi)):
+                continue
+            src = tuple(slice(x - a, y - a) for (a, _), x, y in
+                        zip(entry_spans, lo, hi))
+            dst = tuple(slice(x - c, y - c) for (c, _), x, y in
+                        zip(spans, lo, hi))
+            buf[dst] = np.asarray(arr[src], dtype)
+            blk[1] += int(np.prod([y - x for x, y in zip(lo, hi)],
+                                  dtype=np.int64))
+
+    def reset_key(self, key):
+        """Zero a key's fill counters (buffers are simply overwritten):
+        call before re-filling a key from a DIFFERENT source, so
+        coverage accounting never double-counts overlapping pastes."""
+        for blk in self.need[key][3].values():
+            blk[1] = 0
+
+    def missing(self):
+        """Keys whose local blocks are not fully covered yet."""
+        return {key for key, (_, _, _, blocks, _) in self.need.items()
+                if any(blk[1] < blk[0].size for blk in blocks.values())}
+
+    def filled_nbytes(self):
+        """Bytes pasted so far (restore-size metric for timing logs)."""
+        return sum(blk[1] * spec[1].itemsize
+                   for key, spec in self.need.items()
+                   for blk in spec[3].values())
+
+    def assemble(self):
+        """device_put every block and build the sharded jax.Arrays in
+        the target's tree structure."""
+        leaves = []
+        for path, _ in self._flat_t:
+            shape, _, sharding, blocks, dev_spans = \
+                self.need[_path_key(path)]
+            bufs = [jax.device_put(blocks[spans][0], dev)
+                    for dev, spans in dev_spans.items()]
+            leaves.append(jax.make_array_from_single_device_arrays(
+                shape, sharding, bufs))
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+
 class CheckpointManager(object):
     def __init__(self, directory, keep=3, fs=None, workers=4):
         self._dir = str(directory)
@@ -446,14 +578,25 @@ class CheckpointManager(object):
     def _write_entry_file(self, path, arr):
         """Stream one (contiguous, wire-dtype) array to its own file in
         fixed-size chunks with an incremental crc — no whole-payload
-        BytesIO staging. Returns (nbytes, crc)."""
+        BytesIO staging. Returns (nbytes, crc, chunk_crcs): the
+        per-chunk crc list lands in the manifest so range reads (the
+        placed restore / peer-restore FS fallback) can verify just the
+        chunks they touch instead of the whole file."""
         arr = np.ascontiguousarray(arr)
+        chunk_crcs = []
         if arr.nbytes == 0:
-            return self._fs.write_chunks(path, ())
-        view = memoryview(arr).cast("B")
-        return self._fs.write_chunks(
-            path, (view[off:off + _CHUNK]
-                   for off in range(0, len(view), _CHUNK)))
+            nbytes, crc = self._fs.write_chunks(path, ())
+            return nbytes, crc, chunk_crcs
+
+        def chunks():
+            view = memoryview(arr).cast("B")
+            for off in range(0, len(view), _CHUNK):
+                chunk = view[off:off + _CHUNK]
+                chunk_crcs.append(zlib.crc32(chunk))
+                yield chunk
+
+        nbytes, crc = self._fs.write_chunks(path, chunks())
+        return nbytes, crc, chunk_crcs
 
     def _read_entry_file(self, path, entry):
         """Read one stream entry back (chunked, incremental crc check),
@@ -480,10 +623,42 @@ class CheckpointManager(object):
             raise IOError("checksum mismatch in %s" % path)
         return arr
 
+    def _read_entry_rows(self, path, entry, r0, r1):
+        """Range-read rows [r0, r1) of a stream entry's LEADING axis via
+        fs.read_range, chunk-aligned so the per-chunk crcs recorded at
+        write time still verify (manifests from before the range-read
+        extension lack chunk_crcs — callers route those through the
+        whole-file _read_entry_file). Returns the wire-dtype array of
+        shape (r1-r0,) + shape[1:]."""
+        dtype = np.dtype(entry["dtype"])
+        shape = tuple(entry["shape"])
+        chunk_crcs = entry["chunk_crcs"]
+        csize = int(entry.get("chunk", _CHUNK))
+        nbytes = int(entry["nbytes"])
+        rowbytes = (int(np.prod(shape[1:], dtype=np.int64))
+                    * dtype.itemsize)
+        b0, b1 = r0 * rowbytes, r1 * rowbytes
+        c0 = b0 // csize
+        c1 = min((b1 + csize - 1) // csize, len(chunk_crcs))
+        off = c0 * csize
+        want = min(c1 * csize, nbytes) - off
+        data = self._fs.read_range(path, off, want) if want > 0 else b""
+        if len(data) != want:
+            raise IOError("entry %s: range read returned %d/%d bytes "
+                          "at offset %d" % (path, len(data), want, off))
+        for i in range(c0, c1):
+            lo = i * csize - off
+            hi = min((i + 1) * csize, nbytes) - off
+            if zlib.crc32(data[lo:hi]) != int(chunk_crcs[i]):
+                raise IOError("chunk %d checksum mismatch in %s"
+                              % (i, path))
+        out = np.frombuffer(data, np.uint8)[b0 - off:b1 - off]
+        return out.view(dtype).reshape((r1 - r0,) + shape[1:])
+
     def _write_entries(self, vdir, prefix, entries):
         """Fan the entry files out across the writer pool; returns the
         manifest entry table {span_key: {file, dtype, shape, crc,
-        nbytes}} and the total byte count."""
+        nbytes, chunk, chunk_crcs}} and the total byte count."""
         pool = self._io_pool()
         futs = []
         for i, skey in enumerate(sorted(entries)):
@@ -495,10 +670,11 @@ class CheckpointManager(object):
         table = {}
         total = 0
         for skey, fname, arr, fut in futs:
-            nbytes, crc = fut.result()
+            nbytes, crc, chunk_crcs = fut.result()
             table[skey] = {"file": fname, "dtype": arr.dtype.str,
                            "shape": list(arr.shape), "crc": crc,
-                           "nbytes": nbytes}
+                           "nbytes": nbytes, "chunk": _CHUNK,
+                           "chunk_crcs": chunk_crcs}
             total += nbytes
         return table, total
 
@@ -912,115 +1088,93 @@ class CheckpointManager(object):
 
     # -- placed (locality-aware) restore -------------------------------------
 
-    def restore_placed(self, version, target, shardings):
-        """Restore ``version`` directly into sharded jax.Arrays laid out
-        by ``shardings`` (a pytree matching ``target``).
-
-        The scalable restore: host memory is O(local device blocks),
-        not O(full model), and each process DECOMPRESSES only the shard
-        entries overlapping its own blocks (file reads are whole-file
-        through the FileSystem API and CRC-verified against the
-        manifest; range reads would be a future fs extension). Works
-        over BOTH layouts — sharded files and dense files — and across
-        RESHAPED shardings: any overlap between saved spans and needed
-        device blocks is assembled, so an 8-way dp checkpoint restores
-        onto a 4-way mesh or a different tp layout. A checkpoint whose
-        saved extent EXCEEDS the target shape raises (never silently
-        truncates); one that covers less raises MissingKeysError.
-        """
-        import jax as _jax
-
+    def load_manifest(self, version):
+        """(vdir, manifest, meta_blob) of a committed version — the
+        shared preamble of both placed restore paths (FS and peer)."""
         vdir = self._vdir(version)
         with self._fs.open(vdir + "/MANIFEST", "r") as f:
             manifest = json.load(f)
         with self._fs.open(vdir + "/meta.json", "r") as f:
             meta_blob = json.load(f)
+        return vdir, manifest, meta_blob
 
-        flat_t, treedef = jax.tree_util.tree_flatten_with_path(target)
-        flat_s = jax.tree_util.tree_leaves(shardings)
-        if len(flat_s) != len(flat_t):
-            raise ValueError("shardings tree does not match target")
-        # per leaf: the UNIQUE device blocks this process must fill
-        # (replicated leaves map every device to the same span — share
-        # one host buffer, not one per device) + device -> span mapping
-        need = {}    # key -> (shape, dtype, sharding,
-        #                      {spans: [buffer, filled]}, {device: spans})
-        for (path, leaf), sharding in zip(flat_t, flat_s):
-            key = _path_key(path)
-            shape = tuple(leaf.shape)
-            dtype = np.dtype(leaf.dtype)
-            dev_map = sharding.addressable_devices_indices_map(shape)
-            blocks = {}
-            dev_spans = {}
-            for dev, index in dev_map.items():
-                spans = _concrete_spans(index, shape)
-                dev_spans[dev] = spans
-                if spans not in blocks:
-                    bshape = tuple(e - s for s, e in spans)
-                    blocks[spans] = [np.zeros(bshape, dtype), 0]
-            need[key] = (shape, dtype, sharding, blocks, dev_spans)
+    def _fill_stream(self, vdir, manifest, meta_blob, pt, keys=None):
+        """Fill a PlacedTarget from a stream-format version dir,
+        restricted to ``keys`` (None = every key). Entries whose
+        manifest records chunk crcs and whose needed row hull is a
+        strict subset of the entry are fetched with fs.read_range over
+        just those leading-axis rows (chunk-aligned, per-chunk crc
+        verified); everything else rides the whole-file reader."""
+        pool = self._io_pool()
+        todo = []
+        for skey, entry in manifest["entries"].items():
+            key, _, spans_s = skey.rpartition("@")
+            if key not in pt.need or (keys is not None
+                                      and key not in keys):
+                continue
+            entry_spans = _parse_spans(spans_s)
+            pt.check_bounds(key, entry_spans)
+            rows = pt.needed_rows(key, entry_spans)
+            if rows is None:
+                continue  # skip the file read entirely
+            r0, r1 = rows
+            nrows = (entry_spans[0][1] - entry_spans[0][0]
+                     if entry_spans else 1)
+            if entry.get("chunk_crcs") is not None and entry_spans \
+                    and 0 < (r1 - r0) < nrows:
+                a0 = entry_spans[0][0]
+                sub = ((a0 + r0, a0 + r1),) + entry_spans[1:]
+                todo.append((key, sub, pool.submit(
+                    self._read_entry_rows,
+                    "%s/%s" % (vdir, entry["file"]), entry, r0, r1)))
+            else:
+                todo.append((key, entry_spans, pool.submit(
+                    self._read_entry_file,
+                    "%s/%s" % (vdir, entry["file"]), entry)))
+        for key, spans, fut in todo:
+            pt.paste(key, spans, _untag_array(
+                fut.result(), meta_blob["dtypes"].get(key)))
 
-        def check_bounds(key, entry_spans):
-            """A saved extent beyond the target shape must raise, even
-            when the offending entry overlaps none of our blocks —
-            otherwise in-bounds entries can complete coverage and the
-            restore silently truncates the stored tensor."""
-            shape = need[key][0]
-            if len(entry_spans) != len(shape) or any(
-                    b > dim or a < 0
-                    for (a, b), dim in zip(entry_spans, shape)):
-                raise IOError(
-                    "checkpoint shape mismatch for %r: saved spans %s "
-                    "vs target shape %s" % (key, entry_spans, shape))
+    def fill_placed_from_fs(self, version, pt, keys=None):
+        """Fill a PlacedTarget's device blocks from ``version``'s STREAM
+        files, restricted to ``keys`` (None = all): the per-span FS
+        fallback of the peer restore plane. Raises IOError for
+        non-stream layouts — the caller then falls back to a wholesale
+        restore_placed. Returns the meta blob."""
+        vdir, manifest, meta_blob = self.load_manifest(version)
+        if manifest.get("format") != "stream":
+            raise IOError("fill_placed_from_fs needs a stream-format "
+                          "version (v%d is %s)" % (version,
+                          "sharded npz" if manifest.get("sharded")
+                          else "dense npz"))
+        self._fill_stream(vdir, manifest, meta_blob, pt, keys)
+        return meta_blob
 
-        def overlaps_local(key, entry_spans):
-            blocks = need[key][3]
-            return any(
-                all(max(a, c) < min(b, d)
-                    for (a, b), (c, d) in zip(entry_spans, spans))
-                for spans in blocks)
+    def restore_placed(self, version, target, shardings):
+        """Restore ``version`` directly into sharded jax.Arrays laid out
+        by ``shardings`` (a pytree matching ``target``).
 
-        def paste(key, entry_spans, arr):
-            _, dtype, _, blocks, _ = need[key]
-            arr = _untag_array(arr, meta_blob["dtypes"].get(key))
-            for spans, blk in blocks.items():
-                buf = blk[0]
-                # intersect the saved entry with this device block
-                # (scalars: all spans empty -> full overlap)
-                lo = [max(a, c) for (a, _), (c, _) in
-                      zip(entry_spans, spans)]
-                hi = [min(b, d) for (_, b), (_, d) in
-                      zip(entry_spans, spans)]
-                if any(x >= y for x, y in zip(lo, hi)):
-                    continue
-                src = tuple(slice(x - a, y - a) for (a, _), x, y in
-                            zip(entry_spans, lo, hi))
-                dst = tuple(slice(x - c, y - c) for (c, _), x, y in
-                            zip(spans, lo, hi))
-                buf[dst] = np.asarray(arr[src], dtype)
-                blk[1] += int(np.prod([y - x for x, y in zip(lo, hi)],
-                                      dtype=np.int64))
+        The scalable restore: host memory is O(local device blocks),
+        not O(full model), and each process reads only the shard entries
+        overlapping its own blocks — stream entries with recorded chunk
+        crcs are fetched with fs.read_range over just the needed
+        leading-axis rows, so a process that owns 1/Nth of a leaf pulls
+        ~1/Nth of its bytes. Works over BOTH layouts — sharded files and
+        dense files — and across RESHAPED shardings: any overlap between
+        saved spans and needed device blocks is assembled, so an 8-way
+        dp checkpoint restores onto a 4-way mesh or a different tp
+        layout. A checkpoint whose saved extent EXCEEDS the target shape
+        raises (never silently truncates); one that covers less raises
+        MissingKeysError.
+        """
+        vdir, manifest, meta_blob = self.load_manifest(version)
+        pt = PlacedTarget(target, shardings)
 
         if manifest.get("format") == "stream":
             # stream layout (dense OR sharded): bounds-check every entry
-            # from the manifest table, then read ONLY the overlapping
-            # files, in parallel across the io pool
-            pool = self._io_pool()
-            todo = []
-            for skey, entry in manifest["entries"].items():
-                key, _, spans_s = skey.rpartition("@")
-                if key not in need:
-                    continue
-                entry_spans = _parse_spans(spans_s)
-                check_bounds(key, entry_spans)
-                if not overlaps_local(key, entry_spans):
-                    continue  # skip the file read entirely
-                todo.append((key, entry_spans,
-                             pool.submit(self._read_entry_file,
-                                         "%s/%s" % (vdir, entry["file"]),
-                                         entry)))
-            for key, entry_spans, fut in todo:
-                paste(key, entry_spans, fut.result())
+            # from the manifest table, then range-read ONLY the
+            # overlapping spans, in parallel across the io pool
+            self._fill_stream(vdir, manifest, meta_blob, pt)
         elif manifest.get("sharded"):
             def read_rank(r):
                 with self._fs.open("%s/arrays.r%d.npz" % (vdir, r),
@@ -1035,13 +1189,14 @@ class CheckpointManager(object):
                 npz = np.load(io.BytesIO(payload))
                 for skey in npz.files:
                     key, _, spans_s = skey.rpartition("@")
-                    if key not in need:
+                    if key not in pt.need:
                         continue
                     entry_spans = _parse_spans(spans_s)
-                    check_bounds(key, entry_spans)
-                    if not overlaps_local(key, entry_spans):
+                    pt.check_bounds(key, entry_spans)
+                    if not pt.overlaps_local(key, entry_spans):
                         continue  # skip the decompress entirely
-                    paste(key, entry_spans, npz[skey])
+                    pt.paste(key, entry_spans, _untag_array(
+                        npz[skey], meta_blob["dtypes"].get(key)))
         else:
             with self._fs.open(vdir + "/arrays.npz", "rb") as f:
                 payload = f.read()
@@ -1049,28 +1204,20 @@ class CheckpointManager(object):
                 raise IOError("checksum mismatch in %s" % vdir)
             npz = np.load(io.BytesIO(payload))
             for key in npz.files:
-                if key not in need:
+                if key not in pt.need:
                     continue
                 # entry spans from the SAVED array's real shape: a
                 # larger stored tensor must raise, not truncate
                 arr = npz[key]
                 entry_spans = tuple((0, d) for d in arr.shape)
-                check_bounds(key, entry_spans)
-                paste(key, entry_spans, arr)
+                pt.check_bounds(key, entry_spans)
+                pt.paste(key, entry_spans, _untag_array(
+                    arr, meta_blob["dtypes"].get(key)))
 
-        missing = {key for key, (_, _, _, blocks, _) in need.items()
-                   if any(blk[1] < blk[0].size for blk in blocks.values())}
+        missing = pt.missing()
         if missing:
             raise MissingKeysError(missing)
-        leaves = []
-        for (path, leaf), _ in zip(flat_t, flat_s):
-            shape, _, sharding, blocks, dev_spans = need[_path_key(path)]
-            bufs = [_jax.device_put(blocks[spans][0], dev)
-                    for dev, spans in dev_spans.items()]
-            leaves.append(_jax.make_array_from_single_device_arrays(
-                shape, sharding, bufs))
-        return version, jax.tree_util.tree_unflatten(treedef, leaves), \
-            meta_blob["meta"]
+        return version, pt.assemble(), meta_blob["meta"]
 
     # -- restore -------------------------------------------------------------
 

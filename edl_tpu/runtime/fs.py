@@ -47,6 +47,14 @@ class FileSystem(object):
                 nbytes += len(chunk)
         return nbytes, crc
 
+    def read_range(self, path, offset, length):
+        """Read ``length`` bytes starting at ``offset``. Reads past EOF
+        return the available suffix (may be shorter than ``length``);
+        an offset at/past EOF returns b"". The random-access primitive
+        behind placed restores: a process that owns one device block of
+        a leaf pulls just that byte span instead of the whole file."""
+        raise NotImplementedError
+
     def listdir(self, path):
         raise NotImplementedError
 
@@ -70,6 +78,13 @@ class LocalFS(FileSystem):
 
     def open(self, path, mode):
         return open(path, mode)
+
+    def read_range(self, path, offset, length):
+        if length <= 0:
+            return b""
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
 
     def listdir(self, path):
         try:
@@ -133,10 +148,12 @@ class GCSFS(FileSystem):
 
     # -- http plumbing ----------------------------------------------------
 
-    def _request(self, method, url, data=None, ctype=None):
+    def _request(self, method, url, data=None, ctype=None, headers=None):
         req = urllib.request.Request(url, data=data, method=method)
         if ctype:
             req.add_header("Content-Type", ctype)
+        for k, v in (headers or {}).items():
+            req.add_header(k, v)
         if self._token:
             req.add_header("Authorization", "Bearer %s" % self._token)
         return urllib.request.urlopen(req, timeout=self._timeout)
@@ -161,6 +178,22 @@ class GCSFS(FileSystem):
         with self._request("GET", self._obj_url(bucket, name,
                                                 alt="media")) as resp:
             return resp.read()
+
+    def _download_range(self, bucket, name, offset, length):
+        rng = "bytes=%d-%d" % (offset, offset + length - 1)
+        try:
+            with self._request("GET", self._obj_url(bucket, name,
+                                                    alt="media"),
+                               headers={"Range": rng}) as resp:
+                data = resp.read()
+                if resp.status == 206:
+                    return data
+        except urllib.error.HTTPError as e:
+            if e.code == 416:  # offset at/past EOF
+                return b""
+            raise
+        # a server that ignores Range answers 200 with the full object
+        return data[offset:offset + length]
 
     def _list(self, bucket, prefix, delimiter=None):
         params = {"prefix": prefix}
@@ -207,6 +240,17 @@ class GCSFS(FileSystem):
             raise
         return (io.BytesIO(data) if "b" in mode
                 else io.StringIO(data.decode()))
+
+    def read_range(self, path, offset, length):
+        if length <= 0:
+            return b""
+        bucket, obj = _split_gs(path)
+        try:
+            return self._download_range(bucket, obj, offset, length)
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                raise FileNotFoundError(path)
+            raise
 
     def listdir(self, path):
         bucket, obj = _split_gs(path)

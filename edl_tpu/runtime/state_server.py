@@ -1,0 +1,442 @@
+"""Peer-served state restore: the in-memory fast path of elastic resize.
+
+The resize critical path used to restore every process from shared
+storage, even though surviving peers hold the exact post-snapshot state
+in host memory (the async save engine's phase-1 snapshot) and the
+pipelined RPC plane can move tensors at wire speed. This module closes
+that loop (the Gemini/SOSP'23 argument: in-memory peer-served
+checkpoints cut recovery from storage-bandwidth to NIC-bandwidth):
+
+- :class:`StateServer` — every trainer runs one; after each checkpoint
+  COMMIT the trainer publishes the committed snapshot's host copies and
+  the server serves per-leaf, per-span range reads over the v2 tensor
+  frames (zero-copy uint8 views of the published buffers). The endpoint
+  is advertised through the coordination store (SERVICE_STATE_SERVER,
+  TTL-leased) alongside the trainer's rank.
+- :class:`PeerRestorer` — a restarting/new process resolves which live
+  peers cover its needed device blocks (manifests fetched in parallel),
+  fetches only the overlapping leading-axis rows from each owner —
+  pipelined with ``call_async`` in ~4 MB sub-reads — and pastes into
+  the same :class:`~edl_tpu.runtime.checkpoint.PlacedTarget` the FS
+  restore uses.
+
+Fallback ladder (docs/elastic_resize.md): peers → alternate peers for
+the same span → per-span FS range reads (fill_placed_from_fs) →
+wholesale ``restore_placed`` (the caller's job, on PeerRestoreError).
+
+Version/ownership rules: a server serves exactly ONE version — the
+newest committed — and ``state.read`` raises StaleStateError when a
+newer save supersedes it mid-fetch; the restorer drops that peer and
+falls back. Published buffers are fresh host copies captured at
+snapshot time (NOT the reused _HostBufferPool staging buffers), so an
+in-flight peer read can never observe the next save being staged.
+
+Chaos fault points: ``peer_restore.connect`` (per peer dial, ctx:
+endpoint, rank) and ``peer_restore.read`` (per span fetch, ctx:
+endpoint, key) — see edl_tpu/robustness/faults.py.
+"""
+
+import json
+import threading
+
+import jax
+import numpy as np
+
+from edl_tpu.controller import constants
+from edl_tpu.robustness import faults
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.runtime.checkpoint import (MissingKeysError, PlacedTarget,
+                                        _concrete_spans, _parse_spans,
+                                        _path_key, _spans_str,
+                                        _untag_array, _wire_entry)
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+_CHUNK = 4 << 20  # per call_async sub-read; matches the checkpoint chunk
+
+
+def snapshot_entries(tree):
+    """({span_key: contiguous host ndarray (wire dtype)}, dtype tags) —
+    what a trainer publishes after a commit. EVERY addressable shard is
+    captured (replicas included, deduped by span), so each peer serves
+    exactly the blocks it physically holds; host/replicated leaves are
+    served whole. Arrays are COPIED: jax may alias device buffers into
+    np.asarray views on CPU, and a donated buffer must never leak into
+    a served snapshot."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    entries = {}
+    dtypes = {}
+
+    def add(key, spans, arr):
+        skey = "%s@%s" % (key, _spans_str(spans))
+        if skey in entries:
+            return
+        arr, tag = _wire_entry(np.asarray(arr))
+        if tag:
+            dtypes[key] = tag
+        entries[skey] = np.array(arr, copy=True)
+
+    for path, leaf in flat:
+        key = _path_key(path)
+        if hasattr(leaf, "addressable_shards") and hasattr(leaf,
+                                                           "sharding"):
+            for s in leaf.addressable_shards:
+                add(key, _concrete_spans(s.index, leaf.shape), s.data)
+        else:
+            arr = np.asarray(leaf)
+            add(key, tuple((0, d) for d in arr.shape), arr)
+    return entries, dtypes
+
+
+class StateServer(object):
+    """Serves this process's latest committed snapshot over RPC.
+
+    Served methods:
+
+    - ``state.manifest()`` → ``{"version", "rank", "meta", "dtypes",
+      "entries": {skey: {"dtype", "shape", "nbytes"}}}`` (version None
+      until the first publish).
+    - ``state.read(version, skey, offset, length)`` → a uint8 ndarray
+      slice of the published buffer (zero-copy on the server; rides the
+      v2 tensor frames). Raises StaleStateError on a version mismatch,
+      NotFoundError for a span this peer does not hold.
+
+    ``advertise(coord)`` registers the endpoint in the coordination
+    store under SERVICE_STATE_SERVER with a TTL lease, so a dead
+    process drops out of peer discovery within one TTL.
+    """
+
+    def __init__(self, rank=0, host="0.0.0.0", port=0):
+        self._rank = int(rank)
+        self._lock = threading.Lock()
+        self._version = None
+        self._meta = None
+        self._flats = {}   # skey -> flat uint8 view of the entry
+        self._table = {}   # skey -> {dtype, shape, nbytes}
+        self._dtypes = {}
+        self._register = None
+        self._server = RpcServer(host=host, port=port)
+        self._server.register("state.manifest", self._rpc_manifest)
+        self._server.register("state.read", self._rpc_read)
+        self._server.start()
+
+    @property
+    def endpoint(self):
+        return self._server.endpoint
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    def advertise(self, coord, ttl=None):
+        """TTL-leased registration (controller.register.Register) under
+        SERVICE_STATE_SERVER, keyed by rank. Best-effort: a coord outage
+        only costs the peer fast path, never the trainer."""
+        from edl_tpu.controller.register import Register
+        value = json.dumps({"endpoint": self.endpoint,
+                            "rank": self._rank})
+        try:
+            self._register = Register(
+                coord, constants.SERVICE_STATE_SERVER, str(self._rank),
+                value, ttl=ttl or constants.ETCD_TTL)
+        except errors.EdlError as e:
+            logger.warning("state server: advertise failed (%r); peers "
+                           "will not find this process", e)
+
+    def publish(self, version, entries, dtypes, meta=None):
+        """Atomically swap the served snapshot to ``version``. Entries
+        must be contiguous host ndarrays the caller hands over and never
+        mutates (snapshot_entries makes such copies). In-flight reads of
+        the previous version keep their buffers alive via the returned
+        numpy views; new reads see only the new version."""
+        flats = {}
+        table = {}
+        for skey, arr in entries.items():
+            arr = np.ascontiguousarray(arr)
+            flats[skey] = (np.frombuffer(memoryview(arr).cast("B"),
+                                         np.uint8)
+                           if arr.nbytes else np.empty(0, np.uint8))
+            table[skey] = {"dtype": arr.dtype.str,
+                           "shape": list(arr.shape),
+                           "nbytes": int(arr.nbytes)}
+        with self._lock:
+            self._version = int(version)
+            self._flats = flats
+            self._table = table
+            self._dtypes = dict(dtypes)
+            self._meta = meta
+
+    def unpublish(self):
+        with self._lock:
+            self._version = None
+            self._flats = {}
+            self._table = {}
+            self._dtypes = {}
+            self._meta = None
+
+    def stop(self):
+        if self._register is not None:
+            try:
+                self._register.stop()
+            except errors.EdlError:
+                pass
+            self._register = None
+        self._server.stop()
+
+    # -- served methods ----------------------------------------------------
+
+    def _rpc_manifest(self):
+        with self._lock:
+            return {"version": self._version, "rank": self._rank,
+                    "meta": self._meta, "dtypes": dict(self._dtypes),
+                    "entries": self._table}
+
+    def _rpc_read(self, version, skey, offset, length):
+        with self._lock:
+            if self._version != version:
+                raise errors.StaleStateError(
+                    "peer rank %d holds v%s, not v%s"
+                    % (self._rank, self._version, version))
+            flat = self._flats.get(skey)
+        if flat is None:
+            raise errors.NotFoundError("peer rank %d has no entry %s"
+                                       % (self._rank, skey))
+        return flat[int(offset):int(offset) + int(length)]
+
+
+class PeerRestorer(object):
+    """Placed restore from live peers with per-span FS fallback.
+
+    The ladder, per :meth:`restore_placed` call:
+
+    1. discover peers (SERVICE_STATE_SERVER), fetch every manifest in
+       parallel; drop unreachable/faulted peers and any whose published
+       version differs from the requested one (stale).
+    2. plan: each manifest entry overlapping a local device block gets
+       an owner (first peer seen holding that exact span); further
+       peers holding the same span queue as alternates. Within one
+       world all peers share a sharding, so distinct entries for a key
+       are either identical (replicas) or disjoint (shards) — the plan
+       relies on that for exact coverage accounting.
+    3. fetch only the needed leading-axis row hull of each entry,
+       pipelined (``call_async``, ~4 MB sub-reads), paste untagged.
+    4. per-entry failure → alternates → the key joins the FS fill set;
+       after all pastes, failed + still-missing keys are re-filled from
+       the checkpoint's stream files via range reads.
+    5. still missing after a clean FS fill → MissingKeysError (the
+       trainer's core-only retry handles legacy checkpoints); no usable
+       peers at all, or FS fill impossible (non-stream layout) →
+       PeerRestoreError (caller restores wholesale).
+    """
+
+    def __init__(self, coord, ckpt, self_endpoint=None, timeout=20.0,
+                 chunk=_CHUNK):
+        self._coord = coord
+        self._ckpt = ckpt
+        self._self_endpoint = self_endpoint
+        self._timeout = timeout
+        self._chunk = int(chunk)
+
+    # -- discovery ---------------------------------------------------------
+
+    def _discover(self, version):
+        """[(rank, endpoint, client, manifest)] for peers serving
+        exactly ``version``; open clients are the caller's to close."""
+        try:
+            servers = self._coord.get_service(
+                constants.SERVICE_STATE_SERVER)
+        except errors.EdlError as e:
+            raise errors.PeerRestoreError(
+                "peer discovery failed: %r" % (e,))
+        inflight = []
+        for _, value in servers:
+            try:
+                rec = json.loads(value)
+            except ValueError:
+                continue
+            endpoint = rec.get("endpoint")
+            if not endpoint or endpoint == self._self_endpoint:
+                continue
+            client = None
+            try:
+                if faults.PLANE is not None:
+                    faults.PLANE.fire("peer_restore.connect",
+                                      endpoint=endpoint,
+                                      rank=str(rec.get("rank")))
+                client = RpcClient(endpoint, timeout=self._timeout)
+                fut = client.call_async("state.manifest",
+                                        timeout=self._timeout)
+            except Exception as e:  # noqa: BLE001 — any peer may be gone
+                logger.warning("peer restore: %s unreachable (%r)",
+                               endpoint, e)
+                if client is not None:
+                    client.close()
+                continue
+            inflight.append((rec, endpoint, client, fut))
+        peers = []
+        for rec, endpoint, client, fut in inflight:
+            try:
+                manifest = fut.result()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("peer restore: manifest from %s failed "
+                               "(%r)", endpoint, e)
+                client.close()
+                continue
+            if manifest.get("version") != version:
+                logger.info("peer restore: %s holds v%s, want v%s — "
+                            "skipping stale peer", endpoint,
+                            manifest.get("version"), version)
+                client.close()
+                continue
+            peers.append((rec.get("rank"), endpoint, client, manifest))
+        return peers
+
+    # -- span fetch --------------------------------------------------------
+
+    def _issue(self, source, version, entry_spans, rows):
+        """Start the pipelined sub-reads for rows [r0, r1) of one peer
+        entry; returns the future list."""
+        client, skey, entry, endpoint = source
+        if faults.PLANE is not None:
+            faults.PLANE.fire("peer_restore.read", endpoint=endpoint,
+                              key=skey)
+        shape = tuple(entry["shape"])
+        dtype = np.dtype(entry["dtype"])
+        rowbytes = (int(np.prod(shape[1:], dtype=np.int64))
+                    * dtype.itemsize)
+        r0, r1 = rows
+        b0, b1 = r0 * rowbytes, r1 * rowbytes
+        futs = []
+        for off in range(b0, b1, self._chunk):
+            futs.append(client.call_async(
+                "state.read", version, skey, off,
+                min(self._chunk, b1 - off), timeout=self._timeout))
+        return futs
+
+    @staticmethod
+    def _collect(source, futs, entry_spans, rows):
+        """Join the sub-reads into the wire-dtype row-hull array."""
+        _, skey, entry, _ = source
+        shape = tuple(entry["shape"])
+        dtype = np.dtype(entry["dtype"])
+        r0, r1 = rows
+        parts = [np.asarray(f.result()) for f in futs]
+        data = parts[0] if len(parts) == 1 else np.concatenate(parts)
+        rowbytes = (int(np.prod(shape[1:], dtype=np.int64))
+                    * dtype.itemsize)
+        if data.nbytes != (r1 - r0) * rowbytes:
+            raise IOError("peer entry %s: got %d bytes, want %d"
+                          % (skey, data.nbytes, (r1 - r0) * rowbytes))
+        if not shape:  # scalar: the single "row" is the value itself
+            return data.view(dtype).reshape(())
+        return data.view(dtype).reshape((r1 - r0,) + shape[1:])
+
+    # -- the restore -------------------------------------------------------
+
+    def restore_placed(self, version, target, shardings):
+        """Peer-first placed restore of ``version``. Returns
+        (version, tree, meta, stats) — stats carries ``source``
+        ("peer"/"peer+fs"), ``peer_bytes``, ``fs_keys``, ``peers``."""
+        peers = self._discover(version)
+        if not peers:
+            raise errors.PeerRestoreError(
+                "no live peer serves v%s" % (version,))
+        clients = [p[2] for p in peers]
+        try:
+            return self._restore_from(peers, version, target, shardings)
+        finally:
+            for c in clients:
+                c.close()
+
+    def _restore_from(self, peers, version, target, shardings):
+        pt = PlacedTarget(target, shardings)
+        dtypes = {}
+        meta = peers[0][3].get("meta")
+        # (key, entry_spans) -> [(client, skey, entry, endpoint), ...]
+        plan = {}
+        for rank, endpoint, client, manifest in peers:
+            dtypes.update(manifest.get("dtypes") or {})
+            for skey, entry in manifest["entries"].items():
+                key, _, spans_s = skey.rpartition("@")
+                if key not in pt.need:
+                    continue
+                entry_spans = _parse_spans(spans_s)
+                pt.check_bounds(key, entry_spans)
+                if not pt.overlaps_local(key, entry_spans):
+                    continue
+                plan.setdefault((key, entry_spans), []).append(
+                    (client, skey, entry, endpoint))
+
+        # phase A: issue every owner's sub-reads back-to-back so all
+        # peers stream concurrently; phase B joins in the same order
+        pending = []
+        for (key, entry_spans), sources in sorted(plan.items()):
+            rows = pt.needed_rows(key, entry_spans)
+            if rows is None:  # pragma: no cover — overlap checked above
+                continue
+            try:
+                futs = self._issue(sources[0], version, entry_spans,
+                                   rows)
+            except Exception as e:  # noqa: BLE001 — peer died at issue
+                futs = e
+            pending.append((key, entry_spans, rows, sources, futs))
+
+        peer_bytes = 0
+        failed = set()
+        for key, entry_spans, rows, sources, futs in pending:
+            arr = None
+            for i, src in enumerate(sources):
+                try:
+                    if i > 0 or isinstance(futs, Exception):
+                        if isinstance(futs, Exception) and i == 0:
+                            raise futs
+                        futs = self._issue(src, version, entry_spans,
+                                           rows)
+                    arr = self._collect(src, futs, entry_spans, rows)
+                    break
+                except Exception as e:  # noqa: BLE001 — try alternates
+                    logger.warning("peer restore: fetch %s@%s from %s "
+                                   "failed (%r)", key,
+                                   _spans_str(entry_spans), src[3], e)
+                    arr = None
+            if arr is None:
+                failed.add(key)
+                continue
+            r0, r1 = rows
+            if entry_spans:
+                a0 = entry_spans[0][0]
+                sub = ((a0 + r0, a0 + r1),) + entry_spans[1:]
+            else:
+                sub = entry_spans
+            pt.paste(key, sub, _untag_array(arr, dtypes.get(key)))
+            peer_bytes += arr.nbytes
+
+        need_fs = failed | pt.missing()
+        if need_fs:
+            # a key partially pasted from peers restarts from zero so
+            # the FS fill's coverage accounting stays exact
+            for key in need_fs:
+                pt.reset_key(key)
+            try:
+                meta_blob = self._ckpt.fill_placed_from_fs(
+                    version, pt, keys=need_fs)
+            except MissingKeysError:
+                raise
+            except (IOError, OSError) as e:
+                raise errors.PeerRestoreError(
+                    "per-span FS fallback for %s failed: %r"
+                    % (sorted(need_fs), e))
+            if meta is None:
+                meta = meta_blob.get("meta")
+            logger.info("peer restore v%s: %d key(s) re-filled from "
+                        "FS: %s", version, len(need_fs),
+                        sorted(need_fs))
+        missing = pt.missing()
+        if missing:
+            raise MissingKeysError(missing)
+        stats = {"source": "peer+fs" if need_fs else "peer",
+                 "peer_bytes": int(peer_bytes),
+                 "fs_keys": sorted(need_fs), "peers": len(peers)}
+        return version, pt.assemble(), meta, stats

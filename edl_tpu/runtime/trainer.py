@@ -469,6 +469,29 @@ class ElasticTrainer(object):
             self.coord = CoordClient(self.env.store_endpoints,
                                      root=self.env.job_id)
 
+        # peer-served restore plane (runtime/state_server.py): serve the
+        # latest committed snapshot to restarting peers and prefer live
+        # peers over the shared FS on our own resume. Opt-out with
+        # EDL_TPU_PEER_RESTORE=0; needs both a checkpoint dir (the FS
+        # fallback) and a coordination store (peer discovery).
+        self._state_server = None
+        # per-incarnation resize timing record (docs/elastic_resize.md):
+        # absolute unix timestamps so measure_resize can align them with
+        # its own kill/detect clock
+        self._resize_timing = {"t_construct": time.time()}
+        if (self._ckpt is not None and self.coord is not None
+                and os.environ.get("EDL_TPU_PEER_RESTORE", "1") != "0"):
+            try:
+                from edl_tpu.runtime.state_server import StateServer
+                self._state_server = StateServer(
+                    rank=self.env.global_rank,
+                    host=os.environ.get("EDL_TPU_POD_IP", "0.0.0.0"))
+                self._state_server.advertise(self.coord)
+            except Exception:
+                logger.exception("state server failed to start; peer "
+                                 "restore disabled for this process")
+                self._state_server = None
+
         self._jit_step = self._build_step()
         self._example_batch_sds = None  # captured at the first step
         self._prewarm_thread = None
@@ -486,6 +509,11 @@ class ElasticTrainer(object):
         # emergency checkpoint at or below it belongs to a PRIOR
         # preemption event, not the one being waited on
         self._resumed_version = -1
+        # env override so launchers/benches can flip the save engine
+        # without threading a flag through every example's CLI
+        env_async = os.environ.get("EDL_TPU_ASYNC_SAVE")
+        if env_async is not None:
+            async_save = env_async not in ("0", "")
         self._async_save = async_save
         # flag-only SIGTERM handler + drain hook: every preemption exit
         # path drains the checkpoint engine's in-flight async persist
@@ -784,13 +812,26 @@ class ElasticTrainer(object):
                 lambda x: x.reshape((k, x.shape[0] // k) + x.shape[1:]),
                 host_batch)
         batch = self.shard_batch(host_batch)
-        if self._example_batch_sds is None:
+        first_step = self._example_batch_sds is None
+        if first_step:
             self._example_batch_sds = jax.tree_util.tree_map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
             loaded = self._try_load_prewarmed_step()
             if loaded is not None:
                 self._jit_step = loaded
         self.train_state, loss = self._jit_step(self.train_state, batch, rng)
+        if first_step:
+            # resize downtime breakdown: the first dispatch wall is
+            # (almost entirely) trace+compile; the extra wait to result
+            # availability is the first real step. One-time per
+            # incarnation, so the block_until_ready costs nothing the
+            # caller would not pay anyway.
+            c1 = time.perf_counter()
+            self._resize_timing["compile_s"] = c1 - t0
+            jax.block_until_ready(loss)
+            self._resize_timing["first_step_s"] = time.perf_counter() - c1
+            self._resize_timing["t_first_step"] = time.time()
+            self._publish_resize_timing()
         self._host_step += 1
         self._step_times.append(time.perf_counter() - t0)
         if self._coord_stop is not None:
@@ -1229,12 +1270,34 @@ class ElasticTrainer(object):
         meta = {"state": state_snapshot}
 
         self.wait_for_save()
+        # peer restore plane: capture SEPARATE host copies of this
+        # process's shards NOW (the training thread — later steps may
+        # donate the originals, and the engine's pooled staging buffers
+        # are reused by the next save, so neither may be served) and
+        # publish them only once the version COMMITS — a served version
+        # is always also manifest-valid on the FS.
+        publish = None
+        if self._state_server is not None:
+            from edl_tpu.runtime import state_server as state_server_mod
+            entries, dtags = state_server_mod.snapshot_entries(
+                dict(self.train_state))
+            srv = self._state_server
+
+            def publish():
+                srv.publish(version, entries, dtags, meta=meta)
+
         if not self._state_fully_addressable():
             # per-host sharded write; every rank participates
             rank = jax.process_index()
             nranks = jax.process_count()
-            on_commit = ((lambda: self._save_state_to_store(state_snapshot))
-                         if rank == 0 else None)
+            store_write = ((lambda: self._save_state_to_store(
+                state_snapshot)) if rank == 0 else None)
+
+            def on_commit(_store=store_write, _pub=publish):
+                if _pub is not None:
+                    _pub()
+                if _store is not None:
+                    _store()
             if self._async_save:
                 self._ckpt.save_sharded_async(
                     version, dict(self.train_state), meta=meta,
@@ -1242,20 +1305,24 @@ class ElasticTrainer(object):
                 return
             self._ckpt.save_sharded(version, dict(self.train_state),
                                     meta=meta, rank=rank, nranks=nranks)
-            if on_commit is not None:
-                on_commit()
+            on_commit()
             return
         if self.env.global_rank != 0:
             return
         if self._async_save:
+            def on_commit_dense(_pub=publish):
+                if _pub is not None:
+                    _pub()
+                self._save_state_to_store(state_snapshot)
             self._ckpt.save_async(
                 version, dict(self.train_state), meta=meta,
-                on_commit=lambda: self._save_state_to_store(
-                    state_snapshot))
+                on_commit=on_commit_dense)
             return
         self._ckpt.save(version,
                         checkpoint_mod.to_host_tree(
                             dict(self.train_state)), meta=meta)
+        if publish is not None:
+            publish()
         self._save_state_to_store(state_snapshot)
 
     def wait_for_save(self):
@@ -1268,10 +1335,17 @@ class ElasticTrainer(object):
     def close(self):
         """Release background resources: drain any in-flight async save,
         shut the checkpoint engine's writer pool down, and stop the
-        preemption watcher thread. Idempotent; the trainer remains
-        usable for reads afterwards (notebooks constructing several
-        trainers should close the ones they drop)."""
+        preemption watcher thread and state server. Idempotent; the
+        trainer remains usable for reads afterwards (notebooks
+        constructing several trainers should close the ones they
+        drop)."""
         self.wait_for_save()
+        if self._state_server is not None:
+            try:
+                self._state_server.stop()
+            except Exception:
+                logger.exception("state server stop failed")
+            self._state_server = None
         if self._ckpt is not None:
             self._ckpt.close()
         if self._coord_stop is not None:
@@ -1288,6 +1362,58 @@ class ElasticTrainer(object):
             snap = state_mod.State()
             snap.from_dict(dict(state_dict))
             state_mod.save_to_store(self.coord, snap)
+
+    def _restore_placed_any(self, version, target, shardings):
+        """restore_placed with the peer fast path: fetch from live peer
+        StateServers first (NIC bandwidth, host memory), fall back
+        WHOLESALE to the shared FS when no usable peer path exists.
+        MissingKeysError propagates either way — the caller's core-only
+        retry must see it. Returns (version, tree, meta)."""
+        if self._state_server is not None:
+            from edl_tpu.runtime.state_server import PeerRestorer
+            from edl_tpu.utils.errors import PeerRestoreError
+            restorer = PeerRestorer(
+                self.coord, self._ckpt,
+                self_endpoint=self._state_server.endpoint)
+            try:
+                v, tree, meta, stats = restorer.restore_placed(
+                    version, target, shardings)
+                self._resize_timing["restore_source"] = stats["source"]
+                self._resize_timing["restore_bytes"] = \
+                    stats["peer_bytes"]
+                self._resize_timing["restore_peers"] = stats["peers"]
+                logger.info("peer restore v%d: %.1f MB from %d peer(s)"
+                            " (%s)", v, stats["peer_bytes"] / 1e6,
+                            stats["peers"], stats["source"])
+                return v, tree, meta or {}
+            except MissingKeysError:
+                raise
+            except PeerRestoreError as e:
+                logger.info("peer restore unavailable for v%d (%s); "
+                            "restoring from the shared FS", version, e)
+            except Exception:
+                logger.exception("peer restore for v%d failed; "
+                                 "restoring from the shared FS", version)
+        out = self._ckpt.restore_placed(version, target, shardings)
+        self._resize_timing["restore_source"] = "fs"
+        return out
+
+    def _publish_resize_timing(self):
+        """Write this incarnation's per-stage resume timings to the
+        coordination store (SERVICE_METRICS / resize_timing_r<rank>) so
+        measure_resize can assemble the downtime breakdown without log
+        scraping. Best-effort."""
+        if self.coord is None:
+            return
+        import json as _json
+        from edl_tpu.controller import constants
+        try:
+            self.coord.set_server_permanent(
+                constants.SERVICE_METRICS,
+                "resize_timing_r%d" % self.env.global_rank,
+                _json.dumps(self._resize_timing))
+        except Exception:
+            logger.exception("resize timing publish failed")
 
     def resume(self):
         """Restore the newest valid checkpoint; apply resize adjust hooks if
@@ -1310,9 +1436,10 @@ class ElasticTrainer(object):
         # host memory stays O(local shards), no full-model materialize
         target = jax.tree_util.tree_map(_spec, dict(self.train_state))
         restored = None
+        self._resize_timing["t_resume_start"] = time.time()
         for version in reversed(self._ckpt.versions()):
             try:
-                restored = self._ckpt.restore_placed(
+                restored = self._restore_placed_any(
                     version, target, self._state_shardings)
                 break
             except Exception as e:  # noqa: BLE001
@@ -1323,7 +1450,7 @@ class ElasticTrainer(object):
                     core_sh = dict(self._state_shardings)
                     core_sh.pop("extra")
                     try:
-                        restored = self._ckpt.restore_placed(
+                        restored = self._restore_placed_any(
                             version, core, core_sh)
                         logger.info("checkpoint v%d has no extra state; "
                                     "keeping the initial one", version)
@@ -1352,6 +1479,11 @@ class ElasticTrainer(object):
             self.state.adjust(self.world_size)
         self._host_step = self.global_step
         self._resumed_version = version
+        self._resize_timing["t_resume_end"] = time.time()
+        self._resize_timing["restore_s"] = (
+            self._resize_timing["t_resume_end"]
+            - self._resize_timing["t_resume_start"])
+        self._resize_timing["version"] = version
         if self._coord_stop is not None:
             # preempt keys published by the incarnation that wrote this
             # checkpoint are at or below its final step: stale from here

@@ -34,6 +34,25 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # quiet
         pass
 
+    @staticmethod
+    def _parse_range(header, size):
+        """``bytes=a-b`` → (a, min(b, size-1)); None when absent or
+        malformed (full body), "unsatisfiable" when a >= size (416) —
+        the subset GCSFS.read_range emits."""
+        if not header or not header.startswith("bytes="):
+            return None
+        spec = header[len("bytes="):]
+        if "," in spec or "-" not in spec:
+            return None
+        first, _, last = spec.partition("-")
+        if not first.isdigit():
+            return None  # suffix ranges unsupported: serve full body
+        start = int(first)
+        if start >= size:
+            return "unsatisfiable"
+        end = int(last) if last.isdigit() else size - 1
+        return start, min(end, size - 1)
+
     @property
     def store(self):
         return self.server.objects
@@ -75,8 +94,27 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json(404, {"error": "no such object"})
                     return
                 if q.get("alt", [""])[0] == "media":
-                    self._send(200, objs[name],
-                               ctype="application/octet-stream")
+                    data = objs[name]
+                    rng = self._parse_range(self.headers.get("Range"),
+                                            len(data))
+                    if rng == "unsatisfiable":
+                        self._send(416)
+                    elif rng is not None:
+                        start, end = rng
+                        body = data[start:end + 1]
+                        self.send_response(206)
+                        self.send_header("Content-Type",
+                                         "application/octet-stream")
+                        self.send_header("Content-Range",
+                                         "bytes %d-%d/%d"
+                                         % (start, start + len(body) - 1,
+                                            len(data)))
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._send(200, data,
+                                   ctype="application/octet-stream")
                 else:
                     self._json(200, {"name": name, "bucket": bucket,
                                      "size": str(len(objs[name]))})

@@ -50,7 +50,7 @@ def _spawn_store():
 
 
 def _spawn_pod(store_endpoint, job_id, log_dir, ckpt_dir, cache_dir,
-               args, n_devices=None, prewarm_worlds=""):
+               args, n_devices=None, prewarm_worlds="", extra_env=None):
     env = dict(os.environ)  # TPU env inherited
     if n_devices is not None and args.platform == "cpu":
         from edl_tpu.utils.cpu_mesh import force_cpu_env
@@ -70,6 +70,8 @@ def _spawn_pod(store_endpoint, job_id, log_dir, ckpt_dir, cache_dir,
     })
     if cache_dir:
         env["EDL_TPU_COMPILE_CACHE"] = cache_dir
+    if extra_env:
+        env.update(extra_env)
     os.makedirs(log_dir, exist_ok=True)
     log = open(os.path.join(log_dir, "pod.log"), "ab")
     cmd = [sys.executable, "-u", "-m", "edl_tpu.controller.launch",
@@ -241,6 +243,261 @@ def run_resize_arc(prewarm, args):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- peer-served restore arcs (resize_bench/v1) ---------------------------
+#
+# peer_restore_on / peer_restore_off: SAME-world restart with the
+# checkpoint behind a (fake-)GCS endpoint, so the FS restore path pays a
+# real storage protocol instead of the page cache. The _on arc keeps a
+# holdout peer (tools/peer_holdout.py) serving the committed snapshot
+# from host RAM — the surviving-peer role — and the respawned trainer
+# restores over the pipelined RPC plane; the _off arc disables the peer
+# plane (EDL_TPU_PEER_RESTORE=0) and restores from storage. Both emit
+# one ``resize_bench/v1`` JSON line with the per-stage downtime
+# breakdown (detect / kill / barrier / restore / compile / first_step),
+# the restore stages read back from the trainer's published
+# ``resize_timing_r<rank>`` record (SERVICE_METRICS; absolute unix
+# stamps align with this driver's clock).
+
+BREAKDOWN_STAGES = ("detect_s", "kill_s", "barrier_s", "restore_s",
+                    "compile_s", "first_step_s")
+
+
+def _peer_result(tag, args, mode, total_s, breakdown, restore,
+                 **extras):
+    out = {
+        "schema": "resize_bench/v1",
+        "metric": "resize_downtime_s_%s" % tag,
+        "value": round(total_s, 3),
+        "unit": "s",
+        "arc": tag,
+        "mode": mode,
+        "platform": args.platform,
+        "breakdown": {k: round(float(breakdown.get(k, 0.0)), 3)
+                      for k in BREAKDOWN_STAGES},
+        "restore": restore,
+    }
+    out.update(extras)
+    return out
+
+
+def _spawn_holdout(store_endpoint, job_id, ckpt_dir, ready_file,
+                   log_dir, extra_env):
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"  # serves numpy buffers; never needs TPU
+    os.makedirs(log_dir, exist_ok=True)
+    log = open(os.path.join(log_dir, "holdout.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "edl_tpu.tools.peer_holdout",
+         "--store_endpoints", store_endpoint, "--job_id", job_id,
+         "--ckpt", ckpt_dir, "--ready_file", ready_file],
+        env=env, stdout=log, stderr=subprocess.STDOUT,
+        preexec_fn=os.setsid)
+    log.close()
+    return proc
+
+
+def _wait_file(path, timeout, proc=None, what="holdout ready"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if os.path.exists(path) and open(path).read().strip():
+            return open(path).read().strip()
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError("%s: process exited rc=%r"
+                               % (what, proc.returncode))
+        time.sleep(0.1)
+    raise TimeoutError("%s not reached in %.0fs" % (what, timeout))
+
+
+def _read_resize_timing(coord, after_ts, timeout):
+    """The respawned trainer's resize_timing record (published at its
+    first post-restore step). ``after_ts`` filters out the previous
+    incarnation's record under the same permanent key."""
+    from edl_tpu.controller import constants as C
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        try:
+            for name, value in coord.get_service(C.SERVICE_METRICS):
+                if not name.startswith("resize_timing_r"):
+                    continue
+                rec = json.loads(value)
+                if (rec.get("t_construct", 0) >= after_ts
+                        and "t_first_step" in rec):
+                    return rec
+        except Exception:  # noqa: BLE001 — store may flap mid-restart
+            pass
+        time.sleep(0.2)
+    raise TimeoutError("resize_timing record not published in %.0fs"
+                       % timeout)
+
+
+def run_peer_arc(peer, args):
+    """Pod-based peer_restore arc: train -> (holdout) -> SIGKILL ->
+    respawn -> first step, per-stage breakdown from the trainer's
+    published timing."""
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.tools.fake_gcs import FakeGCSServer
+
+    tag = "peer_restore_%s" % ("on" if peer else "off")
+    tmp = tempfile.mkdtemp(prefix="measure_%s_" % tag)
+    gcs = FakeGCSServer().start()
+    ckpt_dir = "gs://resize-bench/ckpt"
+    extra_env = {
+        "STORAGE_EMULATOR_HOST": gcs.endpoint,
+        # stream layout: the format both the peer publish path and the
+        # per-span FS fallback serve
+        "EDL_TPU_ASYNC_SAVE": "1",
+        "EDL_TPU_PEER_RESTORE": "1" if peer else "0",
+    }
+    store = _spawn_store()
+    job_id = "rz_%s_%d" % (tag, os.getpid())
+    coord = CoordClient([store.endpoint], root=job_id)
+    pod = holdout = None
+    try:
+        pod = _spawn_pod(store.endpoint, job_id,
+                         os.path.join(tmp, "logs"), ckpt_dir, None,
+                         args, extra_env=extra_env)
+        s0, t_first = _wait_step(coord,
+                                 lambda s: s >= args.steps_per_epoch,
+                                 args.timeout, pod)
+        if peer:
+            ready = os.path.join(tmp, "holdout.ready")
+            holdout = _spawn_holdout(store.endpoint, job_id, ckpt_dir,
+                                     ready, os.path.join(tmp, "logs"),
+                                     {"STORAGE_EMULATOR_HOST":
+                                      gcs.endpoint})
+            _wait_file(ready, args.timeout, holdout)
+        t_kill = time.time()
+        _kill_group(pod)
+        t_killed = time.time()
+        base = _store_step(coord)
+        base = s0 if base is None else max(base, s0)
+        t_spawn = time.time()
+        pod = _spawn_pod(store.endpoint, job_id,
+                         os.path.join(tmp, "logs2"), ckpt_dir, None,
+                         args, extra_env=extra_env)
+        s1, _ = _wait_step(coord, lambda s: s > base, args.timeout, pod)
+        rec = _read_resize_timing(coord, after_ts=t_kill, timeout=30.0)
+        breakdown = {
+            "detect_s": t_spawn - t_killed,
+            "kill_s": t_killed - t_kill,
+            "barrier_s": max(0.0, rec["t_resume_start"] - t_spawn),
+            "restore_s": rec.get("restore_s", 0.0),
+            "compile_s": rec.get("compile_s", 0.0),
+            "first_step_s": rec.get("first_step_s", 0.0),
+        }
+        restore = {"source": rec.get("restore_source"),
+                   "bytes": rec.get("restore_bytes"),
+                   "peers": rec.get("restore_peers"),
+                   "version": rec.get("version")}
+        out = _peer_result(
+            tag, args, "pod", rec["t_first_step"] - t_kill, breakdown,
+            restore,
+            initial_launch_to_first_epoch_s=round(t_first, 1),
+            pre_kill_step=s0, first_post_restore_step=s1,
+            steps_per_epoch=args.steps_per_epoch, batch=args.batch,
+            image_size=args.image_size)
+        if peer and rec.get("restore_source") == "fs":
+            out["warning"] = ("peer arc fell back to FS — no live peer "
+                              "covered the resumed version")
+        return out
+    finally:
+        for proc in (pod, holdout):
+            if proc is not None:
+                _kill_group(proc)
+        store.stop()
+        gcs.stop()
+        if os.environ.get("MEASURE_RESIZE_KEEP"):
+            print("kept workdir: %s" % tmp, file=sys.stderr)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_peer_arc_micro(peer, args):
+    """In-process micro arc: save one stream checkpoint behind fake
+    GCS, then time a placed restore with (``peer``) a holdout peer
+    serving it from RAM vs without (storage path). Hermetic and fast —
+    this is the tier-1 smoke arc; detect/kill/barrier are not exercised
+    and report 0."""
+    import numpy as np
+
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.runtime.checkpoint import CheckpointManager
+    from edl_tpu.runtime.fs import GCSFS
+    from edl_tpu.tools.fake_gcs import FakeGCSServer
+
+    import jax
+
+    tag = "peer_restore_%s" % ("on" if peer else "off")
+    tmp = tempfile.mkdtemp(prefix="measure_%s_micro_" % tag)
+    gcs = FakeGCSServer().start()
+    ckpt_dir = "gs://resize-bench/ckpt"
+    cm = CheckpointManager(ckpt_dir, fs=GCSFS(endpoint=gcs.endpoint))
+    store = _spawn_store()
+    job_id = "rzm_%s_%d" % (tag, os.getpid())
+    coord = CoordClient([store.endpoint], root=job_id)
+    holdout = None
+    try:
+        rng = np.random.RandomState(0)
+        n = max(1, int(args.micro_mb))
+        tree = {"layer%d" % i: rng.standard_normal(
+            (256, 1024)).astype(np.float32) for i in range(n)}
+        cm.save_async(1, tree, meta={"bench": tag}).result(60.0)
+        dev = jax.devices()[0]
+        sharding = jax.sharding.SingleDeviceSharding(dev)
+        shardings = {k: sharding for k in tree}
+        if peer:
+            from edl_tpu.runtime.state_server import PeerRestorer
+            ready = os.path.join(tmp, "holdout.ready")
+            holdout = _spawn_holdout(store.endpoint, job_id, ckpt_dir,
+                                     ready, tmp,
+                                     {"STORAGE_EMULATOR_HOST":
+                                      gcs.endpoint})
+            _wait_file(ready, args.timeout, holdout)
+            t0 = time.perf_counter()
+            _, restored, _, stats = PeerRestorer(
+                coord, cm).restore_placed(1, tree, shardings)
+            restore_s = time.perf_counter() - t0
+            restore = {"source": stats["source"],
+                       "bytes": stats["peer_bytes"],
+                       "peers": stats["peers"], "version": 1}
+        else:
+            t0 = time.perf_counter()
+            _, restored, _ = cm.restore_placed(1, tree, shardings)
+            restore_s = time.perf_counter() - t0
+            nbytes = sum(int(a.nbytes)
+                         for a in jax.tree_util.tree_leaves(restored))
+            restore = {"source": "fs", "bytes": nbytes, "peers": 0,
+                       "version": 1}
+        # compile + first step on the restored state: a tiny jitted
+        # reduction stands in for the example's step (the micro arc
+        # times the RESTORE paths; steps are the pod arcs' job)
+        step = jax.jit(lambda t: sum(x.sum()
+                                     for x in jax.tree_util
+                                     .tree_leaves(t)))
+        c0 = time.perf_counter()
+        jax.block_until_ready(step(restored))
+        compile_s = time.perf_counter() - c0
+        c1 = time.perf_counter()
+        jax.block_until_ready(step(restored))
+        first_step_s = time.perf_counter() - c1
+        breakdown = {"detect_s": 0.0, "kill_s": 0.0, "barrier_s": 0.0,
+                     "restore_s": restore_s, "compile_s": compile_s,
+                     "first_step_s": first_step_s}
+        return _peer_result(
+            tag, args, "micro",
+            restore_s + compile_s + first_step_s, breakdown, restore,
+            micro_mb=n, state_bytes=n * 256 * 1024 * 4)
+    finally:
+        if holdout is not None:
+            _kill_group(holdout)
+        cm.close()
+        store.stop()
+        gcs.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("measure kill->first-step recovery")
     p.add_argument("--arcs", default="cold,warm")
@@ -259,14 +516,27 @@ def main(argv=None):
     p.add_argument("--from_devices", type=int, default=2,
                    help="resize arcs shrink from this world to half "
                         "of it (8 for the queued TPU run)")
+    p.add_argument("--micro", action="store_true",
+                   help="peer_restore arcs only: hermetic in-process "
+                        "restore-path timing instead of the full pod "
+                        "kill/respawn (the tier-1 smoke mode)")
+    p.add_argument("--micro_mb", type=int, default=64,
+                   help="approximate micro-arc state size in MB")
     args = p.parse_args(argv)
+    if args.platform == "cpu":
+        # the micro arcs run jax IN this process; the pod arcs only
+        # inherit — either way a CPU run must never grab the TPU
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     cache_dir = tempfile.mkdtemp(prefix="measure_resize_cache_")
     rc = 0
     try:
         for tag in args.arcs.split(","):
             tag = tag.strip()
             try:
-                if tag in ("resize_prewarm_on", "resize_prewarm_off"):
+                if tag in ("peer_restore_on", "peer_restore_off"):
+                    out = (run_peer_arc_micro if args.micro
+                           else run_peer_arc)(tag.endswith("_on"), args)
+                elif tag in ("resize_prewarm_on", "resize_prewarm_off"):
                     out = run_resize_arc(tag.endswith("_on"), args)
                 else:
                     out = run_arc(tag,

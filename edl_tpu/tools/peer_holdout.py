@@ -1,0 +1,100 @@
+"""Checkpoint holdout peer: serve a committed checkpoint over the
+peer-restore plane from a process that is NOT part of the training job.
+
+The peer restore plane (edl_tpu/runtime/state_server.py) assumes
+surviving trainers still hold the committed snapshot in host memory.
+Single-node benches and tests have no survivor — the whole pod group is
+SIGKILLed — so this utility plays the survivor: it loads the newest
+committed STREAM checkpoint from the shared directory into host memory,
+publishes it through a :class:`StateServer`, advertises the endpoint in
+the coordination store, and keeps re-syncing to the newest committed
+version until killed. Loading happens BEFORE the measured restart
+window, so a bench arc that kills the trainer afterwards measures
+exactly what a real surviving peer would provide: RAM-resident state
+behind the pipelined RPC plane.
+
+    python -m edl_tpu.tools.peer_holdout \
+        --store_endpoints 127.0.0.1:7070 --job_id myjob \
+        --ckpt gs://bucket/job/ckpt --ready_file /tmp/holdout.ready
+
+``--ready_file`` is written ("<version>\\n") after the first publish —
+drivers poll it instead of scraping logs.
+"""
+
+import argparse
+import sys
+import time
+
+from edl_tpu.utils.logger import logger
+
+
+def _load_entries(cm, version):
+    """({skey: wire-dtype ndarray}, dtypes, meta) of a committed STREAM
+    version — exactly what a live trainer would have published at its
+    commit. Non-stream layouts are refused loudly: the holdout exists
+    to emulate the publish path, which only ever snapshots what the
+    stream engine wrote."""
+    vdir, manifest, meta_blob = cm.load_manifest(version)
+    if manifest.get("format") != "stream":
+        raise SystemExit(
+            "holdout: v%d is not a stream checkpoint (run the saver "
+            "with async_save / EDL_TPU_ASYNC_SAVE=1)" % version)
+    entries = {}
+    for skey, entry in manifest["entries"].items():
+        entries[skey] = cm._read_entry_file(
+            "%s/%s" % (vdir, entry["file"]), entry)
+    return entries, meta_blob.get("dtypes") or {}, meta_blob
+
+
+def serve(args):
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.runtime.checkpoint import CheckpointManager
+    from edl_tpu.runtime.state_server import StateServer
+
+    coord = CoordClient(args.store_endpoints.split(","),
+                        root=args.job_id)
+    cm = CheckpointManager(args.ckpt)
+    srv = StateServer(rank=args.rank, host=args.host)
+    served = None
+    try:
+        srv.advertise(coord)
+        while True:
+            versions = cm.versions()
+            newest = versions[-1] if versions else None
+            if newest is not None and newest != served:
+                entries, dtypes, meta_blob = _load_entries(cm, newest)
+                # meta on disk is exactly the blob the saver passed
+                # (for the trainer: {"state": ...}) — republish as-is
+                srv.publish(newest, entries, dtypes,
+                            meta=meta_blob.get("meta"))
+                served = newest
+                logger.info("holdout: serving v%d (%d entries) at %s",
+                            newest, len(entries), srv.endpoint)
+                if args.ready_file:
+                    with open(args.ready_file, "w") as f:
+                        f.write("%d\n" % newest)
+            time.sleep(args.poll)
+    finally:
+        srv.stop()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "serve a committed checkpoint as a peer StateServer")
+    p.add_argument("--store_endpoints", required=True)
+    p.add_argument("--job_id", required=True)
+    p.add_argument("--ckpt", required=True,
+                   help="checkpoint directory (local or gs://; GCS "
+                        "emulator via STORAGE_EMULATOR_HOST)")
+    p.add_argument("--rank", type=int, default=9001,
+                   help="advertised rank; keep it out of the trainer "
+                        "rank range")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--ready_file", default="")
+    p.add_argument("--poll", type=float, default=0.25,
+                   help="newest-committed-version re-sync period")
+    serve(p.parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,18 @@ class PreemptedError(EdlError):
     checkpoint; the process should exit so the restart resumes from it."""
 
 
+class StaleStateError(EdlError):
+    """A peer StateServer no longer holds the requested snapshot version
+    (a newer save superseded it mid-fetch). The fetcher drops the peer
+    and falls back — alternates first, then the shared FS."""
+
+
+class PeerRestoreError(EdlError):
+    """No usable peer path for a placed restore (no live peers, none at
+    the requested version, or the FS per-span fallback is unavailable);
+    the caller restores wholesale from the shared FS."""
+
+
 _NAME_TO_CLS = None
 
 

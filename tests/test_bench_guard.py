@@ -154,3 +154,33 @@ def test_distill_bench_tiny_cpu_schema():
         assert 0 < out[mode]["occupancy_pct"] <= 100
     assert out["speedup_predicts_s"] > 0
     json.dumps(out)  # the whole report is JSON-serializable
+
+
+def test_measure_resize_micro_peer_arc_cpu_schema(capsys):
+    """Tier-1 smoke of the peer-restore bench arc: the hermetic micro
+    mode (in-process save -> holdout peer -> placed restore) must run
+    on CPU and emit a resize_bench/v1 record with the full per-stage
+    downtime breakdown. No peer-vs-FS timing gate here — CI boxes are
+    too noisy; the acceptance run compares the two arcs offline."""
+    import json
+
+    from edl_tpu.tools import measure_resize
+
+    rc = measure_resize.main(["--arcs", "peer_restore_on", "--micro",
+                              "--micro_mb", "2", "--platform", "cpu"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "error" not in out
+    assert out["schema"] == "resize_bench/v1"
+    assert out["metric"] == "resize_downtime_s_peer_restore_on"
+    assert out["unit"] == "s" and out["mode"] == "micro"
+    assert out["arc"] == "peer_restore_on"
+    assert set(out["breakdown"]) == set(measure_resize.BREAKDOWN_STAGES)
+    assert out["value"] >= out["breakdown"]["restore_s"] > 0
+    assert out["restore"]["source"] == "peer"
+    assert out["restore"]["peers"] >= 1
+    assert out["restore"]["bytes"] > 0
+    assert out["restore"]["version"] == 1
+    json.dumps(out)  # round-trips

@@ -588,3 +588,107 @@ def test_chaos_frame_faults_during_pipelined_distill(plane):
     finally:
         dr.stop()
         teacher.stop()
+
+
+def _peer_plane_fixture(tmp_path, root):
+    """(store, coord, cm, srv, tree, target, shardings) for the peer
+    restore chaos drills: one committed stream checkpoint, one live
+    peer serving the same version."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    from edl_tpu.runtime.checkpoint import CheckpointManager
+    from edl_tpu.runtime.state_server import StateServer, snapshot_entries
+
+    store = StoreServer(host="127.0.0.1", port=0).start()
+    coord = CoordClient([store.endpoint], root=root)
+    rng = np.random.RandomState(11)
+    tree = {"w": rng.randn(32, 8).astype(np.float32),
+            "b": rng.randn(8).astype(np.float32),
+            "step": np.int32(5)}
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(5, tree, meta={"state": {"epoch": 2}}).result(60.0)
+    srv = StateServer(rank=0, host="127.0.0.1")
+    entries, dtypes = snapshot_entries(tree)
+    srv.publish(5, entries, dtypes, meta={"state": {"epoch": 2}})
+    srv.advertise(coord)
+    sh = SingleDeviceSharding(jax.devices("cpu")[0])
+    target = {k: jax.ShapeDtypeStruct(np.shape(v), np.asarray(v).dtype)
+              for k, v in tree.items()}
+    shardings = {k: sh for k in tree}
+    return store, coord, cm, srv, tree, target, shardings
+
+
+def _assert_tree_bytes_equal(got, tree):
+    for k, v in tree.items():
+        ga = np.asarray(got[k])
+        assert ga.dtype == v.dtype and ga.tobytes() == v.tobytes(), k
+
+
+def test_chaos_peer_connect_partition_wholesale_fs_fallback(plane,
+                                                            tmp_path):
+    """Every peer unreachable at dial time: the peer plane raises
+    PeerRestoreError and the caller's wholesale shared-FS restore
+    yields bit-identical state (the trainer's outermost ladder rung)."""
+    from edl_tpu.runtime.state_server import PeerRestorer
+
+    store, coord, cm, srv, tree, target, shardings = \
+        _peer_plane_fixture(tmp_path, "chaos_peer_conn")
+    cut = plane.inject("peer_restore.connect", "partition")
+    try:
+        with pytest.raises(errors.PeerRestoreError):
+            PeerRestorer(coord, cm).restore_placed(5, target, shardings)
+        assert cut.fired >= 1, "connect fault never fired"
+        v, got, meta = cm.restore_placed(5, target, shardings)
+        assert v == 5 and meta == {"state": {"epoch": 2}}
+        _assert_tree_bytes_equal(got, tree)
+    finally:
+        srv.stop()
+        cm.close()
+        store.stop()
+
+
+def test_chaos_peer_death_mid_fetch_per_span_fs_fill(plane, tmp_path):
+    """Peer dies mid-fetch (every range read errors after a healthy
+    manifest): the failed spans are re-filled per-key from the shared
+    FS and the result is bit-identical to a pure FS restore."""
+    from edl_tpu.runtime.state_server import PeerRestorer
+
+    store, coord, cm, srv, tree, target, shardings = \
+        _peer_plane_fixture(tmp_path, "chaos_peer_read")
+    die = plane.inject("peer_restore.read", "error",
+                       error="ConnectError")
+    try:
+        v, got, meta, stats = PeerRestorer(coord, cm).restore_placed(
+            5, target, shardings)
+        assert die.fired >= 1, "read fault never fired"
+        assert v == 5 and stats["source"] == "peer+fs"
+        assert set(stats["fs_keys"]) == set(tree)
+        _assert_tree_bytes_equal(got, tree)
+        _, fs_got, _ = cm.restore_placed(5, target, shardings)
+        _assert_tree_bytes_equal(fs_got, tree)
+    finally:
+        srv.stop()
+        cm.close()
+        store.stop()
+
+
+def test_chaos_peer_read_error_once_partial_then_peer(plane, tmp_path):
+    """A single faulted read: only that key falls back to FS, the rest
+    still comes off the peer, and the assembled state is unchanged."""
+    from edl_tpu.runtime.state_server import PeerRestorer
+
+    store, coord, cm, srv, tree, target, shardings = \
+        _peer_plane_fixture(tmp_path, "chaos_peer_once")
+    once = plane.inject("peer_restore.read", "error_once")
+    try:
+        v, got, meta, stats = PeerRestorer(coord, cm).restore_placed(
+            5, target, shardings)
+        assert once.fired == 1
+        assert stats["source"] == "peer+fs"
+        assert len(stats["fs_keys"]) == 1
+        _assert_tree_bytes_equal(got, tree)
+    finally:
+        srv.stop()
+        cm.close()
+        store.stop()

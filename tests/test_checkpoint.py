@@ -444,3 +444,162 @@ def test_gcs_fs_primitives():
         assert fs.listdir("gs://b/x") == ["top.bin"]
         with pytest.raises(NotImplementedError):
             fs.rename("gs://b/x/top.bin", "gs://b/x/y")
+
+
+# -- stream range reads + chunk CRCs (the placed/peer restore data path) --
+
+
+def test_stream_manifest_records_chunk_crcs(ckpt_fs, monkeypatch):
+    from edl_tpu.runtime import checkpoint as ckpt_mod
+    monkeypatch.setattr(ckpt_mod, "_CHUNK", 256)
+    cm = _cm(ckpt_fs)
+    arr = np.arange(50 * 16, dtype=np.float32).reshape(50, 16)  # 3200 B
+    cm.save_async(1, {"w": arr, "empty": np.zeros((0, 4), np.float32)}
+                  ).result(60.0)
+    base, fs = ckpt_fs
+    with fs.open(base + "/v_00000001/MANIFEST", "r") as f:
+        manifest = json.load(f)
+    entry = manifest["entries"]["w@0:50;0:16"]
+    assert entry["chunk"] == 256
+    assert len(entry["chunk_crcs"]) == (3200 + 255) // 256
+    assert manifest["entries"]["empty@0:0;0:4"]["chunk_crcs"] == []
+
+
+def test_read_entry_rows_range_read_and_crc_reject(ckpt_fs, monkeypatch):
+    """_read_entry_rows fetches only the chunk-aligned byte range of the
+    needed rows, verifies just those chunks' CRCs, and rejects a
+    corrupted chunk inside the range."""
+    from edl_tpu.runtime import checkpoint as ckpt_mod
+    monkeypatch.setattr(ckpt_mod, "_CHUNK", 256)
+    cm = _cm(ckpt_fs)
+    arr = np.arange(50 * 16, dtype=np.float32).reshape(50, 16)
+    cm.save_async(2, {"w": arr}).result(60.0)
+    base, fs = ckpt_fs
+    vdir = base + "/v_00000002"
+    with fs.open(vdir + "/MANIFEST", "r") as f:
+        entry = json.load(f)["entries"]["w@0:50;0:16"]
+    path = "%s/%s" % (vdir, entry["file"])
+
+    ranges = []
+    orig = fs.read_range
+    monkeypatch.setattr(
+        fs, "read_range",
+        lambda p, off, ln: ranges.append((off, ln)) or orig(p, off, ln))
+    got = cm._read_entry_rows(path, entry, 7, 23)
+    np.testing.assert_array_equal(got, arr[7:23])
+    # rows 7..23 = bytes 448..1472 -> chunks 1..5 -> one 1280 B read
+    assert ranges == [(256, 1280)]
+    # row hull ending exactly on a chunk boundary: rows 4..8 = bytes
+    # 256..512 = exactly chunk 1
+    np.testing.assert_array_equal(cm._read_entry_rows(path, entry, 4, 8),
+                                  arr[4:8])
+    assert ranges[-1] == (256, 256)
+
+    # corrupt one byte inside chunk 2 (bytes 512..768): a range read
+    # touching it must fail the per-chunk crc, one missing it must not
+    with fs.open(path, "rb") as f:
+        raw = bytearray(f.read())
+    raw[600] ^= 0xFF
+    with fs.open(path, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(IOError, match="checksum mismatch"):
+        cm._read_entry_rows(path, entry, 7, 23)
+    np.testing.assert_array_equal(cm._read_entry_rows(path, entry, 0, 4),
+                                  arr[0:4])
+
+
+def test_fill_placed_partial_blocks_uses_range_reads(ckpt_fs,
+                                                     monkeypatch):
+    """A process needing a strict row subset of a dense stream entry
+    (the multi-host placed-restore case) reads only that range."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from edl_tpu.runtime import checkpoint as ckpt_mod
+    monkeypatch.setattr(ckpt_mod, "_CHUNK", 256)
+    cm = _cm(ckpt_fs)
+    arr = np.arange(64 * 16, dtype=np.float32).reshape(64, 16)
+    cm.save_async(3, {"w": arr}).result(60.0)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    sh = NamedSharding(mesh, P("dp"))
+    pt = ckpt_mod.PlacedTarget({"w": jax.ShapeDtypeStruct(arr.shape,
+                                                          arr.dtype)},
+                               {"w": sh})
+    # keep only devices 2 and 3's blocks (rows 16..32): emulates the
+    # remote ranks of a multi-process restore owning the rest
+    _, _, _, blocks, dev_spans = pt.need["w"]
+    keep = {spans for dev, spans in dev_spans.items()
+            if spans[0][0] in (16, 24)}
+    pt.need["w"] = (pt.need["w"][0], pt.need["w"][1], pt.need["w"][2],
+                    {s: b for s, b in blocks.items() if s in keep},
+                    {d: s for d, s in dev_spans.items() if s in keep})
+
+    base, fs = ckpt_fs
+    ranges = []
+    orig = fs.read_range
+    monkeypatch.setattr(
+        fs, "read_range",
+        lambda p, off, ln: ranges.append((off, ln)) or orig(p, off, ln))
+    cm.fill_placed_from_fs(3, pt, keys={"w"})
+    assert not pt.missing()
+    for spans, blk in pt.need["w"][3].items():
+        np.testing.assert_array_equal(blk[0],
+                                      arr[spans[0][0]:spans[0][1]])
+    # rows 16..32 = bytes 1024..2048: exactly 1 KiB of the 4 KiB file
+    assert ranges == [(1024, 1024)]
+
+
+def test_dense_sharded_stream_cross_restore_bit_identical(ckpt_fs):
+    """The same state saved through BOTH stream engines (dense
+    save_async and per-rank save_sharded_async) restores bit-identically
+    onto resharded placed targets."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cm = _cm(ckpt_fs)
+    tree, host = _sharded_tree(11)
+    cm.save_async(1, tree, meta={"src": "dense"}).result(60.0)
+    h = cm.save_sharded_async(2, tree, meta={"src": "sharded"})
+    h.wait(60.0)
+    assert h.exception() is None
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    shardings = {"params": {"w": NamedSharding(mesh4, P())},
+                 "opt": {"mu": NamedSharding(mesh4, P("dp"))},
+                 "bf16": NamedSharding(mesh4, P("dp")),
+                 "step": NamedSharding(mesh4, P())}
+    target = _struct_target(tree)
+    v1, from_dense, m1 = cm.restore_placed(1, target, shardings)
+    v2, from_sharded, m2 = cm.restore_placed(2, target, shardings)
+    assert m1 == {"src": "dense"} and m2 == {"src": "sharded"}
+    for a, b in zip(jax.tree_util.tree_leaves(from_dense),
+                    jax.tree_util.tree_leaves(from_sharded)):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(from_dense["opt"]["mu"]),
+                                  host["opt"]["mu"])
+    np.testing.assert_array_equal(
+        np.asarray(from_dense["bf16"], np.float32),
+        np.asarray(jnp.asarray(host["bf16"], jnp.bfloat16), np.float32))
+
+
+def test_restore_placed_rejects_corrupted_stream_chunk(ckpt_fs):
+    cm = _cm(ckpt_fs)
+    arr = np.arange(32 * 8, dtype=np.float32).reshape(32, 8)
+    cm.save_async(5, {"w": arr}).result(60.0)
+    base, fs = ckpt_fs
+    vdir = base + "/v_00000005"
+    with fs.open(vdir + "/MANIFEST", "r") as f:
+        entry = json.load(f)["entries"]["w@0:32;0:8"]
+    path = "%s/%s" % (vdir, entry["file"])
+    with fs.open(path, "rb") as f:
+        raw = bytearray(f.read())
+    raw[10] ^= 0xFF
+    with fs.open(path, "wb") as f:
+        f.write(bytes(raw))
+    import jax
+    sh = {"w": jax.sharding.SingleDeviceSharding(jax.devices()[0])}
+    with pytest.raises(IOError):
+        cm.restore_placed(5, {"w": jax.ShapeDtypeStruct(arr.shape,
+                                                        arr.dtype)}, sh)

@@ -95,3 +95,79 @@ def test_prefetch_feeds_training_loop():
         for batch in it:
             w = step(w, batch)
     np.testing.assert_allclose(np.asarray(w), np.full((4,), 10.0))
+
+
+def test_prefetch_pump_error_chained_with_original_traceback():
+    """The re-raised pump exception is a NEW instance of the same type
+    whose __cause__ is the ORIGINAL (with the pump thread's traceback)
+    — so the consumer sees both its own call site and where in the
+    input pipeline things actually blew up."""
+    mesh = make_mesh()
+
+    class FeedError(ValueError):
+        pass
+
+    def boom():
+        yield {"x": np.zeros((8, 2), np.float32)}
+        raise FeedError("bad shard spec")
+
+    it = DevicePrefetcher(boom(), data_sharding(mesh))
+    next(it)
+    with pytest.raises(FeedError) as ei:
+        next(it)
+    assert ei.value.args == ("bad shard spec",)
+    cause = ei.value.__cause__
+    assert isinstance(cause, FeedError) and cause is not ei.value
+    assert cause.__traceback__ is not None
+    frames = []
+    tb = cause.__traceback__
+    while tb is not None:
+        frames.append(tb.tb_frame.f_code.co_name)
+        tb = tb.tb_next
+    assert "boom" in frames  # the producer frame survived the hop
+    it.close()
+
+
+def test_prefetch_pump_error_exotic_signature_wrapped():
+    """Exception types that cannot be rebuilt from .args (required
+    keyword ctor) degrade to a RuntimeError wrapper — still chained to
+    the original, never a secondary TypeError."""
+    mesh = make_mesh()
+
+    class Picky(Exception):
+        def __init__(self, *, code):
+            super().__init__("code=%d" % code)
+            self.code = code
+
+    def boom():
+        if False:
+            yield
+        raise Picky(code=7)
+
+    it = DevicePrefetcher(boom(), data_sharding(mesh))
+    with pytest.raises(RuntimeError, match="device prefetch pump") as ei:
+        next(it)
+    assert isinstance(ei.value.__cause__, Picky)
+    assert ei.value.__cause__.code == 7
+    it.close()
+
+
+def test_prefetch_close_is_idempotent_and_joins():
+    mesh = make_mesh()
+
+    def slow_infinite():
+        import itertools
+        import time
+        for i in itertools.count():
+            time.sleep(0.01)
+            yield {"x": np.full((8, 2), i, np.float32)}
+
+    it = DevicePrefetcher(slow_infinite(), data_sharding(mesh), size=2)
+    next(it)
+    it.close()
+    assert not it._thread.is_alive()
+    it.close()  # second close: no-op, no error, thread still dead
+    it.close()
+    assert not it._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(it)

@@ -439,3 +439,111 @@ def test_uds_fast_path_and_fallback(monkeypatch):
         os.unlink(stale)
     finally:
         server2.stop()
+
+
+def test_uds_identity_two_servers_sharing_a_port_number():
+    """Regression: the UDS path is keyed by PORT NUMBER only, so two
+    servers bound to different loopback addresses with the same port
+    number collide on it. The first owner keeps the socket (flock
+    sidecar); a client dialing the OTHER server must detect the
+    identity mismatch on the UDS probe and fall back to TCP — never
+    silently talk to the wrong process."""
+    import os
+
+    from edl_tpu.rpc.server import uds_path_for_port
+
+    a = RpcServer(host="127.0.0.2")
+    a.register("who", lambda: "A")
+    a.start()
+    b = None
+    try:
+        path = uds_path_for_port(a.port)
+        assert os.path.exists(path) and os.path.exists(path + ".lock")
+
+        # same port number, different loopback address: B must see the
+        # held flock, leave A's socket alone, and serve TCP-only
+        b = RpcServer(host="127.0.0.1", port=a.port)
+        b.register("who", lambda: "B")
+        b.start()
+        assert b._uds_server is None
+        assert os.path.exists(path)  # A's listener survived B's start
+
+        # dialing B rides the shared UDS path into A's listener; the
+        # identity probe unmasks it and the call goes out over TCP
+        cb = RpcClient(b.endpoint)
+        assert cb.call("who") == "B"
+        assert cb.transport == "tcp"
+        cb.close()
+
+        # dialing A at 127.0.0.2 is not a "this machine" address for
+        # the client's fast path: plain TCP, and it still reaches A
+        ca = RpcClient(a.endpoint)
+        assert ca.call("who") == "A"
+        assert ca.transport == "tcp"
+        ca.close()
+
+        # positive control: once B is gone, a loopback dial of the same
+        # port number rides A's UDS listener iff the identity matches —
+        # it doesn't (A is bound to 127.0.0.2), so this must stay TCP
+        # even with no competing server
+        b.stop()
+        cb2 = RpcClient("127.0.0.1:%d" % a.port)
+        with pytest.raises(errors.EdlError):
+            cb2.call("who")  # nobody serves TCP 127.0.0.1:P anymore
+        assert cb2.transport != "uds"  # never rode A's socket
+        cb2.close()
+        b = None
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+    # socket unlinked on stop; the lock sidecar deliberately is NOT
+    # (unlinking it would resurrect the probe/unlink/bind race)
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".lock")
+
+
+def test_uds_identity_probe_rejects_garbage(monkeypatch):
+    """A listener that answers the identity probe with junk (or not at
+    all) is treated as a mismatch: silent TCP fallback."""
+    import os
+    import socket as _s
+    import threading
+
+    from edl_tpu.rpc.server import uds_path_for_port
+
+    server = RpcServer(host="127.0.0.1")
+    server.register("ping", lambda: "pong")
+    server.start()
+    try:
+        path = uds_path_for_port(server.port)
+        # replace the real UDS listener with one that answers nothing
+        server._uds_server.shutdown()
+        server._uds_server.server_close()
+        server._uds_server = None
+        if os.path.exists(path):
+            os.unlink(path)
+        rogue = _s.socket(_s.AF_UNIX)
+        rogue.bind(path)
+        os.chmod(path, 0o600)
+        rogue.listen(1)
+
+        def _accept_and_stall():
+            try:
+                conn, _ = rogue.accept()
+                conn.recv(4096)   # swallow the probe, answer nothing
+                conn.close()
+            except OSError:
+                pass
+
+        t = threading.Thread(target=_accept_and_stall, daemon=True)
+        t.start()
+        client = RpcClient(server.endpoint)
+        assert client.call("ping") == "pong"
+        assert client.transport == "tcp"
+        client.close()
+        rogue.close()
+        t.join(timeout=5)
+        os.unlink(path)
+    finally:
+        server.stop()

@@ -1,0 +1,238 @@
+"""Peer-served restore plane: StateServer snapshot/serve semantics and
+PeerRestorer's ladder (peers -> per-span FS fill -> error), including
+the bit-identical peer-vs-FS restore guarantee the resize bench rests
+on."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.controller import constants
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.coordination.server import StoreServer
+from edl_tpu.runtime.checkpoint import CheckpointManager
+from edl_tpu.runtime.state_server import (PeerRestorer, StateServer,
+                                          snapshot_entries)
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import errors
+
+
+@pytest.fixture()
+def coord():
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    try:
+        yield CoordClient([srv.endpoint], root="t_peer")
+    finally:
+        srv.stop()
+
+
+def _tree(seed):
+    """dp-sharded + replicated + bf16 + host-scalar state over the
+    8-device CPU mesh, with its host mirror."""
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    rng = np.random.RandomState(seed)
+    w = rng.randn(8, 4).astype(np.float32)
+    mu = rng.randn(16, 2).astype(np.float32)
+    bf = rng.randn(8, 2).astype(np.float32)
+    tree = {
+        "params": {"w": jax.device_put(w, NamedSharding(mesh, P()))},
+        "opt": {"mu": jax.device_put(mu, NamedSharding(mesh, P("dp")))},
+        "bf16": jax.device_put(jnp.asarray(bf, jnp.bfloat16),
+                               NamedSharding(mesh, P("dp"))),
+        "step": np.int32(seed),
+    }
+    host = {"params": {"w": w}, "opt": {"mu": mu}, "bf16": bf,
+            "step": np.int32(seed)}
+    return tree, host
+
+
+def _target_and_shardings(tree, n=4):
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    shardings = {"params": {"w": NamedSharding(mesh, P())},
+                 "opt": {"mu": NamedSharding(mesh, P("dp"))},
+                 "bf16": NamedSharding(mesh, P("dp")),
+                 "step": NamedSharding(mesh, P())}
+    target = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                       getattr(x, "dtype",
+                                               np.asarray(x).dtype)),
+        tree)
+    return target, shardings
+
+
+def _assert_bit_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype
+        assert xa.tobytes() == ya.tobytes()
+
+
+def test_snapshot_entries_spans_wire_dtypes_and_copies():
+    tree, host = _tree(1)
+    entries, dtypes = snapshot_entries(tree)
+    # replicated leaf: ONE full-span entry, not eight
+    assert "params/w@0:8;0:4" in entries
+    # dp-sharded leaf over 8 devices: 8 disjoint row spans
+    mu_keys = [k for k in entries if k.startswith("opt/mu@")]
+    assert len(mu_keys) == 8
+    np.testing.assert_array_equal(entries["opt/mu@2:4;0:2"],
+                                  host["opt"]["mu"][2:4])
+    # bf16 rides the wire as uint16 + tag
+    assert entries["bf16@0:1;0:2"].dtype == np.uint16
+    assert dtypes["bf16"] == "bfloat16"
+    assert entries["step@"].shape == ()
+
+    # published buffers are copies: mutating the source afterwards must
+    # not change what a peer would be served
+    src = np.arange(6, dtype=np.float32)
+    entries2, _ = snapshot_entries({"h": src})
+    src[:] = -1
+    np.testing.assert_array_equal(entries2["h@0:6"],
+                                  np.arange(6, dtype=np.float32))
+
+
+def test_state_server_manifest_read_stale_and_missing():
+    tree, host = _tree(2)
+    srv = StateServer(rank=3, host="127.0.0.1")
+    client = None
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        srv.publish(5, entries, dtypes, meta={"state": {"epoch": 9}})
+        client = RpcClient(srv.endpoint)
+        man = client.call("state.manifest")
+        assert man["version"] == 5 and man["rank"] == 3
+        assert man["meta"] == {"state": {"epoch": 9}}
+        ent = man["entries"]["opt/mu@2:4;0:2"]
+        want = host["opt"]["mu"][2:4]
+        assert ent["nbytes"] == want.nbytes
+        blob = np.asarray(client.call("state.read", 5,
+                                      "opt/mu@2:4;0:2", 0,
+                                      want.nbytes))
+        np.testing.assert_array_equal(
+            blob.view(np.float32).reshape(2, 2), want)
+        # offset/length sub-reads slice the same buffer
+        part = np.asarray(client.call("state.read", 5,
+                                      "opt/mu@2:4;0:2", 4, 8))
+        assert part.tobytes() == want.tobytes()[4:12]
+        with pytest.raises(errors.StaleStateError):
+            client.call("state.read", 4, "opt/mu@2:4;0:2", 0, 8)
+        with pytest.raises(errors.NotFoundError):
+            client.call("state.read", 5, "nope@0:1", 0, 8)
+    finally:
+        if client is not None:
+            client.close()
+        srv.stop()
+
+
+def test_peer_restore_bit_identical_to_fs(coord, tmp_path):
+    """THE resize-bench invariant: a peer-served placed restore yields
+    byte-for-byte the state a shared-FS placed restore yields."""
+    tree, host = _tree(7)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(7, tree, meta={"state": {"epoch": 1}}).result(60.0)
+
+    srv = StateServer(rank=1, host="127.0.0.1")
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        srv.publish(7, entries, dtypes, meta={"state": {"epoch": 1}})
+        srv.advertise(coord)
+        # discovery sees the advertised endpoint
+        regs = coord.get_service(constants.SERVICE_STATE_SERVER)
+        assert [json.loads(v)["endpoint"] for _, v in regs] \
+            == [srv.endpoint]
+
+        target, shardings = _target_and_shardings(tree)
+        v, peer_tree, meta, stats = PeerRestorer(coord, cm) \
+            .restore_placed(7, target, shardings)
+        assert v == 7 and meta == {"state": {"epoch": 1}}
+        assert stats["source"] == "peer" and stats["fs_keys"] == []
+        assert stats["peers"] == 1 and stats["peer_bytes"] > 0
+
+        _, fs_tree, _ = cm.restore_placed(7, target, shardings)
+        _assert_bit_identical(peer_tree, fs_tree)
+        np.testing.assert_array_equal(
+            np.asarray(peer_tree["opt"]["mu"]), host["opt"]["mu"])
+    finally:
+        srv.stop()
+        cm.close()
+
+
+def test_peer_restore_partial_coverage_fills_rest_from_fs(coord,
+                                                          tmp_path):
+    tree, host = _tree(9)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(3, tree).result(60.0)
+    srv = StateServer(rank=2, host="127.0.0.1")
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        partial = {k: v for k, v in entries.items()
+                   if k.startswith(("opt/mu@", "step@"))}
+        srv.publish(3, partial, dtypes)
+        srv.advertise(coord)
+        target, shardings = _target_and_shardings(tree)
+        v, peer_tree, _, stats = PeerRestorer(coord, cm) \
+            .restore_placed(3, target, shardings)
+        assert stats["source"] == "peer+fs"
+        assert set(stats["fs_keys"]) == {"params/w", "bf16"}
+        _, fs_tree, _ = cm.restore_placed(3, target, shardings)
+        _assert_bit_identical(peer_tree, fs_tree)
+    finally:
+        srv.stop()
+        cm.close()
+
+
+def test_peer_restore_no_peers_and_stale_and_self(coord, tmp_path):
+    tree, _ = _tree(4)
+    cm = CheckpointManager(str(tmp_path))
+    target, shardings = _target_and_shardings(tree)
+    with pytest.raises(errors.PeerRestoreError):
+        PeerRestorer(coord, cm).restore_placed(1, target, shardings)
+    srv = StateServer(rank=0, host="127.0.0.1")
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        srv.publish(6, entries, dtypes)  # older than requested
+        srv.advertise(coord)
+        with pytest.raises(errors.PeerRestoreError):
+            PeerRestorer(coord, cm).restore_placed(7, target, shardings)
+        # a process must never "restore" from its own server
+        srv.publish(7, entries, dtypes)
+        with pytest.raises(errors.PeerRestoreError):
+            PeerRestorer(coord, cm, self_endpoint=srv.endpoint) \
+                .restore_placed(7, target, shardings)
+    finally:
+        srv.stop()
+        cm.close()
+
+
+def test_peer_restore_unreachable_endpoint_skipped(coord, tmp_path):
+    """A peer that died between advertise and dial (lease not yet
+    expired) is skipped, not fatal."""
+    tree, _ = _tree(5)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(2, tree).result(60.0)
+    dead = StateServer(rank=4, host="127.0.0.1")
+    dead.advertise(coord)
+    dead_reg, dead._register = dead._register, None  # keep the lease
+    dead.stop()
+    live = StateServer(rank=5, host="127.0.0.1")
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        live.publish(2, entries, dtypes)
+        live.advertise(coord)
+        target, shardings = _target_and_shardings(tree)
+        v, peer_tree, _, stats = PeerRestorer(
+            coord, cm, timeout=3.0).restore_placed(2, target, shardings)
+        assert stats["source"] == "peer" and stats["peers"] == 1
+        _, fs_tree, _ = cm.restore_placed(2, target, shardings)
+        _assert_bit_identical(peer_tree, fs_tree)
+    finally:
+        dead_reg.stop()
+        live.stop()
+        cm.close()
