@@ -20,11 +20,37 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+import numpy as np
+
+from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
 END = "__END__"
+
+#: server-side ceiling on ds_get_assignment long-polls — a consumer may
+#: ask for less, never more (an unbounded park would pin server threads
+#: to consumers that died mid-poll)
+MAX_ASSIGN_WAIT_MS = 2000
+
+
+def payload_nbytes(obj):
+    """Approximate in-memory size of a batch payload — the unit the
+    byte-bounded BatchCache accounts in. Counts the data that
+    dominates (array buffers, blobs, strings); envelope keys and
+    per-object overhead are noise at batch scale."""
+    if isinstance(obj, np.ndarray):
+        return obj.nbytes
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    if isinstance(obj, str):
+        return len(obj)
+    if isinstance(obj, dict):
+        return sum(payload_nbytes(v) for v in obj.values())
+    if isinstance(obj, (list, tuple)):
+        return sum(payload_nbytes(v) for v in obj)
+    return 8
 
 
 class LeaderDataService(object):
@@ -50,6 +76,9 @@ class LeaderDataService(object):
     def __init__(self, file_list, reader_ttl=30.0, clock=None):
         self._files = list(file_list)
         self._lock = threading.Lock()
+        # long-poll wakeup: notified whenever new batches are reported,
+        # a reader finishes, or eviction changes the END calculus
+        self._avail_cond = threading.Condition(self._lock)
         # pod_id -> {"endpoint", "done", "seen", "evicted"}
         self._readers = {}
         self._file_cursor = 0
@@ -58,6 +87,7 @@ class LeaderDataService(object):
         # batch_id -> producer endpoint
         self._producer = {}
         self._consumed = set()
+        self._stolen = 0
         self._reader_ttl = reader_ttl
         self._clock = clock or time.monotonic
 
@@ -143,41 +173,63 @@ class LeaderDataService(object):
                 if b not in self._consumed and b not in self._producer:
                     q.append(b)
                     self._producer[b] = endpoint
+            self._avail_cond.notify_all()
             return True
 
     def reach_data_end(self, pod_id):
         with self._lock:
             if pod_id in self._readers:
                 self._readers[pod_id]["done"] = True
+            self._avail_cond.notify_all()
             return True
 
     # -- consumption -----------------------------------------------------------
 
-    def get_assignment(self, pod_id, n=1):
+    def get_assignment(self, pod_id, n=1, wait_ms=0):
         """Balanced batch assignments for ``pod_id``: its own production
         first, then stolen from the richest producer. Returns a list of
         {batch_id, endpoint}; [END] when all data is consumed; [] means
-        'retry later' (production still in flight)."""
+        'retry later' (production still in flight).
+
+        ``wait_ms``: long-poll contract — with nothing assignable, park
+        up to ``wait_ms`` (server-capped at MAX_ASSIGN_WAIT_MS) until a
+        production report / data-end / eviction changes the answer,
+        replacing the consumers' fixed 50 ms polling with wakeups at
+        the moment batches appear. [] still means 'retry later'; the
+        poll never parks past the cap, so a consumer that died
+        mid-poll cannot pin a server thread for long."""
+        deadline = (self._clock()
+                    + min(max(0, wait_ms), MAX_ASSIGN_WAIT_MS) / 1e3)
         with self._lock:
             self._touch(pod_id)
-            out = []
-            own = self._avail.get(pod_id)
-            while own and len(out) < n:
-                out.append(self._take(pod_id))
-            while len(out) < n:
-                richest = max(self._avail,
-                              key=lambda p: len(self._avail[p]),
-                              default=None)
-                if richest is None or not self._avail[richest]:
-                    break
-                out.append(self._take(richest))
-            if out:
-                return out
-            self._evict_silent()  # a dead producer must not wedge END
-            all_done = (self._file_cursor >= len(self._files)
-                        and self._readers
-                        and all(r["done"] for r in self._readers.values()))
-            return [END] if all_done else []
+            while True:
+                out = []
+                own = self._avail.get(pod_id)
+                while own and len(out) < n:
+                    out.append(self._take(pod_id))
+                while len(out) < n:
+                    richest = max(self._avail,
+                                  key=lambda p: len(self._avail[p]),
+                                  default=None)
+                    if richest is None or not self._avail[richest]:
+                        break
+                    out.append(self._take(richest))
+                    self._stolen += 1
+                if out:
+                    return out
+                self._evict_silent()  # a dead producer must not wedge END
+                all_done = (self._file_cursor >= len(self._files)
+                            and self._readers
+                            and all(r["done"]
+                                    for r in self._readers.values()))
+                if all_done:
+                    return [END]
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    return []
+                # bounded slices so eviction is re-checked while parked
+                # (a producer dying mid-poll must still converge to END)
+                self._avail_cond.wait(timeout=min(remaining, 0.25))
 
     def _take(self, pod_id):
         batch_id = self._avail[pod_id].popleft()
@@ -194,25 +246,55 @@ class LeaderDataService(object):
                 "files_total": len(self._files),
                 "pending": {p: len(q) for p, q in self._avail.items()},
                 "consumed": len(self._consumed),
+                "stolen": self._stolen,
                 "readers": {p: r["done"] for p, r in self._readers.items()},
             }
 
 
 class BatchCache(object):
-    """Producer-side batch store with back-pressure (bounded size)."""
+    """Producer-side batch store with back-pressure, bounded by BOTH
+    entry count and bytes: a fast producer facing an idle consumer used
+    to grow the cache to ``capacity`` batches of unbounded size — with
+    variable-length records the count bound is no memory bound at all.
+    ``put`` blocks until the payload fits (a payload larger than the
+    whole byte budget is admitted alone, so one oversized batch can
+    never deadlock the producer)."""
 
-    def __init__(self, capacity=64):
+    def __init__(self, capacity=64, capacity_bytes=256 << 20):
         self._cap = capacity
+        self._cap_bytes = capacity_bytes
         self._lock = threading.Lock()
         self._not_full = threading.Condition(self._lock)
         self._data = OrderedDict()  # batch_id -> payload
+        self._sizes = {}            # batch_id -> payload_nbytes
+        self._bytes = 0
 
-    def put(self, batch_id, payload, timeout=600):
+    def _fits(self, size):
+        if len(self._data) >= self._cap:
+            return False
+        if self._cap_bytes is None or not self._data:
+            return True  # oversized batch admitted alone
+        return self._bytes + size <= self._cap_bytes
+
+    def put(self, batch_id, payload, timeout=600, stop=None):
+        """Block until the payload fits. ``stop`` (a threading.Event)
+        aborts the wait promptly — a stopping producer must not sit out
+        the full timeout against a full cache. Returns False iff
+        stopped; raises after ``timeout`` without room."""
+        size = payload_nbytes(payload)
+        deadline = time.monotonic() + timeout
         with self._not_full:
-            if not self._not_full.wait_for(
-                    lambda: len(self._data) < self._cap, timeout=timeout):
-                raise errors.DataAccessError("batch cache full")
+            while not self._fits(size):
+                if stop is not None and stop.is_set():
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise errors.DataAccessError("batch cache full")
+                self._not_full.wait(timeout=min(remaining, 0.2))
             self._data[batch_id] = payload
+            self._sizes[batch_id] = size
+            self._bytes += size
+        return True
 
     def get(self, batch_id):
         with self._lock:
@@ -221,8 +303,14 @@ class BatchCache(object):
     def pop(self, batch_id):
         with self._not_full:
             payload = self._data.pop(batch_id, None)
+            if payload is not None:
+                self._bytes -= self._sizes.pop(batch_id, 0)
             self._not_full.notify_all()
             return payload
+
+    def nbytes(self):
+        with self._lock:
+            return self._bytes
 
     def __len__(self):
         with self._lock:
@@ -237,6 +325,7 @@ class DataPlaneServer(object):
         self._rpc = RpcServer(host=host, port=port)
         self._cache = cache
         self._rpc.register("get_batch", self._get_batch)
+        self._rpc.register("get_batches", self._get_batches)
         if leader_service is not None:
             svc = leader_service
             self._rpc.register("ds_register_reader", svc.register_reader)
@@ -252,6 +341,32 @@ class DataPlaneServer(object):
         if payload is None:
             raise errors.NotFoundError("batch %s not in cache" % batch_id)
         return payload
+
+    def _get_batches(self, batch_ids, fmt="row"):
+        """Multi-batch fetch for pipelined consumers: one RPC moves a
+        whole assignment. The result aligns with ``batch_ids``; a
+        missing batch yields None in its slot (the consumer logs it
+        lost) instead of failing the siblings.
+
+        ``fmt="col"``: each payload's record list is packed into
+        ndarray columns (``fmt: "col"`` marks the payload) so the
+        records ride the v2 tensor frames as a few contiguous segments
+        — no per-record msgpack, no per-record frame segment. Records
+        the columnar codec cannot represent exactly stay row-form
+        (per-payload fallback, mixed results are fine)."""
+        out = []
+        for batch_id in batch_ids:
+            payload = self._cache.pop(batch_id)
+            if payload is not None and fmt == "col" \
+                    and "records" in payload:
+                cols = nd.pack_columns(payload["records"])
+                if cols is not None:
+                    payload = {k: v for k, v in payload.items()
+                               if k != "records"}
+                    payload["fmt"] = "col"
+                    payload["cols"] = cols
+            out.append(payload)
+        return out
 
     def start(self):
         self._rpc.start()

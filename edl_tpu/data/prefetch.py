@@ -10,6 +10,7 @@ previous step's compute.
 
 import queue
 import threading
+import time
 
 import jax
 
@@ -32,10 +33,26 @@ class DevicePrefetcher(object):
         self._err = None
         self._exhausted = False
         self._closed = False
+        # overlap accounting: how long the consumer waited on __next__
+        # vs how long the pump waited on the host iterator — the two
+        # numbers that say which side of the pipeline is the bottleneck
+        self._stats_lock = threading.Lock()
+        self._batches = 0
+        self._consumer_wait_s = 0.0
+        self._pump_wait_s = 0.0
 
         def pump():
             try:
-                for batch in host_iter:
+                it = iter(host_iter)
+                while True:
+                    t0 = time.monotonic()
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                    finally:
+                        with self._stats_lock:
+                            self._pump_wait_s += time.monotonic() - t0
                     if self._stop.is_set():
                         return
                     if transform is not None:
@@ -71,7 +88,12 @@ class DevicePrefetcher(object):
         # or close() — never park on the empty queue
         if self._exhausted or self._stop.is_set():
             raise StopIteration
+        t0 = time.monotonic()
         item = self._q.get()
+        with self._stats_lock:
+            self._consumer_wait_s += time.monotonic() - t0
+            if item is not _END:
+                self._batches += 1
         if item is _END:
             self._exhausted = True
             if self._err is not None:
@@ -90,6 +112,17 @@ class DevicePrefetcher(object):
                 raise wrapper from err
             raise StopIteration
         return item
+
+    def stats(self):
+        """Overlap accounting: ``consumer_wait_s`` is time __next__
+        spent blocked (input-bound step), ``pump_wait_s`` is time the
+        pump spent blocked in the host iterator (step-bound input)."""
+        with self._stats_lock:
+            return {
+                "batches": self._batches,
+                "consumer_wait_s": self._consumer_wait_s,
+                "pump_wait_s": self._pump_wait_s,
+            }
 
     def close(self):
         if self._closed:

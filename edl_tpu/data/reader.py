@@ -5,15 +5,36 @@ Reference parity: edl/collective/distribute_reader.py (DataGenerator /
 DataAccesser design, SURVEY.md §3.4) rebuilt on threads + the in-tree RPC
 substrate; and edl/utils/reader.py (ReaderMeta registration under the
 coordination store so trainers can find the data leader).
+
+The consumer path is PIPELINED (docs/data_plane.md): a background fetch
+thread keeps ``fetch_ahead`` assignments in flight — long-polling the
+leader (``ds_get_assignment(wait_ms=...)``) and fetching whole
+assignment runs per producer with one pipelined ``get_batches`` RPC in
+columnar form — and delivers in-order pending batches into a bounded
+queue, so batch N+1..N+k transfer while the train step consumes N. All
+RPCs ride one shared :class:`~edl_tpu.rpc.pool.ClientPool` (no
+per-batch connection churn). Against pre-pipelining peers every leg
+falls back independently: no ``rpc.pipeline`` on the leader → plain
+polled ``ds_get_assignment``; none on a producer → serial row-format
+``get_batch`` — byte-identical to the pre-pipelining wire traffic.
+Delivery semantics are unchanged: batches are yielded in assignment
+order and a failed fetch is logged-lost exactly as before (the records
+return via the data checkpoint), never reordered past a yielded batch.
 """
 
+import collections
+import queue
+import random
 import threading
 import time
 
 from edl_tpu.controller import constants
 from edl_tpu.data.data_server import (END, BatchCache, DataPlaneServer,
                                       LeaderDataService)
-from edl_tpu.rpc.client import RpcClient
+from edl_tpu.robustness import faults
+from edl_tpu.robustness.policy import RetryPolicy
+from edl_tpu.rpc import ndarray as nd
+from edl_tpu.rpc.pool import ClientPool
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
@@ -34,6 +55,50 @@ def lookup_data_leader(coord, reader_name, timeout=60):
     return _get(timeout=timeout)
 
 
+class _MultiGet(object):
+    """One in-flight ``get_batches`` RPC shared by its batches' pending
+    slots; the first resolve() waits the future, later ones reuse the
+    list. Consumer-thread only."""
+
+    __slots__ = ("fut", "ids", "issued_at", "result", "error", "wire_ms")
+
+    def __init__(self, fut, ids):
+        self.fut = fut
+        self.ids = ids
+        self.issued_at = time.monotonic()
+        self.result = None
+        self.error = None
+        self.wire_ms = None
+
+    def get(self, idx):
+        if self.result is None and self.error is None:
+            try:
+                self.result = self.fut.result()
+                self.wire_ms = (time.monotonic() - self.issued_at) * 1e3
+            except errors.EdlError as e:
+                self.error = e
+        if self.error is not None:
+            raise self.error
+        return self.result[idx]
+
+
+class _Pending(object):
+    """One batch the fetch pipeline owes the consumer, in order."""
+
+    __slots__ = ("batch_id", "endpoint", "value", "group", "idx", "error",
+                 "wire_ms")
+
+    def __init__(self, batch_id, endpoint, value=None, group=None,
+                 idx=None, error=None, wire_ms=0.0):
+        self.batch_id = batch_id
+        self.endpoint = endpoint
+        self.value = value
+        self.group = group
+        self.idx = idx
+        self.error = error
+        self.wire_ms = wire_ms
+
+
 class ElasticReader(object):
     """Iterate balanced batches of records.
 
@@ -43,61 +108,134 @@ class ElasticReader(object):
       batch_size: records per batch.
       file_list: full job file list — only used by the elected data leader.
       is_leader: host the LeaderDataService in this process.
-      leader_endpoint: where the leader lives (None + coord ⇒ discover).
+      leader_endpoint: where the leader lives (None + coord ⇒ discover;
+        None + is_leader ⇒ this process's own server).
       coord/reader_name: coordination-store discovery (optional in tests).
       skip_record: optional (file, idx) -> bool predicate for data-aware
         resume (reference DataCheckpoint semantics). Pass
         ``state.data_checkpoint.is_processed`` to resume where a previous
         incarnation stopped; pair with ``mark_consumed`` on the consume
         side to record progress.
+      fetch_ahead: assignments kept in flight by the fetch pipeline.
+      produce: False makes this a pure consumer (no generator thread;
+        data-end reported immediately) — the disaggregated-input shape
+        where producer pods feed trainer pods.
+      pipelined_fetch: False reverts to the strict inline request-reply
+        consumer loop (the pre-pipelining behavior; also what the
+        benchmark's serial arc runs).
+      columnar: request the columnar wire format from producers that
+        support it (falls back per producer automatically).
+      assign_wait_ms: long-poll budget sent to a feature-negotiated
+        leader; ignored against pre-pipelining leaders.
+      report_every/report_ms: producer-side coalescing of
+        ``ds_report_batches`` — flush every K batches or T ms.
+      cache_bytes: byte bound for this producer's batch cache.
+      pool: a shared ClientPool to ride (the reader makes its own —
+        shared by its fetch/heartbeat/generator threads — when None).
     """
 
     def __init__(self, pod_id, splitter, batch_size, file_list=(),
                  is_leader=False, leader_endpoint=None, coord=None,
                  reader_name="reader", cache_capacity=64, skip_record=None,
-                 fetch_ahead=2, reader_ttl=30.0):
+                 fetch_ahead=2, reader_ttl=30.0, produce=True,
+                 pipelined_fetch=True, columnar=True, assign_wait_ms=500,
+                 report_every=8, report_ms=200.0,
+                 cache_bytes=256 << 20, pool=None):
         self._pod_id = pod_id
         self._splitter = splitter
         self._batch_size = batch_size
         self._skip = skip_record
         self._fetch_ahead = max(1, fetch_ahead)
+        self._produce = produce
+        self._pipelined_fetch = pipelined_fetch
+        self._columnar = columnar
+        self._report_every = max(1, int(report_every))
+        self._report_ms = float(report_ms)
+        self._rng = random.Random()
 
-        self._cache = BatchCache(capacity=cache_capacity)
+        self._pool = pool if pool is not None else ClientPool(timeout=30.0)
+        self._owns_pool = pool is None
+
+        self._cache = BatchCache(capacity=cache_capacity,
+                                 capacity_bytes=cache_bytes)
         leader_service = (LeaderDataService(file_list,
                                             reader_ttl=reader_ttl)
                           if is_leader else None)
         self._server = DataPlaneServer(self._cache,
                                        leader_service=leader_service).start()
-        if is_leader and coord is not None:
-            register_data_leader(coord, reader_name, self._server.endpoint)
+        if is_leader:
+            if coord is not None:
+                register_data_leader(coord, reader_name,
+                                     self._server.endpoint)
             leader_endpoint = self._server.endpoint
         if leader_endpoint is None:
             if coord is None:
                 raise ValueError("need leader_endpoint or coord")
             leader_endpoint = lookup_data_leader(coord, reader_name)
-        self._leader = RpcClient(leader_endpoint, timeout=30)
-        self._leader_gen = RpcClient(leader_endpoint, timeout=30)
+        self._leader_ep = leader_endpoint
+        # back-compat handle (tests poke it): the control-channel client
+        self._leader = self._pool.get(leader_endpoint, channel="ctl")
 
         self._stop = threading.Event()
+        self._stopped = False
+        self._stop_lock = threading.Lock()
         self._gen_done = threading.Event()
         self._gen_error = []
-        reg = self._leader.call("ds_register_reader", pod_id,
-                                self._server.endpoint)
+
+        # fetch pipeline state
+        self._out_q = queue.Queue(maxsize=max(2, self._fetch_ahead))
+        self._fetch_thread = None
+        self._endpoint_modes = {}     # endpoint -> "multi" | "serial"
+        self._assign_retry = RetryPolicy(max_attempts=4, base_delay=0.1,
+                                         max_delay=1.0)
+        # stats (consumer-side accounting; _stats_lock guards them)
+        self._stats_lock = threading.Lock()
+        self._lost = []
+        self._n_local = 0
+        self._n_remote = 0
+        self._fetch_ms = collections.deque(maxlen=4096)
+        self._wait_s = 0.0
+
+        reg = self._pool.call(leader_endpoint, "ds_register_reader",
+                              pod_id, self._server.endpoint, channel="ctl")
         # the heartbeat cadence follows the LEADER'S ttl (returned at
         # registration) — the local reader_ttl only matters when this
         # process hosts the leader service
         leader_ttl = (reg.get("reader_ttl", reader_ttl)
                       if isinstance(reg, dict) else reader_ttl)
-        self._gen_thread = threading.Thread(target=self._generate,
-                                            daemon=True,
-                                            name="reader-gen-%s" % pod_id)
-        self._gen_thread.start()
+        # feature negotiation with the leader: long-poll assignments only
+        # against a pipelining-generation leader — a legacy one would
+        # reject the extra argument
+        try:
+            leader_feats = self._pool.features(leader_endpoint)
+        except errors.EdlError:
+            leader_feats = ()
+        self._assign_wait_ms = (int(assign_wait_ms)
+                                if assign_wait_ms
+                                and "rpc.pipeline" in leader_feats
+                                else None)
+
+        # producer-side report coalescing (generator thread only)
+        self._report_buf = []
+        self._report_t0 = time.monotonic()
+
+        if self._produce:
+            self._gen_thread = threading.Thread(target=self._generate,
+                                                daemon=True,
+                                                name="reader-gen-%s"
+                                                % pod_id)
+            self._gen_thread.start()
+        else:
+            # a pure consumer is done producing before it starts
+            self._gen_thread = None
+            self._pool.call(leader_endpoint, "ds_reach_data_end", pod_id,
+                            channel="ctl")
+            self._gen_done.set()
         # dedicated liveness heartbeat: data RPCs pause while the
         # consumer sits in a long train step, so the leader's silent-
         # reader eviction must key on THIS thread (dies with the
         # process), not on data traffic
         self._hb_interval = min(max(0.5, leader_ttl / 6.0), 10.0)
-        self._hb_client = RpcClient(leader_endpoint, timeout=10)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True,
                                            name="reader-hb-%s" % pod_id)
@@ -107,7 +245,8 @@ class ElasticReader(object):
         misses = 0
         while not self._stop.wait(self._hb_interval):
             try:
-                self._hb_client.call("ds_heartbeat", self._pod_id)
+                self._pool.call(self._leader_ep, "ds_heartbeat",
+                                self._pod_id, channel="hb")
                 misses = 0
             except errors.EdlError as e:
                 # a quiet heartbeat failure is exactly how an eviction
@@ -124,8 +263,9 @@ class ElasticReader(object):
     def _generate(self):
         try:
             while not self._stop.is_set():
-                files = self._leader_gen.call("ds_get_file_list",
-                                              self._pod_id)
+                files = self._pool.call(self._leader_ep,
+                                        "ds_get_file_list",
+                                        self._pod_id, channel="ctl")
                 if not files:
                     return
                 for file_idx, path in files:
@@ -139,10 +279,34 @@ class ElasticReader(object):
             # producer must not leave every consumer in the job spinning
             # on an all_done check that can never become true
             try:
-                self._leader_gen.call("ds_reach_data_end", self._pod_id)
+                self._flush_reports()
+                self._pool.call(self._leader_ep, "ds_reach_data_end",
+                                self._pod_id, channel="ctl")
             except errors.EdlError:
                 pass
             self._gen_done.set()
+
+    def _report(self, batch_id, force=False):
+        """Coalesce ds_report_batches: flush every ``report_every``
+        batches or ``report_ms`` ms, whichever first — one control RPC
+        per K batches instead of per batch. Generator thread only."""
+        if not self._report_buf:
+            self._report_t0 = time.monotonic()
+        if batch_id is not None:
+            self._report_buf.append(batch_id)
+        elapsed_ms = (time.monotonic() - self._report_t0) * 1e3
+        if force or len(self._report_buf) >= self._report_every \
+                or elapsed_ms >= self._report_ms:
+            self._flush_reports()
+
+    def _flush_reports(self):
+        if not self._report_buf:
+            return
+        buf, self._report_buf = self._report_buf, []
+        self._pool.call(self._leader_ep, "ds_report_batches",
+                        self._pod_id, buf, self._server.endpoint,
+                        channel="ctl")
+        self._report_t0 = time.monotonic()
 
     def _produce_file(self, file_idx, path):
         records, first_idx = [], None
@@ -151,7 +315,7 @@ class ElasticReader(object):
         def flush():
             nonlocal records, first_idx, n_batch
             if not records:
-                return
+                return True
             batch_id = "f%d_b%d" % (file_idx, n_batch)
             payload = {
                 "batch_id": batch_id,
@@ -159,11 +323,14 @@ class ElasticReader(object):
                 "range": [first_idx, first_idx + len(records) - 1],
                 "records": records,
             }
-            self._cache.put(batch_id, payload)
-            self._leader_gen.call("ds_report_batches", self._pod_id,
-                                  [batch_id], self._server.endpoint)
+            # blocks on a full cache (count OR bytes); stop-aware so a
+            # stopping reader never sits out the full timeout
+            if not self._cache.put(batch_id, payload, stop=self._stop):
+                return False
+            self._report(batch_id)
             n_batch += 1
             records, first_idx = [], None
+            return True
 
         for idx, record in self._splitter.split(path):
             if self._stop.is_set():
@@ -174,45 +341,368 @@ class ElasticReader(object):
                 first_idx = idx
             records.append(record)
             if len(records) >= self._batch_size:
-                flush()
+                if not flush():
+                    return
         flush()
+        # the file's tail must not sit in the coalescing buffer waiting
+        # for a next put that may never come
+        self._report(None, force=True)
 
     # -- consumer side ---------------------------------------------------------
 
+    def _fire_fault(self, point, **ctx):
+        """Evaluate a data-plane chaos point; returns the error to treat
+        the operation as failed with, else None (site kinds like
+        ``drop`` degrade to a lost operation too)."""
+        if faults.PLANE is None:
+            return None
+        try:
+            f = faults.PLANE.fire(point, pod=self._pod_id, **ctx)
+        except errors.EdlError as e:
+            return e
+        if f is not None:
+            return errors.ConnectError("fault: %s dropped" % point)
+        return None
+
+    def _get_assignment(self):
+        fault = self._fire_fault("data.assign", endpoint=self._leader_ep)
+        if fault is not None:
+            raise fault
+        if self._assign_wait_ms is not None:
+            return self._pool.call(self._leader_ep, "ds_get_assignment",
+                                   self._pod_id, self._fetch_ahead,
+                                   self._assign_wait_ms, channel="assign")
+        return self._pool.call(self._leader_ep, "ds_get_assignment",
+                               self._pod_id, self._fetch_ahead,
+                               channel="assign")
+
+    def _endpoint_mode(self, endpoint):
+        """multi: pipelined multi-batch get_batches; serial: one
+        blocking row-format get_batch per batch (the pre-pipelining
+        wire traffic). Negotiated once per producer endpoint."""
+        mode = self._endpoint_modes.get(endpoint)
+        if mode is None:
+            try:
+                feats = self._pool.features(endpoint)
+            except errors.EdlError:
+                feats = ()
+            mode = "multi" if "rpc.pipeline" in feats else "serial"
+            self._endpoint_modes[endpoint] = mode
+        return mode
+
+    def _fetch_loop(self):
+        """The fetch pipeline: keep assignments in flight, deliver
+        in-order pending batches into the bounded queue."""
+        attempt = 0
+        try:
+            while not self._stop.is_set():
+                try:
+                    assignment = self._get_assignment()
+                except errors.DataAccessError as e:
+                    self._push(("error", e))  # eviction: loud, no retry
+                    return
+                except errors.EdlError as e:
+                    attempt += 1
+                    if not self._stop.is_set():
+                        logger.warning(
+                            "reader %s assignment attempt %d failed: %r",
+                            self._pod_id, attempt, e)
+                    if attempt >= self._assign_retry.max_attempts:
+                        self._push(("error", e))
+                        return
+                    if self._stop.wait(self._assign_retry.delay(attempt)):
+                        return
+                    continue
+                attempt = 0
+                if assignment == [END]:
+                    self._push(("end", None))
+                    return
+                if not assignment:
+                    # long-polled leaders already parked server-side;
+                    # jittered pause covers legacy leaders and races
+                    lo, hi = ((0.005, 0.02) if self._assign_wait_ms
+                              else (0.03, 0.08))
+                    if self._stop.wait(self._rng.uniform(lo, hi)):
+                        return
+                    continue
+                for endpoint, ids in self._group_runs(assignment):
+                    for pending in self._issue(endpoint, ids):
+                        if not self._push(("batch", pending)):
+                            return
+        except Exception as e:  # noqa: BLE001 — never die silently
+            self._push(("error", e))
+
+    @staticmethod
+    def _group_runs(assignment):
+        """Consecutive same-endpoint runs, preserving assignment order
+        (order is the delivery contract — runs are never merged across
+        an interleaving endpoint)."""
+        runs = []
+        for item in assignment:
+            if runs and runs[-1][0] == item["endpoint"]:
+                runs[-1][1].append(item["batch_id"])
+            else:
+                runs.append((item["endpoint"], [item["batch_id"]]))
+        return runs
+
+    def _issue(self, endpoint, ids):
+        """Start fetching ``ids`` from one producer; returns in-order
+        _Pending slots."""
+        if endpoint == self._server.endpoint:
+            # own production: straight out of the local cache
+            return [_Pending(b, endpoint, value=self._cache.pop(b))
+                    for b in ids]
+        out, live = [], []
+        for b in ids:
+            fault = self._fire_fault("data.fetch", endpoint=endpoint,
+                                     batch=b)
+            if fault is not None:
+                out.append(_Pending(b, endpoint, error=fault))
+            else:
+                live.append(b)
+        by_id = {}
+        if live:
+            if self._pipelined_fetch \
+                    and self._endpoint_mode(endpoint) == "multi":
+                by_id = self._issue_multi(endpoint, live)
+            else:
+                for b in live:
+                    try:
+                        by_id[b] = _Pending(
+                            b, endpoint,
+                            value=self._fetch_serial(endpoint, b))
+                    except errors.EdlError as e:
+                        by_id[b] = _Pending(b, endpoint, error=e)
+        merged, cursor = [], 0
+        for b in ids:
+            if b in by_id:
+                merged.append(by_id[b])
+            else:
+                merged.append(out[cursor])
+                cursor += 1
+        return merged
+
+    def _issue_multi(self, endpoint, ids):
+        fmt = "col" if self._columnar else "row"
+        try:
+            fut = self._pool.call_async(endpoint, "get_batches", ids,
+                                        fmt=fmt)
+        except errors.EdlError as e:
+            self._pool.retire(endpoint)
+            return {b: _Pending(b, endpoint, error=e) for b in ids}
+        group = _MultiGet(fut, ids)
+        return {b: _Pending(b, endpoint, group=group, idx=i)
+                for i, b in enumerate(ids)}
+
+    def _fetch_serial(self, endpoint, batch_id):
+        """The pre-pipelining fetch: one blocking row-format get_batch
+        (over the pooled connection instead of a fresh dial). Raises on
+        failure — the CALLER accounts the loss exactly once."""
+        try:
+            with self._pool.lease(endpoint) as client:
+                return client.call("get_batch", batch_id)
+        except errors.ConnectError:
+            self._pool.retire(endpoint)
+            raise
+
+    def _lose(self, batch_id, endpoint, exc):
+        # producer died (resize) — the batch is lost; training continues
+        # and a restart re-reads it via the data checkpoint
+        logger.warning("batch %s from %s lost: %r", batch_id, endpoint,
+                       exc)
+        with self._stats_lock:
+            self._lost.append(batch_id)
+
+    def _resolve(self, pending):
+        """Turn a pending slot into its payload (or None when lost);
+        consumer thread only."""
+        local = pending.endpoint == self._server.endpoint
+        if pending.error is not None:
+            self._lose(pending.batch_id, pending.endpoint, pending.error)
+            return None
+        if pending.group is not None:
+            try:
+                payload = pending.group.get(pending.idx)
+            except errors.EdlError as e:
+                if "no such method" in str(e):
+                    # rpc.pipeline peer without get_batches: demote and
+                    # re-fetch serially — the cache was never popped
+                    self._endpoint_modes[pending.endpoint] = "serial"
+                    try:
+                        payload = self._fetch_serial(pending.endpoint,
+                                                     pending.batch_id)
+                    except errors.EdlError as e2:
+                        self._lose(pending.batch_id, pending.endpoint, e2)
+                        return None
+                else:
+                    if isinstance(e, errors.ConnectError):
+                        self._pool.retire(pending.endpoint)
+                    self._lose(pending.batch_id, pending.endpoint, e)
+                    return None
+            else:
+                pending.wire_ms = pending.group.wire_ms or 0.0
+                if payload is None:
+                    self._lose(pending.batch_id, pending.endpoint,
+                               errors.NotFoundError("batch %s not in "
+                                                    "producer cache"
+                                                    % pending.batch_id))
+                    return None
+        else:
+            payload = pending.value
+            if payload is None:
+                self._lose(pending.batch_id, pending.endpoint,
+                           errors.NotFoundError(
+                               "batch %s not in %s cache"
+                               % (pending.batch_id,
+                                  "local" if local else "producer")))
+                return None
+        payload = self._decode(payload)
+        with self._stats_lock:
+            if local:
+                self._n_local += 1
+            else:
+                self._n_remote += 1
+            self._fetch_ms.append(pending.wire_ms)
+        return payload
+
+    @staticmethod
+    def _decode(payload):
+        """Normalize a wire payload: columnar batches are unpacked back
+        into the exact record list (zero-copy views where the records
+        are arrays); v1 tagged arrays (the tensor-frame escape hatch)
+        are decoded ``copy=False``. Row payloads come out exactly as
+        the producer built them."""
+        payload = nd.decode_tree(payload, copy=False)
+        if isinstance(payload, dict) and payload.get("fmt") == "col":
+            cols = payload.pop("cols")
+            payload.pop("fmt")
+            payload["records"] = nd.unpack_columns(cols, copy=False)
+        return payload
+
     def __iter__(self):
+        if not self._pipelined_fetch:
+            yield from self._iter_serial()
+            return
+        if self._fetch_thread is None:
+            self._fetch_thread = threading.Thread(
+                target=self._fetch_loop, daemon=True,
+                name="reader-fetch-%s" % self._pod_id)
+            self._fetch_thread.start()
         while not self._stop.is_set():
             if self._gen_error:
                 raise self._gen_error[0]
-            assignment = self._leader.call("ds_get_assignment", self._pod_id,
-                                           self._fetch_ahead)
+            t0 = time.monotonic()
+            try:
+                kind, item = self._out_q.get(timeout=0.5)
+            except queue.Empty:
+                with self._stats_lock:
+                    self._wait_s += time.monotonic() - t0
+                continue
+            with self._stats_lock:
+                self._wait_s += time.monotonic() - t0
+            if kind == "end":
+                self._push_front_sticky(("end", None))
+                return
+            if kind == "error":
+                self._push_front_sticky(("error", item))
+                raise item
+            payload = self._resolve(item)
+            if payload is not None:
+                yield payload
+
+    def _push_front_sticky(self, item):
+        """END / error are sticky: re-queued so a later __iter__ call
+        terminates the same way (the pre-pipelining reader re-asked the
+        leader and got [END] again)."""
+        try:
+            self._out_q.put_nowait(item)
+        except queue.Full:
+            pass  # a full queue means batches remain; next drain re-ends
+
+    def _push(self, item):
+        """Bounded-queue put, stop-aware; False when stopping."""
+        while not self._stop.is_set():
+            try:
+                self._out_q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _iter_serial(self):
+        """The strict inline consumer loop (pre-pipelining behavior,
+        minus the per-batch connection churn): blocking assignment,
+        then one blocking fetch per batch."""
+        while not self._stop.is_set():
+            if self._gen_error:
+                raise self._gen_error[0]
+            fault = self._fire_fault("data.assign",
+                                     endpoint=self._leader_ep)
+            if fault is not None:
+                raise fault
+            assignment = self._pool.call(self._leader_ep,
+                                         "ds_get_assignment",
+                                         self._pod_id, self._fetch_ahead,
+                                         channel="assign")
             if assignment == [END]:
                 return
             if not assignment:
-                time.sleep(0.05)
+                if self._stop.wait(self._rng.uniform(0.03, 0.08)):
+                    return
                 continue
             for item in assignment:
-                payload = self._fetch(item)
+                t0 = time.monotonic()
+                payload = self._fetch_item(item)
                 if payload is not None:
+                    with self._stats_lock:
+                        self._fetch_ms.append(
+                            (time.monotonic() - t0) * 1e3)
                     yield payload
 
-    def _fetch(self, item):
+    def _fetch_item(self, item):
         batch_id, endpoint = item["batch_id"], item["endpoint"]
+        fault = self._fire_fault("data.fetch", endpoint=endpoint,
+                                 batch=batch_id)
+        if fault is not None:
+            self._lose(batch_id, endpoint, fault)
+            return None
         if endpoint == self._server.endpoint:
             payload = self._cache.pop(batch_id)
             if payload is not None:
+                with self._stats_lock:
+                    self._n_local += 1
                 return payload
         try:
-            client = RpcClient(endpoint, timeout=30)
-            try:
-                return client.call("get_batch", batch_id)
-            finally:
-                client.close()
+            payload = self._fetch_serial(endpoint, batch_id)
         except errors.EdlError as e:
-            # producer died (resize) — the batch is lost; training continues
-            # and a restart re-reads it via the data checkpoint
-            logger.warning("batch %s from %s lost: %r", batch_id, endpoint,
-                           e)
+            self._lose(batch_id, endpoint, e)
             return None
+        payload = self._decode(payload)
+        with self._stats_lock:
+            self._n_remote += 1
+        return payload
+
+    # -- bookkeeping / lifecycle ----------------------------------------------
+
+    @property
+    def endpoint(self):
+        """This reader's batch-server endpoint (the data-leader endpoint
+        too when constructed with ``is_leader=True``)."""
+        return self._server.endpoint
+
+    def stats(self):
+        """Consumer-side accounting: batches fetched locally/remotely,
+        lost batch ids, per-batch wire latencies (ms), and cumulative
+        seconds the consumer spent waiting on the pipeline."""
+        with self._stats_lock:
+            return {
+                "local": self._n_local,
+                "remote": self._n_remote,
+                "lost": list(self._lost),
+                "fetch_ms": list(self._fetch_ms),
+                "consumer_wait_s": self._wait_s,
+                "endpoint_modes": dict(self._endpoint_modes),
+            }
 
     @staticmethod
     def mark_consumed(state, batch):
@@ -228,10 +718,26 @@ class ElasticReader(object):
         state.data_checkpoint.mark_processed(batch["file"], lo, hi)
 
     def stop(self):
+        """Idempotent shutdown: stops the generator, heartbeat AND any
+        in-flight fetch promptly (an owned pool is closed, failing
+        pending RPCs instead of waiting out their timeouts)."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
         self._stop.set()
-        self._gen_thread.join(timeout=10)
+        if self._gen_thread is not None:
+            self._gen_thread.join(timeout=10)
+        # closing the pool fails any in-flight fetch/assignment RPC, so
+        # the fetch thread cannot sit out a 30s socket timeout
+        if self._owns_pool:
+            self._pool.close()
+        if self._fetch_thread is not None:
+            self._fetch_thread.join(timeout=10)
         self._hb_thread.join(timeout=self._hb_interval + 11)
-        self._leader.close()
-        self._leader_gen.close()
-        self._hb_client.close()
+        while True:
+            try:
+                self._out_q.get_nowait()
+            except queue.Empty:
+                break
         self._server.stop()

@@ -41,6 +41,7 @@ from edl_tpu.distill.discovery_client import DiscoveryClient, FixedDiscover
 from edl_tpu.robustness.policy import CircuitBreaker
 from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.pool import ClientPool
 from edl_tpu.utils import errors, timeline
 from edl_tpu.utils.logger import logger
 
@@ -71,11 +72,16 @@ class _PredictFuture(object):
 
 class _TeacherConn(object):
     """One connection to one teacher; splits oversized batches to the
-    teacher's compiled max_batch."""
+    teacher's compiled max_batch. With a :class:`ClientPool` the
+    connection is the pool's shared client for the endpoint (redialed
+    only when retired); without one the conn owns a private client —
+    the pre-pool behavior."""
 
-    def __init__(self, endpoint, timeout=60.0):
+    def __init__(self, endpoint, timeout=60.0, pool=None):
         self.endpoint = endpoint
-        self._rpc = RpcClient(endpoint, timeout=timeout)
+        self._pool = pool
+        self._rpc = (pool.get(endpoint) if pool is not None
+                     else RpcClient(endpoint, timeout=timeout))
         spec = self._rpc.call("get_feed_fetch")
         self.max_batch = spec.get("max_batch", 64)
         self.fetch_names = list(spec.get("fetch", {}))
@@ -103,7 +109,10 @@ class _TeacherConn(object):
         return self.predict_async(feed).result()
 
     def close(self):
-        self._rpc.close()
+        # a pooled client is shared: its lifetime belongs to the pool
+        # (idle reaping / retire-on-error), not to this worker
+        if self._pool is None:
+            self._rpc.close()
 
 
 class DistillReader(object):
@@ -114,12 +123,18 @@ class DistillReader(object):
 
     def __init__(self, ins, predicts, max_in_flight=8,
                  teacher_backoff=5.0, pipeline_depth=4,
-                 predict_timeout=60.0):
+                 predict_timeout=60.0, pool=None):
         self._ins = list(ins)
         self._predicts = list(predicts)
         self._max_in_flight = max_in_flight
         self._pipeline_depth = max(1, int(pipeline_depth))
         self._predict_timeout = predict_timeout
+        # shared client pool: one connection per teacher across worker
+        # generations (a worker restart used to redial), retired on
+        # transport failure so the next worker dials fresh
+        self._pool = pool if pool is not None \
+            else ClientPool(timeout=predict_timeout)
+        self._owns_pool = pool is None
 
         self._gen = None
         self._gen_kind = None
@@ -266,18 +281,26 @@ class DistillReader(object):
                 logger.warning("teacher %s failed task %d (%r); "
                                "requeueing", endpoint, task_id, e)
                 self._in_q.put(task)
-                self._breaker.record_failure(endpoint)
+                self._retire_teacher(endpoint)
                 return False
             else:
                 pending.append((task, fut))
         return True
 
+    def _retire_teacher(self, endpoint):
+        """A transport failure opens the breaker AND retires the pooled
+        client — the teacher may have restarted as a new generation, so
+        the next worker must dial fresh."""
+        self._breaker.record_failure(endpoint)
+        self._pool.retire(endpoint)
+
     def _predict_loop(self, endpoint, stop_ev):
         try:
-            conn = _TeacherConn(endpoint, timeout=self._predict_timeout)
+            conn = _TeacherConn(endpoint, timeout=self._predict_timeout,
+                                pool=self._pool)
         except errors.EdlError as e:
             logger.warning("teacher %s unreachable: %r", endpoint, e)
-            self._breaker.record_failure(endpoint)
+            self._retire_teacher(endpoint)
             return
         # feature negotiation: a pre-pipelining teacher gets lockstep
         # depth 1 — exactly the old strict call/response traffic
@@ -307,7 +330,7 @@ class DistillReader(object):
                 logger.warning("teacher %s failed task %d (%r); requeueing",
                                endpoint, task_id, e)
                 self._in_q.put(task)
-                self._breaker.record_failure(endpoint)
+                self._retire_teacher(endpoint)
                 ok = False
                 break
             self._track(endpoint, task, add=False)
@@ -436,3 +459,7 @@ class DistillReader(object):
             stop_ev.set()
         if self._discover is not None:
             self._discover.stop()
+        if self._owns_pool:
+            # failing the in-flight predicts wakes any worker blocked
+            # in fut.result(); the requeue-safe drain handles the rest
+            self._pool.close()

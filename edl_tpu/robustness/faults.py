@@ -32,6 +32,10 @@ peer_restore.connect     before a restorer dials a peer StateServer
                          (ctx: endpoint, rank)
 peer_restore.read        before each peer span fetch (ctx: endpoint,
                          key)
+data.assign              before a consumer asks the data leader for an
+                         assignment (ctx: pod, endpoint)
+data.fetch               before a batch fetch is issued to a producer
+                         (ctx: pod, endpoint, batch)
 ======================== ===============================================
 
 Fault kinds:

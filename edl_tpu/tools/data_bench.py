@@ -1,0 +1,209 @@
+"""Elastic data-plane benchmark: pipelined columnar batch fetch vs the
+serial row path.
+
+Topology: one PRODUCER pod (hosts the data leader, produces every
+batch, never consumes) and one pure CONSUMER pod (``produce=False``)
+that steals the whole epoch over the wire while simulating a train
+step of ``--step-ms`` per batch — the disaggregated-input shape where
+the consumer-visible cost of the data plane is maximal (steal ratio
+1.0). Both arcs move the exact same records:
+
+- ``serial_row``     — ``pipelined_fetch=False, columnar=False``, one
+                       blocking ``get_batch`` per batch, per-batch
+                       production reports: the pre-pipelining plane
+                       (minus the per-batch connection churn, which the
+                       shared pool removed for both arcs).
+- ``pipelined_col``  — background fetch pipeline (``fetch_ahead`` in
+                       flight via multi-batch ``get_batches``),
+                       columnar payloads, coalesced reports, leader
+                       long-poll.
+
+The numbers that matter: ``records_s`` (consumer-visible record rate),
+``fetch_ms_p50/p99`` (wire latency per fetch), ``consumer_idle_pct``
+(wall time not spent in the simulated step — the overlap headroom the
+pipeline reclaims), and ``steal_ratio``. ``identical_ok`` gates it
+all: both arcs must deliver byte-identical record streams.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m edl_tpu.tools.data_bench --micro
+    python -m edl_tpu.tools.data_bench --files 8 --rows 4096
+
+Emits one JSON object (schema "databench/v1").
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+#: hermetic tier-1 smoke defaults: small enough for CI seconds, big
+#: enough that the fetch cost is comparable to the simulated step (the
+#: regime where overlap pays)
+MICRO = {"files": 4, "rows": 1024, "dim": 2048, "batch_size": 128,
+         "step_ms": 2.0, "fetch_ahead": 4}
+FULL = {"files": 8, "rows": 8192, "dim": 2048, "batch_size": 128,
+        "step_ms": 2.0, "fetch_ahead": 4}
+
+
+class _NpyRowSplitter(object):
+    """Splitter over .npy matrices: record = one float32 row (the
+    columnar ``nd`` kind — fixed dtype+shape arrays)."""
+
+    def split(self, path):
+        arr = np.load(path)
+        for i in range(len(arr)):
+            yield i, arr[i]
+
+
+def _write_files(root, files, rows, dim, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(files):
+        path = os.path.join(root, "part%03d.npy" % i)
+        np.save(path, rng.rand(rows, dim).astype(np.float32))
+        out.append(path)
+    return out
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+
+def _run_arc(files, batch_size, step_ms, fetch_ahead, pipelined,
+             columnar):
+    from edl_tpu.data.reader import ElasticReader
+
+    splitter = _NpyRowSplitter()
+    producer = ElasticReader(
+        "producer", splitter, batch_size, file_list=files,
+        is_leader=True, fetch_ahead=fetch_ahead,
+        pipelined_fetch=pipelined, columnar=columnar,
+        # per-batch reports = the pre-pipelining control chatter
+        report_every=8 if pipelined else 1,
+        report_ms=200.0 if pipelined else 0.0)
+    consumer = ElasticReader(
+        "consumer", splitter, batch_size, produce=False,
+        leader_endpoint=producer.endpoint, fetch_ahead=fetch_ahead,
+        pipelined_fetch=pipelined, columnar=columnar)
+    step_s = step_ms / 1e3
+    got = []
+    try:
+        t0 = time.perf_counter()
+        for payload in consumer:
+            got.append(payload)
+            if step_s:
+                time.sleep(step_s)  # the simulated train step
+        wall = time.perf_counter() - t0
+        stats = consumer.stats()
+        pool_dials = consumer._pool.stats()["dials"]
+    finally:
+        consumer.stop()
+        producer.stop()
+    n_records = sum(len(p["records"]) for p in got)
+    fetched = stats["local"] + stats["remote"]
+    step_total = len(got) * step_s
+    return got, {
+        "wall_ms": round(wall * 1e3, 3),
+        "batches": len(got),
+        "records": n_records,
+        "records_s": round(n_records / wall, 1) if wall else None,
+        "fetch_ms_p50": round(_percentile(stats["fetch_ms"], 0.50) or 0.0,
+                              3),
+        "fetch_ms_p99": round(_percentile(stats["fetch_ms"], 0.99) or 0.0,
+                              3),
+        "steal_ratio": round(stats["remote"] / fetched, 3) if fetched
+        else None,
+        "consumer_idle_pct": round(100.0 * max(0.0, wall - step_total)
+                                   / wall, 2) if wall else None,
+        "lost": len(stats["lost"]),
+        "pool_dials": pool_dials,
+    }
+
+
+def _stream_signature(batches):
+    """Canonical per-record stream: (file, record index, dtype, shape,
+    bytes), sorted — assignment order differs between arcs, record
+    content must not."""
+    sig = []
+    for p in batches:
+        lo = p["range"][0]
+        for i, r in enumerate(p["records"]):
+            a = np.asarray(r)
+            sig.append((p["file"], lo + i, a.dtype.str, tuple(a.shape),
+                        a.tobytes()))
+    sig.sort(key=lambda t: (t[0], t[1]))
+    return sig
+
+
+def run(files=4, rows=1024, dim=2048, batch_size=128, step_ms=2.0,
+        fetch_ahead=4, mode="micro", keep_dir=None):
+    """Run both arcs over identical on-disk data; returns the report."""
+    root = keep_dir or tempfile.mkdtemp(prefix="data_bench_")
+    try:
+        paths = _write_files(root, files, rows, dim)
+        serial_out, serial = _run_arc(paths, batch_size, step_ms,
+                                      fetch_ahead, pipelined=False,
+                                      columnar=False)
+        piped_out, piped = _run_arc(paths, batch_size, step_ms,
+                                    fetch_ahead, pipelined=True,
+                                    columnar=True)
+    finally:
+        if keep_dir is None:
+            shutil.rmtree(root, ignore_errors=True)
+    return {
+        "schema": "databench/v1",
+        "mode": mode,
+        "files": files,
+        "rows_per_file": rows,
+        "dim": dim,
+        "batch_size": batch_size,
+        "step_ms": step_ms,
+        "fetch_ahead": fetch_ahead,
+        "serial_row": serial,
+        "pipelined_col": piped,
+        "speedup_records_s": round(
+            piped["records_s"] / serial["records_s"], 3)
+        if serial["records_s"] else None,
+        "identical_ok": (serial["lost"] == 0 and piped["lost"] == 0
+                         and _stream_signature(serial_out)
+                         == _stream_signature(piped_out)),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--micro", action="store_true",
+                    help="hermetic CI-sized run (the tier-1 smoke)")
+    ap.add_argument("--files", type=int, default=None,
+                    help="number of .npy input files")
+    ap.add_argument("--rows", type=int, default=None,
+                    help="rows (records) per file")
+    ap.add_argument("--dim", type=int, default=None,
+                    help="float32 features per record")
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--step-ms", type=float, default=None,
+                    help="simulated train step per batch")
+    ap.add_argument("--fetch-ahead", type=int, default=None,
+                    help="assignments kept in flight (pipelined arc)")
+    args = ap.parse_args(argv)
+    base = dict(MICRO if args.micro else FULL)
+    for key in base:
+        flag = getattr(args, key.replace("-", "_"), None)
+        if flag is not None:
+            base[key] = flag
+    out = run(mode="micro" if args.micro else "full", **base)
+    json.dump(out, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return 0 if out["identical_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -184,3 +184,33 @@ def test_measure_resize_micro_peer_arc_cpu_schema(capsys):
     assert out["restore"]["bytes"] > 0
     assert out["restore"]["version"] == 1
     json.dumps(out)  # round-trips
+
+
+def test_data_bench_micro_schema():
+    """The elastic data-plane bench must keep working in a tiny CPU
+    config under tier-1 and honor its JSON contract (schema
+    databench/v1): both arcs report throughput / latency / steal /
+    idle, the two arcs move byte-identical record streams, and the
+    whole report serializes. No speedup assertion here — CI boxes are
+    too noisy for a timing gate; the acceptance run does that offline."""
+    import json
+
+    from edl_tpu.tools import data_bench
+
+    out = data_bench.run(files=2, rows=96, dim=32, batch_size=16,
+                         step_ms=1.0, fetch_ahead=4)
+    assert out["schema"] == "databench/v1"
+    assert out["identical_ok"] is True
+    for arc in ("serial_row", "pipelined_col"):
+        assert out[arc]["wall_ms"] > 0
+        assert out[arc]["batches"] == 12          # 2 files * 96/16
+        assert out[arc]["records"] == 192
+        assert out[arc]["records_s"] > 0
+        assert out[arc]["fetch_ms_p50"] >= 0
+        assert out[arc]["fetch_ms_p99"] >= out[arc]["fetch_ms_p50"]
+        assert out[arc]["steal_ratio"] == 1.0     # pure consumer arc
+        assert 0 <= out[arc]["consumer_idle_pct"] <= 100
+        assert out[arc]["lost"] == 0
+        assert out[arc]["pool_dials"] >= 1
+    assert out["speedup_records_s"] > 0
+    json.dumps(out)  # the whole report is JSON-serializable

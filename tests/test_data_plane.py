@@ -253,7 +253,16 @@ def test_dead_reader_evicted_epoch_converges(tmp_path, coord):
     total = ["file%d_rec%d" % (f, j) for f in range(4) for j in range(20)]
     state = State()
 
-    rA = ElasticReader("podA", TxtFileSplitter(), batch_size=8,
+    class SlowSplitter(TxtFileSplitter):
+        # throttle the LEADER's production so podB deterministically
+        # wins some files — the coalesced-report producer is otherwise
+        # fast enough to drain the whole file list before podB joins
+        def split(self, path):
+            for item in TxtFileSplitter.split(self, path):
+                time.sleep(0.005)
+                yield item
+
+    rA = ElasticReader("podA", SlowSplitter(), batch_size=8,
                        file_list=paths, is_leader=True, coord=coord,
                        reader_name="ev", reader_ttl=2.0)
     ep = lookup_data_leader(coord, "ev")
@@ -425,3 +434,388 @@ def test_heartbeat_protects_busy_reader_and_zombie_rejected():
         raise AssertionError("zombie report must be rejected")
     except errors_mod.DataAccessError as e:
         assert "evicted" in str(e)
+
+
+# ---------------------------------------------------------------------------
+# pipelined data plane (docs/data_plane.md): columnar codec, byte-bounded
+# cache, long-poll assignments, consumer-only steal, eviction mid-pipeline,
+# legacy interop
+# ---------------------------------------------------------------------------
+
+
+def test_pack_unpack_columns_roundtrips():
+    import numpy as np
+
+    from edl_tpu.rpc import ndarray as nd
+
+    cases = [
+        ["alpha", "", "βeta"],                      # str (utf-8, empty)
+        [b"ab", b"", b"\x00\xff"],                   # bytes
+        [np.arange(6, dtype=np.float32).reshape(2, 3),
+         np.ones((2, 3), np.float32)],               # nd: one dtype+shape
+        [1, -5, 2 ** 40],                            # i64
+        [0.5, -1.25, 3.0],                           # f64
+        [(1, "a"), (2, "b")],                        # tuple of columns
+        [[1.0, b"x"], [2.0, b"y"]],                  # list rows
+    ]
+    for records in cases:
+        col = nd.pack_columns(records)
+        assert col is not None, records
+        back = nd.unpack_columns(col, copy=False)
+        assert len(back) == len(records)
+        for orig, got in zip(records, back):
+            if isinstance(orig, np.ndarray):
+                assert got.dtype == orig.dtype and got.shape == orig.shape
+                assert np.array_equal(got, orig)
+            else:
+                assert type(got) is type(orig) and got == orig
+
+
+def test_pack_columns_falls_back_to_row_form():
+    import numpy as np
+
+    from edl_tpu.rpc import ndarray as nd
+
+    # anything the codec cannot represent EXACTLY must return None so
+    # the producer keeps the row format
+    assert nd.pack_columns([]) is None
+    assert nd.pack_columns([1, "a"]) is None          # heterogeneous
+    assert nd.pack_columns([True, False]) is None     # bool is not i64
+    assert nd.pack_columns([1, True]) is None
+    assert nd.pack_columns([2 ** 70]) is None         # int64 overflow
+    assert nd.pack_columns([{"k": 1}]) is None        # dict records
+    assert nd.pack_columns([(1, 2), (3,)]) is None    # ragged tuples
+    assert nd.pack_columns(
+        [np.zeros((2,), np.float32), np.zeros((3,), np.float32)]) is None
+    assert nd.pack_columns(
+        [np.array([object()], dtype=object)]) is None
+
+
+def test_get_batches_columnar_wire_roundtrip():
+    """One multi-batch RPC in columnar form must restore the exact
+    records on the consumer (ElasticReader._decode is the consumer-side
+    half); a missing batch yields None in its slot, and row format
+    matches what get_batch would have returned."""
+    import numpy as np
+
+    cache = BatchCache(capacity=8)
+    server = DataPlaneServer(cache).start()
+    try:
+        recs = [np.full((3,), i, np.float32) for i in range(4)]
+        payload = {"batch_id": "b0", "file": "f", "range": [0, 3],
+                   "records": recs}
+        cache.put("b0", payload)
+        cache.put("b1", {"batch_id": "b1", "file": "f", "range": [4, 5],
+                         "records": ["r4", "r5"]})
+        c = RpcClient(server.endpoint)
+        got = c.call("get_batches", ["b0", "missing", "b1"], fmt="col")
+        assert got[1] is None
+        d0 = ElasticReader._decode(got[0])
+        assert d0["batch_id"] == "b0" and d0["range"] == [0, 3]
+        assert "cols" not in d0 and "fmt" not in d0
+        assert all(np.array_equal(a, b)
+                   for a, b in zip(d0["records"], recs))
+        d1 = ElasticReader._decode(got[2])
+        assert d1["records"] == ["r4", "r5"]
+
+        # row format: byte-compatible with the single-batch RPC
+        cache.put("b2", {"batch_id": "b2", "records": ["x", "y"]})
+        row = c.call("get_batches", ["b2"], fmt="row")[0]
+        assert row == {"batch_id": "b2", "records": ["x", "y"]}
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_batch_cache_byte_bound_blocks_until_pop():
+    import numpy as np
+
+    big = {"records": [np.zeros(64, np.uint8)]}  # 64 bytes of payload
+    cache = BatchCache(capacity=8, capacity_bytes=100)
+    assert cache.put("b0", big)
+    assert cache.nbytes() >= 64
+    done = threading.Event()
+
+    def blocked_put():
+        cache.put("b1", big, timeout=30)
+        done.set()
+
+    t = threading.Thread(target=blocked_put, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    assert not done.is_set()            # 128 > 100: put is parked
+    assert cache.pop("b0") is big       # room appears ...
+    assert done.wait(timeout=5)         # ... and the put completes
+    t.join(timeout=5)
+    assert len(cache) == 1
+
+
+def test_batch_cache_put_stop_aware_and_oversized_alone():
+    import numpy as np
+
+    cache = BatchCache(capacity=8, capacity_bytes=100)
+    # a payload larger than the whole budget is admitted when the cache
+    # is empty — one oversized batch can never deadlock the producer
+    assert cache.put("huge", {"records": [np.zeros(1000, np.uint8)]})
+    stop = threading.Event()
+    result = {}
+
+    def stopping_put():
+        result["v"] = cache.put("b1", {"records": [b"x" * 50]},
+                                timeout=600, stop=stop)
+
+    t = threading.Thread(target=stopping_put, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert "v" not in result            # blocked on the full cache
+    stop.set()
+    t.join(timeout=5)
+    assert result["v"] is False         # aborted promptly, not 600s
+
+
+def test_assignment_long_poll_wakes_on_report_and_end():
+    """The wait_ms contract: with nothing assignable the call parks
+    server-side and returns the moment a production report (or data-end)
+    changes the answer — not after a fixed poll interval."""
+    svc = LeaderDataService(["f0"])
+    svc.register_reader("podA", "a:1")
+    svc.register_reader("podB", "b:1")
+    svc.get_file_list("podB")
+
+    # wait_ms=0 keeps the legacy contract: immediate [] retry signal
+    t0 = time.monotonic()
+    assert svc.get_assignment("podA", 1) == []
+    assert time.monotonic() - t0 < 0.2
+
+    out = {}
+
+    def poll():
+        t0 = time.monotonic()
+        out["got"] = svc.get_assignment("podA", 1, wait_ms=2000)
+        out["elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.3)
+    svc.report_batches("podB", ["f0_b0"], "b:1")
+    t.join(timeout=5)
+    assert out["got"] == [{"batch_id": "f0_b0", "endpoint": "b:1"}]
+    assert 0.25 <= out["elapsed"] < 1.5  # woke on the report, not cap
+
+    def poll_end():
+        t0 = time.monotonic()
+        out["end"] = svc.get_assignment("podA", 1, wait_ms=2000)
+        out["end_elapsed"] = time.monotonic() - t0
+
+    t = threading.Thread(target=poll_end)
+    t.start()
+    time.sleep(0.2)
+    svc.reach_data_end("podA")
+    svc.reach_data_end("podB")
+    t.join(timeout=5)
+    assert out["end"] == [END]
+    assert out["end_elapsed"] < 1.5
+
+
+def test_assignment_long_poll_capped():
+    # a consumer cannot park a server thread past MAX_ASSIGN_WAIT_MS
+    from edl_tpu.data import data_server
+
+    svc = LeaderDataService(["f0"])
+    svc.register_reader("podA", "a:1")
+    t0 = time.monotonic()
+    assert svc.get_assignment("podA", 1, wait_ms=60_000) == []
+    elapsed = time.monotonic() - t0
+    assert elapsed <= data_server.MAX_ASSIGN_WAIT_MS / 1e3 + 1.0
+
+
+def test_consumer_only_pods_steal_everything(tmp_path):
+    """The disaggregated-input shape: one producer pod (never consumes),
+    two pure consumers (produce=False) — everything is stolen, both
+    consumers get a share, exactly-once holds."""
+    paths = _write_files(tmp_path, n_files=4, lines_per_file=24)  # 96
+    total = ["file%d_rec%d" % (f, j) for f in range(4) for j in range(24)]
+    prod = ElasticReader("prod", TxtFileSplitter(), batch_size=8,
+                         file_list=paths, is_leader=True)
+    c1 = ElasticReader("c1", TxtFileSplitter(), batch_size=8,
+                       produce=False, leader_endpoint=prod.endpoint)
+    c2 = ElasticReader("c2", TxtFileSplitter(), batch_size=8,
+                       produce=False, leader_endpoint=prod.endpoint)
+    got = {"c1": [], "c2": []}
+
+    def consume(name, reader):
+        for batch in reader:
+            got[name].extend(batch["records"])
+            time.sleep(0.03)  # pace so the other consumer shares
+
+    t1 = threading.Thread(target=consume, args=("c1", c1))
+    t2 = threading.Thread(target=consume, args=("c2", c2))
+    t1.start(); t2.start()
+    t1.join(timeout=120); t2.join(timeout=120)
+    assert not t1.is_alive() and not t2.is_alive()
+    try:
+        assert sorted(got["c1"] + got["c2"]) == sorted(total)
+        assert got["c1"] and got["c2"]      # steal fairness: both fed
+        for reader in (c1, c2):
+            s = reader.stats()
+            assert s["local"] == 0          # pure consumers own nothing
+            assert s["remote"] > 0          # steal ratio 1.0
+            assert s["lost"] == []
+        stats = prod._leader.call("ds_stats")
+        assert stats["stolen"] == stats["consumed"]  # every batch stolen
+    finally:
+        c1.stop(); c2.stop(); prod.stop()
+
+
+def test_eviction_while_pipelined_fetches_in_flight(tmp_path, coord):
+    """Satellite of the pipelining PR: a producer dies silently while a
+    pipelined consumer (fetch_ahead deep) is mid-epoch. Fetches against
+    the dead endpoint surface as LOST (never wedge, never duplicate),
+    the consumer converges to END, the leader's consumed count equals
+    delivered+lost exactly, and the completion pass recovers exactly
+    the lost records."""
+    from edl_tpu.runtime.state import State
+
+    paths = _write_files(tmp_path, n_files=4, lines_per_file=20)  # 80
+    total = ["file%d_rec%d" % (f, j) for f in range(4) for j in range(20)]
+    state = State()
+
+    class SlowSplitter(TxtFileSplitter):
+        # throttle the leader-side producer so podB wins files
+        def split(self, path):
+            for item in TxtFileSplitter.split(self, path):
+                time.sleep(0.005)
+                yield item
+
+    rA = ElasticReader("podA", SlowSplitter(), batch_size=8,
+                       file_list=paths, is_leader=True, coord=coord,
+                       reader_name="evp", reader_ttl=2.0, fetch_ahead=4)
+    ep = lookup_data_leader(coord, "evp")
+    rB = ElasticReader("podB", TxtFileSplitter(), batch_size=8,
+                       leader_endpoint=ep)
+
+    # podB produces and reports, then dies without a goodbye — its
+    # reported batches stay assignable until eviction, so the pipelined
+    # consumer WILL issue fetches against the dead endpoint
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        with rB._cache._lock:
+            if rB._cache._data:
+                break
+        time.sleep(0.02)
+    rB._stop.set()
+    rB._server.stop()
+    rB._gen_thread.join(timeout=20)
+    rB._hb_thread.join(timeout=20)
+    assert not rB._gen_thread.is_alive()
+    assert not rB._hb_thread.is_alive()
+    rA._leader.call("ds_register_reader", "podB", "127.0.0.1:1")
+
+    got_batches = []
+    got = []
+    for batch in rA:
+        ElasticReader.mark_consumed(state, batch)
+        got_batches.append(batch)
+        got.extend(batch["records"])
+    lost = rA.stats()["lost"]
+    stats = rA._leader.call("ds_stats")
+    rA.stop()
+
+    assert len(got) == len(set(got))
+    assert lost, "no fetch was in flight against the dead producer"
+    # exact accounting: every assignment the leader handed out was
+    # either delivered or logged lost — nothing silently vanished
+    assert stats["consumed"] == len(got_batches) + len(lost)
+
+    state2 = State().from_json(state.to_json())
+    rD = ElasticReader("podD", TxtFileSplitter(), batch_size=8,
+                       file_list=paths, is_leader=True, coord=coord,
+                       reader_name="evp2",
+                       skip_record=state2.data_checkpoint.is_processed)
+    rest = []
+    for batch in rD:
+        rest.extend(batch["records"])
+    rD.stop()
+    assert sorted(got + rest) == sorted(total)
+    assert not set(got) & set(rest)
+
+
+def test_legacy_producer_serial_row_fallback(tmp_path):
+    """Interop: a pre-pipelining producer (no rpc.pipeline feature, only
+    per-batch get_batch) feeds a pipelined consumer unchanged — the
+    consumer negotiates the endpoint down to serial row fetches and the
+    payloads come through byte-identical to what the producer stored."""
+    cache = BatchCache(capacity=8)
+    legacy = DataPlaneServer(cache).start()
+    # masquerade as a pre-pipelining generation
+    legacy._rpc.register("__features__", lambda: [])
+
+    leader = ElasticReader("podL", TxtFileSplitter(), batch_size=8,
+                           file_list=[], is_leader=True)
+    payloads = {}
+    for i in range(3):
+        bid = "leg_b%d" % i
+        payloads[bid] = {"batch_id": bid, "file": "legacy.txt",
+                         "range": [i * 2, i * 2 + 1],
+                         "records": ["legacy_rec%d" % (i * 2),
+                                     "legacy_rec%d" % (i * 2 + 1)]}
+        cache.put(bid, payloads[bid])
+    leader._leader.call("ds_register_reader", "legacy", legacy.endpoint)
+    leader._leader.call("ds_report_batches", "legacy",
+                        list(payloads), legacy.endpoint)
+    leader._leader.call("ds_reach_data_end", "legacy")
+
+    rC = ElasticReader("podC", TxtFileSplitter(), batch_size=8,
+                       produce=False, leader_endpoint=leader.endpoint,
+                       pipelined_fetch=True, columnar=True)
+    try:
+        got = list(rC)
+        assert {b["batch_id"]: b for b in got} == payloads  # byte-compat
+        s = rC.stats()
+        assert s["endpoint_modes"][legacy.endpoint] == "serial"
+        assert s["lost"] == [] and s["remote"] == 3
+    finally:
+        rC.stop()
+        leader.stop()
+        legacy.stop()
+
+
+def test_legacy_leader_disables_long_poll(tmp_path):
+    """A pre-pipelining LEADER would reject the extra wait_ms argument;
+    the consumer must detect the missing feature at registration and
+    fall back to the plain polled assignment call — and still drain the
+    epoch."""
+    paths = _write_files(tmp_path, n_files=1, lines_per_file=16)
+    leader = ElasticReader("podL", TxtFileSplitter(), batch_size=8,
+                           file_list=paths, is_leader=True)
+    # downgrade the leader's advertisement BEFORE the consumer probes it
+    leader._server._rpc.register("__features__", lambda: [])
+    rC = ElasticReader("podC", TxtFileSplitter(), batch_size=8,
+                       produce=False, leader_endpoint=leader.endpoint)
+    try:
+        assert rC._assign_wait_ms is None       # negotiated away
+        assert leader._assign_wait_ms is not None  # probed pre-downgrade
+        got = []
+        for batch in rC:
+            got.extend(batch["records"])
+        assert sorted(got) == sorted("file0_rec%d" % i for i in range(16))
+    finally:
+        rC.stop()
+        leader.stop()
+
+
+def test_reader_stop_idempotent_and_prompt(tmp_path):
+    paths = _write_files(tmp_path, n_files=2, lines_per_file=20)
+    reader = ElasticReader("podA", TxtFileSplitter(), batch_size=8,
+                           file_list=paths, is_leader=True)
+    it = iter(reader)
+    next(it); next(it)  # pipeline warm, fetches in flight
+    t0 = time.monotonic()
+    reader.stop()
+    reader.stop()  # idempotent — second call is a no-op, not an error
+    assert time.monotonic() - t0 < 15  # no 30s socket-timeout stall
+    assert not reader._hb_thread.is_alive()
+    assert reader._gen_thread is None or not reader._gen_thread.is_alive()
+    assert (reader._fetch_thread is None
+            or not reader._fetch_thread.is_alive())
+    assert reader._pool.stats()["open"] == 0  # owned pool closed

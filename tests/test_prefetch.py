@@ -171,3 +171,24 @@ def test_prefetch_close_is_idempotent_and_joins():
     assert not it._thread.is_alive()
     with pytest.raises(StopIteration):
         next(it)
+
+
+def test_prefetch_stats_overlap_accounting():
+    """stats() splits the pipeline's blocked time onto the two sides:
+    a slow host iterator shows up as pump_wait_s (step-bound input),
+    and the batch count matches what the consumer actually saw."""
+    import time
+
+    def slow_batches(n):
+        for i in range(n):
+            time.sleep(0.02)
+            yield {"x": np.full((8, 4), i, np.float32)}
+
+    mesh = make_mesh()
+    with DevicePrefetcher(slow_batches(5), data_sharding(mesh),
+                          size=2) as it:
+        assert len(list(it)) == 5
+        s = it.stats()
+    assert s["batches"] == 5
+    assert s["pump_wait_s"] >= 5 * 0.02 * 0.8  # the host iter was slow
+    assert s["consumer_wait_s"] >= 0.0

@@ -21,7 +21,8 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGES = ("rpc", "coordination", "distill", "liveft", "controller")
+PACKAGES = ("rpc", "coordination", "distill", "liveft", "controller",
+            "data")
 
 # (relpath, enclosing function) -> why the raw sleep-in-loop is OK
 ALLOWLIST = {
