@@ -1,10 +1,17 @@
-"""TTL-leased registration with a background refresh thread.
+"""TTL-leased registration kept alive through the per-process keepalive hub.
 
 Reference parity: edl/utils/register.py (refresh every ttl/2; refresh
 failure ⇒ the node silently drops out of the cluster :57-68). Here refresh
 failure marks the register stopped so the launcher notices and exits.
+
+Refreshes are coalesced: every Register in a process shares ONE timer and
+ONE batched ``lease_refresh_many`` RPC via
+:class:`edl_tpu.coordination.keepalive.KeepaliveHub` (set
+``EDL_TPU_KEEPALIVE_HUB=0`` to fall back to a private per-register
+refresh thread).
 """
 
+import os
 import threading
 import time
 
@@ -15,7 +22,7 @@ from edl_tpu.utils.logger import logger
 
 class Register(object):
     def __init__(self, coord, service, server, value,
-                 ttl=constants.ETCD_TTL):
+                 ttl=constants.ETCD_TTL, use_hub=None):
         self._coord = coord
         self._service = service
         self._server = server
@@ -25,10 +32,43 @@ class Register(object):
                                                      ttl)
         self._stop = threading.Event()
         self._broken = threading.Event()
-        self._thread = threading.Thread(
-            target=self._refresher, daemon=True,
-            name="register-%s-%s" % (service, server))
-        self._thread.start()
+        if use_hub is None:
+            use_hub = os.environ.get("EDL_TPU_KEEPALIVE_HUB", "1") != "0"
+        self._hub = None
+        self._thread = None
+        if use_hub:
+            from edl_tpu.coordination.keepalive import hub_for
+            self._hub = hub_for(coord)
+            self._hub.add(self._lease_id, ttl, on_lost=self._on_lost)
+        else:
+            self._thread = threading.Thread(
+                target=self._refresher, daemon=True,
+                name="register-%s-%s" % (service, server))
+            self._thread.start()
+
+    # -- coalesced path (keepalive hub) --------------------------------
+
+    def _on_lost(self):
+        """Hub callback: the store no longer knows our lease. Never
+        block the shared beat — re-register on a private thread."""
+        if self._stop.is_set():
+            return
+        threading.Thread(
+            target=self._relost, daemon=True,
+            name="reregister-%s-%s" % (self._service, self._server)).start()
+
+    def _relost(self):
+        old = self._lease_id
+        if self._reregister(errors.LeaseExpiredError(
+                "lease %s for %s/%s lost" % (old, self._service,
+                                             self._server))):
+            if not self._stop.is_set() and self._hub is not None:
+                self._hub.replace(old, self._lease_id, self._ttl,
+                                  on_lost=self._on_lost)
+        else:
+            self._broken.set()
+
+    # -- legacy path (private refresh thread) --------------------------
 
     def _refresher(self):
         while not self._stop.wait(self._ttl / 3.0):
@@ -65,7 +105,10 @@ class Register(object):
 
     def stop(self, revoke=True):
         self._stop.set()
-        self._thread.join(timeout=self._ttl)
+        if self._hub is not None:
+            self._hub.remove(self._lease_id)
+        if self._thread is not None:
+            self._thread.join(timeout=self._ttl)
         if revoke:
             try:
                 self._coord.lease_revoke(self._lease_id)

@@ -6,12 +6,25 @@ registration, put-if-absent election, guarded transactions, and prefix watches
 with add/remove diffing — but speaks to the in-tree Store over framed RPC.
 """
 
+import re
 import threading
+import uuid
 
-from edl_tpu.robustness.policy import Deadline, RetryPolicy
+from edl_tpu.robustness.policy import CircuitBreaker, Deadline, RetryPolicy
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
+
+_LEADER_HINT = re.compile(r"leader=([^\s]+)")
+
+
+def _parse_leader_hint(exc):
+    """Extract the leader endpoint from a NotLeaderError detail
+    (``not leader: leader=<host:port> term=<n>``); None if unknown."""
+    m = _LEADER_HINT.search(str(exc))
+    if m and m.group(1) not in ("?", "None"):
+        return m.group(1)
+    return None
 
 
 class Watcher(object):
@@ -101,13 +114,20 @@ class CoordClient(object):
         self._root = root
         self._timeout = timeout
         # how long a call keeps retrying endpoint rotation when EVERY
-        # endpoint refuses — covers the primary-death -> standby-promote
-        # window (standby.py); single-endpoint clients fail fast
+        # endpoint refuses — covers both the primary-death ->
+        # standby-promote window (standby.py) and a replica-set election
+        # (replica.py); single-endpoint clients fail fast on ConnectError
         self._failover_grace = failover_grace
-        # per-thread connections: a watcher's long-poll must not block
-        # lease-refresh heartbeats issued from other threads
+        # per-thread per-endpoint connections: a watcher's long-poll must
+        # not block lease-refresh heartbeats issued from other threads
         self._local = threading.local()
         self._ep_lock = threading.Lock()
+        self._leader = None        # last NotLeader redirect hint
+        self._features = {}        # endpoint -> frozenset of features
+        # per-endpoint breaker: a dead replica stops eating a dial
+        # timeout on every single call while the set stays degraded
+        self._breakers = CircuitBreaker(failure_threshold=3,
+                                        reset_timeout=2.0)
         # jittered backoff between rotation rounds: desyncs the herd of
         # control-plane clients that would otherwise re-dial a dead
         # primary in lockstep every 0.5s
@@ -132,38 +152,119 @@ class CoordClient(object):
 
     # -- transport ----------------------------------------------------------
 
+    def _client_for(self, endpoint):
+        """This thread's cached connection to ``endpoint`` (dialed lazily).
+        Returns (client, was_cached)."""
+        rpcs = getattr(self._local, "rpcs", None)
+        if rpcs is None:
+            rpcs = self._local.rpcs = {}
+        rpc = rpcs.get(endpoint)
+        if rpc is not None:
+            return rpc, True
+        rpc = rpcs[endpoint] = RpcClient(endpoint, timeout=self._timeout)
+        return rpc, False
+
+    def _drop_client(self, endpoint):
+        rpcs = getattr(self._local, "rpcs", None)
+        rpc = rpcs.pop(endpoint, None) if rpcs else None
+        if rpc is not None:
+            rpc.close()
+        with self._ep_lock:
+            self._features.pop(endpoint, None)
+
+    def _supports(self, endpoint, rpc, feature):
+        with self._ep_lock:
+            feats = self._features.get(endpoint)
+        if feats is None:
+            feats = frozenset(rpc.server_features())
+            with self._ep_lock:
+                self._features[endpoint] = feats
+        return feature in feats
+
+    def _round_endpoints(self):
+        """One rotation round: the last known leader first, then every
+        other configured endpoint."""
+        with self._ep_lock:
+            leader = self._leader
+            eps = list(self._endpoints)
+        if leader is not None and leader in eps:
+            eps.remove(leader)
+            eps.insert(0, leader)
+        elif leader is not None:
+            # a redirect may point outside the configured list (replica
+            # advertised endpoint): dial it, but keep the configured
+            # set as fallback
+            eps.insert(0, leader)
+        return eps
+
     def _call(self, method, *args, **kwargs):
         deadline = kwargs.pop("deadline", None)  # caller's Deadline budget
+        # idempotency key: generated once per logical op by the public
+        # method and preserved across every re-dial / redirect below, so
+        # a retry that straddles a failover cannot double-apply
+        op_id = kwargs.pop("op_id", None)
         last = None
         grace = None
         rounds = 0
+        redirects = 0
         while True:
-            # +1: a stale cached connection (severed by a server restart)
-            # costs one attempt; the fresh reconnect deserves its own
-            for _ in range(len(self._endpoints) + 1):
-                rpc = getattr(self._local, "rpc", None)
-                if rpc is None:
-                    with self._ep_lock:
-                        endpoint = self._endpoints[0]
-                    rpc = self._local.rpc = RpcClient(
-                        endpoint, timeout=self._timeout)
-                try:
-                    return rpc.call(method, *args, deadline=deadline,
-                                    **kwargs)
-                except errors.ConnectError as e:
-                    last = e
-                    rpc.close()
-                    self._local.rpc = None
-                    with self._ep_lock:
-                        if self._endpoints[0] == rpc.endpoint:
-                            self._endpoints.append(self._endpoints.pop(0))
-            if len(self._endpoints) < 2:
+            fast_redirect = False
+            for endpoint in self._round_endpoints():
+                if not self._breakers.allow(endpoint):
+                    continue
+                # a stale cached connection (severed by a server restart)
+                # costs one attempt; the fresh reconnect deserves its own
+                # — and a stale-conn error must not open the breaker
+                hint = None
+                for _ in range(2):
+                    rpc, was_cached = self._client_for(endpoint)
+                    call_kwargs = dict(kwargs)
+                    try:
+                        if op_id is not None and self._supports(
+                                endpoint, rpc, "store.txn_dedup"):
+                            call_kwargs["op_id"] = op_id
+                        out = rpc.call(method, *args, deadline=deadline,
+                                       **call_kwargs)
+                        self._breakers.record_success(endpoint)
+                        return out
+                    except errors.NotLeaderError as e:
+                        # the endpoint is healthy — it just isn't the
+                        # leader; follow its redirect
+                        self._breakers.record_success(endpoint)
+                        last = e
+                        hint = _parse_leader_hint(e)
+                        with self._ep_lock:
+                            self._leader = hint
+                        break
+                    except errors.ConnectError as e:
+                        last = e
+                        self._drop_client(endpoint)
+                        with self._ep_lock:
+                            if self._leader == endpoint:
+                                self._leader = None
+                        if not was_cached:
+                            self._breakers.record_failure(endpoint)
+                            break
+                if hint is not None and hint != endpoint and redirects < 3:
+                    # restart the round leader-first, without the backoff
+                    # sleep (bounded, so a redirect ping-pong between two
+                    # confused replicas degrades into the jittered path)
+                    redirects += 1
+                    fast_redirect = True
+                    break
+            if fast_redirect:
+                continue
+            if len(self._endpoints) < 2 and \
+                    not isinstance(last, errors.NotLeaderError):
                 raise last
+            if last is None:
+                last = errors.CircuitOpenError(
+                    "all coordination endpoints circuit-open")
             # multi-endpoint deployments have a FAILOVER WINDOW: the
-            # primary is gone but the standby has not promoted yet and
-            # still answers ConnectError. Retrying rotation rounds for
-            # a bounded grace keeps control-plane calls alive across
-            # the takeover instead of surfacing a transient outage.
+            # leader is gone but no successor has promoted/been elected
+            # yet. Retrying rotation rounds for a bounded grace keeps
+            # control-plane calls alive across the takeover instead of
+            # surfacing a transient outage.
             rounds += 1
             if grace is None:
                 grace = Deadline(self._failover_grace)
@@ -197,10 +298,28 @@ class CoordClient(object):
     # -- leases --------------------------------------------------------------
 
     def lease_grant(self, ttl):
-        return self._call("store_lease_grant", ttl)
+        # idempotency key: a retry that straddles a failover must not
+        # grant two leases for one logical registration
+        return self._call("store_lease_grant", ttl,
+                          op_id=uuid.uuid4().hex)
 
     def lease_refresh(self, lease_id):
         return self._call("store_lease_refresh", lease_id)
+
+    def lease_refresh_many(self, lease_ids):
+        """Batched keepalive; returns {lease_id: ok}. Falls back to
+        per-id refreshes against peers that predate the batched RPC
+        (feature ``store.lease_refresh_many``)."""
+        lease_ids = list(lease_ids)
+        if not lease_ids:
+            return {}
+        try:
+            pairs = self._call("store_lease_refresh_many", lease_ids)
+            return {int(lid): bool(ok) for lid, ok in pairs}
+        except errors.RpcError as e:
+            if "no such method" not in str(e):
+                raise
+        return {lid: bool(self.lease_refresh(lid)) for lid in lease_ids}
 
     def lease_revoke(self, lease_id):
         return self._call("store_lease_revoke", lease_id)
@@ -233,7 +352,7 @@ class CoordClient(object):
         """
         lease_id = self.lease_grant(ttl)
         ok, _ = self._call("store_put_if_absent", self._key(service, server),
-                           value, lease_id)
+                           value, lease_id, op_id=uuid.uuid4().hex)
         if not ok:
             self.lease_revoke(lease_id)
             return None
@@ -265,7 +384,7 @@ class CoordClient(object):
 
     def txn(self, compares, on_success, on_failure=()):
         return self._call("store_txn", list(compares), list(on_success),
-                          list(on_failure))
+                          list(on_failure), op_id=uuid.uuid4().hex)
 
     def put_if_leader(self, leader_service, leader_server, leader_value,
                       puts):

@@ -45,6 +45,57 @@ class EmbeddedStore(object):
         self.stop()
 
 
+class EmbeddedReplicaSet(object):
+    """An in-process quorum-replicated store (3 replicas by default) —
+    the HA analogue of :class:`EmbeddedStore` for tests, tools, and
+    single-host dev rigs. ``endpoints`` (comma-joinable) is what the
+    launcher's ``--store_endpoints`` and ``EDL_TPU_STORE_ENDPOINTS``
+    expect."""
+
+    def __init__(self, n=3, data_dir=None, host="127.0.0.1",
+                 election_timeout=(0.3, 0.6)):
+        self._n = n
+        self._data_dir = data_dir
+        self._host = host
+        self._et = election_timeout
+        self.replicas = []
+
+    def __enter__(self):
+        return self.start()
+
+    def start(self):
+        from edl_tpu.coordination.replica import (start_local_replica_set,
+                                                  wait_for_leader)
+        self.replicas = start_local_replica_set(
+            self._n, data_dir=self._data_dir, host=self._host,
+            election_timeout=self._et)
+        wait_for_leader(self.replicas, timeout=30.0)
+        return self
+
+    @property
+    def endpoints(self):
+        return [r.endpoint for r in self.replicas]
+
+    @property
+    def endpoint(self):
+        """Comma-joined endpoint list (drop-in for EmbeddedStore)."""
+        return ",".join(self.endpoints)
+
+    def client(self, root="edl"):
+        return CoordClient(self.endpoints, root=root)
+
+    def stop(self):
+        for r in self.replicas:
+            try:
+                r.stop()
+            except Exception:
+                pass
+        self.replicas = []
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
 def set_global_endpoints(endpoints):
     os.environ[ENV_ENDPOINTS] = (endpoints if isinstance(endpoints, str)
                                  else ",".join(endpoints))

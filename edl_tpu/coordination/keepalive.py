@@ -1,0 +1,151 @@
+"""Per-process lease keepalive coalescing.
+
+Every component that holds a TTL lease (trainer registration, data
+leader, teacher discovery, state server, ...) historically ran its own
+refresh thread — N threads firing N ``store_lease_refresh`` RPCs per
+TTL window against the coordination store.  At fleet scale that is the
+dominant store traffic (ROADMAP item 4).
+
+:class:`KeepaliveHub` replaces them with ONE timer per process: every
+registered lease is refreshed by a single batched
+``store_lease_refresh_many`` RPC.  Peers that predate the batched RPC
+are handled transparently — ``CoordClient.lease_refresh_many`` falls
+back to per-id refreshes when the endpoint doesn't advertise the
+``store.lease_refresh_many`` feature.
+
+A lease the store reports as gone (expired or revoked behind our back)
+triggers the component's ``on_lost`` callback exactly once and is
+dropped from the hub; the component decides whether to re-register or
+die, exactly as its private refresh loop used to.
+"""
+
+import threading
+
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+
+class KeepaliveHub(object):
+    """One batched lease-refresh timer for a whole process.
+
+    ``interval`` should be at most a third of the smallest TTL that will
+    be registered; :meth:`add` shrinks the effective interval if a
+    shorter-lived lease shows up later.
+    """
+
+    def __init__(self, coord, interval=None):
+        self._coord = coord
+        self._interval = interval
+        self._lock = threading.Lock()
+        self._leases = {}           # lease_id -> (ttl, on_lost or None)
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = None
+
+    # -- registration --------------------------------------------------
+
+    def add(self, lease_id, ttl, on_lost=None):
+        """Start keeping ``lease_id`` alive; ``on_lost()`` fires (once,
+        from the hub thread) if the store no longer knows the lease."""
+        lease_id = int(lease_id)
+        with self._lock:
+            self._leases[lease_id] = (float(ttl), on_lost)
+            start = self._thread is None
+            if start:
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="keepalive-hub")
+                self._thread.start()
+        self._wake.set()            # re-pick the interval for a short ttl
+        return lease_id
+
+    def remove(self, lease_id):
+        with self._lock:
+            self._leases.pop(int(lease_id), None)
+
+    def replace(self, old_lease_id, lease_id, ttl, on_lost=None):
+        """Atomic swap after a re-registration: the old id stops being
+        refreshed in the same beat the new one starts."""
+        with self._lock:
+            self._leases.pop(int(old_lease_id), None)
+            self._leases[int(lease_id)] = (float(ttl), on_lost)
+        self._wake.set()
+        return lease_id
+
+    def __len__(self):
+        with self._lock:
+            return len(self._leases)
+
+    # -- the single timer ----------------------------------------------
+
+    def _pick_interval(self):
+        if self._interval is not None:
+            return self._interval
+        with self._lock:
+            ttls = [t for t, _ in self._leases.values()]
+        return (min(ttls) / 3.0) if ttls else 1.0
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._wake.clear()
+            self._wake.wait(self._pick_interval())
+            if self._stop.is_set():
+                return
+            self.refresh_now()
+
+    def refresh_now(self):
+        """One coalesced refresh beat (also callable from tests)."""
+        with self._lock:
+            ids = list(self._leases)
+        if not ids:
+            return {}
+        try:
+            res = self._coord.lease_refresh_many(ids)
+        except errors.EdlError as e:
+            # transient store outage: keep the leases registered and let
+            # the next beat retry — the server grants a full TTL per
+            # refresh, so one missed beat is survivable by design
+            logger.warning("keepalive beat failed (%d leases): %r",
+                           len(ids), e)
+            return {}
+        lost = [lid for lid, ok in res.items() if not ok]
+        for lid in lost:
+            with self._lock:
+                entry = self._leases.pop(lid, None)
+            if entry is None:
+                continue
+            _, on_lost = entry
+            logger.warning("lease %d lost (expired or revoked)", lid)
+            if on_lost is not None:
+                try:
+                    on_lost()
+                except Exception:
+                    logger.exception("on_lost callback for lease %d "
+                                     "failed", lid)
+        return res
+
+    def stop(self):
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+# -- per-client hub (opt-in) -------------------------------------------
+
+_GLOBAL_LOCK = threading.Lock()
+
+
+def hub_for(coord):
+    """The hub bound to ``coord`` (created on first use).
+
+    Stored as an attribute on the client itself — NOT in an
+    ``id(coord)``-keyed module dict, which would hand a fresh client a
+    dead client's hub whenever the interpreter reuses the id after GC.
+    """
+    with _GLOBAL_LOCK:
+        hub = getattr(coord, "_keepalive_hub", None)
+        if hub is None:
+            hub = KeepaliveHub(coord)
+            coord._keepalive_hub = hub
+        return hub

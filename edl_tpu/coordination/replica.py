@@ -1,0 +1,1078 @@
+"""Quorum-replicated coordination store (raft-lite).
+
+Turns the single-process :class:`~edl_tpu.coordination.store.Store` into a
+3-replica replicated state machine:
+
+* a durable **replication log** (:class:`ReplLog`) layered on the same
+  JSON-lines record format as the Store WAL (one fsynced line per append,
+  torn-tail truncation on replay, full-rewrite compaction);
+* a **leader** holding a store-internal lease-based term appends every
+  mutating op (put / delete / txn / lease grant / revoke — coalesced
+  keepalives stay OFF the log), streams ``repl.append`` entries to
+  followers over the pipelined RPC plane, and acks the client only after
+  a quorum has fsynced; the commit index advances monotonically and
+  followers apply strictly in order, so failover never loses an
+  acknowledged write and never resurrects an unacknowledged one;
+* **leader election**: randomized-timeout candidacy with term fencing
+  (persisted term + vote), a no-op entry asserted on election so the new
+  leader can commit, and the raft commit rule (only entries from the
+  current term advance the commit index by counting);
+* **linearizable reads from followers** via read-index confirmation: the
+  follower asks the leader for a confirmed commit index and serves the
+  read only once its applied index has caught up;
+* **snapshot install** for lagging or wiped replicas, reusing the Store
+  snapshot/rewrite machinery (``snapshot_state``/``install_snapshot``).
+
+The module is dependency-free beyond the in-tree rpc plane and is exercised
+hermetically by ``tests/test_replication.py`` and the ``store_bench --micro``
+failover arc.  The witness/standby pair in ``standby.py`` remains as the
+1-replica fallback for deployments that cannot afford three processes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import OrderedDict
+
+from ..robustness import faults
+from ..robustness.policy import Deadline
+from ..rpc.pool import ClientPool
+from ..rpc.server import RpcServer
+from ..utils import errors
+from .store import Store
+
+log = logging.getLogger("edl_tpu.coordination.replica")
+
+# Dedicated ClientPool channel so replication traffic (appends, votes,
+# snapshots) never queues behind client-facing store calls.
+REPL_CHANNEL = "repl"
+
+# Election timeouts (seconds).  Heartbeat period is min/5.  Tests override
+# with much smaller values; production default targets sub-second failover.
+ELECTION_TIMEOUT = (0.75, 1.5)
+
+# How many applied entries the log may trail the snapshot by before the
+# leader/follower compacts its own log.
+COMPACT_THRESHOLD = 2048
+
+# Replicated dedup table size (client op_id -> result).
+DEDUP_CAP = 4096
+
+# Per-index local result cache (leader-side, for acking proposers).
+RESULT_CAP = 1024
+
+
+def _enc(obj):
+    """JSON-encode helper: bytes -> {"__b64__": ...} recursively."""
+    if isinstance(obj, bytes):
+        return {"__b64__": base64.b64encode(obj).decode("ascii")}
+    if isinstance(obj, (list, tuple)):
+        return [_enc(x) for x in obj]
+    if isinstance(obj, dict):
+        return {k: _enc(v) for k, v in obj.items()}
+    return obj
+
+
+def _dec(obj):
+    if isinstance(obj, dict):
+        if set(obj) == {"__b64__"}:
+            return base64.b64decode(obj["__b64__"])
+        return {k: _dec(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_dec(x) for x in obj]
+    return obj
+
+
+class ReplLog:
+    """Durable replication log: JSON lines, one fsync per append batch.
+
+    Uses the same record style as the Store WAL (self-describing JSON
+    objects, newline-delimited, torn trailing record tolerated and
+    truncated on replay).  The log may begin after a snapshot: records
+
+        {"op": "snap", "index": i, "term": t, "state": {...}}
+        {"op": "ent", "index": i, "term": t, "kind": ..., "args": [...]}
+
+    ``base_index``/``base_term`` describe the entry immediately before
+    ``entries[0]`` (the snapshot point, or 0/0 for an empty prefix).
+    """
+
+    def __init__(self, path=None):
+        self.path = path
+        self.base_index = 0
+        self.base_term = 0
+        self.snapshot = None          # store snapshot dict at base_index
+        self.entries = []             # list of {"index","term","kind","args"}
+        self._f = None
+        if path:
+            self._replay()
+            self._open()
+
+    # -- durability ----------------------------------------------------
+
+    def _open(self):
+        self._f = open(self.path, "ab")
+
+    def _replay(self):
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            raw = f.read()
+        lines = raw.split(b"\n")
+        offset = 0
+        torn_at = None
+        for i, line in enumerate(lines):
+            if not line.strip():
+                offset += len(line) + 1
+                continue
+            try:
+                rec = json.loads(line.decode("utf-8"))
+                op = rec["op"]
+                if op == "snap":
+                    self.base_index = int(rec["index"])
+                    self.base_term = int(rec["term"])
+                    self.snapshot = _dec(rec["state"])
+                    self.entries = []
+                elif op == "ent":
+                    ent = {"index": int(rec["index"]),
+                           "term": int(rec["term"]),
+                           "kind": rec["kind"],
+                           "args": _dec(rec.get("args") or [])}
+                    # a rewritten suffix after truncate_from may overlap
+                    while self.entries and \
+                            self.entries[-1]["index"] >= ent["index"]:
+                        self.entries.pop()
+                    self.entries.append(ent)
+                else:
+                    raise ValueError("unknown op %r" % (op,))
+            except (ValueError, KeyError, TypeError) as e:
+                if i >= len(lines) - 2:
+                    log.warning("repl log %s: torn trailing record "
+                                "(%s); truncating", self.path, e)
+                else:
+                    log.error("repl log %s: corrupt record at byte %d "
+                              "(%s); discarding it and all later records",
+                              self.path, offset, e)
+                torn_at = offset
+                break
+            offset += len(line) + 1
+        if torn_at is not None:
+            with open(self.path, "rb+") as f:
+                f.truncate(torn_at)
+                f.flush()
+                os.fsync(f.fileno())
+
+    def _write(self, recs, fsync=True):
+        if self._f is None:
+            return
+        buf = b"".join(
+            json.dumps(r, separators=(",", ":")).encode("utf-8") + b"\n"
+            for r in recs)
+        self._f.write(buf)
+        self._f.flush()
+        if fsync:
+            os.fsync(self._f.fileno())
+
+    # -- index math ----------------------------------------------------
+
+    @property
+    def last_index(self):
+        return self.entries[-1]["index"] if self.entries else self.base_index
+
+    @property
+    def last_term(self):
+        return self.entries[-1]["term"] if self.entries else self.base_term
+
+    def term_at(self, index):
+        """Term of entry at ``index``; None if compacted away/unknown."""
+        if index == self.base_index:
+            return self.base_term
+        ent = self.get(index)
+        return None if ent is None else ent["term"]
+
+    def get(self, index):
+        i = index - self.base_index - 1
+        if 0 <= i < len(self.entries):
+            return self.entries[i]
+        return None
+
+    def slice_from(self, index):
+        """Entries with index >= ``index`` (must not be compacted)."""
+        i = index - self.base_index - 1
+        return self.entries[max(i, 0):]
+
+    # -- mutation ------------------------------------------------------
+
+    def append(self, ents, fsync=True):
+        self.entries.extend(ents)
+        self._write([{"op": "ent", "index": e["index"], "term": e["term"],
+                      "kind": e["kind"], "args": _enc(e["args"])}
+                     for e in ents], fsync=fsync)
+
+    def truncate_from(self, index):
+        """Drop entries with index >= ``index`` (conflict resolution).
+
+        Rewrites the on-disk log so the divergent suffix cannot
+        resurrect on restart.
+        """
+        i = index - self.base_index - 1
+        if i < 0:
+            i = 0
+        if i >= len(self.entries):
+            return
+        self.entries = self.entries[:i]
+        self._rewrite()
+
+    def compact(self, index, term, snapshot):
+        """Install ``snapshot`` at (index, term), dropping covered entries."""
+        kept = [e for e in self.entries if e["index"] > index]
+        self.base_index = index
+        self.base_term = term
+        self.snapshot = snapshot
+        self.entries = kept
+        self._rewrite()
+
+    def reset(self, index, term, snapshot):
+        """Wholesale replace with a snapshot (install from leader)."""
+        self.base_index = index
+        self.base_term = term
+        self.snapshot = snapshot
+        self.entries = []
+        self._rewrite()
+
+    def _rewrite(self):
+        if not self.path:
+            return
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            recs = []
+            if self.snapshot is not None or self.base_index:
+                recs.append({"op": "snap", "index": self.base_index,
+                             "term": self.base_term,
+                             "state": _enc(self.snapshot)})
+            recs.extend({"op": "ent", "index": e["index"],
+                         "term": e["term"], "kind": e["kind"],
+                         "args": _enc(e["args"])} for e in self.entries)
+            for r in recs:
+                f.write(json.dumps(r, separators=(",", ":"))
+                        .encode("utf-8") + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        d = os.open(os.path.dirname(os.path.abspath(self.path)),
+                    os.O_RDONLY)
+        try:
+            os.fsync(d)
+        finally:
+            os.close(d)
+        self._open()
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class ReplMeta:
+    """Persistent per-replica metadata: current term + vote (fsynced on
+    every change, as raft requires) and the commit index (lazily persisted
+    — safe because commit is recomputed from quorum state on recovery)."""
+
+    def __init__(self, path=None):
+        self.path = path
+        self.term = 0
+        self.voted_for = None
+        self.commit = 0
+        if path and os.path.exists(path):
+            try:
+                with open(path, "r") as f:
+                    d = json.load(f)
+                self.term = int(d.get("term", 0))
+                self.voted_for = d.get("voted_for")
+                self.commit = int(d.get("commit", 0))
+            except (ValueError, KeyError, TypeError):
+                log.warning("repl meta %s unreadable; starting fresh",
+                            path)
+
+    def save(self, fsync=True):
+        if not self.path:
+            return
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for,
+                       "commit": self.commit}, f)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+
+# Features a replica advertises on top of the rpc-plane set.
+FEATURES = ("store.repl", "store.txn_dedup", "store.lease_refresh_many")
+
+
+class ReplicatedStoreServer(object):
+    """One replica of the quorum-replicated coordination store.
+
+    ``endpoint`` is this replica's advertised ``host:port`` and must
+    appear in ``peers`` (the full, odd-sized replica set).  All replicas
+    run the same code; roles (follower / candidate / leader) emerge from
+    the election protocol.
+    """
+
+    def __init__(self, endpoint, peers, data_dir=None, host=None,
+                 election_timeout=ELECTION_TIMEOUT, quorum_timeout=5.0,
+                 heartbeat=None):
+        if endpoint not in peers:
+            raise ValueError("endpoint %s not in replica set %r"
+                             % (endpoint, peers))
+        if len(peers) % 2 == 0:
+            raise ValueError("replica set size must be odd, got %d"
+                             % len(peers))
+        self.endpoint = endpoint
+        self.replica_set = list(peers)
+        self.peers = [p for p in peers if p != endpoint]
+        self.quorum = len(peers) // 2 + 1
+        self._et = tuple(election_timeout)
+        self._hb = heartbeat if heartbeat is not None else self._et[0] / 5.0
+        self._quorum_timeout = quorum_timeout
+
+        log_path = meta_path = None
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            log_path = os.path.join(data_dir, "repl.log")
+            meta_path = os.path.join(data_dir, "repl.meta")
+        # Replicated state machine: revisions are seeded at 0 and leases
+        # never expire locally — every replica applies the identical
+        # entry sequence, so every replica holds the identical store.
+        self.store = Store(wal_path=None, expire_leases=False, seed_rev=0)
+        self.log = ReplLog(log_path)
+        self.meta = ReplMeta(meta_path)
+
+        self._mu = threading.RLock()
+        self._apply_cond = threading.Condition(self._mu)
+        self._prop_lock = threading.Lock()   # serializes proposes
+        self._repl_lock = threading.Lock()   # serializes replicate rounds
+        self._stop = threading.Event()
+        self._thread = None
+
+        self._role = "follower"
+        self._leader = None
+        self._applied = self.log.base_index
+        self._dedup = OrderedDict()   # op_id -> [result], replicated
+        self._results = {}            # index -> [result], leader-local acks
+        self._match = {}
+        self._next = {}
+        self._lease_hint = 1
+        self._quorum_ok_at = 0.0
+        self._reset_timer()
+
+        # recovery: snapshot, then the committed prefix of the log; the
+        # uncommitted tail stays on disk and lives or dies by the
+        # current leader's log-matching checks.
+        if self.log.snapshot is not None:
+            self._install_state(self.log.snapshot)
+            self._applied = self.log.base_index
+        self.meta.commit = max(self.log.base_index,
+                               min(self.meta.commit, self.log.last_index))
+        with self._mu:
+            self._apply_upto_locked(self.meta.commit)
+        # any watcher holding a pre-restart revision must re-list
+        self.store.seed_revision_above(self.store.revision())
+
+        bind_host = host or endpoint.rsplit(":", 1)[0]
+        port = int(endpoint.rsplit(":", 1)[1])
+        self._rpc = RpcServer(host=bind_host, port=port)
+        self._pool = ClientPool(timeout=max(2.0, self._et[0] * 2.0))
+        from ..rpc import server as rpc_server
+        self._rpc.register(
+            "__features__",
+            lambda: list(rpc_server.FEATURES) + list(FEATURES))
+        for name in ("put", "put_if_absent", "get", "get_prefix",
+                     "delete", "delete_prefix", "txn", "wait_events",
+                     "lease_grant", "lease_refresh", "lease_refresh_many",
+                     "lease_revoke", "revision"):
+            self._rpc.register("store_" + name,
+                               getattr(self, "store_" + name))
+        self._rpc.register("repl_append", self.repl_append)
+        self._rpc.register("repl_vote", self.repl_vote)
+        self._rpc.register("repl_snapshot", self.repl_snapshot)
+        self._rpc.register("repl_read_index", self.repl_read_index)
+        self._rpc.register("repl_status", self.repl_status)
+        self._rpc.register("repl_log", self.repl_log_dump)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        self._rpc.start()
+        self._thread = threading.Thread(
+            target=self._ticker, name="repl-ticker", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        with self._apply_cond:
+            self._apply_cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._rpc.stop()
+        self._pool.close()
+        self.store.close()
+        self.log.close()
+
+    @property
+    def port(self):
+        return self._rpc.port
+
+    # -- small helpers -------------------------------------------------
+
+    def _reset_timer(self):
+        self._heard = time.monotonic()
+        self._deadline = self._heard + random.uniform(*self._et)
+
+    def _not_leader(self):
+        leader = self._leader or "?"
+        return errors.NotLeaderError(
+            "not leader: leader=%s term=%d" % (leader, self.meta.term))
+
+    def _fire(self, point, **ctx):
+        """Fire a store.repl.* fault point; a returned site-handled
+        fault (drop) makes the message vanish as a ConnectError."""
+        if faults.PLANE is not None:
+            f = faults.PLANE.fire(point, **ctx)
+            if f is not None:
+                raise errors.ConnectError(
+                    "fault: %s at %s" % (f.kind, point))
+
+    def _install_state(self, snap):
+        self.store.install_snapshot(snap["store"])
+        self._dedup = OrderedDict(
+            (k, v) for k, v in (snap.get("dedup") or []))
+
+    def _step_down(self, term):
+        # caller holds _mu
+        if term > self.meta.term:
+            self.meta.term = term
+            self.meta.voted_for = None
+            self.meta.save()
+        if self._role == "leader":
+            log.warning("replica %s: stepping down at term %d",
+                        self.endpoint, term)
+            self._leader = None
+        self._role = "follower"
+        self._reset_timer()
+        self._apply_cond.notify_all()   # wake blocked proposers/readers
+
+    # -- state machine apply -------------------------------------------
+
+    def _apply_upto_locked(self, commit):
+        """Apply log entries up to ``commit`` (caller holds _mu, or is
+        the single-threaded recovery path)."""
+        while self._applied < commit:
+            idx = self._applied + 1
+            ent = self.log.get(idx)
+            if ent is None:
+                break
+            if faults.PLANE is not None:
+                faults.PLANE.fire("store.repl.apply", index=idx,
+                                  kind=ent["kind"])
+            res = self._apply_one(ent)
+            self._applied = idx
+            op_id = ent.get("op_id")
+            if op_id is not None:
+                self._dedup[op_id] = [res]
+                while len(self._dedup) > DEDUP_CAP:
+                    self._dedup.popitem(last=False)
+            self._results[idx] = [res]
+            if len(self._results) > RESULT_CAP:
+                drop = len(self._results) - RESULT_CAP
+                for k in sorted(self._results)[:drop]:
+                    self._results.pop(k, None)
+        self._apply_cond.notify_all()
+
+    def _apply_one(self, ent):
+        op_id = ent.get("op_id")
+        if op_id is not None and op_id in self._dedup:
+            # the same client op was logged twice (a retry straddling a
+            # failover): apply once, replay the first result
+            return self._dedup[op_id][0]
+        kind = ent["kind"]
+        a = ent["args"]
+        s = self.store
+        if kind == "noop":
+            return None
+        if kind == "put":
+            return s.put(a[0], a[1], a[2])
+        if kind == "put_if_absent":
+            ok, rev = s.put_if_absent(a[0], a[1], a[2])
+            return [ok, rev]
+        if kind == "delete":
+            return s.delete(a[0])
+        if kind == "delete_prefix":
+            return s.delete_prefix(a[0])
+        if kind == "txn":
+            ok, rev = s.txn(a[0], a[1], a[2])
+            return [ok, rev]
+        if kind == "lease_grant":
+            return s.lease_grant(a[0], lease_id=a[1])
+        if kind == "lease_revoke":
+            return s.lease_revoke(a[0])
+        if kind == "lease_expire":
+            for lid in a[0]:
+                s.lease_revoke(lid)
+            return None
+        log.error("unknown log entry kind %r at index %d",
+                  kind, ent["index"])
+        return None
+
+    # -- leader: propose + replicate -----------------------------------
+
+    def _propose(self, kind, args, op_id=None, wait=True):
+        self._fire("store.repl.propose", kind=kind)
+        with self._prop_lock:
+            with self._mu:
+                if op_id is not None and op_id in self._dedup:
+                    return self._dedup[op_id][0]
+                if self._role != "leader":
+                    raise self._not_leader()
+                term = self.meta.term
+                idx = self.log.last_index + 1
+                ent = {"index": idx, "term": term, "kind": kind,
+                       "args": args}
+                if op_id is not None:
+                    ent["op_id"] = op_id
+                self.log.append([ent])          # local fsync
+                self._match[self.endpoint] = idx
+            self._replicate_round()
+        if not wait:
+            return None
+        dl = Deadline(self._quorum_timeout)
+        with self._apply_cond:
+            while self._applied < idx:
+                if self.meta.term != term or self._role != "leader":
+                    raise self._not_leader()
+                if self._stop.is_set():
+                    raise errors.StopError("replica stopping")
+                if dl.expired():
+                    raise errors.DeadlineExceededError(
+                        "no quorum for %s within %.1fs"
+                        % (kind, self._quorum_timeout))
+                self._apply_cond.wait(min(0.1, max(dl.remaining(), 0.01)))
+            res = self._results.pop(idx, None)
+        if res is not None:
+            return res[0]
+        if op_id is not None:
+            with self._mu:
+                cached = self._dedup.get(op_id)
+            if cached is not None:
+                return cached[0]
+        return None
+
+    def _replicate_round(self):
+        """One append fan-out: ships pending entries (or an empty
+        heartbeat) to every peer, advances match/next and the commit
+        index on quorum. Doubles as the heartbeat."""
+        with self._repl_lock:
+            with self._mu:
+                if self._role != "leader":
+                    return
+                term = self.meta.term
+                commit = self.meta.commit
+                plan = {}
+                for p in self.peers:
+                    nxt = self._next.get(p, self.log.last_index + 1)
+                    prev = nxt - 1
+                    pterm = self.log.term_at(prev)
+                    if pterm is None:
+                        plan[p] = None          # compacted away: snapshot
+                        continue
+                    ents = [dict(e) for e in self.log.slice_from(nxt)]
+                    plan[p] = (prev, pterm, ents)
+            futs = {}
+            sent = {}
+            for p, spec in plan.items():
+                if spec is None:
+                    self._send_snapshot(p, term)
+                    continue
+                prev, pterm, ents = spec
+                sent[p] = prev + len(ents)
+                try:
+                    futs[p] = self._pool.call_async(
+                        p, "repl_append", term, self.endpoint, prev,
+                        pterm, ents, commit, channel=REPL_CHANNEL)
+                except errors.EdlError:
+                    self._pool.retire(p, channel=REPL_CHANNEL)
+            acks = 1                            # self, already fsynced
+            for p, fut in futs.items():
+                try:
+                    r = fut.result(timeout=max(0.5, self._hb * 4))
+                except errors.EdlError:
+                    self._pool.retire(p, channel=REPL_CHANNEL)
+                    continue
+                with self._mu:
+                    if int(r.get("term", 0)) > self.meta.term:
+                        self._step_down(int(r["term"]))
+                        return
+                    if r.get("ok"):
+                        self._match[p] = int(r["match"])
+                        self._next[p] = self._match[p] + 1
+                        acks += 1
+                    elif r.get("need_snap"):
+                        self._next[p] = 0       # forces snapshot next round
+                    else:
+                        self._next[p] = max(1, int(r.get("hint", 1)))
+            with self._mu:
+                if self._role != "leader" or self.meta.term != term:
+                    return
+                if acks >= self.quorum:
+                    self._quorum_ok_at = time.monotonic()
+                matched = sorted(self._match.get(ep, 0)
+                                 for ep in self.replica_set)
+                cand = matched[len(self.replica_set) - self.quorum]
+                if cand > self.meta.commit and \
+                        self.log.term_at(cand) == term:
+                    self.meta.commit = cand
+                    self.meta.save(fsync=False)
+                self._apply_upto_locked(self.meta.commit)
+
+    def _send_snapshot(self, peer, term):
+        with self._mu:
+            if self._role != "leader" or self.meta.term != term:
+                return
+            idx = self._applied
+            sterm = self.log.term_at(idx)
+            state = {"store": self.store.snapshot_state(),
+                     "dedup": [[k, v] for k, v in self._dedup.items()]}
+        if sterm is None:
+            return
+        log.warning("replica %s: installing snapshot@%d on %s",
+                    self.endpoint, idx, peer)
+        try:
+            r = self._pool.call(peer, "repl_snapshot", term,
+                                self.endpoint, idx, sterm, state,
+                                channel=REPL_CHANNEL)
+        except errors.EdlError:
+            self._pool.retire(peer, channel=REPL_CHANNEL)
+            return
+        with self._mu:
+            if int(r.get("term", 0)) > self.meta.term:
+                self._step_down(int(r["term"]))
+                return
+            if r.get("ok"):
+                self._match[peer] = idx
+                self._next[peer] = idx + 1
+
+    # -- election ------------------------------------------------------
+
+    def _campaign(self):
+        with self._mu:
+            self._role = "candidate"
+            self._leader = None
+            self.meta.term += 1
+            self.meta.voted_for = self.endpoint
+            self.meta.save()
+            term = self.meta.term
+            li, lt = self.log.last_index, self.log.last_term
+            self._reset_timer()
+        log.info("replica %s: campaigning in term %d", self.endpoint,
+                 term)
+        futs = {}
+        for p in self.peers:
+            try:
+                futs[p] = self._pool.call_async(
+                    p, "repl_vote", term, self.endpoint, li, lt,
+                    channel=REPL_CHANNEL)
+            except errors.EdlError:
+                self._pool.retire(p, channel=REPL_CHANNEL)
+        votes = 1
+        for p, fut in futs.items():
+            try:
+                r = fut.result(timeout=max(0.5, self._et[0]))
+            except errors.EdlError:
+                self._pool.retire(p, channel=REPL_CHANNEL)
+                continue
+            with self._mu:
+                if int(r.get("term", 0)) > self.meta.term:
+                    self._step_down(int(r["term"]))
+                    return
+            if r.get("granted"):
+                votes += 1
+        became = False
+        with self._mu:
+            if self._role == "candidate" and self.meta.term == term \
+                    and votes >= self.quorum:
+                self._become_leader_locked(term)
+                became = True
+        if became:
+            self._replicate_round()
+
+    def _become_leader_locked(self, term):
+        log.warning("replica %s: elected leader for term %d "
+                    "(commit=%d applied=%d last=%d)", self.endpoint,
+                    term, self.meta.commit, self._applied,
+                    self.log.last_index)
+        self._role = "leader"
+        self._leader = self.endpoint
+        nxt = self.log.last_index + 1
+        self._next = {p: nxt for p in self.peers}
+        self._match = {p: 0 for p in self.peers}
+        self._quorum_ok_at = 0.0
+        # lease-id hint: stay above every granted id, including grants
+        # the previous leader logged but we have not applied yet
+        hint = self.store.snapshot_state()["next_lease"]
+        for e in self.log.entries:
+            if e["kind"] == "lease_grant":
+                hint = max(hint, int(e["args"][1]) + 1)
+        self._lease_hint = hint
+        # assert leadership with a no-op so this term can commit, then
+        # give every lease one full ttl of grace before expiry
+        self.log.append([{"index": nxt, "term": term, "kind": "noop",
+                          "args": []}])
+        self._match[self.endpoint] = nxt
+        self.store.rearm_leases()
+
+    # -- ticker --------------------------------------------------------
+
+    def _ticker(self):
+        while not self._stop.is_set():
+            try:
+                self._tick()
+            except errors.EdlError as e:
+                log.warning("replica %s: tick error: %s", self.endpoint,
+                            e)
+            except Exception:
+                log.exception("replica %s: tick failed", self.endpoint)
+            self._stop.wait(self._hb)
+
+    def _tick(self):
+        with self._mu:
+            role = self._role
+            overdue = time.monotonic() >= self._deadline
+        if role == "leader":
+            self._housekeeping()
+            self._replicate_round()
+        elif overdue:
+            self._campaign()
+        self._maybe_compact()
+
+    def _housekeeping(self):
+        # only the leader turns expired leases into logged revokes, so
+        # every replica applies identical deletions in identical order
+        dead = self.store.expired_leases()
+        if dead:
+            try:
+                self._propose("lease_expire", [dead], wait=False)
+            except errors.EdlError as e:
+                log.warning("replica %s: lease expiry propose failed: "
+                            "%s", self.endpoint, e)
+
+    def _maybe_compact(self):
+        with self._mu:
+            if self._applied - self.log.base_index <= COMPACT_THRESHOLD:
+                return
+            t = self.log.term_at(self._applied)
+            if t is None:
+                return
+            snap = {"store": self.store.snapshot_state(),
+                    "dedup": [[k, v] for k, v in self._dedup.items()]}
+            self.log.compact(self._applied, t, snap)
+
+    # -- replication RPC surface (replica <-> replica) -----------------
+
+    def repl_append(self, term, leader, prev_index, prev_term, entries,
+                    commit):
+        self._fire("store.repl.append", term=term, leader=leader,
+                   n=len(entries))
+        term, prev_index, prev_term = \
+            int(term), int(prev_index), int(prev_term)
+        with self._mu:
+            if term < self.meta.term:
+                return {"ok": False, "term": self.meta.term}
+            if term > self.meta.term or self._role != "follower":
+                self._step_down(term)
+            self._leader = leader
+            self._reset_timer()
+            if prev_index > self.log.last_index:
+                return {"ok": False, "term": self.meta.term,
+                        "hint": self.log.last_index + 1}
+            lterm = self.log.term_at(prev_index)
+            if lterm is None:
+                # prev predates our snapshot: ask for a fresh install
+                return {"ok": False, "term": self.meta.term,
+                        "need_snap": True,
+                        "hint": self.log.base_index + 1}
+            if lterm != prev_term:
+                self.log.truncate_from(prev_index)
+                return {"ok": False, "term": self.meta.term,
+                        "hint": prev_index}
+            new = [e for e in entries
+                   if int(e["index"]) > self.log.last_index]
+            for e in entries:
+                i = int(e["index"])
+                if i <= self.log.last_index:
+                    have = self.log.get(i)
+                    if have is not None and have["term"] != e["term"]:
+                        self.log.truncate_from(i)
+                        new = [x for x in entries
+                               if int(x["index"]) >= i]
+                        break
+            if new:
+                self.log.append(new)            # one fsync for the batch
+            match = prev_index + len(entries)
+            newc = min(int(commit), match)
+            if newc > self.meta.commit:
+                self.meta.commit = newc
+                self.meta.save(fsync=False)
+            self._apply_upto_locked(self.meta.commit)
+            return {"ok": True, "term": self.meta.term, "match": match}
+
+    def repl_vote(self, term, candidate, last_index, last_term):
+        self._fire("store.repl.vote", term=term, candidate=candidate)
+        term, last_index, last_term = \
+            int(term), int(last_index), int(last_term)
+        with self._mu:
+            if term < self.meta.term:
+                return {"granted": False, "term": self.meta.term}
+            if term > self.meta.term:
+                self._step_down(term)
+                self._leader = None
+            up_to_date = (last_term, last_index) >= \
+                (self.log.last_term, self.log.last_index)
+            if up_to_date and self.meta.voted_for in (None, candidate):
+                self.meta.voted_for = candidate
+                self.meta.save()
+                self._reset_timer()
+                return {"granted": True, "term": self.meta.term}
+            return {"granted": False, "term": self.meta.term}
+
+    def repl_snapshot(self, term, leader, index, snap_term, state):
+        self._fire("store.repl.snapshot", term=term, index=index)
+        term, index, snap_term = int(term), int(index), int(snap_term)
+        with self._mu:
+            if term < self.meta.term:
+                return {"ok": False, "term": self.meta.term}
+            if term > self.meta.term or self._role != "follower":
+                self._step_down(term)
+            self._leader = leader
+            self._reset_timer()
+            if index <= self._applied:
+                return {"ok": True, "term": self.meta.term}
+            self._install_state(state)
+            self.log.reset(index, snap_term, state)
+            self._applied = index
+            self.meta.commit = max(self.meta.commit, index)
+            self.meta.save()
+            self._apply_cond.notify_all()
+            return {"ok": True, "term": self.meta.term}
+
+    def repl_read_index(self):
+        """Leader-only: a commit index guaranteed current at call time.
+
+        Cheap within the leader lease (a fresh quorum round-trip was
+        seen under election_timeout_min * 0.8 ago); otherwise forces a
+        heartbeat round to re-confirm leadership before answering.
+        """
+        lease = self._et[0] * 0.8
+        with self._mu:
+            if self._role != "leader":
+                raise self._not_leader()
+            if time.monotonic() - self._quorum_ok_at < lease:
+                return {"index": self.meta.commit}
+        self._replicate_round()
+        with self._mu:
+            if self._role != "leader" or \
+                    time.monotonic() - self._quorum_ok_at >= lease:
+                raise self._not_leader()
+            return {"index": self.meta.commit}
+
+    def repl_status(self):
+        with self._mu:
+            return {"endpoint": self.endpoint, "role": self._role,
+                    "term": self.meta.term, "leader": self._leader,
+                    "commit": self.meta.commit, "applied": self._applied,
+                    "last_index": self.log.last_index,
+                    "base_index": self.log.base_index}
+
+    def repl_log_dump(self, since=0):
+        """Committed entries after ``since`` — the raw material for the
+        linearizability check in tests and store_bench."""
+        with self._mu:
+            ents = [dict(e) for e in self.log.entries
+                    if int(since) < e["index"] <= self.meta.commit]
+            return {"base_index": self.log.base_index,
+                    "commit": self.meta.commit, "entries": ents}
+
+    # -- client-facing store surface -----------------------------------
+
+    def _linearize(self):
+        """Read-index protocol: block until this replica has applied at
+        least the cluster commit index observed at call time."""
+        with self._mu:
+            role = self._role
+            leader = self._leader
+        if role == "leader":
+            idx = self.repl_read_index()["index"]
+        else:
+            if not leader or leader == self.endpoint:
+                raise self._not_leader()
+            try:
+                idx = self._pool.call(
+                    leader, "repl_read_index",
+                    channel=REPL_CHANNEL)["index"]
+            except errors.NotLeaderError:
+                raise
+            except errors.EdlError:
+                with self._mu:
+                    self._leader = None
+                raise errors.NotLeaderError(
+                    "not leader: leader=? term=%d" % self.meta.term)
+        dl = Deadline(self._quorum_timeout)
+        with self._apply_cond:
+            while self._applied < idx:
+                if dl.expired():
+                    raise errors.DeadlineExceededError(
+                        "read-index %d not applied (at %d)"
+                        % (idx, self._applied))
+                self._apply_cond.wait(min(0.1, max(dl.remaining(),
+                                                   0.01)))
+
+    def store_put(self, key, value, lease_id=None, op_id=None):
+        return self._propose("put", [key, value, lease_id], op_id=op_id)
+
+    def store_put_if_absent(self, key, value, lease_id=None, op_id=None):
+        return self._propose("put_if_absent", [key, value, lease_id],
+                             op_id=op_id)
+
+    def store_delete(self, key, op_id=None):
+        return self._propose("delete", [key], op_id=op_id)
+
+    def store_delete_prefix(self, prefix, op_id=None):
+        return self._propose("delete_prefix", [prefix], op_id=op_id)
+
+    def store_txn(self, compares, on_success, on_failure=(), op_id=None):
+        return self._propose(
+            "txn", [list(compares), list(on_success), list(on_failure)],
+            op_id=op_id)
+
+    def store_lease_grant(self, ttl, op_id=None):
+        # the leader assigns the lease id at propose time so every
+        # replica's lease table stays identical
+        with self._mu:
+            if self._role != "leader":
+                raise self._not_leader()
+            lid = self._lease_hint
+            self._lease_hint = lid + 1
+        return self._propose("lease_grant", [float(ttl), lid],
+                             op_id=op_id)
+
+    def store_lease_revoke(self, lease_id, op_id=None):
+        return self._propose("lease_revoke", [int(lease_id)],
+                             op_id=op_id)
+
+    def store_lease_refresh(self, lease_id):
+        # keepalives stay OFF the log: only the leader tracks deadlines,
+        # and expiry reaches followers as a logged lease_expire
+        with self._mu:
+            if self._role != "leader":
+                raise self._not_leader()
+        return self.store.lease_refresh(lease_id)
+
+    def store_lease_refresh_many(self, lease_ids):
+        with self._mu:
+            if self._role != "leader":
+                raise self._not_leader()
+        return self.store.lease_refresh_many(lease_ids)
+
+    def store_get(self, key):
+        self._linearize()
+        return self.store.get(key)
+
+    def store_get_prefix(self, prefix):
+        self._linearize()
+        return self.store.get_prefix(prefix)
+
+    def store_revision(self):
+        self._linearize()
+        return self.store.revision()
+
+    def store_wait_events(self, prefix, since_rev, timeout):
+        # watches are served locally on any replica: a lagging follower
+        # just delivers events a beat later, and a watcher whose rev
+        # predates this replica's floor gets a reset and re-lists
+        return self.store.wait_events(prefix, since_rev, timeout)
+
+
+def start_local_replica_set(n=3, data_dir=None, host="127.0.0.1",
+                            election_timeout=(0.3, 0.6), **kw):
+    """Spin up an in-process n-replica set on free ports (tests/bench)."""
+    from ..utils.network import find_free_ports
+    ports = find_free_ports(n)
+    eps = ["%s:%d" % (host, p) for p in ports]
+    reps = []
+    for i, ep in enumerate(eps):
+        dd = os.path.join(data_dir, "r%d" % i) if data_dir else None
+        reps.append(ReplicatedStoreServer(
+            ep, eps, data_dir=dd,
+            election_timeout=election_timeout, **kw).start())
+    return reps
+
+
+def wait_for_leader(replicas, timeout=10.0):
+    """Block until exactly one live replica leads; returns it."""
+    dl = Deadline(timeout)
+    tick = threading.Event()
+    while True:
+        for r in replicas:
+            with r._mu:
+                if r._role == "leader" and not r._stop.is_set():
+                    return r
+        if dl.expired():
+            raise errors.DeadlineExceededError(
+                "no leader elected within %.1fs" % timeout)
+        tick.wait(0.02)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        "edl_tpu replicated coordination store replica")
+    ap.add_argument("--endpoint", required=True,
+                    help="advertised host:port of THIS replica")
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated replica set "
+                         "(all endpoints, including this one)")
+    ap.add_argument("--data_dir", default=None,
+                    help="directory for the replication log + meta")
+    ap.add_argument("--host", default=None,
+                    help="bind host (default: host from --endpoint)")
+    ap.add_argument("--election_min", type=float,
+                    default=ELECTION_TIMEOUT[0])
+    ap.add_argument("--election_max", type=float,
+                    default=ELECTION_TIMEOUT[1])
+    args = ap.parse_args(argv)
+    import signal
+    server = ReplicatedStoreServer(
+        args.endpoint, [p for p in args.peers.split(",") if p],
+        data_dir=args.data_dir, host=args.host,
+        election_timeout=(args.election_min, args.election_max)).start()
+    log.info("replica %s serving (peers=%s)", args.endpoint, args.peers)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

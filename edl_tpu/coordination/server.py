@@ -20,8 +20,13 @@ class StoreServer(object):
         s = self.store
         for name in ("put", "put_if_absent", "get", "get_prefix", "delete",
                      "delete_prefix", "txn", "wait_events", "lease_grant",
-                     "lease_refresh", "lease_revoke", "revision"):
+                     "lease_refresh", "lease_refresh_many", "lease_revoke",
+                     "revision"):
             self._rpc.register("store_" + name, getattr(s, name))
+        from edl_tpu.rpc import server as rpc_server
+        self._rpc.register(
+            "__features__",
+            lambda: list(rpc_server.FEATURES) + ["store.lease_refresh_many"])
 
     def start(self):
         self._rpc.start()

@@ -3,6 +3,16 @@ availability story the reference got from etcd clustering
 (scripts/download_etcd.sh:18-36 ran a raft cluster; client endpoint
 lists are plural in edl/discovery/etcd_client.py:51-56).
 
+STATUS: demoted to the 1-replica fallback. The default availability
+path is now the quorum-replicated store (``replica.py``,
+docs/coordination.md): 3 replicas, leader election with term fencing,
+log replication with quorum fsync acks, and linearizable follower
+reads — a failover there loses no acknowledged write and needs no
+witness corroboration or ``rejoin_wipe``. Use the standby/witness pair
+only where running three store processes is not affordable (single
+-host dev rigs, tiny clusters); its mirror is asynchronous, so a
+promote can lose the tail of committed-but-unreplicated writes.
+
 The in-tree store is durable (WAL, fsync, crash-tested) but a
 single-node primary stalls the whole control plane until restarted.
 This module adds a second server that keeps a live mirror and takes

@@ -53,17 +53,26 @@ class Store(object):
     # retain this many recent events for watch catch-up
     EVENT_HISTORY = 10000
 
-    def __init__(self, wal_path=None):
+    def __init__(self, wal_path=None, expire_leases=True, seed_rev=None):
         """``wal_path``: append-only log making PERMANENT keys durable
         across restarts (cluster maps, job statuses, state). Leased keys
         are deliberately ephemeral — their owners re-register within a TTL
-        (etcd-restart semantics; cf. register.py's re-register-on-loss)."""
+        (etcd-restart semantics; cf. register.py's re-register-on-loss).
+
+        ``expire_leases=False``: the sweeper tracks deadlines but never
+        deletes — replicated-state-machine mode, where only the elected
+        leader may turn an expiry into a (logged) revoke so every replica
+        applies the same deletions in the same order (replica.py).
+        ``seed_rev``: start revisions at an exact value instead of the
+        wall-clock seed — replicas must count revisions identically."""
         self._kv = {}            # key -> KeyValue
         self._leases = {}        # lease_id -> (ttl, deadline, set(keys))
+        self._expire_leases = bool(expire_leases)
         # revisions are seeded by wall-clock millis so they NEVER regress
         # across restarts: every watcher from a previous incarnation holds
         # since_rev < this incarnation's floor and is told to re-list
-        self._rev = int(time.time() * 1000)
+        self._rev = (int(seed_rev) if seed_rev is not None
+                     else int(time.time() * 1000))
         self._next_lease = 1
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -103,31 +112,64 @@ class Store(object):
     def _replay_wal(self, path):
         if not os.path.exists(path):
             return
-        with open(path) as f:
-            lines = f.read().splitlines()
-        for i, line in enumerate(lines):
-            if not line.strip():
+        with open(path, "rb") as f:
+            raw = f.read()
+        lines = raw.split(b"\n")
+        offset = 0  # byte offset of the current line
+        torn_at = None
+        for i, bline in enumerate(lines):
+            line = bline.decode("utf-8", errors="replace").strip()
+            if not line:
+                offset += len(bline) + 1
                 continue
+            # a crash mid-write leaves a partial JSON line at the tail
+            # (the group-commit fsync had not covered it, so nothing in
+            # it was ever acknowledged). Skip it, warn, and remember the
+            # offset so the file is physically truncated below — an
+            # append after an un-truncated tear would glue two records
+            # into one corrupt line and poison the NEXT replay too.
             try:
                 rec = json.loads(line)
-            except ValueError:
-                if i == len(lines) - 1:
-                    logger.warning("WAL torn tail at line %d; ignored", i)
+                applied = self._replay_one(rec)
+            except (ValueError, KeyError, TypeError) as e:
+                if i >= len(lines) - 2:  # last record (+- trailing "\n")
+                    logger.warning(
+                        "WAL torn trailing record at byte %d (%r); "
+                        "skipped and truncated", offset, e)
+                    torn_at = offset
                 else:
                     logger.error(
-                        "WAL corrupt at line %d of %d; DISCARDING %d "
-                        "later records", i, len(lines), len(lines) - i - 1)
+                        "WAL corrupt at line %d of %d (%r); DISCARDING "
+                        "%d later records", i, len(lines), e,
+                        len(lines) - i - 1)
+                    torn_at = offset
                 break
-            with self._lock:
-                if rec["op"] == "put":
-                    value = rec["v"]
-                    if rec.get("b"):
-                        value = base64.b64decode(value)
-                    self._put_locked(rec["k"], value, None)
-                elif rec["op"] == "del":
-                    self._delete_locked(rec["k"])
-                elif rec["op"] == "rev":
-                    self._rev = max(self._rev, int(rec["r"]))
+            if not applied:
+                logger.warning("WAL record with unknown op ignored: %r",
+                               rec.get("op"))
+            offset += len(bline) + 1
+        if torn_at is not None:
+            with open(path, "rb+") as f:
+                f.truncate(torn_at)
+                f.flush()
+                os.fsync(f.fileno())
+
+    def _replay_one(self, rec):
+        """Apply one WAL record; False for an unknown op (forward
+        compat: newer writers may add record types)."""
+        with self._lock:
+            if rec["op"] == "put":
+                value = rec["v"]
+                if rec.get("b"):
+                    value = base64.b64decode(value)
+                self._put_locked(rec["k"], value, None)
+            elif rec["op"] == "del":
+                self._delete_locked(rec["k"])
+            elif rec["op"] == "rev":
+                self._rev = max(self._rev, int(rec["r"]))
+            else:
+                return False
+            return True
 
     def _log(self, rec):
         if self._wal is not None:
@@ -200,13 +242,15 @@ class Store(object):
     def _sweep_loop(self):
         while not self._stop.wait(0.2):
             now = time.monotonic()
+            dead = []
             with self._lock:
-                dead = [lid for lid, (_, dl, _k) in self._leases.items()
-                        if dl <= now]
-                for lid in dead:
-                    _, _, keys = self._leases.pop(lid)
-                    for k in list(keys):
-                        self._delete_locked(k)
+                if self._expire_leases:
+                    dead = [lid for lid, (_, dl, _k) in self._leases.items()
+                            if dl <= now]
+                    for lid in dead:
+                        _, _, keys = self._leases.pop(lid)
+                        for k in list(keys):
+                            self._delete_locked(k)
             if dead and faults.PLANE is not None:
                 # observation/delay point (fired OUTSIDE the lock: a
                 # delay here models a slow expiry sweep, not a wedged
@@ -249,12 +293,15 @@ class Store(object):
                 self._wal_watermark = self._rev
                 self._sync_locked()
 
-    def lease_grant(self, ttl):
+    def lease_grant(self, ttl, lease_id=None):
+        """``lease_id``: force an exact id — the replicated-apply path
+        (replica.py), where the leader assigns the id at propose time so
+        every replica's lease table stays identical."""
         if faults.PLANE is not None:
             faults.PLANE.fire("store.lease.grant", ttl=ttl)
         with self._lock:
-            lid = self._next_lease
-            self._next_lease += 1
+            lid = self._next_lease if lease_id is None else int(lease_id)
+            self._next_lease = max(self._next_lease, lid + 1)
             self._leases[lid] = [ttl, time.monotonic() + ttl, set()]
             return lid
 
@@ -273,6 +320,13 @@ class Store(object):
                 return False
             lease[1] = time.monotonic() + lease[0]
             return True
+
+    def lease_refresh_many(self, lease_ids):
+        """Batched keepalive: refresh every lease in one call, returning
+        ``[[lease_id, ok], ...]`` (a list, not a dict — msgpack map keys
+        must be strings on the wire). One coalesced RPC per process
+        replaces N per-component refresh loops (keepalive.py)."""
+        return [[lid, self.lease_refresh(lid)] for lid in lease_ids]
 
     def lease_revoke(self, lease_id):
         with self._lock:
@@ -367,6 +421,70 @@ class Store(object):
                     raise ValueError("bad txn action %r" % (action,))
             self._sync_locked()
             return ok, self._rev
+
+    # -- replicated-state-machine hooks (replica.py) ------------------------
+
+    def expired_leases(self):
+        """Lease ids past their deadline, WITHOUT deleting anything —
+        the replicated leader turns these into logged revokes so every
+        replica applies the same deletions in the same order."""
+        now = time.monotonic()
+        with self._lock:
+            return [lid for lid, (_, dl, _k) in self._leases.items()
+                    if dl <= now]
+
+    def rearm_leases(self):
+        """Reset every lease deadline to now + ttl. A freshly elected
+        leader inherits follower-side deadlines that were never kept
+        current (refreshes are leader-local, off the log) — granting one
+        full TTL of grace lets live owners keepalive before anything
+        expires, exactly the re-registration window a store restart
+        already grants."""
+        now = time.monotonic()
+        with self._lock:
+            for lease in self._leases.values():
+                lease[1] = now + lease[0]
+
+    def force_rev(self, rev):
+        """Set the revision counter exactly (no floor change, no event
+        reset) — replicas sync their counters at snapshot boundaries."""
+        with self._lock:
+            self._rev = int(rev)
+
+    def snapshot_state(self):
+        """The full replicable state: kv (with revs), lease table (ttl
+        only; deadlines are leader-local), rev and lease counters."""
+        with self._lock:
+            kv = [[kv.key, kv.value, kv.lease_id, kv.create_rev,
+                   kv.mod_rev] for kv in self._kv.values()]
+            leases = [[lid, lease[0]] for lid, lease in
+                      self._leases.items()]
+            return {"kv": kv, "leases": leases, "rev": self._rev,
+                    "next_lease": self._next_lease}
+
+    def install_snapshot(self, snap):
+        """Replace the whole state with ``snap`` (snapshot_state shape).
+        Watchers holding older revisions re-list: the floor moves to the
+        snapshot revision and history is cleared, the same contract as a
+        restart-with-WAL (__init__) or a standby promotion."""
+        with self._lock:
+            self._kv = {}
+            self._leases = {}
+            now = time.monotonic()
+            for lid, ttl in snap.get("leases", []):
+                self._leases[int(lid)] = [ttl, now + ttl, set()]
+            for key, value, lease_id, create_rev, mod_rev in snap["kv"]:
+                self._kv[key] = KeyValue(key, value, lease_id,
+                                         create_rev, mod_rev)
+                if lease_id:
+                    lease = self._leases.get(lease_id)
+                    if lease is not None:
+                        lease[2].add(key)
+            self._rev = int(snap["rev"])
+            self._next_lease = int(snap.get("next_lease", 1))
+            self._floor_rev = self._rev
+            self._events.clear()
+            self._cond.notify_all()
 
     def wait_events(self, prefix, since_rev, timeout):
         """Long-poll: block until an event with rev > since_rev under prefix.

@@ -36,6 +36,15 @@ data.assign              before a consumer asks the data leader for an
                          assignment (ctx: pod, endpoint)
 data.fetch               before a batch fetch is issued to a producer
                          (ctx: pod, endpoint, batch)
+store.repl.propose       before a leader logs a client op (ctx: kind)
+store.repl.append        before a follower handles repl_append (ctx:
+                         term, leader, n)
+store.repl.vote          before a replica handles a vote request (ctx:
+                         term, candidate)
+store.repl.snapshot      before a follower installs a leader snapshot
+                         (ctx: term, index)
+store.repl.apply         before a committed entry is applied (ctx:
+                         index, kind)
 ======================== ===============================================
 
 Fault kinds:

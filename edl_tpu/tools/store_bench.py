@@ -115,11 +115,135 @@ def _bench_backend(name, endpoint, n):
     return rows
 
 
+def run(writes=120, pods=64, replicas=3, election_timeout=(0.2, 0.4),
+        seed=0):
+    """Hermetic replication + fleet-sim arcs -> one ``store_bench/v1``
+    record (the tier-1 smoke path; ``--micro`` on the CLI).
+
+    Replication arc: start an in-process ``replicas``-set, elect, push
+    quorum-acked writes, kill the leader mid-stream, keep writing
+    through the client's redirect/breaker path, then assert zero
+    acknowledged-write loss and log-matching across the survivors.
+    Failover downtime = last ack on the old leader -> first ack on the
+    new one, i.e. election plus client re-dial, the number the ISSUE
+    asks for.
+
+    Fleet-sim arc: ``pods`` fake pods' leases kept alive from one
+    process, comparing one coalesced ``lease_refresh_many`` beat
+    against per-lease refresh RPCs.
+    """
+    import random as _random
+
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.coordination.replica import (start_local_replica_set,
+                                              wait_for_leader)
+    from edl_tpu.utils import errors
+
+    _random.seed(seed)
+    out = {"schema": "store_bench/v1", "mode": "micro"}
+
+    reps = start_local_replica_set(replicas,
+                                   election_timeout=election_timeout)
+    eps = [r.endpoint for r in reps]
+    try:
+        t0 = time.perf_counter()
+        leader = wait_for_leader(reps, timeout=10.0)
+        elect_ms = (time.perf_counter() - t0) * 1e3
+
+        c = CoordClient(eps, root="bench", timeout=10.0,
+                        failover_grace=15.0)
+        acked = {}               # key -> value the cluster ACKED
+        val = b"x" * 64
+
+        t0 = time.perf_counter()
+        for i in range(writes // 2):
+            k = "/bench/fleet/nodes/w%d" % i
+            c.put(k, val)
+            acked[k] = val
+        write_s = (writes // 2) / (time.perf_counter() - t0)
+
+        # kill the leader mid-stream; keep writing through the client's
+        # NotLeader redirect + per-endpoint breaker path
+        last_ack = time.perf_counter()
+        leader.stop()
+        survivors = [r for r in reps if r is not leader]
+        downtime_ms = None
+        for i in range(writes // 2, writes):
+            k = "/bench/fleet/nodes/w%d" % i
+            c.put(k, val)
+            if downtime_ms is None:
+                downtime_ms = (time.perf_counter() - last_ack) * 1e3
+            acked[k] = val
+        leader2 = wait_for_leader(survivors, timeout=10.0)
+
+        # zero acked-write loss: every acknowledged write must be
+        # readable (linearizably) after the failover
+        lost = sum(1 for k, v in acked.items()
+                   if (c.get_key(k) or {}).get("value") != v)
+
+        # log-matching check over the replicated log: the committed
+        # prefixes of the survivors must be identical entry-for-entry
+        logs = [r.repl_log_dump() for r in survivors]
+        common = min(l["commit"] for l in logs)
+        sigs = []
+        for l in logs:
+            sigs.append([(e["index"], e["term"], e["kind"])
+                         for e in l["entries"] if e["index"] <= common])
+        linearizable_ok = all(s == sigs[0] for s in sigs[1:]) and lost == 0
+
+        out["replication"] = {
+            "replicas": replicas,
+            "elect_ms": round(elect_ms, 2),
+            "writes_acked": len(acked),
+            "write_ops_s": round(write_s, 1),
+            "failover_downtime_ms": round(downtime_ms, 2),
+            "lost_acked_writes": lost,
+            "commit_index": common,
+            "linearizable_ok": bool(linearizable_ok),
+            "leader_changed": leader2.endpoint != leader.endpoint,
+        }
+
+        # fleet-sim: coalesced vs per-lease keepalive
+        lids = [c.lease_grant(30.0) for _ in range(pods)]
+        t0 = time.perf_counter()
+        res = c.lease_refresh_many(lids)
+        coalesced_ms = (time.perf_counter() - t0) * 1e3
+        t0 = time.perf_counter()
+        per = [c.lease_refresh(lid) for lid in lids]
+        per_lease_ms = (time.perf_counter() - t0) * 1e3
+        out["fleet"] = {
+            "pods": pods,
+            "refreshes_ok": sum(1 for ok in res.values() if ok),
+            "per_lease_ok": sum(1 for ok in per if ok),
+            "coalesced_ms": round(coalesced_ms, 2),
+            "per_lease_ms": round(per_lease_ms, 2),
+            "coalesce_speedup": round(per_lease_ms
+                                      / max(coalesced_ms, 1e-6), 2),
+        }
+        return out
+    finally:
+        for r in reps:
+            try:
+                r.stop()
+            except errors.EdlError:
+                pass
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("store benchmark")
     p.add_argument("--n", type=int, default=2000)
     p.add_argument("--backends", default="py,native")
+    p.add_argument("--micro", action="store_true",
+                   help="hermetic 3-replica failover + fleet-sim arcs "
+                        "(one store_bench/v1 JSON line)")
+    p.add_argument("--writes", type=int, default=120)
+    p.add_argument("--pods", type=int, default=64)
     args = p.parse_args(argv)
+
+    if args.micro:
+        print(json.dumps(run(writes=args.writes, pods=args.pods)),
+              flush=True)
+        return 0
 
     names = [b for b in args.backends.split(",") if b]
     unknown = sorted(set(names) - {"py", "native"})

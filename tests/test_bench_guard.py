@@ -186,6 +186,40 @@ def test_measure_resize_micro_peer_arc_cpu_schema(capsys):
     json.dumps(out)  # round-trips
 
 
+def test_store_bench_micro_schema():
+    """The replicated-store bench must keep working hermetically under
+    tier-1 and honor its JSON contract (schema store_bench/v1): the
+    3-replica micro arc elects, quorum-acks writes, kills the leader,
+    re-elects, and proves zero acknowledged-write loss; the fleet arc
+    reports keepalive coalescing. No latency gate — CI boxes are too
+    noisy; the acceptance run reads failover downtime offline."""
+    import json
+
+    from edl_tpu.tools import store_bench
+
+    out = store_bench.run(writes=40, pods=16,
+                          election_timeout=(0.15, 0.3))
+    assert out["schema"] == "store_bench/v1"
+    assert out["mode"] == "micro"
+    rep = out["replication"]
+    assert rep["replicas"] == 3
+    assert rep["elect_ms"] > 0
+    assert rep["writes_acked"] == 40
+    assert rep["write_ops_s"] > 0
+    assert rep["failover_downtime_ms"] > 0
+    assert rep["lost_acked_writes"] == 0
+    assert rep["linearizable_ok"] is True
+    assert rep["leader_changed"] is True
+    assert rep["commit_index"] >= 40
+    fleet = out["fleet"]
+    assert fleet["pods"] == 16
+    assert fleet["refreshes_ok"] == 16
+    assert fleet["per_lease_ok"] == 16
+    assert fleet["coalesced_ms"] > 0 and fleet["per_lease_ms"] > 0
+    assert fleet["coalesce_speedup"] > 0
+    json.dumps(out)  # the whole report is JSON-serializable
+
+
 def test_data_bench_micro_schema():
     """The elastic data-plane bench must keep working in a tiny CPU
     config under tier-1 and honor its JSON contract (schema

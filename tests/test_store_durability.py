@@ -46,6 +46,39 @@ def test_wal_torn_tail_is_ignored(tmp_path):
         s2.stop()
 
 
+def test_wal_torn_tail_truncated_before_append(tmp_path):
+    """Crash simulation for the full torn-tail contract: the partial
+    record must be TRUNCATED from the file (not just skipped) before
+    the store appends again — otherwise the next write glues onto the
+    torn bytes and a later replay loses everything from the tear on."""
+    wal = str(tmp_path / "store.wal")
+    s1 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    c1 = CoordClient([s1.endpoint], root="jobd")
+    c1.set_server_permanent("svc", "a", "v1")
+    s1.stop()
+    torn = '{"op": "put", "k": "/jobd/svc/nodes/b", "v": "tr'
+    with open(wal, "a") as f:
+        f.write(torn)  # crash mid-write()
+
+    s2 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    c2 = CoordClient([s2.endpoint], root="jobd")
+    assert c2.get_value("svc", "a") == "v1"
+    c2.set_server_permanent("svc", "c", "v3")  # append AFTER the tear
+    s2.stop()
+    raw = open(wal, "rb").read()
+    assert torn.encode() not in raw  # physically truncated
+
+    # third incarnation replays cleanly: old + new records, no tear
+    s3 = StoreServer(host="127.0.0.1", wal_path=wal).start()
+    try:
+        c3 = CoordClient([s3.endpoint], root="jobd")
+        assert c3.get_value("svc", "a") == "v1"
+        assert c3.get_value("svc", "c") == "v3"
+        assert c3.get_value("svc", "b") is None
+    finally:
+        s3.stop()
+
+
 def test_revisions_and_watchers_survive_restart(tmp_path):
     """Revisions never regress across a restart, and a watcher from the
     previous incarnation is forced to re-list (reset) so it sees both new
