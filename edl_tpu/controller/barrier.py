@@ -12,6 +12,7 @@ import threading
 from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, leader
 from edl_tpu.controller.resource_pods import load_resource_pods
+from edl_tpu.obs.publisher import MetricsPublisher
 from edl_tpu.robustness.policy import Deadline, RetryPolicy
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.rpc.server import RpcServer
@@ -60,6 +61,9 @@ class PodServer(object):
         self._coord = coord
         self._stats_fn = stats_fn
         self._pod = pod
+        # the pod process's registry/timeline feed for the fleet view
+        # (job_stats merges every pod's obs_* publication)
+        self._publisher = MetricsPublisher(coord, pod.id)
 
     def _pod_stats(self):
         try:  # a store hiccup must not fail the locally-known fields
@@ -83,6 +87,7 @@ class PodServer(object):
     def start(self):
         self._rpc.start()
         self._pod.port = self._rpc.port
+        self._publisher.start()
         return self
 
     @property
@@ -90,6 +95,7 @@ class PodServer(object):
         return self._rpc.port
 
     def stop(self):
+        self._publisher.stop()
         self._rpc.stop()
 
 

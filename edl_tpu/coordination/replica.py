@@ -41,6 +41,8 @@ import threading
 import time
 from collections import OrderedDict
 
+from ..obs import events as obs_events
+from ..obs import metrics as obs_metrics
 from ..robustness import faults
 from ..robustness.policy import Deadline
 from ..rpc.pool import ClientPool
@@ -49,6 +51,15 @@ from ..utils import errors
 from .store import Store
 
 log = logging.getLogger("edl_tpu.coordination.replica")
+
+_PROPOSE_MS = obs_metrics.histogram(
+    "edl_repl_propose_ms", "leader propose -> quorum-applied latency",
+    labels=("kind",))
+_APPLIED_INDEX = obs_metrics.gauge(
+    "edl_repl_applied_index", "last log index applied to the local "
+    "state machine")
+_ELECTIONS = obs_metrics.counter(
+    "edl_repl_elections_total", "elections this replica won")
 
 # Dedicated ClientPool channel so replication traffic (appends, votes,
 # snapshots) never queues behind client-facing store calls.
@@ -469,6 +480,8 @@ class ReplicatedStoreServer(object):
         if self._role == "leader":
             log.warning("replica %s: stepping down at term %d",
                         self.endpoint, term)
+            obs_events.emit("store.stepdown", endpoint=self.endpoint,
+                            term=term)
             self._leader = None
         self._role = "follower"
         self._reset_timer()
@@ -499,6 +512,7 @@ class ReplicatedStoreServer(object):
                 drop = len(self._results) - RESULT_CAP
                 for k in sorted(self._results)[:drop]:
                     self._results.pop(k, None)
+        _APPLIED_INDEX.set(self._applied)
         self._apply_cond.notify_all()
 
     def _apply_one(self, ent):
@@ -540,6 +554,7 @@ class ReplicatedStoreServer(object):
 
     def _propose(self, kind, args, op_id=None, wait=True):
         self._fire("store.repl.propose", kind=kind)
+        t0 = time.monotonic()
         with self._prop_lock:
             with self._mu:
                 if op_id is not None and op_id in self._dedup:
@@ -570,6 +585,7 @@ class ReplicatedStoreServer(object):
                         % (kind, self._quorum_timeout))
                 self._apply_cond.wait(min(0.1, max(dl.remaining(), 0.01)))
             res = self._results.pop(idx, None)
+        _PROPOSE_MS.labels(kind).observe((time.monotonic() - t0) * 1e3)
         if res is not None:
             return res[0]
         if op_id is not None:
@@ -724,6 +740,10 @@ class ReplicatedStoreServer(object):
                     self.log.last_index)
         self._role = "leader"
         self._leader = self.endpoint
+        _ELECTIONS.inc()
+        obs_events.emit("store.leader_elected", endpoint=self.endpoint,
+                        term=term, commit=self.meta.commit,
+                        applied=self._applied)
         nxt = self.log.last_index + 1
         self._next = {p: nxt for p in self.peers}
         self._match = {p: 0 for p in self.peers}

@@ -14,7 +14,12 @@ import time
 
 import jax
 
+from edl_tpu.obs import metrics as obs_metrics
+
 _END = object()
+
+_PREFETCH_DEPTH = obs_metrics.gauge(
+    "edl_prefetch_queue_depth", "device-resident batches staged ahead")
 
 
 class DevicePrefetcher(object):
@@ -90,6 +95,7 @@ class DevicePrefetcher(object):
             raise StopIteration
         t0 = time.monotonic()
         item = self._q.get()
+        _PREFETCH_DEPTH.set(self._q.qsize())
         with self._stats_lock:
             self._consumer_wait_s += time.monotonic() - t0
             if item is not _END:
@@ -118,11 +124,12 @@ class DevicePrefetcher(object):
         spent blocked (input-bound step), ``pump_wait_s`` is time the
         pump spent blocked in the host iterator (step-bound input)."""
         with self._stats_lock:
-            return {
+            stats = {
                 "batches": self._batches,
                 "consumer_wait_s": self._consumer_wait_s,
                 "pump_wait_s": self._pump_wait_s,
             }
+        return obs_metrics.mirror_stats("edl_prefetch", stats)
 
     def close(self):
         if self._closed:

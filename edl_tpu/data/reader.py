@@ -29,6 +29,7 @@ import threading
 import time
 
 from edl_tpu.controller import constants
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.data.data_server import (END, BatchCache, DataPlaneServer,
                                       LeaderDataService)
 from edl_tpu.robustness import faults
@@ -37,6 +38,20 @@ from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.pool import ClientPool
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
+
+_FETCH_MS = obs_metrics.histogram(
+    "edl_reader_fetch_ms", "per-batch wire latency (consumer side)")
+_BATCHES = obs_metrics.counter(
+    "edl_reader_batches_total", "batches delivered to the consumer",
+    labels=("src",))
+_LOST = obs_metrics.counter(
+    "edl_reader_lost_total", "batches lost to producer death")
+_QUEUE_DEPTH = obs_metrics.gauge(
+    "edl_reader_out_queue_depth", "fetched batches parked in the "
+    "delivery queue")
+_PIPE_INFLIGHT = obs_metrics.gauge(
+    "edl_reader_fetch_inflight", "assignments in flight in the fetch "
+    "pipeline")
 
 
 def register_data_leader(coord, reader_name, endpoint):
@@ -414,6 +429,7 @@ class ElasticReader(object):
                         return
                     continue
                 attempt = 0
+                _PIPE_INFLIGHT.set(len(assignment))
                 if assignment == [END]:
                     self._push(("end", None))
                     return
@@ -512,6 +528,7 @@ class ElasticReader(object):
                        exc)
         with self._stats_lock:
             self._lost.append(batch_id)
+        _LOST.inc()
 
     def _resolve(self, pending):
         """Turn a pending slot into its payload (or None when lost);
@@ -563,6 +580,8 @@ class ElasticReader(object):
             else:
                 self._n_remote += 1
             self._fetch_ms.append(pending.wire_ms)
+        _BATCHES.labels("local" if local else "remote").inc()
+        _FETCH_MS.observe(pending.wire_ms)
         return payload
 
     @staticmethod
@@ -624,6 +643,7 @@ class ElasticReader(object):
         while not self._stop.is_set():
             try:
                 self._out_q.put(item, timeout=0.2)
+                _QUEUE_DEPTH.set(self._out_q.qsize())
                 return True
             except queue.Full:
                 continue
@@ -654,9 +674,10 @@ class ElasticReader(object):
                 t0 = time.monotonic()
                 payload = self._fetch_item(item)
                 if payload is not None:
+                    wire_ms = (time.monotonic() - t0) * 1e3
                     with self._stats_lock:
-                        self._fetch_ms.append(
-                            (time.monotonic() - t0) * 1e3)
+                        self._fetch_ms.append(wire_ms)
+                    _FETCH_MS.observe(wire_ms)
                     yield payload
 
     def _fetch_item(self, item):
@@ -671,6 +692,7 @@ class ElasticReader(object):
             if payload is not None:
                 with self._stats_lock:
                     self._n_local += 1
+                _BATCHES.labels("local").inc()
                 return payload
         try:
             payload = self._fetch_serial(endpoint, batch_id)
@@ -680,6 +702,7 @@ class ElasticReader(object):
         payload = self._decode(payload)
         with self._stats_lock:
             self._n_remote += 1
+        _BATCHES.labels("remote").inc()
         return payload
 
     # -- bookkeeping / lifecycle ----------------------------------------------
@@ -695,7 +718,7 @@ class ElasticReader(object):
         lost batch ids, per-batch wire latencies (ms), and cumulative
         seconds the consumer spent waiting on the pipeline."""
         with self._stats_lock:
-            return {
+            stats = {
                 "local": self._n_local,
                 "remote": self._n_remote,
                 "lost": list(self._lost),
@@ -703,6 +726,7 @@ class ElasticReader(object):
                 "consumer_wait_s": self._wait_s,
                 "endpoint_modes": dict(self._endpoint_modes),
             }
+        return obs_metrics.mirror_stats("edl_reader", stats)
 
     @staticmethod
     def mark_consumed(state, batch):

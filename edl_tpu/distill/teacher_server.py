@@ -33,11 +33,23 @@ import time
 
 import numpy as np
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.server import FEATURES as _RPC_FEATURES
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
+
+_DEVICE_BATCHES = obs_metrics.counter(
+    "edl_teacher_batches_total", "teacher device-batch executions")
+_DEVICE_ROWS = obs_metrics.counter(
+    "edl_teacher_rows_total", "real (unpadded) rows served")
+_BATCH_FILL = obs_metrics.histogram(
+    "edl_teacher_batch_fill", "real rows per device execution as a "
+    "fraction of max_batch",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+_TEACHER_QUEUE = obs_metrics.gauge(
+    "edl_teacher_queue_depth", "requests waiting for the device thread")
 
 
 class _ItemFuture(object):
@@ -130,9 +142,10 @@ class TeacherServer(object):
         with self._stats_lock:
             batches, rows = self._batches, self._rows
         cap = batches * self._max_batch
-        return {"batches": batches, "rows": rows,
-                "max_batch": self._max_batch,
-                "occupancy": (rows / cap) if cap else 0.0}
+        return obs_metrics.mirror_stats("edl_teacher", {
+            "batches": batches, "rows": rows,
+            "max_batch": self._max_batch,
+            "occupancy": (rows / cap) if cap else 0.0})
 
     def _validate(self, feed):
         missing = set(self._feed_specs) - set(feed)
@@ -165,6 +178,7 @@ class TeacherServer(object):
             return self._predict_serial(feed, n)
         item = _BatchItem(feed, n)
         self._queue.put(item)
+        _TEACHER_QUEUE.set(self._queue.qsize())
         # generous rendezvous bound: the device thread always resolves
         # every item it dequeues (success, error, or shutdown drain)
         return item.future.result(timeout=600.0)
@@ -185,6 +199,9 @@ class TeacherServer(object):
             with self._stats_lock:
                 self._batches += 1
                 self._rows += n
+        _DEVICE_BATCHES.inc()
+        _DEVICE_ROWS.inc(n)
+        _BATCH_FILL.observe(n / float(self._max_batch))
         # raw arrays: the v2 tensor frame ships them out-of-band with
         # no tobytes()/msgpack-bin copies (framing.py MAGIC_V2)
         return {k: np.asarray(v)[:n] for k, v in out.items()}
@@ -279,6 +296,9 @@ class TeacherServer(object):
             with self._stats_lock:
                 self._batches += 1
                 self._rows += rows
+            _DEVICE_BATCHES.inc()
+            _DEVICE_ROWS.inc(rows)
+            _BATCH_FILL.observe(rows / float(self._max_batch))
         except Exception as e:  # noqa: BLE001 — fail every waiter, keep serving
             for item in group:
                 item.future.set(error=e)

@@ -1,0 +1,35 @@
+"""Fleet-wide observability plane: metrics, traces, events.
+
+One substrate replaces the scattered per-component ``stats()`` dicts,
+the env-gated stderr stopwatch, and the ad-hoc JSON blobs under
+``SERVICE_METRICS``:
+
+- :mod:`edl_tpu.obs.metrics` — process-local registry of labeled
+  counters / gauges / bounded-bucket histograms with Prometheus text
+  exposition and a JSON snapshot. Served per-process via the
+  auto-registered ``__metrics__`` RPC method on every
+  :class:`~edl_tpu.rpc.server.RpcServer`.
+- :mod:`edl_tpu.obs.trace` — Dapper-style trace-context propagation:
+  a ``[trace_id, span_id]`` header rides the RPC envelope (behind
+  ``obs.trace`` feature negotiation), spans land in a bounded ring
+  buffer, exportable as Chrome-trace JSON.
+- :mod:`edl_tpu.obs.events` — the elastic-event timeline: structured,
+  causally-linked records for resize phases, leader elections, breaker
+  trips, and fault injections.
+- :mod:`edl_tpu.obs.publisher` — periodic snapshot publication into
+  the coordination store so ``job_stats`` renders a fleet-wide view.
+
+This package is a LEAF: it imports nothing from edl_tpu outside
+``utils.logger``, so every plane (rpc, robustness, data, coordination)
+can instrument itself without import cycles.
+"""
+
+from edl_tpu.obs import events, metrics, trace
+from edl_tpu.obs.events import EVENTS, emit
+from edl_tpu.obs.metrics import (REGISTRY, counter, gauge, histogram,
+                                 mirror_stats, set_enabled)
+from edl_tpu.obs.publisher import MetricsPublisher
+
+__all__ = ["metrics", "trace", "events", "REGISTRY", "EVENTS",
+           "counter", "gauge", "histogram", "mirror_stats",
+           "set_enabled", "emit", "MetricsPublisher"]

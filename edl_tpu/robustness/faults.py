@@ -86,6 +86,7 @@ import threading
 import time
 import zlib
 
+from edl_tpu.obs import events as obs_events
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
@@ -235,6 +236,10 @@ class FaultPlane(object):
         out = None
         for f in hits:
             logger.warning("fault fired: %s:%s %r", point, f.kind, ctx)
+            # the injection lands on the elastic-event timeline, so a
+            # chaos drill's observed recovery is causally attributable
+            obs_events.emit("fault.fired", point=point, fault=f.kind,
+                            ctx={k: str(v) for k, v in ctx.items()})
             if f.kind == "delay":
                 time.sleep(float(f.params.get("seconds", 0.05)))
             elif f.kind in ("error", "error_once"):

@@ -22,7 +22,13 @@ import random
 import threading
 import time
 
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils import errors
+
+_BREAKER_TRIPS = obs_metrics.counter(
+    "edl_breaker_trips_total", "circuit-breaker closed/half-open -> "
+    "open transitions")
 
 
 class Deadline(object):
@@ -220,9 +226,16 @@ class CircuitBreaker(object):
         with self._lock:
             cell = self._cell(key)
             cell[1] += 1
-            if cell[0] == self.HALF_OPEN \
-                    or cell[1] >= self.failure_threshold:
+            tripped = cell[0] == self.HALF_OPEN \
+                or cell[1] >= self.failure_threshold
+            if tripped:
+                reopened = cell[0] == self.HALF_OPEN
                 self._s[key] = [self.OPEN, 0, self._clock(), 0]
+        if tripped:
+            # outside the lock: the timeline write takes its own lock
+            _BREAKER_TRIPS.inc()
+            obs_events.emit("breaker.open", key=str(key),
+                            reopened=reopened)
 
     def state(self, key):
         with self._lock:

@@ -26,9 +26,25 @@ import socket
 import threading
 import time
 
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.robustness import faults
 from edl_tpu.rpc import framing
 from edl_tpu.utils import errors
+
+_CALL_MS = obs_metrics.histogram(
+    "edl_rpc_client_call_ms", "request send to response resolve",
+    labels=("method",))
+_INFLIGHT = obs_metrics.gauge(
+    "edl_rpc_client_inflight", "requests awaiting a response")
+_RETRIES = obs_metrics.counter(
+    "edl_rpc_client_retries_total", "transport-failure retries",
+    labels=("method",))
+_CALL_ERRS = obs_metrics.counter(
+    "edl_rpc_client_errors_total", "calls resolved with an error",
+    labels=("method",))
+_DIALS = obs_metrics.counter(
+    "edl_rpc_client_connects_total", "connections dialed")
 
 _LOCAL_HOSTS = None
 _LOCAL_LOCK = threading.Lock()
@@ -67,7 +83,7 @@ class RpcFuture(object):
     """
 
     __slots__ = ("_client", "_conn", "method", "_budget", "_sent_at",
-                 "_event", "_value", "_error")
+                 "_event", "_value", "_error", "_span", "_counted")
 
     def __init__(self, client, conn, method, budget):
         self._client = client
@@ -78,11 +94,22 @@ class RpcFuture(object):
         self._event = threading.Event()
         self._value = None
         self._error = None
+        self._span = None     # client trace span, closed at resolve
+        self._counted = False  # in-flight gauge held (set post-send)
 
     def _resolve(self, value=None, error=None):
         if self._event.is_set():
             return
         self._value, self._error = value, error
+        _CALL_MS.labels(self.method).observe(
+            (time.monotonic() - self._sent_at) * 1e3)
+        if self._counted:
+            _INFLIGHT.dec()
+            self._counted = False
+        if error is not None:
+            _CALL_ERRS.labels(self.method).inc()
+        obs_trace.end_span(self._span, ok=error is None)
+        self._span = None
         self._event.set()
 
     def done(self):
@@ -155,6 +182,7 @@ class RpcClient(object):
         self._conn = None
         self._ids = itertools.count()
         self._lock = threading.Lock()   # guards _conn (re)creation
+        self._features = None  # peer's __features__, probed lazily
         self.transport = None  # "uds" | "tcp" after connect
 
     def _try_uds(self):
@@ -245,6 +273,7 @@ class RpcClient(object):
                 except OSError as e:
                     raise errors.ConnectError(
                         "connect %s:%s failed: %s" % (*self._addr, e))
+            _DIALS.inc()
             conn = _Conn(sock, transport)
             conn.reader = threading.Thread(
                 target=self._read_loop, args=(conn,), daemon=True,
@@ -347,60 +376,109 @@ class RpcClient(object):
 
     def server_features(self):
         """The peer's advertised feature set (empty for pre-pipelining
-        servers, which lack the ``__features__`` method)."""
+        servers, which lack the ``__features__`` method). Cached on the
+        client — the trace-header gate consults the cache on every
+        send, and a pool retire discards the whole client anyway."""
+        if self._features is not None:
+            return self._features
         try:
-            return tuple(self.call("__features__"))
+            feats = tuple(self.call("__features__"))
         except errors.RpcError:
-            return ()
+            feats = ()
+        self._features = feats
+        return feats
+
+    def _trace_header(self, span, method):
+        """The ``[trace_id, span_id]`` header for ``span`` — but only
+        once the peer negotiated ``obs.trace`` (probed lazily, once per
+        client). A legacy peer never sees the key: byte-compatible
+        fallback, same negotiation pattern as rpc.pipeline. Internal
+        dunder methods never probe (the probe itself is one)."""
+        if span is None:
+            return None
+        feats = self._features
+        if feats is None:
+            if method.startswith("__"):
+                return None
+            try:
+                feats = self.server_features()
+            except errors.EdlError:
+                self._features = feats = ()
+        if "obs.trace" not in feats:
+            return None
+        return [span.trace_id, span.span_id]
 
     def _send(self, method, args, kwargs, timeout, deadline,
               pipelined, wrote=None):
-        conn = self._ensure_conn()
-        budget = timeout or self._timeout
-        if deadline is not None:
-            budget = deadline.remaining(cap=budget)
-            if budget is not None and budget <= 0:
-                raise errors.DeadlineExceededError(
-                    "rpc %s to %s: no budget left"
-                    % (method, self.endpoint))
-        with conn.wlock:
-            if faults.PLANE is not None:
-                f = faults.PLANE.fire("rpc.client.call",
-                                      endpoint=self.endpoint, method=method)
-                if f is not None:
-                    # a dropped request manifests to the caller as a
-                    # timed-out connection
+        # span + header resolved BEFORE taking the write lock: the
+        # first traced call may probe __features__, a full nested call
+        span = obs_trace.begin_span("rpc.client/%s" % method,
+                                    kind="client",
+                                    tags={"endpoint": self.endpoint})
+        header = self._trace_header(span, method)
+        try:
+            conn = self._ensure_conn()
+            budget = timeout or self._timeout
+            if deadline is not None:
+                budget = deadline.remaining(cap=budget)
+                if budget is not None and budget <= 0:
+                    raise errors.DeadlineExceededError(
+                        "rpc %s to %s: no budget left"
+                        % (method, self.endpoint))
+            with conn.wlock:
+                if faults.PLANE is not None:
+                    f = faults.PLANE.fire("rpc.client.call",
+                                          endpoint=self.endpoint,
+                                          method=method)
+                    if f is not None:
+                        # a dropped request manifests to the caller as
+                        # a timed-out connection
+                        self._kill_conn(conn, errors.ConnectError(
+                            "rpc %s to %s failed: fault: request dropped"
+                            % (method, self.endpoint)))
+                        raise errors.ConnectError(
+                            "rpc %s to %s failed: fault: request dropped"
+                            % (method, self.endpoint))
+                call_id = next(self._ids)
+                req = {"id": call_id, "method": method,
+                       "args": list(args), "kwargs": kwargs}
+                if pipelined:
+                    req["pl"] = 1
+                if header is not None:
+                    req["tr"] = header
+                fut = RpcFuture(self, conn, method, budget)
+                fut._span = span
+                with conn.plock:
+                    if conn.dead:
+                        raise errors.ConnectError(
+                            "rpc %s to %s failed: connection died"
+                            % (method, self.endpoint))
+                    # registered BEFORE the write: the response can
+                    # arrive the instant the last request byte hits the
+                    # wire
+                    conn.pending[call_id] = fut
+                _INFLIGHT.inc()
+                fut._counted = True
+                try:
+                    conn.sock.settimeout(budget)
+                    framing.write_frame(conn.sock, req)
+                    if wrote is not None:
+                        wrote[0] = True
+                except (OSError, ConnectionError,
+                        framing.FramingError) as e:
                     self._kill_conn(conn, errors.ConnectError(
-                        "rpc %s to %s failed: fault: request dropped"
-                        % (method, self.endpoint)))
+                        "rpc %s to %s failed: %s"
+                        % (method, self.endpoint, e)))
                     raise errors.ConnectError(
-                        "rpc %s to %s failed: fault: request dropped"
-                        % (method, self.endpoint))
-            call_id = next(self._ids)
-            req = {"id": call_id, "method": method,
-                   "args": list(args), "kwargs": kwargs}
-            if pipelined:
-                req["pl"] = 1
-            fut = RpcFuture(self, conn, method, budget)
-            with conn.plock:
-                if conn.dead:
-                    raise errors.ConnectError(
-                        "rpc %s to %s failed: connection died"
-                        % (method, self.endpoint))
-                # registered BEFORE the write: the response can arrive
-                # the instant the last request byte hits the wire
-                conn.pending[call_id] = fut
-            try:
-                conn.sock.settimeout(budget)
-                framing.write_frame(conn.sock, req)
-                if wrote is not None:
-                    wrote[0] = True
-            except (OSError, ConnectionError, framing.FramingError) as e:
-                self._kill_conn(conn, errors.ConnectError(
-                    "rpc %s to %s failed: %s"
-                    % (method, self.endpoint, e)))
-                raise errors.ConnectError(
-                    "rpc %s to %s failed: %s" % (method, self.endpoint, e))
+                        "rpc %s to %s failed: %s"
+                        % (method, self.endpoint, e))
+        except Exception:
+            # a send that never reached _resolve closes its span here
+            # (end_span is idempotent, so the _kill_conn path — which
+            # resolves the registered future and closes the span — is
+            # safe to race)
+            obs_trace.end_span(span, ok=False)
+            raise
         return fut
 
     def call(self, method, *args, timeout=None, deadline=None,
@@ -437,6 +515,7 @@ class RpcClient(object):
                             "attempts; last error: %r"
                             % (method, self.endpoint, attempt, e)) from e
                     raise
+                _RETRIES.labels(method).inc()
 
     def _call_once(self, method, args, kwargs, timeout, deadline,
                    wrote=None):

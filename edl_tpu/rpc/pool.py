@@ -34,9 +34,20 @@ import contextlib
 import threading
 import time
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
+
+_POOL_OPEN = obs_metrics.gauge(
+    "edl_rpc_pool_open", "pooled clients currently open")
+_POOL_DIALS = obs_metrics.counter(
+    "edl_rpc_pool_dials_total", "pooled clients ever created (churn)")
+_POOL_REAPS = obs_metrics.counter(
+    "edl_rpc_pool_reaps_total", "idle clients reaped")
+_POOL_RETIRES = obs_metrics.counter(
+    "edl_rpc_pool_retires_total", "clients retired after transport "
+    "errors")
 
 
 class _Entry(object):
@@ -68,6 +79,8 @@ class ClientPool(object):
         self._stop = threading.Event()
         self._reaper = None
         self.dials = 0       # clients ever created (churn metric)
+        self.reaps = 0       # idle clients closed by the reaper
+        self.retires = 0     # clients dropped after transport errors
 
     # -- checkout ----------------------------------------------------------
 
@@ -103,6 +116,8 @@ class ClientPool(object):
                                          retry=self._retry))
                 self._entries[key] = entry
                 self.dials += 1
+                _POOL_DIALS.inc()
+                _POOL_OPEN.set(len(self._entries))
             entry.last_used = time.monotonic()
             entry.leases += 1
             if self._reaper is None:
@@ -159,6 +174,9 @@ class ClientPool(object):
             dropped = [self._entries.pop(k) for k in keys
                        if k in self._entries]
             self._features.pop(endpoint, None)
+            self.retires += len(dropped)
+            _POOL_RETIRES.inc(len(dropped))
+            _POOL_OPEN.set(len(self._entries))
         for entry in dropped:
             entry.client.close()
 
@@ -170,6 +188,9 @@ class ClientPool(object):
                         if e.leases <= 0
                         and now - e.last_used > self._idle_ttl]
                 dropped = [self._entries.pop(k) for k in idle]
+                self.reaps += len(dropped)
+                _POOL_REAPS.inc(len(dropped))
+                _POOL_OPEN.set(len(self._entries))
             for entry in dropped:
                 logger.debug("pool: reaping idle client for %s",
                              entry.client.endpoint)
@@ -177,7 +198,9 @@ class ClientPool(object):
 
     def stats(self):
         with self._lock:
-            return {"open": len(self._entries), "dials": self.dials}
+            stats = {"open": len(self._entries), "dials": self.dials,
+                     "reaps": self.reaps, "retires": self.retires}
+        return obs_metrics.mirror_stats("edl_rpc_pool", stats)
 
     def close(self):
         """Close every client and stop the reaper. Idempotent; in-flight

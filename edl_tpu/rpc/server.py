@@ -21,15 +21,35 @@ import os
 import socket
 import socketserver
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import trace as obs_trace
 from edl_tpu.robustness import faults
 from edl_tpu.rpc import framing
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
-#: capabilities every in-tree server advertises through __features__
-FEATURES = ("rpc.pipeline",)
+#: capabilities every in-tree server advertises through __features__.
+#: obs.trace: requests may carry a ``"tr": [trace_id, span_id]`` header
+#: and the dispatch runs under a server span adopting it as parent.
+#: obs.metrics: the ``__metrics__`` method serves this process's
+#: registry snapshot / Prometheus text.
+FEATURES = ("rpc.pipeline", "obs.trace", "obs.metrics")
+
+_REQS = obs_metrics.counter(
+    "edl_rpc_server_requests_total", "requests dispatched",
+    labels=("method",))
+_ERRS = obs_metrics.counter(
+    "edl_rpc_server_errors_total", "requests answered with an error "
+    "envelope", labels=("method",))
+_HANDLE_MS = obs_metrics.histogram(
+    "edl_rpc_server_handle_ms", "request wall time: dequeue to "
+    "response written", labels=("method",))
+_INFLIGHT = obs_metrics.gauge(
+    "edl_rpc_server_inflight", "requests currently executing")
 
 # per-connection cap on pooled requests in flight: when a client
 # pipelines deeper than this the read loop stops pulling frames and TCP
@@ -44,6 +64,17 @@ def uds_path_for_port(port):
     1381 MB/s on the v2 tensor-frame path, r5). uid-scoped so multiple
     users can't collide; the file itself is chmod 0600."""
     return "/tmp/edl_tpu_rpc_%d_%d.sock" % (os.getuid(), port)
+
+
+def _metrics_method(fmt="json", events_since=0):
+    """Auto-registered ``__metrics__``: this process's observability
+    surface. ``fmt="prom"`` returns Prometheus text exposition;
+    ``fmt="json"`` returns the registry snapshot plus the event
+    timeline (incrementally, via ``events_since`` id watermark)."""
+    if fmt == "prom":
+        return obs_metrics.REGISTRY.prometheus_text()
+    return {"metrics": obs_metrics.REGISTRY.snapshot(),
+            "events": obs_events.EVENTS.snapshot(since_id=events_since)}
 
 
 def _default_workers():
@@ -98,6 +129,8 @@ class _Handler(socketserver.BaseRequestHandler):
         """Execute one request and write its response; False means the
         connection is gone and the read loop should exit."""
         resp = {"id": req.get("id")}
+        t0 = time.monotonic()
+        _INFLIGHT.inc()
         try:
             method = req["method"]
             if faults.PLANE is not None:
@@ -111,8 +144,13 @@ class _Handler(socketserver.BaseRequestHandler):
             if fn is None:
                 raise errors.RpcError("no such method: %s" % method)
             resp["ok"] = True
-            resp["result"] = fn(*req.get("args", []),
-                                **req.get("kwargs", {}))
+            # the server span adopts the envelope's trace header as
+            # parent and activates the context, so a nested RPC issued
+            # inside the handler carries the same trace onward
+            with obs_trace.server_span("rpc/%s" % method,
+                                       req.get("tr")):
+                resp["result"] = fn(*req.get("args", []),
+                                    **req.get("kwargs", {}))
         except Exception as e:  # noqa: BLE001 — envelope every failure
             if not isinstance(e, errors.EdlError):
                 logger.exception("rpc handler %s failed",
@@ -120,6 +158,13 @@ class _Handler(socketserver.BaseRequestHandler):
             name, detail = errors.serialize_error(e)
             resp["ok"] = False
             resp["error"] = {"name": name, "detail": detail}
+            _ERRS.labels(str(req.get("method"))).inc()
+        finally:
+            _INFLIGHT.dec()
+            method_lbl = str(req.get("method"))
+            _REQS.labels(method_lbl).inc()
+            _HANDLE_MS.labels(method_lbl).observe(
+                (time.monotonic() - t0) * 1e3)
         try:
             with wlock:
                 try:
@@ -185,6 +230,7 @@ class RpcServer(object):
         self.methods = {}
         self.register("__features__", lambda: list(FEATURES))
         self.register("__identity__", self._identity)
+        self.register("__metrics__", _metrics_method)
 
     def _identity(self):
         """Who answers on this listener: the bind host + bound TCP

@@ -60,8 +60,16 @@ from concurrent.futures import ThreadPoolExecutor
 import jax
 import numpy as np
 
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.runtime.fs import get_fs
 from edl_tpu.utils.logger import logger
+
+_SAVE_MS = obs_metrics.histogram(
+    "edl_ckpt_save_ms", "checkpoint save wall time to manifest commit",
+    labels=("mode",))
+_RESTORE_MS = obs_metrics.histogram(
+    "edl_ckpt_restore_ms", "checkpoint restore wall time")
 
 try:
     import ml_dtypes
@@ -486,6 +494,7 @@ class CheckpointManager(object):
 
     def save(self, version, tree, meta=None):
         """Write checkpoint ``version``; commit is the MANIFEST write."""
+        t0 = time.monotonic()
         vdir = self._vdir(version)
         self._fs.delete_tree(vdir)  # clear any half-written attempt
         self._fs.makedirs(vdir)
@@ -512,6 +521,9 @@ class CheckpointManager(object):
                        "nbytes": len(payload)}, f)
         logger.info("checkpoint v%d committed (%d arrays, %.1f MB)", version,
                     len(to_save), len(payload) / 1e6)
+        _SAVE_MS.labels("sync").observe((time.monotonic() - t0) * 1e3)
+        obs_events.emit("ckpt.saved", version=version, mode="sync",
+                        nbytes=len(payload))
         self._gc()
         return vdir
 
@@ -708,6 +720,10 @@ class CheckpointManager(object):
                 logger.info("checkpoint v%d committed async (%d entries,"
                             " %.1f MB)", version, len(table),
                             total / 1e6)
+                _SAVE_MS.labels("async").observe(
+                    (time.perf_counter() - p0) * 1e3)
+                obs_events.emit("ckpt.saved", version=version,
+                                mode="async", nbytes=total)
                 self._gc()
                 if on_commit is not None:
                     on_commit()
@@ -851,6 +867,7 @@ class CheckpointManager(object):
         every done marker carries the current nonce. The MANIFEST the
         commit writes MUST record ``attempt: nonce`` — the non-rank-0
         resolution loop keys on it."""
+        t0 = time.monotonic()
         vdir = self._vdir(version)
         use_sentinel = barrier is None and nranks > 1
         nonce = None
@@ -962,7 +979,10 @@ class CheckpointManager(object):
                         pass
             logger.info("sharded checkpoint v%d committed (%d ranks)",
                         version, nranks)
+            obs_events.emit("ckpt.saved", version=version,
+                            mode="sharded", ranks=nranks)
             self._gc()
+        _SAVE_MS.labels("sharded").observe((time.monotonic() - t0) * 1e3)
         return vdir
 
     def save_sharded_async(self, version, tree, meta=None, rank=0,
@@ -1238,6 +1258,17 @@ class CheckpointManager(object):
         return None
 
     def restore(self, version, target=None):
+        t0 = time.monotonic()
+        try:
+            out = self._restore(version, target)
+        except Exception:
+            obs_events.emit("ckpt.restore_failed", version=version)
+            raise
+        _RESTORE_MS.observe((time.monotonic() - t0) * 1e3)
+        obs_events.emit("ckpt.restored", version=version)
+        return out
+
+    def _restore(self, version, target=None):
         vdir = self._vdir(version)
         with self._fs.open(vdir + "/MANIFEST", "r") as f:
             manifest = json.load(f)

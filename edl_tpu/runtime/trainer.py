@@ -30,11 +30,16 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from edl_tpu.controller import train_status as train_status_mod
 from edl_tpu.controller.env import TrainerEnv
 from edl_tpu.coordination.client import CoordClient
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.runtime import checkpoint as checkpoint_mod
 from edl_tpu.runtime import state as state_mod
 from edl_tpu.runtime.checkpoint import CheckpointManager, MissingKeysError
 from edl_tpu.runtime.mesh import DATA_AXIS, data_sharding, make_mesh
 from edl_tpu.utils.logger import logger
+
+_STEP_MS = obs_metrics.histogram(
+    "edl_train_step_ms", "train_step wall time (host dispatch)")
 
 _distributed_initialized = False
 
@@ -832,8 +837,15 @@ class ElasticTrainer(object):
             self._resize_timing["first_step_s"] = time.perf_counter() - c1
             self._resize_timing["t_first_step"] = time.time()
             self._publish_resize_timing()
+            obs_events.emit("resize.first_step",
+                            rank=self.env.global_rank,
+                            compile_s=self._resize_timing["compile_s"],
+                            first_step_s=self._resize_timing
+                            ["first_step_s"])
         self._host_step += 1
-        self._step_times.append(time.perf_counter() - t0)
+        step_s = time.perf_counter() - t0
+        self._step_times.append(step_s)
+        _STEP_MS.observe(step_s * 1e3)
         if self._coord_stop is not None:
             if not self._coord_stop.started:
                 # first boundary: the baseline is final (resume() ran
@@ -1031,6 +1043,8 @@ class ElasticTrainer(object):
                 "checkpoint" % (self._coord_stop.stop_at, self._host_step))
         logger.info("coordinated preemption stop at step %d",
                     self._host_step)
+        obs_events.emit("resize.coordinated_stop",
+                        rank=self.env.global_rank, step=self._host_step)
         self.state.global_step = self.global_step
         self.wait_for_save()
         was_async, self._async_save = self._async_save, False
@@ -1437,6 +1451,8 @@ class ElasticTrainer(object):
         target = jax.tree_util.tree_map(_spec, dict(self.train_state))
         restored = None
         self._resize_timing["t_resume_start"] = time.time()
+        obs_events.emit("resize.resume_start", rank=self.env.global_rank,
+                        world_size=self.world_size)
         for version in reversed(self._ckpt.versions()):
             try:
                 restored = self._restore_placed_any(
@@ -1484,6 +1500,10 @@ class ElasticTrainer(object):
             self._resize_timing["t_resume_end"]
             - self._resize_timing["t_resume_start"])
         self._resize_timing["version"] = version
+        obs_events.emit("resize.resumed", rank=self.env.global_rank,
+                        version=version,
+                        restore_s=self._resize_timing["restore_s"],
+                        source=self._resize_timing.get("restore_source"))
         if self._coord_stop is not None:
             # preempt keys published by the incarnation that wrote this
             # checkpoint are at or below its final step: stale from here

@@ -3,12 +3,17 @@
 Net-new vs the reference (it had no metrics surface; its design doc only
 called for perf reporting to the scheduler — SURVEY.md §5.5). Scrapes the
 store (cluster map, job/train status, elastic State, per-pod resize
-recovery histories) and every live pod's ``pod_stats`` RPC, and returns
-one JSON document — the thing an operator or autoscaler polls.
+recovery histories, and the ``obs_*`` registry snapshots every
+MetricsPublisher ships) plus every live pod's ``pod_stats`` RPC, and
+returns one JSON document — the thing an operator or autoscaler polls.
+The ``fleet_metrics`` section is the cross-pod merge of each process's
+metrics registry (counters/histograms summed, gauges kept per-pod) and
+``timeline`` is the causally-ordered union of every pod's elastic-event
+log — see docs/observability.md.
 
 CLI:
   python -m edl_tpu.tools.job_stats --store_endpoints 127.0.0.1:2379 \
-      --job_id myjob
+      --job_id myjob [--pretty]
 """
 
 import argparse
@@ -19,6 +24,9 @@ from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, status
 from edl_tpu.controller.resource_pods import load_resource_pods
 from edl_tpu.coordination.client import CoordClient
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs.publisher import KEY_PREFIX as _OBS_KEY_PREFIX
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.runtime import state as state_mod
 
@@ -60,17 +68,21 @@ def collect_job_stats(coord, rpc_timeout=5.0):
     else:
         out["train"] = None
 
-    # per-pod resize-recovery histories (written by each launcher) +
-    # per-rank missed-coordinated-stop counters (written by trainers)
+    # per-pod resize-recovery histories (written by each launcher),
+    # per-rank missed-coordinated-stop counters (written by trainers),
+    # and per-process registry/timeline publications (MetricsPublisher)
     resize = {}
     missed = {}
+    obs_pub = {}
     try:
         for key, raw in coord.get_service(constants.SERVICE_METRICS):
             try:
                 val = json.loads(raw)
             except ValueError:
                 continue
-            if key.startswith("preempt_missed"):
+            if key.startswith(_OBS_KEY_PREFIX):
+                obs_pub[key[len(_OBS_KEY_PREFIX):]] = val
+            elif key.startswith("preempt_missed"):
                 missed[key] = val
             else:
                 resize[key] = val
@@ -104,16 +116,80 @@ def collect_job_stats(coord, rpc_timeout=5.0):
             client.close()
     out["pods"] = pods
     out["pods_alive"] = sum(1 for v in pods.values() if "error" not in v)
+
+    # fleet view: merge every published registry snapshot and splice the
+    # per-pod event logs into one causally-ordered timeline
+    snaps = {pod: doc.get("metrics") for pod, doc in obs_pub.items()
+             if isinstance(doc.get("metrics"), dict)}
+    out["fleet_metrics"] = (obs_metrics.merge_snapshots(snaps)
+                            if snaps else None)
+    out["timeline"] = obs_events.merge_timelines(
+        {pod: doc.get("events") or [] for pod, doc in obs_pub.items()})
     return out
+
+
+def format_fleet(doc, width=72):
+    """Human-readable rendering of a collect_job_stats() document: the
+    train summary, the merged fleet metrics (histograms as count/p50-ish
+    mean), and the tail of the elastic-event timeline."""
+    lines = []
+    train = doc.get("train") or {}
+    lines.append("job %s  status=%s  pods_alive=%s"
+                 % (doc.get("job_id"), doc.get("job_status"),
+                    doc.get("pods_alive")))
+    if train:
+        lines.append("  epoch=%s step=%s world=%s samples/s=%s"
+                     % (train.get("epoch"), train.get("global_step"),
+                        train.get("world_size"),
+                        train.get("samples_per_sec")))
+    fleet = doc.get("fleet_metrics")
+    if fleet:
+        lines.append("fleet metrics (%d pods):" % len(fleet.get("pods",
+                                                                ())))
+        for name, fam in sorted((fleet.get("metrics") or {}).items()):
+            for s in fam.get("series", []):
+                lbl = ",".join("%s=%s" % kv
+                               for kv in sorted((s.get("labels")
+                                                 or {}).items()))
+                head = "  %s%s" % (name, ("{%s}" % lbl) if lbl else "")
+                if fam["kind"] == "histogram":
+                    count = s.get("count", 0)
+                    mean = (s.get("sum", 0.0) / count) if count else 0.0
+                    lines.append("%s count=%d mean=%.3f"
+                                 % (head, count, mean))
+                elif "value" in s:  # counter: fleet-summed total
+                    lines.append("%s %s" % (head, s.get("value")))
+                else:  # gauge: per-pod spread, no meaningful single sum
+                    lines.append("%s min=%s max=%s sum=%s"
+                                 % (head, s.get("min"), s.get("max"),
+                                    s.get("sum")))
+    timeline = doc.get("timeline") or []
+    if timeline:
+        lines.append("timeline (last %d of %d events):"
+                     % (min(20, len(timeline)), len(timeline)))
+        for ev in timeline[-20:]:
+            attrs = " ".join("%s=%s" % kv
+                             for kv in sorted((ev.get("attrs")
+                                               or {}).items()))
+            line = "  [%s] %s %s" % (ev.get("pod"), ev.get("kind"),
+                                     attrs)
+            lines.append(line[:width * 2])
+    return "\n".join(lines)
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description="job-level stats scrape")
     ap.add_argument("--store_endpoints", required=True)
     ap.add_argument("--job_id", required=True)
+    ap.add_argument("--pretty", action="store_true",
+                    help="human-readable fleet summary instead of JSON")
     args = ap.parse_args(argv)
     coord = CoordClient(args.store_endpoints.split(","), root=args.job_id)
-    print(json.dumps(collect_job_stats(coord), indent=2, sort_keys=True))
+    doc = collect_job_stats(coord)
+    if args.pretty:
+        print(format_fleet(doc))
+    else:
+        print(json.dumps(doc, indent=2, sort_keys=True))
     return 0
 
 

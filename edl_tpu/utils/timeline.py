@@ -2,9 +2,16 @@
 
 Reference parity: edl/distill/timeline.py:20-46 — a Nop/Real stopwatch pair
 switched by an env var, recording per-pid op latencies to stderr. Here the
-switch is EDL_TPU_PROFILE=1 (and the distill plane also accepts the
-reference's DISTILL_READER_PROFILE=1). jax_trace() adds the TPU-native
-path: a jax.profiler trace context writing TensorBoard-readable dumps.
+stopwatch is backed by the unified metrics registry (``edl_timeline_op_ms``
+histogram, labeled by op) so timeline spans land on the same fleet
+snapshot as every other metric; EDL_TPU_PROFILE=1 (or the reference's
+DISTILL_READER_PROFILE=1) additionally keeps the legacy stderr line sink.
+jax_trace() adds the TPU-native path: a jax.profiler trace context
+writing TensorBoard-readable dumps.
+
+The environment is read ONCE, at first :func:`get_timeline` call; the
+instance is cached per process (hot loops used to re-read os.environ on
+every construction). Tests that flip the env call :func:`reset`.
 """
 
 import contextlib
@@ -12,26 +19,35 @@ import os
 import sys
 import time
 
+from edl_tpu.obs import metrics as obs_metrics
 
-class _NopTimeLine(object):
-    def record(self, op):
-        pass
+_OP_MS = obs_metrics.histogram(
+    "edl_timeline_op_ms", "env-gated stopwatch span latencies",
+    labels=("op",))
 
-    @contextlib.contextmanager
-    def span(self, op):
-        yield
+_cached = None
 
 
-class _RealTimeLine(object):
-    def __init__(self, out=None):
+class TimeLine(object):
+    """Registry-backed stopwatch. ``verbose`` adds the legacy
+    ``[timeline] pid= op= ms=`` stderr lines (the profile-env sink)."""
+
+    def __init__(self, verbose=False, out=None):
         self._pid = os.getpid()
         self._last = time.monotonic()
+        self._verbose = verbose
         self._out = out or sys.stderr
 
+    def _emit(self, op, ms):
+        _OP_MS.labels(op).observe(ms)
+        if self._verbose:
+            self._out.write("[timeline] pid=%d op=%s ms=%.3f\n"
+                            % (self._pid, op, ms))
+
     def record(self, op):
+        """Lap timer: time since the previous record()."""
         now = time.monotonic()
-        self._out.write("[timeline] pid=%d op=%s ms=%.3f\n"
-                        % (self._pid, op, (now - self._last) * 1000))
+        self._emit(op, (now - self._last) * 1000)
         self._last = now
 
     @contextlib.contextmanager
@@ -40,9 +56,17 @@ class _RealTimeLine(object):
         try:
             yield
         finally:
-            self._out.write("[timeline] pid=%d op=%s ms=%.3f\n"
-                            % (self._pid, op,
-                               (time.monotonic() - t0) * 1000))
+            self._emit(op, (time.monotonic() - t0) * 1000)
+
+
+# legacy aliases: pre-registry callers constructed these directly
+_RealTimeLine = TimeLine
+
+
+class _NopTimeLine(TimeLine):
+    """Kept for API compatibility; records to the registry like every
+    timeline now (near-zero cost, and EDL_TPU_OBS=0 disables it), just
+    never to stderr."""
 
 
 def enabled():
@@ -51,7 +75,21 @@ def enabled():
 
 
 def get_timeline(out=None):
-    return _RealTimeLine(out) if enabled() else _NopTimeLine()
+    """The process's shared timeline (env read once, instance cached).
+    Passing ``out`` bypasses the cache — explicit sinks are for tests."""
+    global _cached
+    if out is not None:
+        return TimeLine(verbose=True, out=out)
+    if _cached is None:
+        _cached = TimeLine(verbose=True) if enabled() else _NopTimeLine()
+    return _cached
+
+
+def reset():
+    """Drop the cached timeline so the next get_timeline() re-reads the
+    environment (test hook)."""
+    global _cached
+    _cached = None
 
 
 @contextlib.contextmanager

@@ -248,3 +248,32 @@ def test_data_bench_micro_schema():
         assert out[arc]["pool_dials"] >= 1
     assert out["speedup_records_s"] > 0
     json.dumps(out)  # the whole report is JSON-serializable
+
+
+def test_obs_bench_micro_schema():
+    """The observability-overhead bench must keep working in a tiny CPU
+    config under tier-1 and honor its JSON contract (schema
+    obs_bench/v1): both arcs run the pipelined data hot loop, the
+    primitive microbenchmarks cover every handle op enabled AND
+    disabled, and the registry is left enabled afterwards. No overhead
+    gate here — CI boxes are too noisy for a timing assertion; the <2%
+    acceptance number is measured offline."""
+    import json
+
+    from edl_tpu.obs import metrics as obs_metrics
+    from edl_tpu.tools import obs_bench
+
+    out = obs_bench.run(mode="micro", files=2, rows=64, dim=32,
+                        batch_size=16, step_ms=0.2)
+    assert out["schema"] == "obs_bench/v1"
+    for arc in ("on", "off"):
+        assert out[arc]["records_s"] > 0
+        assert out[arc]["lost"] == 0
+    assert out["overhead_pct"] is not None
+    prim = out["primitives"]
+    for state in ("enabled", "disabled"):
+        for op in ("counter_inc_ns", "labeled_inc_ns", "gauge_set_ns",
+                   "histogram_observe_ns", "span_noop_ns"):
+            assert prim[state][op] > 0
+    assert obs_metrics.enabled()  # the bench must restore the switch
+    json.dumps(out)  # the whole report is JSON-serializable

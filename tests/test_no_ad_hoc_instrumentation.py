@@ -1,0 +1,61 @@
+"""Tier-1 wiring for tools/check_no_ad_hoc_instrumentation.py: a NEW
+stopwatch-plus-print pair in one function fails the build — record a
+registry histogram (edl_tpu.obs.metrics) or a timeline span
+(edl_tpu.utils.timeline) so the sample lands on the fleet snapshot."""
+
+import ast
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_no_ad_hoc_instrumentation.py")
+
+
+def test_no_new_ad_hoc_instrumentation():
+    out = subprocess.run([sys.executable, TOOL], capture_output=True,
+                         text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def _finder(src):
+    sys.path.insert(0, os.path.dirname(TOOL))
+    try:
+        import check_no_ad_hoc_instrumentation as lint
+    finally:
+        sys.path.pop(0)
+    f = lint._Finder("x.py")
+    f.visit(ast.parse(src))
+    return f.hits
+
+
+def test_lint_actually_detects_stopwatch_print():
+    """The lint must not be a rubber stamp: it flags the timed-then-
+    printed combination in both the attribute and the from-import
+    spelling, via print and via sys.stderr.write."""
+    hits = _finder(
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.monotonic()\n"
+        "    print('took', time.monotonic() - t0)\n")
+    assert hits == [("x.py", "f", 4)]
+    hits = _finder(
+        "import sys\n"
+        "from time import perf_counter as pc\n"
+        "def g():\n"
+        "    t0 = pc()\n"
+        "    sys.stderr.write('%f\\n' % (pc() - t0))\n")
+    assert hits == [("x.py", "g", 5)]
+
+
+def test_lint_ignores_benign_timing():
+    """Timing into a variable/stats dict (no console write) and printing
+    without a stopwatch are both fine — separately or in sibling
+    functions."""
+    assert _finder(
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.monotonic()\n"
+        "    return time.monotonic() - t0\n"
+        "def g():\n"
+        "    print('hello')\n") == []

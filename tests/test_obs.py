@@ -1,0 +1,386 @@
+"""The observability plane: metrics registry semantics, trace-context
+propagation over pipelined RPC (the one-trace_id acceptance path and
+the legacy-peer byte-compatible fallback), the elastic-event timeline,
+and the fleet publisher/merge pipeline job_stats is built on."""
+
+import json
+import threading
+
+import pytest
+
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import publisher as obs_publisher
+from edl_tpu.obs import trace as obs_trace
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.rpc.server import RpcServer
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    """Every test starts with an empty span ring and sampling off, and
+    cannot leak either to its neighbors."""
+    obs_trace.TRACER.clear()
+    was = obs_trace.TRACER.enabled
+    obs_trace.TRACER.disable()
+    yield
+    obs_trace.TRACER.clear()
+    (obs_trace.TRACER.enable if was else obs_trace.TRACER.disable)()
+
+
+# -- registry --------------------------------------------------------------
+
+
+def test_counter_concurrent_increments():
+    """8 threads hammering one labeled child (and the labels() lookup
+    itself) lose no increments."""
+    fam = obs_metrics.counter("t_obs_conc_total", "c", labels=("k",))
+    n_threads, n_incs = 8, 5000
+
+    def work():
+        for _ in range(n_incs):
+            fam.labels("x").inc()
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fam.labels("x").value == n_threads * n_incs
+    obs_metrics.REGISTRY.unregister("t_obs_conc_total")
+
+
+def test_histogram_bucket_boundaries():
+    """le-semantics at the exact boundary: an observation equal to a
+    bound lands in that bucket, epsilon above spills to the next."""
+    hist = obs_metrics.histogram("t_obs_bounds_ms", "h",
+                                 buckets=(1.0, 2.0, 5.0))
+    for v in (1.0, 1.0001, 2.0, 5.0, 6.0):
+        hist.observe(v)
+    cum, total_sum, count = hist._d().read()
+    # raw per-bucket: [le=1: 1, le=2: 2, le=5: 1, +Inf: 1]
+    assert cum == [1, 3, 4, 5]
+    assert count == 5
+    assert total_sum == pytest.approx(15.0001)
+    assert hist.percentile(0.5) == 2.0
+    text = obs_metrics.REGISTRY.prometheus_text()
+    assert 't_obs_bounds_ms_bucket{le="1"} 1' in text
+    assert 't_obs_bounds_ms_bucket{le="+Inf"} 5' in text
+    assert "t_obs_bounds_ms_count 5" in text
+    obs_metrics.REGISTRY.unregister("t_obs_bounds_ms")
+
+
+def test_label_cardinality_cap_collapses_to_overflow():
+    """Past max_series new label sets share ONE __overflow__ child and
+    the registry counts every drop — bounded memory under label abuse."""
+    fam = obs_metrics.Family(obs_metrics.REGISTRY, "counter",
+                             "t_obs_cap_total", labelnames=("k",),
+                             max_series=4)
+    dropped0 = obs_metrics.REGISTRY.series_dropped
+    for i in range(4):
+        fam.labels("k%d" % i).inc()
+    over_a = fam.labels("k_extra_a")
+    over_b = fam.labels("k_extra_b")
+    assert over_a is over_b  # both collapsed into the overflow child
+    over_a.inc()
+    over_b.inc()
+    series = fam.series()
+    assert len(series) == 5  # 4 real + 1 overflow, never more
+    assert series[(obs_metrics._OVERFLOW,)].value == 2
+    # pre-cap children are untouched and still addressable
+    assert fam.labels("k0").value == 1
+    assert obs_metrics.REGISTRY.series_dropped == dropped0 + 2
+
+
+def test_family_redeclaration_rules():
+    """Same declaration → same object (module-scope declarations across
+    planes may collide on purpose); conflicting kind/labels → error."""
+    a = obs_metrics.counter("t_obs_redecl_total", "c", labels=("k",))
+    b = obs_metrics.counter("t_obs_redecl_total", "c", labels=("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        obs_metrics.gauge("t_obs_redecl_total")
+    with pytest.raises(ValueError):
+        obs_metrics.counter("t_obs_redecl_total", labels=("other",))
+    obs_metrics.REGISTRY.unregister("t_obs_redecl_total")
+
+
+def test_kill_switch_stops_observation():
+    ctr = obs_metrics.counter("t_obs_kill_total")
+    hist = obs_metrics.histogram("t_obs_kill_ms")
+    prev = obs_metrics.set_enabled(False)
+    try:
+        assert obs_metrics.enabled() is False
+        ctr.inc()
+        hist.observe(3.0)
+    finally:
+        obs_metrics.set_enabled(prev)
+    assert ctr.value == 0
+    assert hist._d().read()[2] == 0
+    ctr.inc()
+    assert ctr.value == 1  # live again after restore
+    obs_metrics.REGISTRY.unregister("t_obs_kill_total")
+    obs_metrics.REGISTRY.unregister("t_obs_kill_ms")
+
+
+def test_mirror_stats_exports_numeric_scalars():
+    stats = {"hits": 7, "ratio": 0.5, "alive": True, "name": "x",
+             "items": [1, 2]}
+    out = obs_metrics.mirror_stats("t_obs_mirror", stats)
+    assert out is stats  # passthrough for the legacy caller
+    fams = obs_metrics.REGISTRY.families()
+    assert fams["t_obs_mirror_hits"].value == 7
+    assert fams["t_obs_mirror_ratio"].value == 0.5
+    assert fams["t_obs_mirror_alive"].value == 1
+    assert "t_obs_mirror_name" not in fams
+    assert "t_obs_mirror_items" not in fams
+    for k in ("hits", "ratio", "alive"):
+        obs_metrics.REGISTRY.unregister("t_obs_mirror_%s" % k)
+
+
+def test_merge_snapshots_fleet_semantics():
+    """Counters and histogram buckets sum elementwise across pods;
+    gauges keep per-pod values plus min/max/sum."""
+    snaps = {}
+    for pod, (c, g, h) in (("p0", (3, 10.0, 1.5)),
+                           ("p1", (4, 2.0, 100.0))):
+        reg = obs_metrics.MetricsRegistry()
+        reg.counter("f_total", labels=("k",)).labels("x").inc(c)
+        reg.gauge("f_gauge").set(g)
+        reg.histogram("f_ms", buckets=(10.0, 1000.0)).observe(h)
+        snaps[pod] = reg.snapshot()
+    fleet = obs_metrics.merge_snapshots(snaps)
+    assert fleet["schema"] == "obs_fleet/v1"
+    assert fleet["pods"] == ["p0", "p1"]
+    ctr = fleet["metrics"]["f_total"]["series"][0]
+    assert ctr["value"] == 7 and ctr["pods"] == {"p0": 3, "p1": 4}
+    gauge = fleet["metrics"]["f_gauge"]["series"][0]
+    assert (gauge["min"], gauge["max"], gauge["sum"]) == (2.0, 10.0, 12.0)
+    hist = fleet["metrics"]["f_ms"]["series"][0]
+    assert hist["buckets"] == [1, 1, 0]  # le=10 + le=1000, elementwise
+    assert hist["count"] == 2
+    json.dumps(fleet)  # the whole fleet doc must stay JSON-able
+
+    # the --pretty renderer must handle every merged-cell shape:
+    # counters carry a summed value, gauges only min/max/sum/pods
+    from edl_tpu.tools import job_stats
+    text = job_stats.format_fleet({
+        "job_id": "j", "job_status": "RUNNING", "pods_alive": 2,
+        "train": None, "fleet_metrics": fleet,
+        "timeline": [{"pod": "p0", "kind": "resize.resumed",
+                      "attrs": {"version": 3}}]})
+    assert "f_total{k=x} 7" in text
+    assert "f_gauge min=2.0 max=10.0 sum=12.0" in text
+    assert "f_ms count=2" in text
+    assert "[p0] resize.resumed version=3" in text
+    assert "None" not in text.split("status=RUNNING")[1]
+
+
+# -- trace propagation over pipelined RPC ----------------------------------
+
+
+@pytest.fixture()
+def echo_server():
+    srv = RpcServer(host="127.0.0.1", port=0)
+    srv.register("echo", lambda x: x)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_trace_links_client_and_server_spans_pipelined(echo_server):
+    """THE acceptance path: one trace_id links the client span of a
+    pipelined call_async to the server dispatch span it caused, with
+    parent_id threading client → server."""
+    obs_trace.TRACER.enable()
+    client = RpcClient("127.0.0.1:%d" % echo_server.port, timeout=10)
+    try:
+        fut = client.call_async("echo", "hello")
+        assert fut.result(timeout=10) == "hello"
+    finally:
+        client.close()
+    [client_span] = obs_trace.TRACER.find(name="rpc.client/echo",
+                                          kind="client")
+    [server_span] = obs_trace.TRACER.find(name="rpc/echo", kind="server")
+    assert client_span["trace_id"] == server_span["trace_id"]
+    assert server_span["parent_id"] == client_span["span_id"]
+    assert client_span["dur_ms"] is not None
+    assert server_span["dur_ms"] is not None
+    assert client_span["tags"]["ok"] is True
+
+
+def test_trace_context_spans_multiple_pipelined_calls(echo_server):
+    """An active root context stamps EVERY overlapping call_async on
+    the connection: 3 concurrent calls → 3 client + 3 server spans, all
+    six sharing the root's trace_id."""
+    client = RpcClient("127.0.0.1:%d" % echo_server.port, timeout=10)
+    try:
+        with obs_trace.span("resize/restore", root=True) as root:
+            futs = [client.call_async("echo", i) for i in range(3)]
+            assert [f.result(timeout=10) for f in futs] == [0, 1, 2]
+    finally:
+        client.close()
+    trace_id = root.trace_id
+    clients = obs_trace.TRACER.find(name="rpc.client/echo",
+                                    trace_id=trace_id)
+    servers = obs_trace.TRACER.find(name="rpc/echo", trace_id=trace_id)
+    assert len(clients) == 3 and len(servers) == 3
+    # every client span hangs off the root; every server span off one
+    # distinct client span
+    assert {c["parent_id"] for c in clients} == {root.span_id}
+    assert ({s["parent_id"] for s in servers}
+            == {c["span_id"] for c in clients})
+
+
+def test_legacy_peer_fallback_no_header_no_breakage(echo_server):
+    """A peer without __features__ (pre-obs build) must see a
+    byte-identical request: no ``tr`` key, the call succeeds, the
+    client span still records locally, and no server span adopts it."""
+    del echo_server.methods["__features__"]  # simulate the legacy peer
+    client = RpcClient("127.0.0.1:%d" % echo_server.port, timeout=10)
+    try:
+        with obs_trace.span("legacy_root", root=True):
+            fut = client.call_async("echo", "old")
+            assert fut.result(timeout=10) == "old"
+    finally:
+        client.close()
+    assert client.server_features() == ()  # probe failed → cached empty
+    [client_span] = obs_trace.TRACER.find(name="rpc.client/echo",
+                                          kind="client")
+    assert obs_trace.TRACER.find(kind="server") == []
+    assert client_span["tags"]["ok"] is True
+
+
+def test_malformed_trace_header_served_normally(echo_server):
+    """Garbage in the tr slot must never fail the request."""
+    client = RpcClient("127.0.0.1:%d" % echo_server.port, timeout=10)
+    try:
+        # bypass the negotiated path and hand-craft a bad header
+        with obs_trace.server_span("rpc/x", 42) as sp:
+            assert sp is None
+        assert client.call("echo", "fine") == "fine"
+    finally:
+        client.close()
+
+
+def test_metrics_rpc_serves_both_formats(echo_server):
+    obs_metrics.counter("t_obs_rpc_total", "c").inc(5)
+    client = RpcClient("127.0.0.1:%d" % echo_server.port, timeout=10)
+    try:
+        doc = client.call("__metrics__")
+        assert doc["metrics"]["schema"] == "obs_snapshot/v1"
+        fam = doc["metrics"]["metrics"]["t_obs_rpc_total"]
+        assert fam["series"][0]["value"] == 5
+        assert isinstance(doc["events"], list)
+        text = client.call("__metrics__", fmt="prom")
+        assert "# TYPE t_obs_rpc_total counter" in text
+        assert "t_obs_rpc_total 5" in text
+    finally:
+        client.close()
+        obs_metrics.REGISTRY.unregister("t_obs_rpc_total")
+
+
+def test_chrome_trace_export(echo_server):
+    obs_trace.TRACER.enable()
+    client = RpcClient("127.0.0.1:%d" % echo_server.port, timeout=10)
+    try:
+        client.call("echo", 1)
+    finally:
+        client.close()
+    doc = obs_trace.TRACER.chrome_trace()
+    events = [e for e in doc["traceEvents"] if e["name"] == "rpc/echo"]
+    assert events and events[0]["ph"] == "X"
+    assert events[0]["args"]["parent_id"] is not None
+    json.dumps(doc)
+
+
+# -- elastic-event timeline ------------------------------------------------
+
+
+def test_event_causal_chain_and_since_watermark():
+    log = obs_events.EventLog(capacity=16)
+    stop = log.emit("resize.coordinated_stop", reason="scale_up")
+    restore = log.emit("resize.restore", cause=stop, source="peer")
+    done = log.emit("resize.resumed", cause=restore)
+    chain = log.snapshot()
+    assert [e["kind"] for e in chain] == [
+        "resize.coordinated_stop", "resize.restore", "resize.resumed"]
+    assert chain[1]["cause"] == stop and chain[2]["cause"] == restore
+    # incremental read: only events past the watermark come back
+    assert [e["id"] for e in log.snapshot(since_id=restore)] == [done]
+    assert log.snapshot(since_id=0, kinds=("resize.res",)) == chain[1:]
+    assert log.last("resize.restore")["id"] == restore
+
+
+def test_event_carries_active_trace_id():
+    log = obs_events.EventLog()
+    obs_trace.TRACER.enable()
+    with obs_trace.span("resize/rebuild", root=True) as sp:
+        log.emit("store.leader_elected", term=3)
+    ev = log.last("store.leader_elected")
+    assert ev["trace_id"] == sp.trace_id
+    assert ev["attrs"] == {"term": 3}
+
+
+def test_merge_timelines_orders_across_pods():
+    a = [{"id": 1, "ts": 10.0, "kind": "x"},
+         {"id": 2, "ts": 30.0, "kind": "y"}]
+    b = [{"id": 1, "ts": 20.0, "kind": "z"}]
+    merged = obs_events.merge_timelines({"p0": a, "p1": b, "p2": None})
+    assert [(e["pod"], e["kind"]) for e in merged] == [
+        ("p0", "x"), ("p1", "z"), ("p0", "y")]
+
+
+# -- fleet publisher -------------------------------------------------------
+
+
+class _FakeCoord(object):
+    """The one store method the publisher needs."""
+
+    def __init__(self):
+        self.store = {}
+
+    def set_server_permanent(self, service, server, value):
+        self.store[(service, server)] = value
+
+
+def test_publisher_service_name_matches_controller_constant():
+    """publisher.SERVICE_METRICS is inlined (obs is a leaf package);
+    this is the drift guard the inline comment promises."""
+    from edl_tpu.controller import constants
+    assert obs_publisher.SERVICE_METRICS == constants.SERVICE_METRICS
+
+
+def test_publisher_publishes_and_watermarks_events():
+    coord = _FakeCoord()
+    log = obs_events.EventLog()
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("pub_total").inc(2)
+    pub = obs_publisher.MetricsPublisher(coord, "pod7", interval=999,
+                                         registry=reg, events=log)
+    log.emit("breaker.open", peer="10.0.0.1:7001")
+    doc = pub.publish_once()
+    assert doc["schema"] == "obs_pub/v1"
+    stored = json.loads(coord.store[("metrics", "obs_pod7")])
+    assert stored["metrics"]["metrics"]["pub_total"]["series"][0][
+        "value"] == 2
+    assert [e["kind"] for e in stored["events"]] == ["breaker.open"]
+    # watermark: an unchanged timeline publishes zero events...
+    assert pub.publish_once()["events"] == []
+    # ...and only the new event rides the next tick
+    log.emit("breaker.close", peer="10.0.0.1:7001")
+    assert [e["kind"] for e in pub.publish_once()["events"]] == [
+        "breaker.close"]
+
+
+def test_publisher_stop_flushes_final_doc():
+    coord = _FakeCoord()
+    log = obs_events.EventLog()
+    pub = obs_publisher.MetricsPublisher(
+        coord, "pod8", interval=999,
+        registry=obs_metrics.MetricsRegistry(), events=log)
+    pub.start()
+    log.emit("fault.injected", fault="rpc.drop")
+    pub.stop()  # final_flush=True must land the event despite interval
+    stored = json.loads(coord.store[("metrics", "obs_pod8")])
+    assert [e["kind"] for e in stored["events"]] == ["fault.injected"]

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Lint: no NEW ad-hoc stopwatch-and-print instrumentation.
+
+A function that both reads a stopwatch (``time.monotonic()`` /
+``time.perf_counter()``) and writes it straight to a console
+(``print(...)`` / ``sys.stderr.write``) is hand-rolled instrumentation —
+exactly what ``edl_tpu.obs`` replaces: the sample never reaches the
+fleet snapshot, can't be aggregated by job_stats, and costs a syscall
+on the hot path. Record it as a registry histogram (pre-bound handle +
+``observe``) or a timeline span (``edl_tpu.utils.timeline``) instead.
+
+Timing INTO a variable/stat dict is fine (most of the tree does that);
+only the timed-then-printed combination in one function is flagged.
+``edl_tpu/obs`` (the sanctioned sink) and ``edl_tpu/tools`` (benches
+print reports by design) are out of scope.
+
+Pre-existing sites are grandfathered in ALLOWLIST, keyed by
+``(relative path, enclosing function)`` so ordinary line drift does not
+churn the list. Runs as a tier-1 test
+(tests/test_no_ad_hoc_instrumentation.py).
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_ROOT = "edl_tpu"
+EXCLUDE_DIRS = ("edl_tpu/obs", "edl_tpu/tools")
+
+STOPWATCHES = {"monotonic", "perf_counter"}
+
+# (relpath, enclosing function) -> why the stopwatch+console pair is OK.
+# Empty today: the one legacy site (utils/timeline.py's stderr sink)
+# was rewired onto the registry with an injected output object, which
+# this lint correctly no longer sees as a raw console write.
+ALLOWLIST = {}
+
+
+class _Finder(ast.NodeVisitor):
+    """Per-function pairing of stopwatch reads and console writes."""
+
+    def __init__(self, relpath):
+        self.relpath = relpath
+        self.hits = []  # (relpath, func, lineno)
+        # stack of [name, stopwatch_lineno, console_lineno]
+        self._funcs = [["<module>", None, None]]
+        self.time_aliases = {"time"}
+        self.clock_aliases = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            if a.name == "time":
+                self.time_aliases.add(a.asname or "time")
+
+    def visit_ImportFrom(self, node):
+        if node.module == "time":
+            for a in node.names:
+                if a.name in STOPWATCHES:
+                    self.clock_aliases.add(a.asname or a.name)
+
+    def _in_func(self, node):
+        self._funcs.append([node.name, None, None])
+        self.generic_visit(node)
+        name, clock, console = self._funcs.pop()
+        if clock is not None and console is not None:
+            self.hits.append((self.relpath, name, console))
+
+    visit_FunctionDef = _in_func
+    visit_AsyncFunctionDef = _in_func
+
+    def _is_stopwatch(self, call):
+        f = call.func
+        if isinstance(f, ast.Attribute) and f.attr in STOPWATCHES \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in self.time_aliases:
+            return True
+        return isinstance(f, ast.Name) and f.id in self.clock_aliases
+
+    @staticmethod
+    def _is_console_write(call):
+        f = call.func
+        if isinstance(f, ast.Name) and f.id == "print":
+            return True
+        # sys.stderr.write / sys.stdout.write
+        return (isinstance(f, ast.Attribute) and f.attr == "write"
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr in ("stderr", "stdout")
+                and isinstance(f.value.value, ast.Name)
+                and f.value.value.id == "sys")
+
+    def visit_Call(self, node):
+        frame = self._funcs[-1]
+        if frame[1] is None and self._is_stopwatch(node):
+            frame[1] = node.lineno
+        if frame[2] is None and self._is_console_write(node):
+            frame[2] = node.lineno
+        self.generic_visit(node)
+
+
+def scan():
+    hits = []
+    root = os.path.join(REPO, SCAN_ROOT)
+    for dirpath, _, files in os.walk(root):
+        rel_dir = os.path.relpath(dirpath, REPO)
+        if any(rel_dir == ex or rel_dir.startswith(ex + os.sep)
+               for ex in EXCLUDE_DIRS):
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            relpath = os.path.relpath(path, REPO)
+            with open(path) as f:
+                tree = ast.parse(f.read(), filename=relpath)
+            finder = _Finder(relpath)
+            finder.visit(tree)
+            hits.extend(finder.hits)
+    return hits
+
+
+def main():
+    hits = scan()
+    violations = [(rel, func, line) for rel, func, line in hits
+                  if (rel, func) not in ALLOWLIST]
+    stale = sorted(set(ALLOWLIST) - {(rel, func) for rel, func, _ in hits})
+    if stale:
+        print("stale ALLOWLIST entries (site no longer exists — remove "
+              "them):")
+        for rel, func in stale:
+            print("  %s :: %s" % (rel, func))
+    if violations:
+        print("ad-hoc instrumentation (stopwatch + console write in one "
+              "function):")
+        for rel, func, line in violations:
+            print("  %s:%d in %s()" % (rel, line, func))
+        print("record a registry histogram (edl_tpu.obs.metrics) or a "
+              "timeline span (edl_tpu.utils.timeline) instead, or "
+              "allowlist the site in "
+              "tools/check_no_ad_hoc_instrumentation.py with a "
+              "justification.")
+    if violations or stale:
+        return 1
+    print("ok: no ad-hoc stopwatch+print instrumentation outside the "
+          "allowlist")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
