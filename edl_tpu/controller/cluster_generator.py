@@ -24,12 +24,16 @@ from edl_tpu.utils.logger import logger
 
 class Generator(object):
     def __init__(self, coord, pod_id, min_nodes, max_nodes,
-                 topology_valid=None, below_min_grace=None):
+                 topology_valid=None, below_min_grace=None,
+                 preferred_victims=None):
         self._coord = coord
         self._pod_id = pod_id
         self._min = min_nodes
         self._max = max_nodes
         self._topology_valid = topology_valid or (lambda n: True)
+        # advisory hook (obs/health.HealthMonitor.preferred_victims):
+        # when a shrink must drop pods, flagged stragglers go first
+        self._preferred_victims = preferred_victims
         self._stop = threading.Event()
         self._thread = None
         self._lock = threading.Lock()
@@ -179,7 +183,9 @@ class Generator(object):
             return None
 
         # shrink to the largest topology-valid size >= min (drop newly
-        # added pods first, then alive pods from the tail)
+        # added pods first, then alive pods from the tail — unless the
+        # health monitor has flagged stragglers, which move to the tail
+        # so the eviction lands on them first)
         candidates = alive + added
         n = len(candidates)
         while n >= self._min and not self._topology_valid(n):
@@ -203,6 +209,8 @@ class Generator(object):
             status.save_job_status(self._coord, status.Status.FAILED)
             return None
         self._below_min_since = None
+        if n < len(candidates):
+            candidates = self._order_for_eviction(candidates, n)
         candidates = candidates[:n]
 
         new = Cluster()
@@ -212,6 +220,37 @@ class Generator(object):
                     "stage %s", n, len(gone), len(finished),
                     len([p for p in candidates if p in added]), new.stage)
         return new
+
+    def _order_for_eviction(self, candidates, n):
+        """Reorder ``candidates`` before the tail-drop to ``n`` so
+        health-flagged stragglers are evicted first. The hook is
+        ADVISORY and fail-open: any error means the default order
+        stands; the leader pod is never moved (evicting the pod that
+        hosts the generator and monitor would decapitate the job to
+        save it); the worst-ranked victim goes LAST so a multi-pod
+        shrink takes the worst first."""
+        if self._preferred_victims is None:
+            return candidates
+        try:
+            ranked = list(self._preferred_victims() or ())
+        except Exception:
+            logger.exception("preferred_victims hook failed; using "
+                             "default eviction order")
+            return candidates
+        victims = [v for v in ranked
+                   if v != self._pod_id
+                   and v in {p.id for p in candidates}]
+        if not victims:
+            return candidates
+        tail_order = {v: i for i, v in enumerate(victims)}
+        keep = [p for p in candidates if p.id not in tail_order]
+        # candidates[:n] keeps the FRONT, so eviction consumes the tail
+        # back-to-front: the worst-ranked victim (rank 0) must sit LAST
+        tail = sorted((p for p in candidates if p.id in tail_order),
+                      key=lambda p: -tail_order[p.id])
+        logger.info("scale-in eviction order honors health verdicts: "
+                    "victims %s move to the tail", victims)
+        return keep + tail
 
     def _scale_out_allowed(self, statuses):
         """Don't bother scaling out when training is nearly done

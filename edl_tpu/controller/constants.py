@@ -15,6 +15,8 @@ SERVICE_READER = "reader"
 SERVICE_STATE = "state"
 SERVICE_JOB_FLAG = "job_flag"
 SERVICE_METRICS = "metrics"
+# leader HealthMonitor's health_report/v1 verdict doc (obs/health.py)
+SERVICE_HEALTH = "health"
 # peer-served restore plane: each trainer's StateServer endpoint +
 # published snapshot version (edl_tpu/runtime/state_server.py)
 SERVICE_STATE_SERVER = "state_server"

@@ -17,6 +17,7 @@ from edl_tpu.controller.cluster_generator import Generator
 from edl_tpu.controller.cluster_watcher import ClusterWatcher
 from edl_tpu.controller.leader import LeaderElector
 from edl_tpu.controller.resource_pods import ResourceRegister
+from edl_tpu.obs.health import HealthMonitor
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
@@ -41,6 +42,7 @@ class Launcher(object):
         self._resource_register = None
         self._elector = None
         self._generator = None
+        self._health = None
         self._watcher = None
         self._procs = []
         self._cluster = None
@@ -77,13 +79,20 @@ class Launcher(object):
     def _launch(self):
         je = self._job_env
         self._resource_register = ResourceRegister(self._coord, self._pod)
+        # the health monitor is leader-hosted alongside the generator:
+        # its verdicts advise the generator's scale-in victim choice,
+        # and exactly one monitor writes the fleet's health_report/v1
+        self._health = HealthMonitor(self._coord, self._pod.id)
         self._generator = Generator(
             self._coord, self._pod.id, je.min_nodes, je.max_nodes,
-            topology_valid=self._topology_valid)
+            topology_valid=self._topology_valid,
+            preferred_victims=self._health.preferred_victims)
         self._elector = LeaderElector(
             self._coord, self._pod.id,
-            on_elected=lambda: self._generator.start(),
-            on_lost=lambda: self._generator.stop()).start()
+            on_elected=lambda: (self._generator.start(),
+                                self._health.start()),
+            on_lost=lambda: (self._generator.stop(),
+                             self._health.stop())).start()
 
         verdict = self._join_cluster()
         if verdict is _JOIN_FAILED:
@@ -377,8 +386,9 @@ class Launcher(object):
     def _cleanup(self):
         if self._procs:
             train_process.terminate_trainers(self._procs)
-        for closer in (self._watcher, self._generator, self._elector,
-                       self._resource_register, self._pod_server):
+        for closer in (self._watcher, self._generator, self._health,
+                       self._elector, self._resource_register,
+                       self._pod_server):
             if closer is not None:
                 try:
                     closer.stop()

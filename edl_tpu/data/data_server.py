@@ -22,6 +22,7 @@ from collections import OrderedDict, deque
 
 import numpy as np
 
+from edl_tpu.robustness import faults
 from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.server import RpcServer
 from edl_tpu.utils import errors
@@ -321,9 +322,11 @@ class DataPlaneServer(object):
     """One per trainer process: serves this producer's batches, and — iff
     this process is the job's data leader — the LeaderDataService too."""
 
-    def __init__(self, cache, leader_service=None, host="0.0.0.0", port=0):
+    def __init__(self, cache, leader_service=None, host="0.0.0.0", port=0,
+                 pod_id=None):
         self._rpc = RpcServer(host=host, port=port)
         self._cache = cache
+        self._pod_id = str(pod_id) if pod_id is not None else ""
         self._rpc.register("get_batch", self._get_batch)
         self._rpc.register("get_batches", self._get_batches)
         if leader_service is not None:
@@ -336,7 +339,20 @@ class DataPlaneServer(object):
             self._rpc.register("ds_get_assignment", svc.get_assignment)
             self._rpc.register("ds_stats", svc.stats)
 
+    def _fire_fetch_fault(self, batch):
+        """``data.fetch.delay``: the producer-side latency twin of the
+        consumer's ``data.fetch`` point. Fired INSIDE the serve path,
+        so an armed delay extends the RPC's wall time and lands in the
+        consumer's measured fetch window (``edl_reader_fetch_ms``) —
+        the consumer-side point fires before the timing clock starts
+        and so cannot simulate a slow data plane. Filter with
+        ``pod=<producer pod id>`` to slow exactly one pod."""
+        if faults.PLANE is not None:
+            faults.PLANE.fire("data.fetch.delay", pod=self._pod_id,
+                              batch=batch)
+
     def _get_batch(self, batch_id):
+        self._fire_fetch_fault(batch_id)
         payload = self._cache.pop(batch_id)
         if payload is None:
             raise errors.NotFoundError("batch %s not in cache" % batch_id)
@@ -354,6 +370,7 @@ class DataPlaneServer(object):
         — no per-record msgpack, no per-record frame segment. Records
         the columnar codec cannot represent exactly stay row-form
         (per-payload fallback, mixed results are fine)."""
+        self._fire_fetch_fault(",".join(str(b) for b in batch_ids))
         out = []
         for batch_id in batch_ids:
             payload = self._cache.pop(batch_id)

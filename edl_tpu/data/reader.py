@@ -177,7 +177,8 @@ class ElasticReader(object):
                                             reader_ttl=reader_ttl)
                           if is_leader else None)
         self._server = DataPlaneServer(self._cache,
-                                       leader_service=leader_service).start()
+                                       leader_service=leader_service,
+                                       pod_id=pod_id).start()
         if is_leader:
             if coord is not None:
                 register_data_leader(coord, reader_name,

@@ -18,18 +18,26 @@ the env-gated stderr stopwatch, and the ad-hoc JSON blobs under
   trips, and fault injections.
 - :mod:`edl_tpu.obs.publisher` — periodic snapshot publication into
   the coordination store so ``job_stats`` renders a fleet-wide view.
+- :mod:`edl_tpu.obs.health` / :mod:`edl_tpu.obs.slo` — the ACTIVE
+  layer: streaming detectors (straggler EWMA/MAD, publisher staleness,
+  breaker flap, queue saturation) and multi-window SLO burn rates over
+  the published docs, run by the leader-hosted
+  :class:`~edl_tpu.obs.health.HealthMonitor`, which writes a
+  ``health_report/v1`` verdict under ``SERVICE_HEALTH`` and feeds the
+  cluster generator's scale-in victim choice.
 
 This package is a LEAF: it imports nothing from edl_tpu outside
 ``utils.logger``, so every plane (rpc, robustness, data, coordination)
 can instrument itself without import cycles.
 """
 
-from edl_tpu.obs import events, metrics, trace
+from edl_tpu.obs import events, health, metrics, slo, trace
 from edl_tpu.obs.events import EVENTS, emit
+from edl_tpu.obs.health import HealthMonitor
 from edl_tpu.obs.metrics import (REGISTRY, counter, gauge, histogram,
                                  mirror_stats, set_enabled)
 from edl_tpu.obs.publisher import MetricsPublisher
 
-__all__ = ["metrics", "trace", "events", "REGISTRY", "EVENTS",
-           "counter", "gauge", "histogram", "mirror_stats",
-           "set_enabled", "emit", "MetricsPublisher"]
+__all__ = ["metrics", "trace", "events", "health", "slo", "REGISTRY",
+           "EVENTS", "counter", "gauge", "histogram", "mirror_stats",
+           "set_enabled", "emit", "MetricsPublisher", "HealthMonitor"]

@@ -1,0 +1,702 @@
+"""Fleet health verdicts: streaming detectors over the published docs.
+
+PR 7 built the passive plane — every process publishes an
+``obs_pub/v1`` doc (registry snapshot + fresh events) under
+``SERVICE_METRICS``. This module closes the loop: a leader-hosted
+:class:`HealthMonitor` re-reads every ``obs_*`` doc on a timer, runs
+streaming detectors over consecutive snapshots, and writes ONE
+machine-readable ``health_report/v1`` verdict doc under
+``SERVICE_HEALTH`` that elastic decisions (and the job doctor) consume.
+
+Detectors (each a small streaming class, unit-testable offline):
+
+- :class:`StragglerDetector` — per-pod EWMA of the windowed mean of a
+  latency histogram (``delta_sum / delta_count`` between consecutive
+  snapshots), flagged when the EWMA sits more than ``k`` times the
+  fleet MAD above the fleet median for ``n_windows`` CONSECUTIVE
+  windows. Median/MAD (not mean/stddev) so one straggler cannot drag
+  the baseline toward itself; the EWMA warmup keeps a pod that just
+  joined (cold cache, first compile) from being flagged on its first
+  windows; a floor under the MAD keeps a perfectly homogeneous fleet
+  (MAD ~ 0) from flagging micro-jitter.
+- :class:`StalenessDetector` — a publisher whose doc ``ts`` stops
+  advancing past ``stale_after`` is flagged dead-or-partitioned; its
+  return produces a recovery transition.
+- :class:`BreakerFlapDetector` — ``edl_breaker_trips_total`` deltas: a
+  breaker that trips in >= ``flap_threshold`` of the last
+  ``window_count`` windows is flapping (the retry plane is masking a
+  recurring fault, not riding out a blip).
+- :class:`QueueSaturationDetector` — a depth gauge pinned at/above its
+  configured ceiling for ``n_windows`` consecutive windows (the
+  consumer is not keeping up; back-pressure has gone steady-state).
+
+SLO burn rates ride along via :mod:`edl_tpu.obs.slo`.
+
+The monitor also exposes ``preferred_victims()`` — an ADVISORY ranked
+list of flagged pods that the cluster generator consults when a
+scale-in must drop someone: evict the straggler first, not an arbitrary
+tail pod. Advisory means: never the monitor's own pod, never a reason
+to shrink, only an ordering hint.
+
+This package is a LEAF — ``SERVICE_HEALTH`` is inlined here (value of
+``controller.constants.SERVICE_HEALTH``, drift-guarded by a test) and
+the coordination client is injected, never imported.
+"""
+
+import json
+import threading
+import time
+from collections import deque
+
+from edl_tpu.obs import events as events_mod
+from edl_tpu.obs import slo as slo_mod
+from edl_tpu.utils.logger import logger
+
+#: value of controller.constants.SERVICE_HEALTH, inlined so obs stays
+#: a leaf package (guarded by a test against drift)
+SERVICE_HEALTH = "health"
+
+#: the single verdict key under SERVICE_HEALTH (leader-written,
+#: last-writer-wins — there is at most one elected monitor)
+HEALTH_KEY = "report"
+
+KEY_PREFIX = "obs_"
+
+SEVERITY_RANK = {"critical": 2, "warn": 1}
+
+#: event kinds worth citing as causal evidence next to a finding
+_EVIDENCE_KINDS = ("fault.", "breaker.", "resize.", "store.", "health.",
+                  "preempt.")
+
+
+def _median(values):
+    vs = sorted(values)
+    n = len(vs)
+    if not n:
+        return 0.0
+    mid = n // 2
+    if n % 2:
+        return vs[mid]
+    return (vs[mid - 1] + vs[mid]) / 2.0
+
+
+def _mad(values, med):
+    return _median([abs(v - med) for v in values])
+
+
+class Finding(dict):
+    """One detector verdict — a plain dict (JSON-able) with helpers."""
+
+    @classmethod
+    def make(cls, detector, pod, severity, summary, metric=None,
+             value=None, baseline=None, threshold=None, windows=None,
+             **extra):
+        f = cls(detector=detector, pod=pod, severity=severity,
+                summary=summary)
+        if metric is not None:
+            f["metric"] = metric
+        if value is not None:
+            f["value"] = round(float(value), 3)
+        if baseline is not None:
+            f["baseline"] = round(float(baseline), 3)
+        if threshold is not None:
+            f["threshold"] = round(float(threshold), 3)
+        if windows is not None:
+            f["windows"] = windows
+        f.update(extra)
+        return f
+
+
+class _EwmaState(object):
+    __slots__ = ("ewma", "windows", "streak", "last_sum", "last_count")
+
+    def __init__(self):
+        self.ewma = None
+        self.windows = 0
+        self.streak = 0
+        self.last_sum = None
+        self.last_count = None
+
+
+class StragglerDetector(object):
+    """EWMA/MAD straggler scoring over one histogram family.
+
+    Feed :meth:`update` one ``{pod: window_mean_ms}`` map per tick
+    (pods with no new observations this window simply absent). Knobs:
+    ``k`` (MADs above the median), ``n_windows`` (consecutive windows
+    over threshold before flagging), ``warmup`` (windows of data a pod
+    needs before it can be FLAGGED; within warmup the EWMA re-seeds
+    from each window instead of blending, so a one-window cold-start
+    spike — first compile, cold page cache after a resize join — dies
+    with the window instead of living on in the average), ``min_pods``
+    (below this many pods there is no fleet to be a straggler OF),
+    ``min_delta_ms`` / ``min_rel`` (floors under the MAD term so a
+    tight fleet doesn't flag noise).
+
+    The baseline median/MAD comes from warmed-up pods when enough
+    exist, else from every pod with data — so a cold fleet (all pods
+    started together, one of them genuinely slow from its first
+    window) still converges on a verdict within ``n_windows``."""
+
+    def __init__(self, family, k=3.0, n_windows=2, warmup=2, alpha=0.5,
+                 min_pods=2, min_delta_ms=5.0, min_rel=0.25):
+        self.family = family
+        self.k = float(k)
+        self.n_windows = int(n_windows)
+        self.warmup = int(warmup)
+        self.alpha = float(alpha)
+        self.min_pods = int(min_pods)
+        self.min_delta_ms = float(min_delta_ms)
+        self.min_rel = float(min_rel)
+        self._pods = {}  # pod -> _EwmaState
+
+    def window_mean(self, pod, hist_sum, hist_count):
+        """Cumulative (sum, count) -> this window's mean for ``pod``,
+        or None when no new observations landed (or the counters went
+        backwards — a restart; the state re-anchors)."""
+        st = self._pods.setdefault(pod, _EwmaState())
+        if st.last_count is None or hist_count < st.last_count:
+            st.last_sum, st.last_count = hist_sum, hist_count
+            return None
+        d_count = hist_count - st.last_count
+        d_sum = hist_sum - st.last_sum
+        st.last_sum, st.last_count = hist_sum, hist_count
+        if d_count <= 0:
+            return None
+        return d_sum / d_count
+
+    def forget(self, pod):
+        self._pods.pop(pod, None)
+
+    def pods(self):
+        return list(self._pods)
+
+    def update(self, samples):
+        """One detector window; returns a list of Findings."""
+        for pod, mean in samples.items():
+            st = self._pods.setdefault(pod, _EwmaState())
+            st.windows += 1
+            if st.ewma is None or st.windows <= self.warmup:
+                st.ewma = float(mean)  # warmup: re-seed, don't blend
+            else:
+                st.ewma = (self.alpha * float(mean)
+                           + (1.0 - self.alpha) * st.ewma)
+        have = {pod: st.ewma for pod, st in self._pods.items()
+                if st.ewma is not None}
+        if len(have) < self.min_pods:
+            for st in self._pods.values():
+                st.streak = 0
+            return []
+        warm_vals = [st.ewma for st in self._pods.values()
+                     if st.ewma is not None
+                     and st.windows >= self.warmup]
+        base_vals = (warm_vals if len(warm_vals) >= self.min_pods
+                     else list(have.values()))
+        med = _median(base_vals)
+        mad = _mad(base_vals, med)
+        threshold = med + max(self.k * mad, self.min_delta_ms,
+                              self.min_rel * med)
+        findings = []
+        for pod, st in self._pods.items():
+            value = have.get(pod)
+            if value is None:
+                continue
+            if value > threshold:
+                # only count windows with fresh evidence toward the
+                # streak; a silent window holds the streak (a pod so
+                # slow it finished nothing is not thereby healthy)
+                if pod in samples:
+                    st.streak += 1
+            else:
+                st.streak = 0
+            if st.streak >= self.n_windows and st.windows >= self.warmup:
+                findings.append(Finding.make(
+                    "straggler", pod, "critical",
+                    "%s ewma %.1fms vs fleet median %.1fms "
+                    "(threshold %.1fms, %d consecutive windows)"
+                    % (self.family, value, med, threshold, st.streak),
+                    metric=self.family, value=value, baseline=med,
+                    threshold=threshold, windows=st.streak, mad=round(mad,
+                                                                      3)))
+        return findings
+
+
+class StalenessDetector(object):
+    """Publisher-liveness from the doc ``ts`` the publisher stamps."""
+
+    def __init__(self, stale_after):
+        self.stale_after = float(stale_after)
+
+    def update(self, now, doc_ts):
+        """``doc_ts``: {pod: last published ts}; returns Findings."""
+        findings = []
+        for pod, ts in doc_ts.items():
+            if ts is None:
+                continue  # pre-fix publisher: cannot judge liveness
+            age = now - ts
+            if age > self.stale_after:
+                findings.append(Finding.make(
+                    "stale_publisher", pod, "critical",
+                    "no publication for %.1fs (stale_after %.1fs) — "
+                    "process dead or partitioned" % (age,
+                                                     self.stale_after),
+                    metric="obs_pub.ts", value=age,
+                    threshold=self.stale_after))
+        return findings
+
+
+class BreakerFlapDetector(object):
+    """A circuit breaker that keeps re-tripping across windows."""
+
+    def __init__(self, family="edl_breaker_trips_total", window_count=6,
+                 flap_threshold=3):
+        self.family = family
+        self.window_count = int(window_count)
+        self.flap_threshold = int(flap_threshold)
+        self._last = {}     # pod -> cumulative trips
+        self._windows = {}  # pod -> deque of 0/1 tripped-this-window
+
+    def update(self, trips):
+        """``trips``: {pod: cumulative trip count}; returns Findings."""
+        findings = []
+        for pod, total in trips.items():
+            prev = self._last.get(pod)
+            self._last[pod] = total
+            if prev is None or total < prev:
+                continue  # first sight or restart: re-anchor
+            ring = self._windows.setdefault(
+                pod, deque(maxlen=self.window_count))
+            ring.append(1 if total > prev else 0)
+            flaps = sum(ring)
+            if flaps >= self.flap_threshold:
+                findings.append(Finding.make(
+                    "breaker_flap", pod, "warn",
+                    "breaker tripped in %d of the last %d windows "
+                    "(retries are masking a recurring fault)"
+                    % (flaps, len(ring)),
+                    metric=self.family, value=flaps,
+                    threshold=self.flap_threshold, windows=len(ring)))
+        return findings
+
+
+class QueueSaturationDetector(object):
+    """A depth gauge pinned at/above its ceiling: steady back-pressure."""
+
+    def __init__(self, family, threshold, n_windows=3):
+        self.family = family
+        self.threshold = float(threshold)
+        self.n_windows = int(n_windows)
+        self._streak = {}
+
+    def update(self, depths):
+        """``depths``: {pod: gauge value}; returns Findings."""
+        findings = []
+        for pod, depth in depths.items():
+            if depth >= self.threshold:
+                self._streak[pod] = self._streak.get(pod, 0) + 1
+            else:
+                self._streak[pod] = 0
+            if self._streak[pod] >= self.n_windows:
+                findings.append(Finding.make(
+                    "queue_saturation", pod, "warn",
+                    "%s at %.0f >= %.0f for %d consecutive windows "
+                    "(consumer not keeping up)"
+                    % (self.family, depth, self.threshold,
+                       self._streak[pod]),
+                    metric=self.family, value=depth,
+                    threshold=self.threshold, windows=self._streak[pod]))
+        return findings
+
+
+class HealthMonitor(object):
+    """The leader-hosted verdict service.
+
+    ``check_once()`` (called by a timer thread between elections) reads
+    every ``obs_*`` doc under ``service_metrics``, runs the streaming
+    detectors + SLO burn evaluation, writes a ``health_report/v1`` doc
+    under ``service_health``/``HEALTH_KEY``, and emits
+    ``health.degraded`` / ``health.recovered`` transitions into the
+    causal event ring. ``evaluate(docs)`` is the pure core (no store,
+    no wall clock when ``now`` is passed) — tests and the detector
+    bench drive it directly.
+
+    The monitor is stateful across ticks (EWMAs, streaks, SLO rings,
+    event watermarks) but stateless across ELECTIONS by design: a new
+    leader's monitor re-warms within ``warmup`` windows rather than
+    inheriting a dead leader's baselines."""
+
+    def __init__(self, coord, pod_id, interval=10.0,
+                 service_metrics="metrics", service_health=SERVICE_HEALTH,
+                 key_prefix=KEY_PREFIX, stale_after=None,
+                 straggler_families=("edl_train_step_ms",
+                                     "edl_reader_fetch_ms"),
+                 k=3.0, n_windows=2, warmup=2,
+                 saturation_gauges=(("edl_reader_out_queue_depth", 16.0),
+                                    ("edl_teacher_queue_depth", 64.0)),
+                 slos=slo_mod.DEFAULT_SLOS, evaluator=None, events=None,
+                 clock=time.time, max_transitions=64):
+        self._coord = coord
+        self._pod_id = pod_id
+        self._interval = float(interval)
+        self._service_metrics = service_metrics
+        self._service_health = service_health
+        self._key_prefix = key_prefix
+        self._clock = clock
+        self._events = events or events_mod.EVENTS
+        self._stragglers = [
+            StragglerDetector(fam, k=k, n_windows=n_windows, warmup=warmup)
+            for fam in straggler_families]
+        self._staleness = StalenessDetector(
+            stale_after if stale_after is not None else 3.0 * interval
+            + 5.0)
+        self._breaker = BreakerFlapDetector()
+        self._saturation = [QueueSaturationDetector(fam, thr)
+                            for fam, thr in saturation_gauges]
+        self._evaluator = evaluator or slo_mod.BurnRateEvaluator(
+            slos=slos, clock=clock)
+        # pod -> {"verdict", "event_id"} for transition detection
+        self._pod_state = {}
+        # pod -> event-id watermark + bounded recent-evidence ring
+        self._event_marks = {}
+        self._evidence = {}  # pod -> deque of recent evidence events
+        self._transitions = deque(maxlen=int(max_transitions))
+        self._last_report = None
+        self._victims = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    # -- doc plumbing ------------------------------------------------------
+
+    def _read_docs(self):
+        """{pod: obs_pub doc} from the store; best-effort."""
+        docs = {}
+        try:
+            for key, raw in self._coord.get_service(self._service_metrics):
+                if not key.startswith(self._key_prefix):
+                    continue
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict) \
+                        and doc.get("schema") == "obs_pub/v1":
+                    docs[key[len(self._key_prefix):]] = doc
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            logger.debug("health: obs doc read failed: %r", e)
+        return docs
+
+    @staticmethod
+    def _family(doc, name):
+        metrics = (doc.get("metrics") or {}).get("metrics") or {}
+        return metrics.get(name)
+
+    @staticmethod
+    def _series_total(fam, field="value"):
+        """Sum a counter/gauge family's series values."""
+        return sum(s.get(field, 0.0) for s in (fam or {}).get("series",
+                                                              ()))
+
+    @staticmethod
+    def _hist_totals(fam):
+        """(sum, count) across all series of a histogram family."""
+        total_sum = total_count = 0.0
+        for s in (fam or {}).get("series", ()):
+            total_sum += s.get("sum", 0.0)
+            total_count += s.get("count", 0)
+        return total_sum, total_count
+
+    def _ingest_events(self, pod, doc):
+        """Accumulate this doc's fresh events into the pod's bounded
+        evidence ring (docs carry increments and overwrite in place, so
+        the monitor is the one remembering). Returns the new events."""
+        mark = self._event_marks.get(pod, 0)
+        fresh = []
+        max_id = mark
+        for e in doc.get("events") or ():
+            eid = e.get("id") or 0
+            if eid <= mark:
+                # ids went backwards across the whole doc -> restart;
+                # handled below by re-anchoring on the doc's max id
+                continue
+            fresh.append(e)
+            max_id = max(max_id, eid)
+        doc_ids = [e.get("id") or 0 for e in doc.get("events") or ()]
+        if doc_ids and max(doc_ids) < mark:
+            # publisher restarted and ids re-zeroed: re-anchor
+            fresh = list(doc.get("events") or ())
+            max_id = max(doc_ids)
+        self._event_marks[pod] = max_id
+        ring = self._evidence.setdefault(pod, deque(maxlen=256))
+        for e in fresh:
+            if any(str(e.get("kind", "")).startswith(p)
+                   for p in _EVIDENCE_KINDS):
+                ring.append(e)
+        return fresh
+
+    # -- the pure core -----------------------------------------------------
+
+    def evaluate(self, docs, now=None):
+        """One detector window over ``{pod: obs_pub doc}``; returns the
+        ``health_report/v1`` dict (does not write the store — that is
+        :meth:`check_once`)."""
+        now = self._clock() if now is None else now
+        known = set(docs)
+        # prune state for pods that left the fleet entirely
+        for det in self._stragglers:
+            for pod in det.pods():
+                if pod not in known:
+                    det.forget(pod)
+
+        fresh_events = []
+        doc_ts = {}
+        for pod, doc in sorted(docs.items()):
+            doc_ts[pod] = doc.get("ts")
+            for e in self._ingest_events(pod, doc):
+                e = dict(e)
+                e["pod"] = pod
+                fresh_events.append(e)
+
+        findings = []
+        for det in self._stragglers:
+            samples = {}
+            for pod, doc in docs.items():
+                fam = self._family(doc, det.family)
+                if fam is None:
+                    continue
+                h_sum, h_count = self._hist_totals(fam)
+                mean = det.window_mean(pod, h_sum, h_count)
+                if mean is not None:
+                    samples[pod] = mean
+            findings.extend(det.update(samples))
+
+        findings.extend(self._staleness.update(now, doc_ts))
+        findings.extend(self._breaker.update({
+            pod: self._series_total(self._family(doc,
+                                                 self._breaker.family))
+            for pod, doc in docs.items()
+            if self._family(doc, self._breaker.family) is not None}))
+        for det in self._saturation:
+            depths = {}
+            for pod, doc in docs.items():
+                fam = self._family(doc, det.family)
+                if fam is None:
+                    continue
+                vals = [s.get("value", 0.0) for s in fam.get("series", ())]
+                if vals:
+                    depths[pod] = max(vals)
+            findings.extend(det.update(depths))
+
+        # SLOs: latency objectives from the cross-pod histogram sums
+        # (cumulative — the evaluator differentiates), event objectives
+        # from the freshly ingested timeline increments
+        slo_rows = self._eval_slos(docs, fresh_events, now)
+        for row in slo_rows:
+            if row["severity"]:
+                findings.append(Finding.make(
+                    "slo_burn", "fleet", row["severity"],
+                    "SLO %s burning %.1fx budget (short) / %.1fx (long)"
+                    % (row["slo"]["name"], row["burn_short"],
+                       row["burn_long"]),
+                    metric=row["slo"].get("family") or row["slo"]["name"],
+                    value=row["burn_short"], threshold=1.0,
+                    slo=row["slo"]["name"]))
+
+        findings.sort(key=lambda f: (-SEVERITY_RANK.get(f["severity"], 0),
+                                     f["pod"]))
+        report = self._build_report(docs, findings, slo_rows, now)
+        with self._lock:
+            self._last_report = report
+            self._victims = list(report["preferred_victims"])
+        return report
+
+    def _eval_slos(self, docs, fresh_events, now):
+        for slo in self._evaluator.slos:
+            if slo.kind == "latency":
+                total = bad = 0
+                for doc in docs.values():
+                    fam = self._family(doc, slo.family)
+                    if fam is None:
+                        continue
+                    t, b = slo_mod.hist_good_bad(fam, slo.threshold_ms,
+                                                 labels=slo.labels)
+                    total += t
+                    bad += b
+                self._evaluator.observe(slo.name, total, bad, now=now)
+        for slo in self._evaluator.slos:
+            if slo.kind == "event":
+                pairs = slo_mod.pair_event_durations(
+                    fresh_events, slo.start_kind, slo.end_kind)
+                prev = self._evaluator.last_sample(slo.name)
+                if not pairs and prev is None:
+                    continue  # never seen: keep "no data", not zeros
+                base_total = prev[1] if prev else 0.0
+                base_bad = prev[2] if prev else 0.0
+                bad = sum(1 for p in pairs
+                          if p["duration_s"] > slo.threshold_s)
+                self._evaluator.observe(slo.name,
+                                        base_total + len(pairs),
+                                        base_bad + bad, now=now)
+        return self._evaluator.evaluate(now=now)
+
+    def _build_report(self, docs, findings, slo_rows, now):
+        pods = {}
+        for pod in docs:
+            pods[pod] = {"verdict": "ok", "findings": 0}
+        for f in findings:
+            pod = f["pod"]
+            if pod == "fleet":
+                continue
+            cell = pods.setdefault(pod, {"verdict": "ok", "findings": 0})
+            cell["findings"] += 1
+            if SEVERITY_RANK.get(f["severity"], 0) \
+                    > SEVERITY_RANK.get(cell["verdict"], 0):
+                cell["verdict"] = f["severity"]
+
+        # transition events: ok -> degraded emits health.degraded (id
+        # kept so the recovery can cite its cause)
+        for pod, cell in sorted(pods.items()):
+            prev = self._pod_state.get(pod, {"verdict": "ok",
+                                             "event_id": None})
+            if cell["verdict"] != "ok" and prev["verdict"] == "ok":
+                worst = next((f for f in findings if f["pod"] == pod),
+                             None)
+                eid = self._events.emit(
+                    "health.degraded", pod=pod,
+                    severity=cell["verdict"],
+                    detector=worst["detector"] if worst else None,
+                    summary=worst["summary"] if worst else None)
+                self._pod_state[pod] = {"verdict": cell["verdict"],
+                                        "event_id": eid}
+                self._transitions.append(
+                    {"id": eid, "ts": now, "kind": "health.degraded",
+                     "pod": pod, "severity": cell["verdict"]})
+            elif cell["verdict"] == "ok" and prev["verdict"] != "ok":
+                eid = self._events.emit("health.recovered", pod=pod,
+                                        cause=prev["event_id"])
+                self._pod_state[pod] = {"verdict": "ok", "event_id": None}
+                self._transitions.append(
+                    {"id": eid, "ts": now, "kind": "health.recovered",
+                     "pod": pod, "cause": prev["event_id"]})
+            else:
+                self._pod_state[pod] = {"verdict": cell["verdict"],
+                                        "event_id": prev["event_id"]}
+
+        # attach causal evidence: the degraded-transition event id plus
+        # the pod's recent evidence ring (fault firings, breaker trips,
+        # resize phases) and the freshest trace id among them
+        for f in findings:
+            pod = f["pod"]
+            state = self._pod_state.get(pod) or {}
+            evidence = list(self._evidence.get(pod, ()))[-8:]
+            f["event_ids"] = [e.get("id") for e in evidence]
+            if state.get("event_id"):
+                f["event_ids"].append(state["event_id"])
+            trace = next((e.get("trace_id") for e in reversed(evidence)
+                          if e.get("trace_id")), None)
+            f["trace_id"] = trace
+            f["events"] = [
+                {"id": e.get("id"), "kind": e.get("kind"),
+                 "ts": e.get("ts"), "attrs": e.get("attrs") or {}}
+                for e in evidence]
+
+        degraded = sorted(p for p, c in pods.items()
+                          if c["verdict"] != "ok")
+        fleet_verdict = "ok"
+        for f in findings:
+            if SEVERITY_RANK.get(f["severity"], 0) \
+                    > SEVERITY_RANK.get(fleet_verdict, 0):
+                fleet_verdict = f["severity"]
+
+        # advisory eviction ranking: critical per-pod findings only,
+        # worst value/baseline ratio first, never the monitor itself
+        scored = {}
+        for f in findings:
+            pod = f["pod"]
+            if pod in ("fleet", self._pod_id) \
+                    or f["severity"] != "critical":
+                continue
+            base = f.get("baseline") or 0.0
+            score = (f.get("value", 0.0) / base) if base else 1.0
+            scored[pod] = max(scored.get(pod, 0.0), score)
+        victims = [p for p, _ in sorted(scored.items(),
+                                        key=lambda kv: -kv[1])]
+
+        return {
+            "schema": "health_report/v1",
+            "ts": now,
+            "monitor": self._pod_id,
+            "interval_s": self._interval,
+            "fleet": {"verdict": fleet_verdict,
+                      "pods_total": len(pods),
+                      "pods_degraded": degraded},
+            "pods": pods,
+            "findings": findings,
+            "slos": slo_rows,
+            "preferred_victims": victims,
+            "events": list(self._transitions),
+        }
+
+    # -- store-facing surface ----------------------------------------------
+
+    def check_once(self):
+        """One full tick: read docs, evaluate, publish the verdict.
+        Best-effort on the write (the verdict is recomputed next tick);
+        returns the report."""
+        report = self.evaluate(self._read_docs())
+        try:
+            self._coord.set_server_permanent(
+                self._service_health, HEALTH_KEY, json.dumps(report))
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            logger.debug("health report write failed (will retry): %r", e)
+        return report
+
+    def last_report(self):
+        with self._lock:
+            return self._last_report
+
+    def preferred_victims(self):
+        """Ranked advisory eviction order (worst straggler first) from
+        the latest tick; empty when the fleet is healthy."""
+        with self._lock:
+            return list(self._victims)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self.check_once()
+            except Exception as e:  # noqa: BLE001 — best-effort by contract
+                logger.debug("health check failed (will retry): %r", e)
+
+    def start(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="health-monitor")
+                self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self._interval + 5)
+            self._thread = None
+
+
+def load_report(coord, service=SERVICE_HEALTH):
+    """Latest ``health_report/v1`` from the store, or None."""
+    try:
+        raw = coord.get_value(service, HEALTH_KEY)
+        if not raw:
+            return None
+        doc = json.loads(raw)
+        if isinstance(doc, dict) \
+                and doc.get("schema") == "health_report/v1":
+            return doc
+    except Exception as e:  # noqa: BLE001 — absent store == no report
+        logger.debug("health report read failed: %r", e)
+    return None

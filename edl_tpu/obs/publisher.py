@@ -21,6 +21,7 @@ plane it observes.
 
 import json
 import threading
+import time
 
 from edl_tpu.obs import events as events_mod
 from edl_tpu.obs import metrics as metrics_mod
@@ -59,7 +60,11 @@ class MetricsPublisher(object):
         fresh = self._events.snapshot(since_id=self._since)
         if len(fresh) > self._max_events:
             fresh = fresh[-self._max_events:]
+        # "ts" is the staleness detector's liveness signal (obs/health):
+        # a doc whose ts stops advancing means the publisher is dead or
+        # partitioned, even though the stale doc itself stays readable
         doc = {"schema": "obs_pub/v1", "key": self._key,
+               "ts": time.time(),
                "metrics": self._registry.snapshot(),
                "events": fresh}
         self._coord.set_server_permanent(self._service, self._key,

@@ -36,6 +36,12 @@ data.assign              before a consumer asks the data leader for an
                          assignment (ctx: pod, endpoint)
 data.fetch               before a batch fetch is issued to a producer
                          (ctx: pod, endpoint, batch)
+data.fetch.delay         producer-side, inside get_batch/get_batches
+                         before the cache is read (ctx: pod, batch) —
+                         the latency twin of data.fetch: an armed delay
+                         extends the RPC wall time and lands inside the
+                         consumer's measured fetch window, so a slow
+                         data plane is seeded-reproducible
 store.repl.propose       before a leader logs a client op (ctx: kind)
 store.repl.append        before a follower handles repl_append (ctx:
                          term, leader, n)

@@ -1,0 +1,234 @@
+"""The job doctor: ranked diagnoses with causal evidence chains.
+
+``job_stats`` answers "what are the numbers"; the doctor answers "what
+is wrong and WHY". It reads the leader monitor's latest
+``health_report/v1`` verdict plus every ``obs_*`` doc, and renders each
+finding as a causal chain:
+
+    verdict -> triggering metric + baseline -> linked event ids
+            -> trace id
+
+so an operator lands on the faulting pod (and, under chaos drills, the
+exact ``fault.fired`` injection) without grepping logs. Output is a
+``doctor_report/v1`` JSON doc (the machine surface — the autoscaler and
+the acceptance harness parse this) or a human rendering; ``--watch N``
+re-diagnoses every N seconds.
+
+CLI:
+  python -m edl_tpu.tools.job_doctor --store_endpoints 127.0.0.1:2379 \
+      --job_id myjob [--json] [--watch 10]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+from edl_tpu.controller import constants, status
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import health as health_mod
+from edl_tpu.obs.publisher import KEY_PREFIX as _OBS_KEY_PREFIX
+
+#: ranking: detector class when severities tie — liveness first (a dead
+#: publisher hides every other signal from that pod), then stragglers
+#: (they gate the whole synchronous step), then fleet-wide burn, then
+#: the warn-level plumbing signals
+_DETECTOR_RANK = {"stale_publisher": 0, "straggler": 1, "slo_burn": 2,
+                  "breaker_flap": 3, "queue_saturation": 4}
+
+
+def collect(coord):
+    """Store-only scrape (no per-pod RPCs — the doctor must work when
+    pods are the problem): health report + obs docs + job status."""
+    out = {"job_id": coord.root, "health": health_mod.load_report(coord)}
+    try:
+        out["job_status"] = status.load_job_status(coord)
+    except Exception:
+        out["job_status"] = None
+    obs_pub = {}
+    try:
+        for key, raw in coord.get_service(constants.SERVICE_METRICS):
+            if not key.startswith(_OBS_KEY_PREFIX):
+                continue
+            try:
+                doc = json.loads(raw)
+            except ValueError:
+                continue
+            if isinstance(doc, dict) and doc.get("schema") == "obs_pub/v1":
+                obs_pub[key[len(_OBS_KEY_PREFIX):]] = doc
+    except Exception:
+        pass
+    out["obs"] = obs_pub
+    return out
+
+
+def _resolve_events(finding, timeline, report_events):
+    """Full event records for a finding's ``event_ids``: the finding's
+    own embedded evidence first, then the merged timeline and the
+    monitor's transition ring (the report carries both because per-pod
+    docs hold only the latest increment)."""
+    by_id = {}
+    for e in timeline:
+        by_id[(e.get("pod"), e.get("id"))] = e
+    resolved = list(finding.get("events") or ())
+    seen = {e.get("id") for e in resolved}
+    pod = finding.get("pod")
+    for eid in finding.get("event_ids") or ():
+        if eid in seen:
+            continue
+        ev = by_id.get((pod, eid))
+        if ev is None:
+            ev = next((e for e in report_events if e.get("id") == eid),
+                      None)
+        if ev is not None:
+            resolved.append(ev)
+            seen.add(eid)
+    resolved.sort(key=lambda e: (e.get("ts") or 0, e.get("id") or 0))
+    return resolved
+
+
+def _chain(finding, events):
+    """The rendered causal chain, most recent evidence last."""
+    steps = ["%s verdict on %s: %s" % (finding.get("severity"),
+                                       finding.get("pod"),
+                                       finding.get("detector"))]
+    if finding.get("metric") is not None:
+        base = finding.get("baseline")
+        steps.append("metric %s = %s%s (threshold %s)"
+                     % (finding.get("metric"), finding.get("value"),
+                        (" vs baseline %s" % base) if base is not None
+                        else "", finding.get("threshold")))
+    for e in events:
+        attrs = e.get("attrs") or {}
+        detail = " ".join("%s=%s" % kv for kv in sorted(attrs.items()))
+        steps.append("event #%s %s%s" % (e.get("id"), e.get("kind"),
+                                         (" " + detail) if detail else ""))
+    if finding.get("trace_id"):
+        steps.append("trace %s" % finding["trace_id"])
+    return steps
+
+
+def diagnose(collected, now=None):
+    """Pure: a ``collect()`` doc -> ``doctor_report/v1``."""
+    now = time.time() if now is None else now
+    health = collected.get("health")
+    obs = collected.get("obs") or {}
+    timeline = obs_events.merge_timelines(
+        {pod: doc.get("events") or [] for pod, doc in obs.items()})
+    report = {
+        "schema": "doctor_report/v1",
+        "ts": now,
+        "job_id": collected.get("job_id"),
+        "job_status": collected.get("job_status"),
+        "pods_published": sorted(obs),
+    }
+    if health is None:
+        report["verdict"] = "unknown"
+        report["summary"] = ("no health_report/v1 in the store — the "
+                             "leader HealthMonitor has not run (job too "
+                             "young, or no leader elected)")
+        report["findings"] = []
+        report["slos"] = []
+        return report
+
+    report["verdict"] = (health.get("fleet") or {}).get("verdict", "ok")
+    report["report_age_s"] = round(max(0.0, now - (health.get("ts")
+                                                   or now)), 1)
+    report["monitor"] = health.get("monitor")
+    report["pods"] = health.get("pods") or {}
+    findings = sorted(
+        health.get("findings") or (),
+        key=lambda f: (-health_mod.SEVERITY_RANK.get(f.get("severity"),
+                                                     0),
+                       _DETECTOR_RANK.get(f.get("detector"), 9)))
+    out_findings = []
+    for rank, f in enumerate(findings, 1):
+        events = _resolve_events(f, timeline,
+                                 health.get("events") or ())
+        out_findings.append({
+            "rank": rank,
+            "pod": f.get("pod"),
+            "detector": f.get("detector"),
+            "severity": f.get("severity"),
+            "summary": f.get("summary"),
+            "metric": f.get("metric"),
+            "value": f.get("value"),
+            "baseline": f.get("baseline"),
+            "threshold": f.get("threshold"),
+            "trace_id": f.get("trace_id"),
+            "chain": _chain(f, events),
+            "event_ids": f.get("event_ids") or [],
+        })
+    report["findings"] = out_findings
+    report["slos"] = health.get("slos") or []
+    report["preferred_victims"] = health.get("preferred_victims") or []
+    if out_findings:
+        head = out_findings[0]
+        report["summary"] = ("%d finding(s); worst: %s on %s — %s"
+                             % (len(out_findings), head["detector"],
+                                head["pod"], head["summary"]))
+    else:
+        report["summary"] = ("fleet healthy: %d pod(s) publishing, no "
+                             "degraded verdicts"
+                             % len(report["pods_published"]))
+    return report
+
+
+def render(report, width=76):
+    """Human rendering of a doctor_report/v1 doc."""
+    lines = []
+    lines.append("job %s  verdict=%s  status=%s"
+                 % (report.get("job_id"), report.get("verdict"),
+                    report.get("job_status")))
+    if report.get("report_age_s") is not None:
+        lines.append("  health report by %s, %.1fs old"
+                     % (report.get("monitor"), report["report_age_s"]))
+    lines.append("  %s" % report.get("summary"))
+    for f in report.get("findings") or ():
+        lines.append("finding #%d [%s] %s on %s"
+                     % (f["rank"], f["severity"], f["detector"],
+                        f["pod"]))
+        for step in f["chain"]:
+            lines.append(("    -> %s" % step)[:width * 2])
+    burning = [r for r in report.get("slos") or () if r.get("severity")]
+    for r in burning:
+        lines.append("slo %s [%s] burn short=%sx long=%sx"
+                     % (r["slo"]["name"], r["severity"],
+                        r.get("burn_short"), r.get("burn_long")))
+    victims = report.get("preferred_victims")
+    if victims:
+        lines.append("preferred scale-in victims: %s"
+                     % ", ".join(victims))
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="diagnose a job from its health + obs docs")
+    ap.add_argument("--store_endpoints", required=True)
+    ap.add_argument("--job_id", required=True)
+    ap.add_argument("--json", action="store_true",
+                    help="emit doctor_report/v1 JSON instead of text")
+    ap.add_argument("--watch", type=float, default=None, metavar="SEC",
+                    help="re-diagnose every SEC seconds until ^C")
+    args = ap.parse_args(argv)
+    coord = CoordClient(args.store_endpoints.split(","), root=args.job_id)
+    while True:
+        report = diagnose(collect(coord))
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print(render(report))
+        if args.watch is None:
+            return 0
+        sys.stdout.flush()
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+        print()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

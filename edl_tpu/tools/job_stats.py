@@ -25,6 +25,7 @@ from edl_tpu.controller import constants, status
 from edl_tpu.controller.resource_pods import load_resource_pods
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import health as obs_health
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs.publisher import KEY_PREFIX as _OBS_KEY_PREFIX
 from edl_tpu.rpc.client import RpcClient
@@ -125,6 +126,8 @@ def collect_job_stats(coord, rpc_timeout=5.0):
                             if snaps else None)
     out["timeline"] = obs_events.merge_timelines(
         {pod: doc.get("events") or [] for pod, doc in obs_pub.items()})
+    # the leader monitor's latest verdict doc (None until it has run)
+    out["health"] = obs_health.load_report(coord)
     return out
 
 
@@ -163,6 +166,27 @@ def format_fleet(doc, width=72):
                     lines.append("%s min=%s max=%s sum=%s"
                                  % (head, s.get("min"), s.get("max"),
                                     s.get("sum")))
+    health = doc.get("health")
+    if health:
+        fl = health.get("fleet") or {}
+        lines.append("health: %s (%d/%s pods degraded, report %s)"
+                     % (fl.get("verdict", "?"),
+                        len(fl.get("pods_degraded") or ()),
+                        fl.get("pods_total", "?"),
+                        health.get("monitor")))
+        for f in (health.get("findings") or ())[:8]:
+            lines.append("  [%s] %s %s: %s"
+                         % (f.get("severity"), f.get("detector"),
+                            f.get("pod"), f.get("summary")))
+        for r in health.get("slos") or ():
+            if r.get("severity"):
+                lines.append("  [%s] slo %s burn short=%sx long=%sx"
+                             % (r["severity"], r["slo"]["name"],
+                                r.get("burn_short"), r.get("burn_long")))
+        victims = health.get("preferred_victims")
+        if victims:
+            lines.append("  preferred scale-in victims: %s"
+                         % ", ".join(victims))
     timeline = doc.get("timeline") or []
     if timeline:
         lines.append("timeline (last %d of %d events):"

@@ -17,6 +17,14 @@ at observation time. This bench quantifies both halves:
   numbers; the arc delta is noisy on shared CI boxes, which is why the
   tier-1 guard checks the schema only and the <2% acceptance number is
   measured offline (same policy as every other bench in the tree).
+- ``detectors`` — the ACTIVE layer's cost and latency: one
+  HealthMonitor.evaluate() tick over a synthetic fleet of ``pods``
+  snapshot docs, timed per window (``overhead_pct_of_interval`` is the
+  tick cost relative to the publish interval — the <2% criterion for
+  the detector arc), plus an injected-straggler run: one pod's step
+  time is multiplied from a known window on and the bench reports how
+  many windows the straggler detector took to flag it (and that the
+  clean warm-up windows produced zero findings).
 
 Usage:
     JAX_PLATFORMS=cpu python -m edl_tpu.tools.obs_bench --micro
@@ -79,6 +87,104 @@ def bench_primitives(n=_PRIMITIVE_N):
     return out
 
 
+def _synth_fleet_docs(pods, window, step_ms_by_pod, state, base_ts,
+                      interval_s, steps_per_window=20):
+    """One window's ``{pod: obs_pub doc}`` for the detector bench:
+    per-pod cumulative ``edl_train_step_ms`` histograms advanced by
+    ``steps_per_window`` observations at that pod's current step time.
+    ``state`` carries the running (sum, count, buckets) per pod."""
+    bounds = list(obs_metrics.DEFAULT_BUCKETS)
+    docs = {}
+    for p in range(pods):
+        pod = "pod-%02d" % p
+        step_ms = step_ms_by_pod[pod]
+        st = state.setdefault(pod, {"sum": 0.0, "count": 0,
+                                    "buckets": [0] * (len(bounds) + 1)})
+        idx = len(bounds)
+        for i, b in enumerate(bounds):
+            if step_ms <= b:
+                idx = i
+                break
+        st["sum"] += step_ms * steps_per_window
+        st["count"] += steps_per_window
+        st["buckets"][idx] += steps_per_window
+        docs[pod] = {
+            "schema": "obs_pub/v1", "key": "obs_" + pod,
+            "ts": base_ts + window * interval_s,
+            "metrics": {
+                "schema": "obs_snapshot/v1",
+                "ts": base_ts + window * interval_s,
+                "pid": 0, "series_dropped": 0,
+                "metrics": {"edl_train_step_ms": {
+                    "kind": "histogram", "help": "", "labelnames": [],
+                    "bounds": bounds,
+                    "series": [{"labels": {},
+                                "buckets": list(st["buckets"]),
+                                "sum": st["sum"],
+                                "count": st["count"]}]}}},
+            "events": []}
+    return docs
+
+
+def bench_detectors(pods=8, windows=24, interval_s=10.0,
+                    base_step_ms=100.0, slow_factor=6.0):
+    """Detector-overhead + detection-latency arc (see module
+    docstring). Synthetic snapshots, virtual clock — exact and immune
+    to host load except for the tick timing itself."""
+    from edl_tpu.obs import events as obs_events
+    from edl_tpu.obs import health as obs_health
+
+    base_ts = 1_000_000.0
+    monitor = obs_health.HealthMonitor(
+        coord=None, pod_id="bench-monitor", interval=interval_s,
+        events=obs_events.EventLog(),
+        clock=lambda: base_ts)  # evaluate() is always passed `now`
+    victim = "pod-%02d" % (pods - 1)
+    inject_at = windows // 2
+    state = {}
+    tick_s = []
+    detected_window = None
+    clean_findings = 0
+    for w in range(windows):
+        step_ms_by_pod = {
+            "pod-%02d" % p: (base_step_ms * slow_factor
+                             if w >= inject_at
+                             and "pod-%02d" % p == victim
+                             else base_step_ms)
+            for p in range(pods)}
+        docs = _synth_fleet_docs(pods, w, step_ms_by_pod, state,
+                                 base_ts, interval_s)
+        t0 = time.perf_counter()
+        report = monitor.evaluate(docs, now=base_ts + w * interval_s)
+        tick_s.append(time.perf_counter() - t0)
+        stragglers = {f["pod"] for f in report["findings"]
+                      if f["detector"] == "straggler"}
+        if w < inject_at:
+            clean_findings += len(report["findings"])
+        elif detected_window is None and victim in stragglers:
+            detected_window = w
+    tick_sorted = sorted(tick_s)
+    tick_p50 = tick_sorted[len(tick_sorted) // 2]
+    return {
+        "pods": pods,
+        "windows": windows,
+        "interval_s": interval_s,
+        "tick_ms_p50": round(tick_p50 * 1e3, 4),
+        "tick_ms_max": round(tick_sorted[-1] * 1e3, 4),
+        "overhead_pct_of_interval": round(
+            100.0 * tick_p50 / interval_s, 4),
+        "straggler": {
+            "victim": victim,
+            "injected_window": inject_at,
+            "detected_window": detected_window,
+            "detection_windows": (detected_window - inject_at + 1
+                                  if detected_window is not None
+                                  else None),
+            "clean_false_positives": clean_findings,
+        },
+    }
+
+
 def _run_data_arc(cfg):
     """One pipelined-columnar data_bench arc over fresh on-disk data;
     returns the arc's stats dict (records_s is the headline)."""
@@ -119,6 +225,7 @@ def run(mode="micro", **cfg):
         "off": arcs["off"],
         "overhead_pct": overhead,
         "primitives": bench_primitives(),
+        "detectors": bench_detectors(),
     }
 
 

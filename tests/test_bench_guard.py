@@ -276,4 +276,70 @@ def test_obs_bench_micro_schema():
                    "histogram_observe_ns", "span_noop_ns"):
             assert prim[state][op] > 0
     assert obs_metrics.enabled()  # the bench must restore the switch
+    det = out["detectors"]
+    assert det["pods"] >= 2 and det["windows"] > 0
+    assert det["tick_ms_p50"] > 0
+    assert det["tick_ms_max"] >= det["tick_ms_p50"]
+    strag = det["straggler"]
+    assert strag["clean_false_positives"] == 0
+    assert strag["detected_window"] is not None
+    # the detection-latency acceptance bound: the injected straggler is
+    # flagged within 2 publish windows (virtual clock — not host-noisy)
+    assert strag["detection_windows"] <= 2
     json.dumps(out)  # the whole report is JSON-serializable
+
+
+def test_health_report_schema():
+    """health_report/v1 contract: every field the doctor and job_stats
+    consume, produced by a real HealthMonitor.evaluate() pass over the
+    detector bench's synthetic fleet."""
+    import json
+
+    from edl_tpu.obs import events as obs_events
+    from edl_tpu.obs import health as obs_health
+    from edl_tpu.tools import obs_bench
+
+    monitor = obs_health.HealthMonitor(
+        coord=None, pod_id="guard-monitor", interval=10.0,
+        events=obs_events.EventLog(), clock=lambda: 1_000_000.0)
+    state = {}
+    steps = {"pod-%02d" % p: (600.0 if p == 3 else 100.0)
+             for p in range(4)}
+    report = None
+    for w in range(4):
+        docs = obs_bench._synth_fleet_docs(4, w, steps, state,
+                                           1_000_000.0, 10.0)
+        report = monitor.evaluate(docs, now=1_000_000.0 + w * 10.0)
+    assert report["schema"] == "health_report/v1"
+    assert report["fleet"]["verdict"] == "critical"
+    assert report["fleet"]["pods_total"] == 4
+    assert report["fleet"]["pods_degraded"] == ["pod-03"]
+    assert set(report["pods"]) == set(steps)
+    assert report["pods"]["pod-03"]["verdict"] == "critical"
+    f = report["findings"][0]
+    for field in ("detector", "pod", "severity", "summary", "metric",
+                  "value", "baseline", "threshold"):
+        assert field in f
+    assert isinstance(report["slos"], list)
+    assert report["preferred_victims"] == ["pod-03"]
+    kinds = [e["kind"] for e in report["events"]]
+    assert "health.degraded" in kinds
+    json.dumps(report)
+
+
+def test_doctor_report_schema():
+    """doctor_report/v1 contract, including the no-monitor degradation:
+    verdict "unknown" with an explanatory summary when no health report
+    has ever been published."""
+    import json
+
+    from edl_tpu.tools import job_doctor
+
+    doc = job_doctor.diagnose({"job_id": "j", "job_status": None,
+                               "health": None, "obs": {}})
+    assert doc["schema"] == "doctor_report/v1"
+    assert doc["verdict"] == "unknown"
+    assert doc["findings"] == []
+    assert doc["summary"]
+    json.dumps(doc)
+    job_doctor.render(doc)  # the human surface renders without a report

@@ -220,3 +220,107 @@ def test_generator_failover_guard_holds_membership(coord):
     finally:
         gen.stop()
         reg_a.stop()
+
+
+# -- health-advisory eviction ordering (PR: fleet health verdicts) ---------
+
+
+class _NullCoord(object):
+    """Just enough store surface for a direct _next_cluster call."""
+
+    def get_key(self, key):
+        return None
+
+    def get_service(self, service):
+        return []
+
+
+def _victim_gen(victims, leader_id):
+    # room for a 5th pod to join, but topology caps the cluster at 4:
+    # admitting the joiner forces a one-pod drop, which is where the
+    # eviction-order hook bites
+    return Generator(_NullCoord(), leader_id, min_nodes=1, max_nodes=5,
+                     topology_valid=lambda n: n <= 4,
+                     preferred_victims=lambda: victims)
+
+
+def _cluster_of(pods):
+    c = cluster_mod.Cluster()
+    c.pods = list(pods)
+    return c
+
+
+def test_scale_in_evicts_flagged_straggler_over_tail_default():
+    """A joiner over capacity forces a one-pod drop; with a health
+    verdict naming pod c, the eviction lands on c and the newcomer is
+    admitted (default order would have dropped the newcomer)."""
+    a, b, c, d, e = (_pod() for _ in range(5))
+    gen = _victim_gen([c.id], a.id)
+    resources = {p.id: p for p in (a, b, c, d, e)}
+    new = gen._next_cluster(_cluster_of([a, b, c, d]), resources, {})
+    assert new is not None
+    assert set(p.id for p in new.pods) == {a.id, b.id, d.id, e.id}
+
+
+def test_scale_in_takes_worst_ranked_victim_first():
+    """Victims are ranked worst-first by the monitor; a single-pod drop
+    must consume rank 0, not whichever victim happens to sit later."""
+    a, b, c, d, e = (_pod() for _ in range(5))
+    gen = _victim_gen([c.id, b.id], a.id)  # c is ranked worse than b
+    resources = {p.id: p for p in (a, b, c, d, e)}
+    new = gen._next_cluster(_cluster_of([a, b, c, d]), resources, {})
+    ids = set(p.id for p in new.pods)
+    assert c.id not in ids and b.id in ids
+
+
+def test_scale_in_never_evicts_the_leader():
+    """The hook is advisory: flagging the generator's own pod must not
+    decapitate the job."""
+    a, b, c, d, e = (_pod() for _ in range(5))
+    gen = _victim_gen([a.id], a.id)
+    resources = {p.id: p for p in (a, b, c, d, e)}
+    new = gen._next_cluster(_cluster_of([a, b, c, d]), resources, {})
+    ids = set(p.id for p in new.pods)
+    assert a.id in ids and e.id not in ids  # default tail-drop instead
+
+
+def test_scale_in_victim_hook_fails_open():
+    a, b, c, d, e = (_pod() for _ in range(5))
+
+    def boom():
+        raise RuntimeError("monitor not ready")
+
+    gen = Generator(_NullCoord(), a.id, min_nodes=1, max_nodes=5,
+                    topology_valid=lambda n: n <= 4,
+                    preferred_victims=boom)
+    resources = {p.id: p for p in (a, b, c, d, e)}
+    new = gen._next_cluster(_cluster_of([a, b, c, d]), resources, {})
+    assert set(p.id for p in new.pods) == {a.id, b.id, c.id, d.id}
+
+
+def test_generator_loop_scale_in_prefers_flagged_straggler(coord):
+    """End to end against the store: an even-sizes-only topology forces
+    a 4->2 shrink when one pod dies; the health-flagged pod is evicted
+    instead of the tail default."""
+    pods = [_pod() for _ in range(4)]
+    regs = [ResourceRegister(coord, p) for p in pods]
+    leader = pods[0]
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, leader.id)
+    gen = Generator(coord, leader.id, min_nodes=2, max_nodes=4,
+                    topology_valid=lambda n: n % 2 == 0,
+                    below_min_grace=1.0,
+                    preferred_victims=lambda: [pods[1].id]).start()
+    try:
+        _wait(lambda: (lambda c: c and len(c.pods) == 4)(
+            cluster_mod.load_from_store(coord)))
+        regs[3].stop()  # pod 3 dies; 3 is topology-invalid -> shrink to 2
+        c2 = _wait(lambda: (lambda c: c if c and len(c.pods) == 2
+                            else None)(cluster_mod.load_from_store(coord)))
+        assert set(c2.pod_ids()) == {pods[0].id, pods[2].id}, \
+            "flagged straggler survived the shrink"
+    finally:
+        gen.stop()
+        for i, r in enumerate(regs):
+            if i != 3:
+                r.stop()

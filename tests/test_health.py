@@ -1,0 +1,469 @@
+"""The active observability layer: straggler/staleness/flap/saturation
+detectors, SLO burn rates, the leader HealthMonitor's verdict doc +
+transition events, the generator's victim ordering, and the chaos
+drill the PR's acceptance criterion names — a seeded latency fault on
+one pod's data plane must be flagged (that pod exactly) within 2
+publish intervals, the job doctor must name the fault event in its
+causal chain, and a clean run of the same length must stay green."""
+
+import json
+import time
+
+import pytest
+
+from edl_tpu.data.data_server import BatchCache, DataPlaneServer
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import health as obs_health
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs import slo as obs_slo
+from edl_tpu.obs.publisher import MetricsPublisher
+from edl_tpu.robustness import faults
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.tools import job_doctor
+
+
+class _FleetCoord(object):
+    """The store surface the publisher, monitor and doctor share."""
+
+    def __init__(self):
+        self.store = {}
+        self.root = "test_job"
+
+    def set_server_permanent(self, service, server, value):
+        self.store[(service, server)] = value
+
+    def get_service(self, service):
+        return [(server, v) for (s, server), v in sorted(self.store.items())
+                if s == service]
+
+    def get_value(self, service, server):
+        return self.store.get((service, server))
+
+
+# -- straggler detector ----------------------------------------------------
+
+
+def test_straggler_flags_k_mad_above_median_for_n_windows():
+    det = obs_health.StragglerDetector("edl_train_step_ms")
+    base = {"a": 100.0, "b": 102.0, "c": 98.0}
+    for _ in range(3):
+        assert det.update(dict(base, d=101.0)) == []
+    # d turns slow: first over-threshold window arms the streak only
+    assert det.update(dict(base, d=500.0)) == []
+    flagged = det.update(dict(base, d=500.0))
+    assert [f["pod"] for f in flagged] == ["d"]
+    f = flagged[0]
+    assert f["severity"] == "critical" and f["detector"] == "straggler"
+    assert f["metric"] == "edl_train_step_ms"
+    assert f["value"] > f["threshold"] > f["baseline"]
+    assert f["windows"] >= 2
+    # recovery: the EWMA decays back under threshold within a few good
+    # windows and the flag clears (no one-window flap in either direction)
+    for _ in range(4):
+        det.update(dict(base, d=101.0))
+    assert det.update(dict(base, d=101.0)) == []
+
+
+def test_straggler_single_pod_fleet_never_flags():
+    """No peers to compare against -> no verdict, however wild the
+    values (edge case #1 from the issue)."""
+    det = obs_health.StragglerDetector("edl_train_step_ms")
+    for mean in (10.0, 5000.0, 10.0, 9000.0, 8000.0):
+        assert det.update({"solo": mean}) == []
+
+
+def test_straggler_cold_join_spike_is_not_flagged():
+    """A pod joining mid-window after a resize pays one-off costs
+    (compile, cold cache) in its first window; the warmup re-seed must
+    keep that spike out of the EWMA so the joiner is never flagged
+    once it converges (edge case #2)."""
+    det = obs_health.StragglerDetector("edl_train_step_ms")
+    base = {"a": 100.0, "b": 101.0, "c": 99.0}
+    for _ in range(4):
+        det.update(base)
+    assert det.update(dict(base, d=5000.0)) == []  # the compile window
+    for _ in range(4):  # converged: stays unflagged forever after
+        assert det.update(dict(base, d=103.0)) == []
+
+
+def test_straggler_cold_fleet_genuinely_slow_pod_flagged_in_2_windows():
+    """All pods cold (fresh monitor after an election): a pod slow from
+    its FIRST window is still flagged by its second — warmup must not
+    add latency on top of the n_windows streak."""
+    det = obs_health.StragglerDetector("edl_train_step_ms")
+    assert det.update({"a": 100.0, "b": 100.0, "c": 600.0}) == []
+    flagged = det.update({"a": 100.0, "b": 100.0, "c": 600.0})
+    assert [f["pod"] for f in flagged] == ["c"]
+
+
+def test_straggler_window_mean_reanchors_on_counter_reset():
+    det = obs_health.StragglerDetector("edl_train_step_ms")
+    assert det.window_mean("a", 1000.0, 10) is None  # first sight
+    assert det.window_mean("a", 2000.0, 20) == pytest.approx(100.0)
+    assert det.window_mean("a", 50.0, 1) is None     # restart: re-anchor
+    assert det.window_mean("a", 150.0, 2) == pytest.approx(100.0)
+
+
+def test_straggler_tight_fleet_does_not_flag_jitter():
+    """MAD ~ 0 on a homogeneous fleet: the min_delta/min_rel floors keep
+    micro-jitter below the flag line."""
+    det = obs_health.StragglerDetector("edl_train_step_ms")
+    for _ in range(6):
+        assert det.update({"a": 100.0, "b": 100.2, "c": 100.4,
+                           "d": 101.0}) == []
+
+
+# -- other detectors -------------------------------------------------------
+
+
+def test_breaker_flap_detector():
+    det = obs_health.BreakerFlapDetector(window_count=4, flap_threshold=2)
+    assert det.update({"a": 0.0}) == []   # anchor
+    assert det.update({"a": 1.0}) == []   # 1 flap window
+    flagged = det.update({"a": 2.0})      # 2 of last 2
+    assert [f["pod"] for f in flagged] == ["a"]
+    assert flagged[0]["detector"] == "breaker_flap"
+    assert flagged[0]["severity"] == "warn"
+    # quiet windows age the flaps out of the ring
+    for _ in range(4):
+        det.update({"a": 2.0})
+    assert det.update({"a": 2.0}) == []
+
+
+def test_queue_saturation_detector():
+    det = obs_health.QueueSaturationDetector("edl_teacher_queue_depth",
+                                             threshold=10, n_windows=2)
+    assert det.update({"a": 12.0}) == []
+    flagged = det.update({"a": 15.0})
+    assert [f["pod"] for f in flagged] == ["a"]
+    assert flagged[0]["detector"] == "queue_saturation"
+    assert det.update({"a": 3.0}) == []  # drained: streak resets
+    assert det.update({"a": 15.0}) == []
+
+
+# -- SLO burn rates --------------------------------------------------------
+
+
+def test_hist_good_bad_snaps_threshold_to_bucket_bound():
+    fam = {"bounds": [1.0, 2.0, 5.0],
+           "series": [{"labels": {}, "buckets": [1, 2, 1, 1], "sum": 0.0,
+                       "count": 5}]}
+    assert obs_slo.hist_good_bad(fam, 2.0) == (5, 2)
+    # 3.0 snaps UP to the le=5 bound: only +Inf observations are bad
+    assert obs_slo.hist_good_bad(fam, 3.0) == (5, 1)
+    assert obs_slo.hist_good_bad(fam, 100.0) == (5, 1)
+
+
+def test_hist_good_bad_label_filter():
+    fam = {"bounds": [1.0],
+           "series": [
+               {"labels": {"method": "predict"}, "buckets": [0, 4],
+                "sum": 0.0, "count": 4},
+               {"labels": {"method": "other"}, "buckets": [9, 0],
+                "sum": 0.0, "count": 9}]}
+    assert obs_slo.hist_good_bad(fam, 1.0,
+                                 labels={"method": "predict"}) == (4, 4)
+
+
+def test_burn_rate_pages_only_when_both_windows_burn():
+    slo = obs_slo.Slo.latency("t", "train", "m", threshold_ms=1.0,
+                              target=0.999)
+    ev = obs_slo.BurnRateEvaluator(slos=(slo,), short_window=60,
+                                   long_window=120, clock=lambda: 0)
+    # sustained burn: 2% errors against a 0.1% budget in BOTH windows
+    ev.observe("t", 0, 0, now=0)
+    ev.observe("t", 6000, 120, now=60)
+    ev.observe("t", 12000, 240, now=120)
+    row = ev.evaluate(now=120)[0]
+    assert row["severity"] == "critical"
+    assert row["burn_short"] >= 14.4 and row["burn_long"] >= 14.4
+
+    # short-window spike over a long healthy history: page suppressed
+    ev2 = obs_slo.BurnRateEvaluator(slos=(slo,), short_window=60,
+                                    long_window=120, clock=lambda: 0)
+    ev2.observe("t", 0, 0, now=0)
+    ev2.observe("t", 60000, 0, now=60)
+    ev2.observe("t", 66000, 120, now=120)
+    row2 = ev2.evaluate(now=120)[0]
+    assert row2["burn_short"] >= 14.4
+    assert row2["burn_long"] < 6.0
+    assert row2["severity"] is None
+
+
+def test_burn_rate_no_traffic_is_not_a_violation():
+    slo = obs_slo.Slo.latency("t", "train", "m", threshold_ms=1.0,
+                              target=0.99)
+    ev = obs_slo.BurnRateEvaluator(slos=(slo,))
+    row = ev.evaluate(now=100)[0]
+    assert row["burn_short"] is None and row["severity"] is None
+    # a counter reset (restart) clears instead of going negative
+    ev.observe("t", 1000, 10, now=10)
+    ev.observe("t", 50, 0, now=20)
+    ev.observe("t", 100, 0, now=30)
+    row = ev.evaluate(now=30)[0]
+    assert row["severity"] is None
+
+
+def test_pair_event_durations():
+    events = [
+        {"id": 1, "ts": 10.0, "kind": "resize.coordinated_stop",
+         "pod": "a"},
+        {"id": 2, "ts": 11.0, "kind": "resize.coordinated_stop",
+         "pod": "b"},
+        {"id": 3, "ts": 14.0, "kind": "resize.resumed", "pod": "a"},
+        # b's resize still in flight; c's end has no observed start
+        {"id": 4, "ts": 15.0, "kind": "resize.resumed", "pod": "c"},
+    ]
+    pairs = obs_slo.pair_event_durations(events, "resize.coordinated_stop",
+                                         "resize.resumed")
+    assert len(pairs) == 1
+    assert pairs[0]["pod"] == "a"
+    assert pairs[0]["duration_s"] == pytest.approx(4.0)
+    assert (pairs[0]["start_id"], pairs[0]["end_id"]) == (1, 3)
+
+
+# -- HealthMonitor ---------------------------------------------------------
+
+
+def _pub(coord, pod, registry, log):
+    return MetricsPublisher(coord, pod, interval=999, registry=registry,
+                            events=log)
+
+
+def test_monitor_stale_publisher_then_recovery_event():
+    """Publisher death -> stale verdict -> recovery event citing the
+    degraded event as its cause (edge case #3)."""
+    coord = _FleetCoord()
+    reg_a, reg_b = obs_metrics.MetricsRegistry(), \
+        obs_metrics.MetricsRegistry()
+    log = obs_events.EventLog()
+    pub_a = _pub(coord, "a", reg_a, obs_events.EventLog())
+    pub_b = _pub(coord, "b", reg_b, obs_events.EventLog())
+    monitor = obs_health.HealthMonitor(coord, "mon", interval=10,
+                                       stale_after=30.0, events=log)
+    pub_a.publish_once()
+    pub_b.publish_once()
+    r1 = monitor.check_once()
+    assert r1["fleet"]["verdict"] == "ok"
+    assert json.loads(coord.store[(obs_health.SERVICE_HEALTH,
+                                   obs_health.HEALTH_KEY)])[
+        "schema"] == "health_report/v1"
+
+    # b's publisher dies: its doc ts freezes while a keeps publishing
+    stale = json.loads(coord.store[("metrics", "obs_b")])
+    stale["ts"] = time.time() - 120.0
+    coord.store[("metrics", "obs_b")] = json.dumps(stale)
+    pub_a.publish_once()
+    r2 = monitor.check_once()
+    assert r2["pods"]["b"]["verdict"] == "critical"
+    assert r2["fleet"]["pods_degraded"] == ["b"]
+    finding = next(f for f in r2["findings"] if f["pod"] == "b")
+    assert finding["detector"] == "stale_publisher"
+    degraded = log.last("health.degraded")
+    assert degraded is not None and degraded["attrs"]["pod"] == "b"
+
+    # the publisher returns: verdict clears, recovery cites the cause
+    pub_b.publish_once()
+    r3 = monitor.check_once()
+    assert r3["fleet"]["verdict"] == "ok"
+    recovered = log.last("health.recovered")
+    assert recovered is not None
+    assert recovered["attrs"]["pod"] == "b"
+    assert recovered["cause"] == degraded["id"]
+    # both transitions ride the report for the doctor
+    kinds = [e["kind"] for e in r3["events"]]
+    assert kinds.count("health.degraded") == 1
+    assert kinds.count("health.recovered") == 1
+
+
+def test_monitor_victims_exclude_self_and_rank_worst_first():
+    coord = _FleetCoord()
+    monitor = obs_health.HealthMonitor(coord, "self-pod", interval=10,
+                                       stale_after=1e9,
+                                       events=obs_events.EventLog())
+    bounds = [10.0, 100.0, 1000.0]
+
+    def docs(step_by_pod, cum):
+        out = {}
+        for pod, step in step_by_pod.items():
+            st = cum.setdefault(pod, {"sum": 0.0, "count": 0})
+            st["sum"] += step * 10
+            st["count"] += 10
+            out[pod] = {
+                "schema": "obs_pub/v1", "key": "obs_" + pod,
+                "ts": time.time(),
+                "metrics": {"schema": "obs_snapshot/v1",
+                            "ts": time.time(), "pid": 1,
+                            "series_dropped": 0,
+                            "metrics": {"edl_train_step_ms": {
+                                "kind": "histogram", "help": "",
+                                "labelnames": [], "bounds": bounds,
+                                "series": [{"labels": {},
+                                            "buckets": [0, 0, 0, 0],
+                                            "sum": st["sum"],
+                                            "count": st["count"]}]}}},
+                "events": []}
+        return out
+
+    cum = {}
+    steps = {"self-pod": 900.0, "w1": 100.0, "w2": 100.0, "w4": 100.0,
+             "w3": 400.0}
+    monitor.evaluate(docs(steps, cum))
+    for _ in range(3):
+        report = monitor.evaluate(docs(steps, cum))
+    flagged = {f["pod"] for f in report["findings"]
+               if f["detector"] == "straggler"}
+    # the monitor's own pod IS flagged (the verdict is honest)...
+    assert flagged == {"self-pod", "w3"}
+    # ...but never offered as a scale-in victim (advisory contract)
+    assert report["preferred_victims"] == ["w3"]
+    assert monitor.preferred_victims() == ["w3"]
+
+
+# -- the chaos drill -------------------------------------------------------
+
+
+def _run_drill(faulted, windows=3, fetches=4, delay_s=0.04):
+    """Anchor window (pre-fault baseline), then ``windows`` rounds of
+    fetch -> publish -> check. Returns (coord, flagged_at, reports)."""
+    coord = _FleetCoord()
+    pods = ["pod-a", "pod-b", "pod-c"]
+    victim = "pod-c"
+    obs_events.EVENTS.clear()
+    servers, pubs, hists, clients = {}, {}, {}, {}
+    plane = None
+    try:
+        for p in pods:
+            servers[p] = DataPlaneServer(BatchCache(capacity=8),
+                                         pod_id=p).start()
+            reg = obs_metrics.MetricsRegistry()
+            # the victim publishes the GLOBAL ring so the fault plane's
+            # fault.fired emissions ride its doc (they fire in-process
+            # on the producer, which in this drill is this process)
+            log = (obs_events.EVENTS if p == victim
+                   else obs_events.EventLog())
+            pubs[p] = _pub(coord, p, reg, log)
+            hists[p] = reg.histogram("edl_reader_fetch_ms",
+                                     "batch fetch wire ms")
+            clients[p] = RpcClient(servers[p].endpoint)
+
+        monitor = obs_health.HealthMonitor(coord, "monitor-pod",
+                                           interval=999, stale_after=1e9,
+                                           events=obs_events.EventLog())
+
+        def window(w):
+            for p in pods:
+                for i in range(fetches):
+                    with hists[p].time_ms():
+                        clients[p].call("get_batches",
+                                        ["w%d-%d" % (w, i)])
+                pubs[p].publish_once()
+            return monitor.check_once()
+
+        reports = [window(0)]  # anchor: establishes cumulative baselines
+        if faulted:
+            plane = faults.FaultPlane(seed=7)
+            plane.inject("data.fetch.delay", "delay", seconds=delay_s,
+                         pod=victim)
+            plane.install()
+        flagged_at = None
+        for w in range(1, windows + 1):
+            report = window(w)
+            reports.append(report)
+            stragglers = {f["pod"] for f in report["findings"]
+                          if f["detector"] == "straggler"}
+            if stragglers and flagged_at is None:
+                flagged_at = w
+                assert stragglers == {victim}
+        return coord, flagged_at, reports
+    finally:
+        if plane is not None:
+            plane.uninstall()
+        for c in clients.values():
+            c.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_chaos_drill_detects_exactly_the_faulted_pod():
+    """The acceptance drill: a seeded data.fetch.delay on one pod's
+    data plane is flagged — that pod exactly — within 2 publish
+    intervals of the fault arming, and the doctor's causal chain names
+    the fault event."""
+    coord, flagged_at, reports = _run_drill(faulted=True)
+    assert flagged_at is not None and flagged_at <= 2
+    final = reports[-1]
+    assert final["fleet"]["verdict"] == "critical"
+    assert final["fleet"]["pods_degraded"] == ["pod-c"]
+    assert final["preferred_victims"] == ["pod-c"]
+
+    doc = job_doctor.diagnose(job_doctor.collect(coord))
+    assert doc["schema"] == "doctor_report/v1"
+    assert doc["verdict"] == "critical"
+    top = doc["findings"][0]
+    assert top["pod"] == "pod-c" and top["detector"] == "straggler"
+    chain = "\n".join(top["chain"])
+    assert "fault.fired" in chain          # the causal evidence...
+    assert "data.fetch.delay" in chain     # ...names the fault point
+    rendered = job_doctor.render(doc)
+    assert "pod-c" in rendered and "fault.fired" in rendered
+    assert "preferred scale-in victims: pod-c" in rendered
+    json.dumps(doc)  # the machine surface round-trips
+
+
+def test_chaos_drill_clean_run_has_zero_false_positives():
+    """Same drill, no fault: every window's verdict is ok and the
+    doctor reports a healthy fleet."""
+    coord, flagged_at, reports = _run_drill(faulted=False)
+    assert flagged_at is None
+    for report in reports:
+        assert report["fleet"]["verdict"] == "ok"
+        assert report["findings"] == []
+    doc = job_doctor.diagnose(job_doctor.collect(coord))
+    assert doc["verdict"] == "ok" and doc["findings"] == []
+    assert "healthy" in doc["summary"]
+
+
+def test_data_fetch_delay_fault_point_fires_on_single_get_batch():
+    """The producer-side fault point also covers the serial get_batch
+    path, and an armed pod filter keeps other producers untouched."""
+    cache = BatchCache(capacity=4)
+    cache.put("b1", {"records": [1, 2]})
+    server = DataPlaneServer(cache, pod_id="slowpod").start()
+    other = DataPlaneServer(BatchCache(capacity=4),
+                            pod_id="fastpod").start()
+    plane = faults.FaultPlane(seed=3)
+    fault = plane.inject("data.fetch.delay", "delay", seconds=0.0,
+                         pod="slowpod")
+    plane.install()
+    try:
+        c = RpcClient(server.endpoint)
+        assert c.call("get_batch", "b1")["records"] == [1, 2]
+        c.close()
+        c2 = RpcClient(other.endpoint)
+        c2.call("get_batches", ["nope"])
+        c2.close()
+        assert fault.fired == 1  # slowpod only; fastpod filtered out
+        assert plane.log == [("data.fetch.delay", "delay")]
+    finally:
+        plane.uninstall()
+        server.stop()
+        other.stop()
+
+
+# -- job_stats integration -------------------------------------------------
+
+
+def test_job_stats_renders_health_section():
+    """Satellite: collect_job_stats picks up the verdict doc and
+    --pretty renders a health section next to the fleet metrics."""
+    from edl_tpu.tools import job_stats
+
+    coord, _, _ = _run_drill(faulted=True, windows=2)
+    doc = job_stats.collect_job_stats(coord)
+    assert doc["health"]["schema"] == "health_report/v1"
+    assert doc["health"]["fleet"]["verdict"] == "critical"
+    pretty = job_stats.format_fleet(doc)
+    assert "health: critical" in pretty
+    assert "straggler pod-c" in pretty
+    assert "preferred scale-in victims: pod-c" in pretty

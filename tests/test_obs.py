@@ -351,6 +351,33 @@ def test_publisher_service_name_matches_controller_constant():
     assert obs_publisher.SERVICE_METRICS == constants.SERVICE_METRICS
 
 
+def test_health_service_name_matches_controller_constant():
+    """health.SERVICE_HEALTH is inlined (obs is a leaf package); the
+    same drift guard as SERVICE_METRICS above."""
+    from edl_tpu.controller import constants
+    from edl_tpu.obs import health as obs_health
+    assert obs_health.SERVICE_HEALTH == constants.SERVICE_HEALTH
+
+
+def test_publisher_doc_carries_ts():
+    """Regression: publish_once once omitted the "ts" field its
+    docstring promises — staleness liveness detection (obs/health)
+    depends on the doc's own publication timestamp, not the inner
+    registry snapshot's."""
+    import time as _time
+
+    coord = _FakeCoord()
+    before = _time.time()
+    pub = obs_publisher.MetricsPublisher(
+        coord, "pod_ts", interval=999,
+        registry=obs_metrics.MetricsRegistry(),
+        events=obs_events.EventLog())
+    doc = pub.publish_once()
+    stored = json.loads(coord.store[("metrics", "obs_pod_ts")])
+    for d in (doc, stored):
+        assert before <= d["ts"] <= _time.time()
+
+
 def test_publisher_publishes_and_watermarks_events():
     coord = _FakeCoord()
     log = obs_events.EventLog()
