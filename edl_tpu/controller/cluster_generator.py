@@ -13,11 +13,13 @@ the reference's any-count-in-[min,max].
 
 import threading
 import time
+import uuid
 
 from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, status, train_status
 from edl_tpu.controller.cluster import Cluster
 from edl_tpu.controller.resource_pods import load_resource_pods
+from edl_tpu.runtime import live_resize as live_mod
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
@@ -25,7 +27,7 @@ from edl_tpu.utils.logger import logger
 class Generator(object):
     def __init__(self, coord, pod_id, min_nodes, max_nodes,
                  topology_valid=None, below_min_grace=None,
-                 preferred_victims=None):
+                 preferred_victims=None, live_ack_timeout=10.0):
         self._coord = coord
         self._pod_id = pod_id
         self._min = min_nodes
@@ -47,6 +49,9 @@ class Generator(object):
         self._below_min_grace = (below_min_grace if below_min_grace
                                  is not None
                                  else 2.0 * constants.ETCD_TTL)
+        # how long the two-phase live commit waits for survivor acks
+        # before aborting to the stop-resume ladder
+        self._live_ack_timeout = float(live_ack_timeout)
 
     def start(self):
         with self._lock:
@@ -80,6 +85,7 @@ class Generator(object):
         job = status.load_job_status(self._coord)
         if job in (status.Status.SUCCEED, status.Status.FAILED):
             return
+        self._abort_stale_intent()
         current = cluster_mod.load_from_store(self._coord)
         resources = load_resource_pods(self._coord)
         statuses = status.load_pods_status(self._coord)
@@ -91,7 +97,7 @@ class Generator(object):
         if new is None:
             return
         new.assign_ranks()
-        self._commit(new)
+        self._commit(new, current=current)
 
     def _initial_cluster(self, resources):
         if len(resources) < self._min:
@@ -264,9 +270,92 @@ class Generator(object):
                 return False
         return True
 
-    def _commit(self, new):
+    # -- live resize: the leader-coordinated two-phase commit ----------------
+
+    def _abort_stale_intent(self):
+        """Leader-loss-mid-reshard recovery: a leader that finds a
+        ``prepare`` intent it did not publish (or one past its
+        deadline) aborts it, so survivors stop draining and the
+        stop-resume ladder runs. A coordinator death between prepare
+        and commit therefore degrades to stop-resume, never a wedge."""
+        try:
+            intent = live_mod.read_intent(self._coord)
+        except errors.EdlError:
+            return
+        if not intent or intent.get("phase") != live_mod.PREPARE:
+            return
+        foreign = intent.get("leader") not in (None, self._pod_id)
+        if not foreign and not live_mod.intent_expired(intent):
+            return
+        if live_mod.abort(self._coord, self._pod_id, intent,
+                          reason="stale prepare (leader=%s, expired=%s)"
+                          % (intent.get("leader"),
+                             live_mod.intent_expired(intent))):
+            logger.warning("aborted stale live-resize intent %s "
+                           "(published by %s)", intent.get("id"),
+                           intent.get("leader"))
+
+    def _live_eligible(self, current, new):
+        """The live in-place path replaces kill/respawn only when every
+        pod of the NEW cluster is already running (a survivors-only
+        change — a joining pod has no process to reshape) and each
+        survivor advertises the live-resize capability key."""
+        if current is None or not current.pods or not new.pods:
+            return False
+        cur_ids = set(current.pod_ids())
+        new_ids = set(new.pod_ids())
+        if not new_ids.issubset(cur_ids):
+            return False
+        try:
+            ready = live_mod.ready_participants(self._coord)
+        except errors.EdlError:
+            return False
+        return new_ids.issubset(ready)
+
+    def _try_live_commit(self, new, cluster_key):
+        """Two-phase live commit: leader-guarded ``prepare`` intent →
+        every survivor drains + reshards + acks → one guarded
+        transaction flips the intent to ``commit`` AND installs the new
+        cluster map, so the launcher adopts it without killing anyone.
+        Any nack, ack timeout, or lost leadership aborts the intent and
+        returns False — the caller falls through to stop-resume."""
+        devices = {p.id: (sum(len(t.devices) for t in p.trainers)
+                          or len(p.devices)) for p in new.pods}
+        intent = live_mod.make_intent(
+            uuid.uuid4().hex, new.pod_ids(), devices=devices,
+            leader=self._pod_id, cluster_json=new.to_json(),
+            deadline_s=self._live_ack_timeout + 10.0)
+        if not live_mod.publish_prepare(self._coord, self._pod_id, intent):
+            raise errors.NotLeaderError(
+                "pod %s lost leadership publishing live-resize intent"
+                % self._pod_id)
+        all_ok, acks = live_mod.wait_for_acks(self._coord, intent,
+                                              self._live_ack_timeout)
+        if not all_ok:
+            nacks = sorted(w for w, a in acks.items() if not a.get("ok"))
+            missing = sorted(set(intent["survivors"]) - set(acks))
+            live_mod.abort(self._coord, self._pod_id, intent,
+                           reason="nack=%s missing=%s" % (nacks, missing))
+            logger.warning("live resize %s aborted (nack=%s, missing=%s);"
+                           " falling back to stop-resume", intent["id"],
+                           nacks, missing)
+            return False
+        if not live_mod.commit(self._coord, self._pod_id, intent,
+                               extra_puts=[(cluster_key, new.to_json())]):
+            raise errors.NotLeaderError(
+                "pod %s lost leadership committing live resize"
+                % self._pod_id)
+        logger.info("live resize %s committed: %d survivors adopted the "
+                    "new cluster in place (no kill)", intent["id"],
+                    len(intent["survivors"]))
+        return True
+
+    def _commit(self, new, current=None):
         cluster_key = self._coord.service_prefix(
             constants.SERVICE_CLUSTER) + constants.CLUSTER_SERVER
+        if self._live_eligible(current, new):
+            if self._try_live_commit(new, cluster_key):
+                return
         ok = self._coord.put_if_leader(
             constants.SERVICE_LEADER, constants.LEADER_SERVER, self._pod_id,
             [(cluster_key, new.to_json())])

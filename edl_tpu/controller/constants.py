@@ -20,6 +20,10 @@ SERVICE_HEALTH = "health"
 # peer-served restore plane: each trainer's StateServer endpoint +
 # published snapshot version (edl_tpu/runtime/state_server.py)
 SERVICE_STATE_SERVER = "state_server"
+# zero-downtime live resize: the leader's two-phase intent, per-pod
+# acks, and the trainers' live-capability keys
+# (edl_tpu/runtime/live_resize.py)
+SERVICE_LIVE_RESIZE = "live_resize"
 
 LEADER_SERVER = "0"          # the single leader key
 CLUSTER_SERVER = "cluster"   # the single cluster-map key

@@ -12,6 +12,7 @@ import json
 import time
 
 from edl_tpu.controller import barrier as barrier_mod
+from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, status, train_process
 from edl_tpu.controller.cluster_generator import Generator
 from edl_tpu.controller.cluster_watcher import ClusterWatcher
@@ -46,6 +47,9 @@ class Launcher(object):
         self._watcher = None
         self._procs = []
         self._cluster = None
+        # live-resize intents this launcher already adopted (ids); a
+        # committed intent stays in the store until the next one
+        self._live_done = set()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -296,10 +300,67 @@ class Launcher(object):
             logger.exception("clearing preemption keys failed "
                              "(stage %s)", self._cluster.stage)
 
+    def _live_intent_for_pod(self):
+        """The committed live-resize intent this pod should adopt, or
+        None (→ stop-resume). Requires: phase ``commit``, this pod in
+        the survivor set, an ok ack from this pod's trainer (the
+        trainer already drained + resharded in place), and an intent id
+        not yet consumed."""
+        from edl_tpu.runtime import live_resize as live_mod
+        try:
+            intent = live_mod.read_intent(self._coord)
+        except errors.EdlError:
+            return None
+        if (not intent or intent.get("phase") != live_mod.COMMIT
+                or intent.get("id") in self._live_done
+                or self._pod.id not in (intent.get("survivors") or ())):
+            return None
+        try:
+            ack = live_mod.read_acks(self._coord,
+                                     intent["id"]).get(self._pod.id)
+        except errors.EdlError:
+            return None
+        if not ack or not ack.get("ok"):
+            return None
+        return intent
+
+    def _resize_live(self, intent):
+        """Adopt a committed live resize: the trainers are ALIVE and
+        already resharded — no kill, no barrier, no respawn. Just load
+        the atomically-installed cluster map, take the new rank
+        assignment, and rearm the watcher. Returns False if the new map
+        somehow excludes this pod (then the stop-resume eviction path
+        has already decided)."""
+        t0 = time.monotonic()
+        self._live_done.add(intent.get("id"))
+        cluster = cluster_mod.load_from_store(self._coord)
+        if cluster is None:
+            return None  # caller falls back to stop-resume
+        self._cluster = cluster
+        if not self._update_local_pod():
+            return False
+        self._watcher.stop()
+        self._watcher = ClusterWatcher(self._coord, self._cluster)
+        recovery_s = time.monotonic() - t0
+        logger.info("live resize adopted on pod %s: world=%d stage=%s "
+                    "(%.3fs, trainers kept alive)", self._pod.id,
+                    self._cluster.world_size(), self._cluster.stage,
+                    recovery_s)
+        self._record_resize_metric(recovery_s, mode="live")
+        return True
+
     def _resize(self):
-        """Stop-resume elasticity (reference: launcher.py:221-244): kill
-        trainers, re-barrier on the new cluster, respawn. Returns False if
-        this pod was evicted by the new cluster map."""
+        """Membership changed. A committed live-resize intent covering
+        this pod means the trainer already resharded in place — adopt
+        the map without touching the processes. Otherwise stop-resume
+        (reference: launcher.py:221-244): kill trainers, re-barrier on
+        the new cluster, respawn. Returns False if this pod was evicted
+        by the new cluster map."""
+        intent = self._live_intent_for_pod()
+        if intent is not None:
+            adopted = self._resize_live(intent)
+            if adopted is not None:
+                return adopted
         logger.info("membership changed; stop-resume resize on pod %s",
                     self._pod.id)
         t0 = time.monotonic()
@@ -328,7 +389,7 @@ class Launcher(object):
         self._record_resize_metric(recovery_s)
         return True
 
-    def _record_resize_metric(self, recovery_s):
+    def _record_resize_metric(self, recovery_s, mode="stop_resume"):
         """Per-pod resize history under the metrics service, scrapeable by
         drivers/operators (per-pod keys, so no cross-pod write races)."""
         try:
@@ -339,6 +400,7 @@ class Launcher(object):
                 "stage": self._cluster.stage,
                 "world": self._cluster.world_size(),
                 "recovery_s": round(recovery_s, 2),
+                "mode": mode,
                 "ts": round(time.time(), 1),
             })
             self._coord.set_server_permanent(constants.SERVICE_METRICS,

@@ -29,13 +29,46 @@ RESTART = "RESTART"
 HOLD = "HOLD"
 ERROR = "ERROR"
 
+# membership-transition kinds (classify_transition / on_transition):
+# not every delta is fatal — a live-resize-capable supervisor restarts
+# nothing on grow/shrink-with-survivors, and only self-eviction means
+# "this process is out of the job"
+GROW = "grow"
+SHRINK = "shrink"
+SELF_EVICTED = "self_evicted"
+UNCHANGED = "unchanged"
+
+
+def classify_transition(old_hosts, new_hosts, host):
+    """What a membership delta means for ``host``: GROW (new peers
+    joined, we survive), SHRINK (peers left, we survive), SELF_EVICTED
+    (the agreed world no longer contains us), UNCHANGED. Mixed
+    join+leave counts as SHRINK when anybody left — the conservative
+    reading for a supervisor deciding whether survivors can reshape in
+    place."""
+    old = set(old_hosts or ())
+    new = set(new_hosts or ())
+    if host not in new:
+        return SELF_EVICTED
+    if old - new:
+        return SHRINK
+    if new - old:
+        return GROW
+    return UNCHANGED
+
 
 class ElasticManager(object):
-    def __init__(self, coord, host, np_target, ttl=10):
+    def __init__(self, coord, host, np_target, ttl=10,
+                 on_transition=None):
         self._coord = coord
         self._host = host
         self._np = int(np_target)
         self._ttl = ttl
+        # on_transition(kind, old_hosts, new_hosts): observe-only hook
+        # fired from watch() when the agreed membership shifts; kind is
+        # one of GROW/SHRINK/SELF_EVICTED. Exceptions are swallowed —
+        # a broken observer must not take down supervision.
+        self._on_transition = on_transition
         self._lease = None
         self._stop = threading.Event()
         self._hosts_changed = threading.Event()
@@ -147,20 +180,49 @@ class ElasticManager(object):
     def complete(self):
         self._completed.set()
 
+    def _notify_transition(self, kind, old_hosts, new_hosts):
+        if self._on_transition is None:
+            return
+        try:
+            self._on_transition(kind, list(old_hosts or ()), list(new_hosts))
+        except Exception:  # noqa: BLE001 — observer must not kill watch()
+            logger.exception("liveft: on_transition observer failed")
+
     def watch(self, poll=1.0):
         """One supervision tick: COMPLETED | RESTART (membership or np
-        changed) | HOLD (keep running) | ERROR (we fell out and could not
-        re-register)."""
+        changed, and we survive) | HOLD (keep running) | ERROR (we fell
+        out and could not re-register, or the settled world evicted us).
+
+        When an ``on_transition`` observer is installed it is told
+        WHICH kind of change settled — GROW / SHRINK (survivors, verdict
+        RESTART) vs SELF_EVICTED (verdict ERROR) — so a live-resize
+        supervisor can reshape survivors in place instead of treating
+        every delta as a full restart. Self-eviction used to HOLD
+        forever; it now surfaces as ERROR."""
         if self._completed.is_set():
             return COMPLETED
         if not self._registered.is_set():
             return ERROR
         if self._np_changed.is_set() or self._hosts_changed.is_set():
             hosts = self.hosts()
-            if len(hosts) == self._np and self._host in hosts:
-                self._np_changed.clear()
-                self._hosts_changed.clear()
-                return RESTART
+            if len(hosts) == self._np:
+                kind = classify_transition(self._agreed_hosts, hosts,
+                                           self._host)
+                if self._host in hosts:
+                    self._np_changed.clear()
+                    self._hosts_changed.clear()
+                    if kind != UNCHANGED:
+                        self._notify_transition(kind, self._agreed_hosts,
+                                                hosts)
+                        return RESTART
+                else:
+                    # the world settled at np WITHOUT us: we were
+                    # evicted, and no future event will re-admit us
+                    self._np_changed.clear()
+                    self._hosts_changed.clear()
+                    self._notify_transition(SELF_EVICTED,
+                                            self._agreed_hosts, hosts)
+                    return ERROR
         time.sleep(poll)
         return HOLD
 

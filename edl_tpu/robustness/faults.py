@@ -51,6 +51,15 @@ store.repl.snapshot      before a follower installs a leader snapshot
                          (ctx: term, index)
 store.repl.apply         before a committed entry is applied (ctx:
                          index, kind)
+resize.live.drain        in live_resize before the save-engine drain
+                         (ctx: from_devices, to_devices) — a failure
+                         here rolls back before anything moved
+resize.live.reshard      in live_resize after the new mesh is built,
+                         before any state is resharded (ctx:
+                         from_devices, to_devices) — the mid-reshard
+                         crash drill; rollback must leave the old mesh
+                         byte-identical and the 2PC must abort to
+                         stop-resume
 ======================== ===============================================
 
 Fault kinds:

@@ -1,0 +1,354 @@
+"""Zero-downtime live resize: the store protocol and the in-place
+reshard engine.
+
+Stop-resume elasticity (the reference's model) kills every trainer on a
+membership change and pays kill + barrier + restore + compile on the
+way back. A SURVIVING process already holds everything the new world
+needs — the committed state (host snapshot + device arrays), the peer
+restore plane, and (with prewarm) the new world's AOT step executable —
+so the only work a resize truly requires is a reshard and an executable
+swap. This module provides the two halves:
+
+**The protocol** (leader-coordinated two-phase commit over the
+coordination store, SERVICE_LIVE_RESIZE):
+
+- trainers that can reshape in place advertise a TTL-leased
+  ``ready_<who>`` capability key (:func:`advertise_capability`);
+- the coordinator (cluster generator, resize driver, bench) publishes a
+  ``prepare`` intent under the single ``intent`` key — leader-guarded,
+  so a deposed leader's intent is a no-op (:func:`publish_prepare`);
+- each surviving trainer drains to a step boundary, reshards
+  (:meth:`ElasticTrainer.live_resize`), and writes an ``ack_<who>``
+  key (:func:`write_ack`);
+- all-acks-ok → the coordinator atomically flips the intent to
+  ``commit`` *and* installs the new cluster map in ONE guarded
+  transaction (:func:`commit`) — the launcher sees the committed intent
+  and adopts the map without killing anyone;
+- any nack, timeout, or leader change → ``abort`` (:func:`abort`) and
+  the existing stop-resume ladder runs unchanged. A fresh leader
+  finding a stale foreign/expired ``prepare`` aborts it first
+  (generator `_abort_stale_intent`), so a coordinator death mid-reshard
+  degrades to stop-resume, never to a wedge.
+
+**The engine** (:func:`reshard_placed`): build the new world's
+:class:`~edl_tpu.runtime.checkpoint.PlacedTarget`, paste every span the
+process already holds locally from the live device arrays (zero copy in
+from host: ``np.asarray`` on a CPU/host-local shard aliases the
+buffer), fetch only the still-missing spans from peer StateServers at
+the published version (:meth:`PeerRestorer.fill_from_peers`), then the
+per-span FS fallback — the same ladder as a stop-resume restore, minus
+the process restart.
+
+Scope: the engine reshapes within ONE process (the JAX runtime cannot
+re-run ``jax.distributed.initialize``), so live resize applies to
+single-process trainers on a pure-dp mesh with replicated state — the
+same predicate as the AOT resize prewarm, and exactly the shape of the
+headline "resize 8→4→8" arc. Multi-process worlds keep stop-resume;
+the capability key simply never appears, and the generator's
+eligibility check falls through. See docs/elastic_resize.md.
+"""
+
+import json
+import time
+
+import numpy as np
+
+from edl_tpu.controller import constants
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+INTENT_KEY = "intent"
+PREPARE = "prepare"
+COMMIT = "commit"
+ABORT = "abort"
+
+# a prepare older than this is stale even without an explicit deadline
+DEFAULT_DEADLINE_S = 30.0
+
+
+def make_intent(intent_id, survivors, devices=None, leader=None,
+                cluster_json=None, deadline_s=DEFAULT_DEADLINE_S):
+    """The intent document. ``survivors`` are the pods/trainers that
+    must ack; ``devices`` the per-survivor device target (None = keep);
+    ``cluster_json`` the new cluster map the commit installs."""
+    return {
+        "id": str(intent_id),
+        "phase": PREPARE,
+        "survivors": [str(s) for s in survivors],
+        "devices": devices,
+        "leader": leader,
+        "cluster": cluster_json,
+        "deadline_ts": time.time() + float(deadline_s),
+        "ts": time.time(),
+    }
+
+
+def _intent_full_key(coord):
+    return coord.service_prefix(constants.SERVICE_LIVE_RESIZE) + INTENT_KEY
+
+
+def read_intent(coord):
+    raw = coord.get_value(constants.SERVICE_LIVE_RESIZE, INTENT_KEY)
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+def intent_expired(intent, now=None):
+    return (now or time.time()) > float(intent.get("deadline_ts", 0))
+
+
+def publish_prepare(coord, leader_value, intent):
+    """Phase 1: leader-guarded write of the prepare intent. Returns
+    True iff this coordinator still held the leader key."""
+    try:
+        return bool(coord.put_if_leader(
+            constants.SERVICE_LEADER, constants.LEADER_SERVER,
+            leader_value, [(_intent_full_key(coord),
+                            json.dumps(intent))]))
+    except errors.NotLeaderError:
+        return False
+
+
+def commit(coord, leader_value, intent, extra_puts=()):
+    """Phase 2: atomically flip the intent to ``commit`` AND apply
+    ``extra_puts`` (the new cluster map) in one leader-guarded
+    transaction — survivors and the launcher observe either the whole
+    live resize or none of it. Returns True iff still leader."""
+    doc = dict(intent)
+    doc["phase"] = COMMIT
+    doc["commit_ts"] = time.time()
+    puts = [(_intent_full_key(coord), json.dumps(doc))]
+    puts.extend(extra_puts)
+    try:
+        return bool(coord.put_if_leader(
+            constants.SERVICE_LEADER, constants.LEADER_SERVER,
+            leader_value, puts))
+    except errors.NotLeaderError:
+        return False
+
+
+def abort(coord, leader_value, intent, reason=""):
+    """Flip a prepare intent to ``abort`` (leader-guarded); the ladder
+    falls back to stop-resume. Returns True iff still leader."""
+    doc = dict(intent)
+    doc["phase"] = ABORT
+    doc["abort_reason"] = reason
+    doc["abort_ts"] = time.time()
+    try:
+        return bool(coord.put_if_leader(
+            constants.SERVICE_LEADER, constants.LEADER_SERVER,
+            leader_value, [(_intent_full_key(coord), json.dumps(doc))]))
+    except errors.NotLeaderError:
+        return False
+
+
+def write_ack(coord, who, intent_id, ok, reason=None, info=None):
+    """A survivor's vote on the prepare intent (permanent key; the
+    intent id scopes it, so stale acks from a previous resize are
+    ignored by :func:`read_acks`)."""
+    doc = {"id": str(intent_id), "who": str(who), "ok": bool(ok),
+           "reason": reason, "ts": time.time()}
+    if info:
+        doc.update(info)
+    coord.set_server_permanent(constants.SERVICE_LIVE_RESIZE,
+                               "ack_%s" % who, json.dumps(doc))
+
+
+def read_acks(coord, intent_id):
+    """{who: ack doc} for acks scoped to ``intent_id``."""
+    out = {}
+    for name, value in coord.get_service(constants.SERVICE_LIVE_RESIZE):
+        if not name.startswith("ack_"):
+            continue
+        try:
+            doc = json.loads(value)
+        except ValueError:
+            continue
+        if doc.get("id") == str(intent_id):
+            out[doc.get("who") or name[len("ack_"):]] = doc
+    return out
+
+
+def advertise_capability(coord, who, info=None, ttl=None):
+    """TTL-leased ``ready_<who>`` key: "this participant can reshape in
+    place". Returns the Register (caller stops it on close); None when
+    the store is unreachable (best-effort — losing the key only costs
+    the live path, never correctness)."""
+    from edl_tpu.controller.register import Register
+    value = json.dumps(dict(info or {}, who=str(who)))
+    try:
+        return Register(coord, constants.SERVICE_LIVE_RESIZE,
+                        "ready_%s" % who, value,
+                        ttl=ttl or constants.ETCD_TTL)
+    except errors.EdlError as e:
+        logger.warning("live resize: capability advertise failed (%r)", e)
+        return None
+
+
+def ready_participants(coord):
+    """Set of ``who`` with a live ``ready_*`` capability key."""
+    out = set()
+    try:
+        for name, _ in coord.get_service(constants.SERVICE_LIVE_RESIZE):
+            if name.startswith("ready_"):
+                out.add(name[len("ready_"):])
+    except errors.EdlError:
+        pass
+    return out
+
+
+def wait_for_acks(coord, intent, timeout, poll=0.1):
+    """Block until every survivor acked (any verdict) or the deadline
+    passes. Returns (all_ok, {who: ack})."""
+    want = set(intent.get("survivors") or ())
+    t_end = time.monotonic() + float(timeout)
+    acks = {}
+    while time.monotonic() < t_end:
+        acks = read_acks(coord, intent["id"])
+        if want.issubset(acks):
+            return all(a.get("ok") for a in acks.values()), acks
+        time.sleep(poll)
+    return False, acks
+
+
+class LiveResizeWatcher(object):
+    """Trainer-side intent watcher: a store watch on SERVICE_LIVE_RESIZE
+    keeps a pending prepare intent addressed to ``who``; the training
+    loop polls :meth:`pending` at step boundaries (a lock + dict read —
+    nothing on the hot path) and calls :meth:`done` after acking."""
+
+    def __init__(self, coord, who):
+        import threading
+        self._coord = coord
+        self._who = str(who)
+        self._lock = threading.Lock()
+        self._pending = None
+        self._handled = set()
+        self._watcher = coord.watch_service(constants.SERVICE_LIVE_RESIZE,
+                                            self._on_change)
+        # the watch delivers future changes; pick up a pre-existing one
+        self._consider(read_intent(coord))
+
+    def _on_change(self, added, removed, all_servers):
+        raw = (all_servers or {}).get(INTENT_KEY)
+        if raw is None:
+            return
+        try:
+            self._consider(json.loads(raw))
+        except ValueError:
+            pass
+
+    def _consider(self, rec):
+        if (not rec or rec.get("phase") != PREPARE
+                or self._who not in (rec.get("survivors") or ())
+                or rec.get("id") in self._handled
+                or intent_expired(rec)):
+            return
+        with self._lock:
+            self._pending = rec
+
+    def pending(self):
+        with self._lock:
+            rec = self._pending
+        if rec is not None and intent_expired(rec):
+            self.done(rec.get("id"))
+            return None
+        return rec
+
+    def done(self, intent_id):
+        with self._lock:
+            self._handled.add(intent_id)
+            if self._pending and self._pending.get("id") == intent_id:
+                self._pending = None
+
+    def stop(self):
+        try:
+            self._watcher.stop()
+        except Exception:
+            pass
+
+
+# -- the reshard engine ----------------------------------------------------
+
+
+def _leaf_spec(x):
+    import jax
+    a = x if hasattr(x, "shape") and hasattr(x, "dtype") else np.asarray(x)
+    return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+
+def reshard_placed(tree, shardings, coord=None, ckpt=None, version=None,
+                   self_endpoint=None, timeout=20.0):
+    """Reshard a live pytree onto new shardings IN PLACE of a restore:
+    paste locally-held spans straight from the device arrays (no wire,
+    no disk), fill the rest by peer range-reads at the committed
+    ``version``, then the per-span FS fallback. Returns
+    (new_tree, stats) where stats = {"source", "local_bytes",
+    "peer_bytes", "fs_keys", "peers"}.
+
+    Raises MissingKeysError when spans remain uncovered — the caller
+    rolls back to the old mesh and the stop-resume ladder takes over.
+    """
+    import jax
+    from edl_tpu.runtime.checkpoint import (PlacedTarget, _concrete_spans,
+                                            _path_key)
+
+    target = jax.tree_util.tree_map(_leaf_spec, tree)
+    pt = PlacedTarget(target, shardings)
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    local_bytes = 0
+    for path, leaf in flat:
+        key = _path_key(path)
+        if key not in pt.need:
+            continue
+        if hasattr(leaf, "addressable_shards") and hasattr(leaf,
+                                                           "sharding"):
+            seen = set()
+            for s in leaf.addressable_shards:
+                spans = _concrete_spans(s.index, leaf.shape)
+                if spans in seen:
+                    continue
+                seen.add(spans)
+                if not pt.overlaps_local(key, spans):
+                    continue
+                arr = np.asarray(s.data)
+                pt.paste(key, spans, arr)
+                local_bytes += arr.nbytes
+        else:
+            arr = np.asarray(leaf)
+            spans = tuple((0, d) for d in arr.shape)
+            if pt.overlaps_local(key, spans):
+                pt.paste(key, spans, arr)
+                local_bytes += arr.nbytes
+
+    stats = {"source": "local", "local_bytes": int(local_bytes),
+             "peer_bytes": 0, "fs_keys": [], "peers": 0}
+    missing = pt.missing()
+    if missing and coord is not None and version is not None:
+        from edl_tpu.runtime.state_server import PeerRestorer
+        try:
+            peer_stats = PeerRestorer(
+                coord, ckpt, self_endpoint=self_endpoint,
+                timeout=timeout).fill_from_peers(version, pt)
+            stats["source"] = "local+peer"
+            stats["peer_bytes"] = peer_stats["peer_bytes"]
+            stats["peers"] = peer_stats["peers"]
+        except errors.PeerRestoreError as e:
+            logger.info("live reshard: no peer path (%s); trying the "
+                        "FS fallback", e)
+        missing = pt.missing()
+    if missing and ckpt is not None and version is not None:
+        for key in missing:
+            pt.reset_key(key)
+        ckpt.fill_placed_from_fs(version, pt, keys=missing)
+        stats["source"] += "+fs"
+        stats["fs_keys"] = sorted(missing)
+    from edl_tpu.runtime.checkpoint import MissingKeysError
+    missing = pt.missing()
+    if missing:
+        raise MissingKeysError(missing)
+    return pt.assemble(), stats

@@ -105,6 +105,14 @@ class StateServer(object):
     ``advertise(coord)`` registers the endpoint in the coordination
     store under SERVICE_STATE_SERVER with a TTL lease, so a dead
     process drops out of peer discovery within one TTL.
+
+    Live resize: the served snapshot is host copies captured at commit
+    time, fully decoupled from the device arrays — a trainer resharding
+    its mesh in place (`ElasticTrainer.live_resize`) keeps this server
+    running and advertised throughout, so peers mid-restore keep their
+    version and the resharding survivor can itself range-read spans its
+    new placement needs (`PeerRestorer.fill_from_peers`). Only a NEW
+    commit swaps the served version, exactly as in steady state.
     """
 
     def __init__(self, rank=0, host="0.0.0.0", port=0):
@@ -350,17 +358,45 @@ class PeerRestorer(object):
             for c in clients:
                 c.close()
 
-    def _restore_from(self, peers, version, target, shardings):
-        pt = PlacedTarget(target, shardings)
+    def fill_from_peers(self, version, pt):
+        """Fill the still-missing spans of an EXISTING PlacedTarget by
+        peer range-reads at ``version`` — the live-resize reshard path:
+        the caller already pasted the spans it holds locally and only
+        the remainder crosses the wire. Entries a peer holds but the
+        target has already fully filled are skipped. Returns
+        {"peer_bytes", "peers", "failed"}; raises PeerRestoreError when
+        no live peer serves the version. The caller owns the FS
+        fallback and the final missing() accounting."""
+        peers = self._discover(version)
+        if not peers:
+            raise errors.PeerRestoreError(
+                "no live peer serves v%s" % (version,))
+        clients = [p[2] for p in peers]
+        try:
+            peer_bytes, failed, _ = self._fill_from(
+                peers, version, pt, only_missing=True)
+            return {"peer_bytes": int(peer_bytes), "peers": len(peers),
+                    "failed": sorted(failed)}
+        finally:
+            for c in clients:
+                c.close()
+
+    def _fill_from(self, peers, version, pt, only_missing=False):
+        """The shared span-fetch core: plan owners/alternates from the
+        peers' manifests, issue pipelined sub-reads, paste into ``pt``.
+        Returns (peer_bytes, failed_keys, meta). ``only_missing``
+        restricts the plan to keys pt still reports missing (the
+        reshard path; a full restore wants every needed key)."""
         dtypes = {}
         meta = peers[0][3].get("meta")
+        todo = pt.missing() if only_missing else set(pt.need)
         # (key, entry_spans) -> [(client, skey, entry, endpoint), ...]
         plan = {}
         for rank, endpoint, client, manifest in peers:
             dtypes.update(manifest.get("dtypes") or {})
             for skey, entry in manifest["entries"].items():
                 key, _, spans_s = skey.rpartition("@")
-                if key not in pt.need:
+                if key not in todo:
                     continue
                 entry_spans = _parse_spans(spans_s)
                 pt.check_bounds(key, entry_spans)
@@ -412,7 +448,11 @@ class PeerRestorer(object):
                 sub = entry_spans
             pt.paste(key, sub, _untag_array(arr, dtypes.get(key)))
             peer_bytes += arr.nbytes
+        return peer_bytes, failed, meta
 
+    def _restore_from(self, peers, version, target, shardings):
+        pt = PlacedTarget(target, shardings)
+        peer_bytes, failed, meta = self._fill_from(peers, version, pt)
         need_fs = failed | pt.missing()
         if need_fs:
             # a key partially pasted from peers restarts from zero so

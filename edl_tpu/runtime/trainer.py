@@ -32,6 +32,7 @@ from edl_tpu.controller.env import TrainerEnv
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.robustness import faults
 from edl_tpu.runtime import checkpoint as checkpoint_mod
 from edl_tpu.runtime import state as state_mod
 from edl_tpu.runtime.checkpoint import CheckpointManager, MissingKeysError
@@ -40,6 +41,16 @@ from edl_tpu.utils.logger import logger
 
 _STEP_MS = obs_metrics.histogram(
     "edl_train_step_ms", "train_step wall time (host dispatch)")
+# prewarm effectiveness: job_doctor names a cold compile cache from
+# these (a first step in prewarm scope either loaded an AOT executable
+# or paid a full XLA compile)
+_PREWARM_HITS = obs_metrics.counter(
+    "edl_resize_prewarm_hits_total",
+    "first steps that loaded a prewarmed AOT step executable")
+_PREWARM_MISSES = obs_metrics.counter(
+    "edl_resize_prewarm_misses_total",
+    "first steps in prewarm scope with no usable AOT artifact "
+    "(full compile paid)")
 
 _distributed_initialized = False
 
@@ -330,34 +341,12 @@ class ElasticTrainer(object):
         if checkpoint_dir is None:
             # default to the launcher-provided shared checkpoint path
             checkpoint_dir = self.env.checkpoint_path
-        self.mesh = mesh if mesh is not None else make_mesh()
         self.total_batch_size = total_batch_size
-        self._batch_sharding_early = data_sharding(self.mesh)
-        # batch divisibility is over the BATCH-SHARDED axes (dcn, dp) —
-        # with model axes (tp/sp/pp) in the mesh, rows are replicated
-        # across them, not split
-        n_batch_shards = 1
-        spec0 = self._batch_sharding_early.spec[0] \
-            if self._batch_sharding_early.spec else None
-        for ax in ((spec0,) if isinstance(spec0, str)
-                   else tuple(spec0 or ())):
-            n_batch_shards *= self.mesh.shape[ax]
-        if total_batch_size % n_batch_shards != 0:
-            raise ValueError(
-                "total_batch_size %d not divisible by %d batch shards"
-                % (total_batch_size, n_batch_shards))
-        self.per_device_batch = total_batch_size // n_batch_shards
-        # rows THIS process must supply = the union of its devices' batch
-        # spans (with cross-process model axes a process can own every
-        # row; with pure dp it owns a contiguous slice)
-        idx_map = self._batch_sharding_early \
-            .addressable_devices_indices_map((total_batch_size,))
-        spans = sorted({(sl[0].start or 0,
-                         total_batch_size if sl[0].stop is None
-                         else sl[0].stop)
-                        for sl in idx_map.values()})
-        self._host_row_spans = spans
-        self.per_host_batch = sum(b - a for a, b in spans)
+        # _bind_mesh consumes _grad_accum; bind at 1 first, rebind after
+        # the accumulation is resolved (auto_grad_accum needs the
+        # per-device batch the first binding computes)
+        self._grad_accum = 1
+        self._bind_mesh(mesh if mesh is not None else make_mesh())
 
         self._loss_fn = loss_fn
         self._tx = tx
@@ -378,15 +367,10 @@ class ElasticTrainer(object):
             grad_accum = auto_grad_accum(self.per_device_batch,
                                          max_per_device_batch)
         if grad_accum > 1:
-            if self.per_host_batch % grad_accum != 0:
-                raise ValueError(
-                    "per-host batch %d not divisible by grad_accum %d"
-                    % (self.per_host_batch, grad_accum))
-            if self.per_device_batch % grad_accum != 0:
-                raise ValueError(
-                    "per-device batch %d not divisible by grad_accum %d"
-                    % (self.per_device_batch, grad_accum))
-        self._grad_accum = grad_accum
+            # rebind: the batch sharding becomes microbatch-major and
+            # the divisibility checks run against the accumulation
+            self._grad_accum = grad_accum
+            self._bind_mesh(self.mesh)
         if extra_state is not None:
             for leaf in jax.tree_util.tree_leaves(extra_state):
                 # only explicit numpy 64-bit leaves are dangerous; Python
@@ -402,16 +386,6 @@ class ElasticTrainer(object):
                         "host-side metadata (file offsets, loader positions) "
                         "in trainer.state.user_defined instead" % dt)
         self.state = state_mod.State(total_batch_size=total_batch_size)
-        self._repl = NamedSharding(self.mesh, P())
-        if self._grad_accum > 1:
-            # microbatch-major [k, rows/k, ...]: scan axis replicated,
-            # rows sharded over the same data axes as the flat layout
-            early = self._batch_sharding_early.spec
-            row_axes = early[0] if early else None
-            self._batch_sharding = NamedSharding(self.mesh,
-                                                 P(None, row_axes))
-        else:
-            self._batch_sharding = self._batch_sharding_early
 
         # model parallelism: partition rules (regex, PartitionSpec) or an
         # explicit sharding pytree for the params; optimizer-state
@@ -482,8 +456,10 @@ class ElasticTrainer(object):
         self._state_server = None
         # per-incarnation resize timing record (docs/elastic_resize.md):
         # absolute unix timestamps so measure_resize can align them with
-        # its own kill/detect clock
-        self._resize_timing = {"t_construct": time.time()}
+        # its own kill/detect clock. live_resize() replaces the record
+        # (mode "live") without a process restart.
+        self._resize_timing = {"t_construct": time.time(),
+                               "mode": "stop_resume"}
         if (self._ckpt is not None and self.coord is not None
                 and os.environ.get("EDL_TPU_PEER_RESTORE", "1") != "0"):
             try:
@@ -499,6 +475,15 @@ class ElasticTrainer(object):
 
         self._jit_step = self._build_step()
         self._example_batch_sds = None  # captured at the first step
+        # the step that next stamps compile_s/first_step_s into
+        # _resize_timing: the first step of this incarnation, and the
+        # first step after every live_resize() (same record semantics
+        # as a restart, without the restart)
+        self._stamp_first_step = True
+        # live-resize protocol state (enable_live_resize)
+        self._live_watcher = None
+        self._live_register = None
+        self._live_who = None
         self._prewarm_thread = None
         self._step_times = []
         # start-to-start wall intervals (NOT in-call durations: jit
@@ -538,6 +523,60 @@ class ElasticTrainer(object):
         import weakref
         ref = weakref.ref(self)
         atexit.register(lambda: (lambda t: t and t.wait_for_save())(ref()))
+
+    # -- mesh binding --------------------------------------------------------
+
+    def _bind_mesh(self, mesh):
+        """Bind every mesh-derived attribute: batch shardings, the
+        per-device/per-host batch math, host row spans, the replicated
+        sharding. Called at construction and again by live_resize()
+        with the new world's mesh. Validates before assigning anything,
+        so a ValueError leaves the previous binding intact."""
+        total = self.total_batch_size
+        early = data_sharding(mesh)
+        # batch divisibility is over the BATCH-SHARDED axes (dcn, dp) —
+        # with model axes (tp/sp/pp) in the mesh, rows are replicated
+        # across them, not split
+        n_batch_shards = 1
+        spec0 = early.spec[0] if early.spec else None
+        for ax in ((spec0,) if isinstance(spec0, str)
+                   else tuple(spec0 or ())):
+            n_batch_shards *= mesh.shape[ax]
+        if total % n_batch_shards != 0:
+            raise ValueError(
+                "total_batch_size %d not divisible by %d batch shards"
+                % (total, n_batch_shards))
+        per_device = total // n_batch_shards
+        # rows THIS process must supply = the union of its devices' batch
+        # spans (with cross-process model axes a process can own every
+        # row; with pure dp it owns a contiguous slice)
+        idx_map = early.addressable_devices_indices_map((total,))
+        spans = sorted({(sl[0].start or 0,
+                         total if sl[0].stop is None else sl[0].stop)
+                        for sl in idx_map.values()})
+        per_host = sum(b - a for a, b in spans)
+        if self._grad_accum > 1:
+            if per_host % self._grad_accum != 0:
+                raise ValueError(
+                    "per-host batch %d not divisible by grad_accum %d"
+                    % (per_host, self._grad_accum))
+            if per_device % self._grad_accum != 0:
+                raise ValueError(
+                    "per-device batch %d not divisible by grad_accum %d"
+                    % (per_device, self._grad_accum))
+        self.mesh = mesh
+        self._batch_sharding_early = early
+        self.per_device_batch = per_device
+        self._host_row_spans = spans
+        self.per_host_batch = per_host
+        self._repl = NamedSharding(mesh, P())
+        if self._grad_accum > 1:
+            # microbatch-major [k, rows/k, ...]: scan axis replicated,
+            # rows sharded over the same data axes as the flat layout
+            row_axes = early.spec[0] if early.spec else None
+            self._batch_sharding = NamedSharding(mesh, P(None, row_axes))
+        else:
+            self._batch_sharding = early
 
     # -- the compiled step ---------------------------------------------------
 
@@ -593,7 +632,10 @@ class ElasticTrainer(object):
             repl = self._repl
         else:
             axes = self.mesh.axis_names
-            devices = list(self.mesh.devices.flat)
+            # the PROCESS device list, not the current mesh's: a trainer
+            # running on a shrunken sub-mesh can then prewarm the grow
+            # direction too (the 4→8 leg of the live-resize arc)
+            devices = jax.devices()
             shape_n = tuple(world_n if a == DATA_AXIS else 1
                             for a in axes)
             from jax.sharding import Mesh
@@ -653,7 +695,8 @@ class ElasticTrainer(object):
             logger.info("prewarm: EDL_TPU_COMPILE_CACHE unset — "
                         "nowhere to persist, skipped")
             return []
-        devices = list(self.mesh.devices.flat)
+        devices = jax.devices()  # targets may exceed the CURRENT mesh
+        current = len(list(self.mesh.devices.flat))
         # the DATA-SHARDED axis of the example batch (under grad
         # accumulation the leading axis is the microbatch count, and
         # the rows sit on axis 1 — follow the sharding spec, not a
@@ -668,7 +711,7 @@ class ElasticTrainer(object):
             self._example_batch_sds)[0].shape[axis_index]
         targets = []
         for n in sorted(set(int(w) for w in world_sizes)):
-            if n == len(devices):
+            if n == current:
                 continue
             if n < 1 or n > len(devices):
                 logger.info("prewarm: world %d outside this process's "
@@ -724,7 +767,13 @@ class ElasticTrainer(object):
         if self._prewarm_in_scope() is not None:
             return None
         aot = self._aot_dir()
-        if aot is None or not os.path.isdir(aot):
+        if aot is None:
+            return None
+        # from here the cache is CONFIGURED: every early-out is a real
+        # miss (full compile paid) and counts toward the doctor's
+        # compile-cache-cold finding
+        if not os.path.isdir(aot):
+            _PREWARM_MISSES.inc()
             return None
         n = len(list(self.mesh.devices.flat))
         # any candidate for this world at all? — checked BEFORE paying
@@ -732,14 +781,17 @@ class ElasticTrainer(object):
         # the common case, e.g. a same-world restart)
         import glob as glob_mod
         if not glob_mod.glob(os.path.join(aot, "step_w%d_*.pkl" % n)):
+            _PREWARM_MISSES.inc()
             return None
         try:
             _, fp = self._step_lowered()
         except Exception:
             logger.exception("prewarm load: lowering failed")
+            _PREWARM_MISSES.inc()
             return None
         path = os.path.join(aot, "step_w%d_%s.pkl" % (n, fp))
         if not os.path.exists(path):
+            _PREWARM_MISSES.inc()
             return None
         try:
             from jax.experimental import serialize_executable as se
@@ -777,10 +829,12 @@ class ElasticTrainer(object):
             logger.info("resize prewarm HIT: world-%d step loaded from "
                         "%s in %.2fs (compile skipped)", n, path,
                         time.perf_counter() - t0)
+            _PREWARM_HITS.inc()
             return step
         except Exception:
             logger.exception("prewarm load failed (falling back to "
                              "the normal compile)")
+            _PREWARM_MISSES.inc()
             return None
 
     def local_batch_slice(self, full_batch):
@@ -825,12 +879,14 @@ class ElasticTrainer(object):
             if loaded is not None:
                 self._jit_step = loaded
         self.train_state, loss = self._jit_step(self.train_state, batch, rng)
-        if first_step:
+        if self._stamp_first_step:
+            self._stamp_first_step = False
             # resize downtime breakdown: the first dispatch wall is
             # (almost entirely) trace+compile; the extra wait to result
-            # availability is the first real step. One-time per
-            # incarnation, so the block_until_ready costs nothing the
-            # caller would not pay anyway.
+            # availability is the first real step. Once per incarnation
+            # AND once per live_resize (which re-arms the flag), so the
+            # block_until_ready costs nothing the caller would not pay
+            # anyway.
             c1 = time.perf_counter()
             self._resize_timing["compile_s"] = c1 - t0
             jax.block_until_ready(loss)
@@ -846,6 +902,10 @@ class ElasticTrainer(object):
         step_s = time.perf_counter() - t0
         self._step_times.append(step_s)
         _STEP_MS.observe(step_s * 1e3)
+        if self._live_watcher is not None:
+            # a published live-resize intent is handled HERE, at a step
+            # boundary — the drain point of the drain/reshard/swap loop
+            self._maybe_live_resize()
         if self._coord_stop is not None:
             if not self._coord_stop.started:
                 # first boundary: the baseline is final (resume() ran
@@ -873,6 +933,267 @@ class ElasticTrainer(object):
         elif self._preempted:
             self._emergency_save()
         return loss
+
+    # -- live resize (in-place reshard, no kill/respawn) ---------------------
+    #
+    # Stop-resume pays detect + kill + barrier + restore + compile per
+    # membership change. A SURVIVING process holds the state on device,
+    # a committed host snapshot on the peer plane, and (with prewarm)
+    # the new world's AOT executable — so the only genuinely required
+    # work is: drain to a step boundary, rebuild the mesh, reshard the
+    # pytree, swap the step executable. Scope: single-process trainers
+    # on a pure-dp mesh with replicated state (the JAX runtime cannot
+    # re-run jax.distributed.initialize, so cross-process worlds keep
+    # stop-resume). Protocol + the placed reshard engine live in
+    # runtime/live_resize.py; docs/elastic_resize.md has the ladder.
+
+    # everything the new mesh derives — snapshotted before a live
+    # resize so ANY failure rolls back to a numerically untouched
+    # trainer and the stop-resume ladder takes over
+    _MESH_BOUND_ATTRS = ("mesh", "_batch_sharding_early",
+                         "per_device_batch", "_host_row_spans",
+                         "per_host_batch", "_repl", "_batch_sharding",
+                         "_state_shardings", "_jit_step", "train_state")
+
+    def _snapshot_bindings(self):
+        return {a: getattr(self, a) for a in self._MESH_BOUND_ATTRS}
+
+    def _restore_bindings(self, saved):
+        for a, v in saved.items():
+            setattr(self, a, v)
+
+    def _live_scope_check(self, n_devices):
+        """Reason string when an in-place reshape to ``n_devices`` is
+        impossible, else None. The same family as _prewarm_in_scope —
+        live resize and the AOT prewarm cover exactly the same shape
+        (the stop-resume workhorse: single process, pure dp,
+        replicated state)."""
+        if jax.process_count() > 1:
+            return ("multi-process world (jax.distributed cannot "
+                    "re-initialize in place)")
+        sizes = dict(self.mesh.shape)
+        if any(sizes[a] != 1 for a in self.mesh.axis_names
+               if a != DATA_AXIS):
+            return "model-parallel mesh %s" % (sizes,)
+        flat = jax.tree_util.tree_leaves(self._state_shardings)
+        if not all(getattr(s, "spec", None) == P() for s in flat):
+            return "non-replicated state sharding"
+        n_all = len(jax.devices())
+        if n_devices < 1 or n_devices > n_all:
+            return ("target world %d outside this process's 1..%d "
+                    "devices" % (n_devices, n_all))
+        if self.total_batch_size % n_devices:
+            return ("total batch %d not divisible by target world %d"
+                    % (self.total_batch_size, n_devices))
+        return None
+
+    def _reshard_tree(self, tree, shardings):
+        """Reshard the live pytree onto ``shardings``. Fully-addressable
+        leaves (the single-process live scope) take the zero-wire fast
+        path: jax.device_put lays the new placement out straight from
+        the live device arrays. Anything else runs the placed ladder —
+        local-span paste, peer range-reads at the committed version,
+        per-span FS fill (live_resize.reshard_placed). Returns
+        (new_tree, stats)."""
+        leaves = jax.tree_util.tree_leaves(tree)
+        if all(getattr(x, "is_fully_addressable", True) for x in leaves):
+            out = jax.device_put(tree, shardings)
+            jax.block_until_ready(out)
+            nbytes = sum(int(getattr(x, "nbytes", 0)) for x in leaves)
+            return out, {"source": "local", "local_bytes": nbytes,
+                         "peer_bytes": 0, "peers": 0, "fs_keys": []}
+        from edl_tpu.runtime import live_resize as live_mod
+        version = (self._state_server.version
+                   if self._state_server is not None else None)
+        return live_mod.reshard_placed(
+            tree, shardings, coord=self.coord, ckpt=self._ckpt,
+            version=version,
+            self_endpoint=(self._state_server.endpoint
+                           if self._state_server is not None else None))
+
+    def live_resize(self, n_devices):
+        """Reshape the mesh to ``n_devices`` IN PLACE: drain the save
+        engine to a clean boundary, rebuild the dp mesh, reshard
+        params + optimizer state onto it, rebuild the step (loading the
+        prewarmed AOT executable when one exists), and resume — the
+        process never exits, so the kill/barrier/restore stages of the
+        stop-resume budget are eliminated. Stamps a fresh
+        ``_resize_timing`` record (mode "live", with the new
+        ``reshard_s`` stage); the next train_step stamps
+        compile/first-step and republishes it.
+
+        On ANY failure the trainer is rolled back to the old mesh —
+        numerically untouched, still training — and LiveResizeError is
+        raised; the caller (the intent ack path, or an operator) lets
+        the stop-resume ladder handle the membership change instead.
+        Chaos fault points: ``resize.live.drain`` (before the drain)
+        and ``resize.live.reshard`` (after the new mesh is built,
+        before any state moves)."""
+        from edl_tpu.utils.errors import LiveResizeError
+
+        n_devices = int(n_devices)
+        t_start = time.time()
+        old_n = len(list(self.mesh.devices.flat))
+        start_id = obs_events.emit("resize.live.start",
+                                   rank=self.env.global_rank,
+                                   from_devices=old_n,
+                                   to_devices=n_devices)
+        why = self._live_scope_check(n_devices)
+        if why is not None:
+            obs_events.emit("resize.live.fallback", cause=start_id,
+                            rank=self.env.global_rank, reason=why,
+                            from_devices=old_n, to_devices=n_devices)
+            raise LiveResizeError("live resize out of scope: %s" % why)
+        if n_devices == old_n:
+            obs_events.emit("resize.live.done", cause=start_id,
+                            rank=self.env.global_rank, noop=True,
+                            from_devices=old_n, to_devices=n_devices)
+            return {"mode": "live", "noop": True,
+                    "from_devices": old_n, "to_devices": n_devices}
+        saved = self._snapshot_bindings()
+        try:
+            t0 = time.perf_counter()
+            if faults.PLANE is not None:
+                faults.PLANE.fire("resize.live.drain",
+                                  from_devices=str(old_n),
+                                  to_devices=str(n_devices))
+            # drain: the in-flight async persist commits (and its peer
+            # publish runs) BEFORE the reshape — peers keep a stable
+            # version to read across our reshard
+            self.wait_for_save()
+            drain_s = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            new_mesh = make_mesh(devices=jax.devices()[:n_devices])
+            if faults.PLANE is not None:
+                faults.PLANE.fire("resize.live.reshard",
+                                  from_devices=str(old_n),
+                                  to_devices=str(n_devices))
+            self._bind_mesh(new_mesh)
+            new_shardings = jax.tree_util.tree_map(
+                lambda _: self._repl, saved["_state_shardings"])
+            self.train_state, reshard_stats = self._reshard_tree(
+                self.train_state, new_shardings)
+            self._state_shardings = new_shardings
+            self._jit_step = self._build_step()
+            prewarm = "n/a"
+            if self._example_batch_sds is not None \
+                    and self._aot_dir() is not None:
+                loaded = self._try_load_prewarmed_step()
+                if loaded is not None:
+                    self._jit_step = loaded
+                    prewarm = "hit"
+                else:
+                    prewarm = "miss"
+            reshard_s = time.perf_counter() - t1
+        except Exception as e:  # noqa: BLE001 — ANY failure rolls back
+            self._restore_bindings(saved)
+            reason = "%s: %s" % (type(e).__name__, e)
+            obs_events.emit("resize.live.fallback", cause=start_id,
+                            rank=self.env.global_rank, reason=reason,
+                            from_devices=old_n, to_devices=n_devices)
+            logger.exception("live resize %d -> %d failed; rolled back "
+                             "to the old mesh (stop-resume takes over)",
+                             old_n, n_devices)
+            if isinstance(e, LiveResizeError):
+                raise
+            raise LiveResizeError(
+                "live resize %d -> %d failed (%s); rolled back"
+                % (old_n, n_devices, reason)) from e
+        # a live resize begins a new timing "incarnation": the record
+        # carries the same stages measure_resize reads, with
+        # t_construct = the moment training paused, so the driver's
+        # after_ts filter works unchanged
+        self._resize_timing = {
+            "t_construct": t_start, "mode": "live",
+            "t_resume_start": t_start,
+            "drain_s": round(drain_s, 6),
+            "reshard_s": round(reshard_s, 6),
+            "from_devices": old_n, "to_devices": n_devices,
+            "prewarm": prewarm,
+            "restore_source": reshard_stats["source"],
+            "restore_bytes": (reshard_stats["local_bytes"]
+                              + reshard_stats["peer_bytes"]),
+            "restore_peers": reshard_stats["peers"],
+        }
+        if self._state_server is not None \
+                and self._state_server.version is not None:
+            self._resize_timing["version"] = self._state_server.version
+        self._stamp_first_step = True
+        obs_events.emit("resize.live.done", cause=start_id,
+                        rank=self.env.global_rank,
+                        from_devices=old_n, to_devices=n_devices,
+                        reshard_s=reshard_s, prewarm=prewarm,
+                        source=reshard_stats["source"])
+        logger.info("live resize %d -> %d: drain %.3fs reshard %.3fs "
+                    "(%s, prewarm %s) — process stayed alive", old_n,
+                    n_devices, drain_s, reshard_s,
+                    reshard_stats["source"], prewarm)
+        return dict(self._resize_timing)
+
+    def enable_live_resize(self, who=None):
+        """Join the live-resize protocol: advertise the TTL-leased
+        capability key (only while in scope — a dummy or multi-process
+        trainer never advertises, so the generator's eligibility check
+        routes it to stop-resume) and watch for prepare intents
+        addressed to this participant. train_step handles a pending
+        intent at the next step boundary: drain → reshard → swap →
+        ack. Returns self."""
+        from edl_tpu.runtime import live_resize as live_mod
+        if self.coord is None:
+            raise ValueError("live resize needs a coordination store "
+                             "(coord=)")
+        self._live_who = (str(who) if who is not None
+                          else (self.env.pod_id
+                                or "r%d" % self.env.global_rank))
+        why = self._live_scope_check(len(list(self.mesh.devices.flat)))
+        if why is None:
+            self._live_register = live_mod.advertise_capability(
+                self.coord, self._live_who,
+                info={"devices": len(jax.devices()),
+                      "rank": self.env.global_rank})
+        else:
+            logger.info("live resize out of scope (%s); capability not "
+                        "advertised — stop-resume only", why)
+            self._live_register = None
+        self._live_watcher = live_mod.LiveResizeWatcher(self.coord,
+                                                        self._live_who)
+        return self
+
+    def _maybe_live_resize(self):
+        """Handle a pending prepare intent at this step boundary:
+        live_resize + ack ok, or roll back + nack (the coordinator then
+        aborts and stop-resume runs). Never raises — a failed live
+        resize leaves the trainer training on its old mesh until the
+        launcher's kill arrives."""
+        from edl_tpu.runtime import live_resize as live_mod
+        from edl_tpu.utils.errors import LiveResizeError
+        rec = self._live_watcher.pending()
+        if rec is None:
+            return
+        intent_id = rec.get("id")
+        target = rec.get("devices")
+        if isinstance(target, dict):
+            target = target.get(self._live_who)
+        ok, reason, info = False, None, None
+        try:
+            if target is None:
+                raise LiveResizeError(
+                    "intent %s carries no device target for %s"
+                    % (intent_id, self._live_who))
+            stats = self.live_resize(int(target))
+            ok = True
+            info = {"world": stats.get("to_devices"),
+                    "reshard_s": stats.get("reshard_s"),
+                    "prewarm": stats.get("prewarm"),
+                    "step": self._host_step}
+        except LiveResizeError as e:
+            reason = str(e)
+        self._live_watcher.done(intent_id)
+        try:
+            live_mod.write_ack(self.coord, self._live_who, intent_id,
+                               ok, reason=reason, info=info)
+        except Exception:
+            logger.exception("live resize: ack write failed")
 
     # -- the high-level loop -------------------------------------------------
 
@@ -1354,6 +1675,15 @@ class ElasticTrainer(object):
         constructing several trainers should close the ones they
         drop)."""
         self.wait_for_save()
+        if self._live_register is not None:
+            try:
+                self._live_register.stop()
+            except Exception:
+                logger.exception("live-resize capability stop failed")
+            self._live_register = None
+        if self._live_watcher is not None:
+            self._live_watcher.stop()
+            self._live_watcher = None
         if self._state_server is not None:
             try:
                 self._state_server.stop()

@@ -35,7 +35,8 @@ from edl_tpu.obs.publisher import KEY_PREFIX as _OBS_KEY_PREFIX
 #: (they gate the whole synchronous step), then fleet-wide burn, then
 #: the warn-level plumbing signals
 _DETECTOR_RANK = {"stale_publisher": 0, "straggler": 1, "slo_burn": 2,
-                  "breaker_flap": 3, "queue_saturation": 4}
+                  "breaker_flap": 3, "queue_saturation": 4,
+                  "live_resize_fallback": 5, "prewarm_miss": 6}
 
 
 def collect(coord):
@@ -109,6 +110,101 @@ def _chain(finding, events):
     return steps
 
 
+def _counter_total(obs, name):
+    """Sum a counter across every pod's obs doc; None when no pod
+    publishes it (counter absent != counter zero)."""
+    total, seen = 0.0, False
+    for doc in obs.values():
+        metric = (((doc.get("metrics") or {}).get("metrics") or {})
+                  .get(name))
+        if not metric:
+            continue
+        for s in metric.get("series") or ():
+            seen = True
+            total += float(s.get("value") or 0.0)
+    return total if seen else None
+
+
+def _live_resize_findings(obs, timeline):
+    """Doctor-local detectors for the live-resize path (these need no
+    HealthMonitor — they read the obs docs directly):
+
+    - live_resize_fallback: a ``resize.live.fallback`` event means an
+      in-place resize rolled back and the job paid a full stop-resume;
+      the chain links the fallback to its ``resize.live.start`` via the
+      event's cause id and names the reason.
+    - prewarm_miss: prewarm-scope first steps paid a full compile and
+      none ever loaded an AOT artifact — the compile cache is cold or
+      unconfigured, so every resize (live or not) eats compile_s."""
+    findings = []
+    falls = [e for e in timeline
+             if e.get("kind") == "resize.live.fallback"]
+    if falls:
+        last = falls[-1]
+        attrs = last.get("attrs") or {}
+        cause = last.get("cause")
+        evidence = [e for e in timeline
+                    if e is last
+                    or (cause is not None and e.get("id") == cause
+                        and e.get("pod") == last.get("pod"))]
+        findings.append({
+            "pod": last.get("pod"),
+            "detector": "live_resize_fallback",
+            "severity": "warn",
+            "summary": ("live resize fell back to stop-resume: %s"
+                        % (attrs.get("reason") or "unknown reason")),
+            "events": evidence,
+            "event_ids": [i for i in (cause, last.get("id"))
+                          if i is not None],
+        })
+    hits = _counter_total(obs, "edl_resize_prewarm_hits_total")
+    misses = _counter_total(obs, "edl_resize_prewarm_misses_total")
+    if misses and not hits:
+        findings.append({
+            "pod": None,
+            "detector": "prewarm_miss",
+            "severity": "warn",
+            "summary": ("compile cache cold: %d prewarm-scope first "
+                        "step(s) paid a full compile and none loaded "
+                        "an AOT artifact — check EDL_TPU_COMPILE_CACHE "
+                        "and the prewarm_resize_compiles schedule"
+                        % int(misses)),
+            "metric": "edl_resize_prewarm_misses_total",
+            "value": misses,
+            "threshold": 0,
+            "event_ids": [],
+        })
+    return findings
+
+
+def _render_findings(findings, timeline, report_events):
+    """Sort by severity then detector class and resolve each finding's
+    evidence into a rendered chain."""
+    findings = sorted(
+        findings,
+        key=lambda f: (-health_mod.SEVERITY_RANK.get(f.get("severity"),
+                                                     0),
+                       _DETECTOR_RANK.get(f.get("detector"), 9)))
+    out = []
+    for rank, f in enumerate(findings, 1):
+        events = _resolve_events(f, timeline, report_events)
+        out.append({
+            "rank": rank,
+            "pod": f.get("pod"),
+            "detector": f.get("detector"),
+            "severity": f.get("severity"),
+            "summary": f.get("summary"),
+            "metric": f.get("metric"),
+            "value": f.get("value"),
+            "baseline": f.get("baseline"),
+            "threshold": f.get("threshold"),
+            "trace_id": f.get("trace_id"),
+            "chain": _chain(f, events),
+            "event_ids": f.get("event_ids") or [],
+        })
+    return out
+
+
 def diagnose(collected, now=None):
     """Pure: a ``collect()`` doc -> ``doctor_report/v1``."""
     now = time.time() if now is None else now
@@ -128,7 +224,16 @@ def diagnose(collected, now=None):
         report["summary"] = ("no health_report/v1 in the store — the "
                              "leader HealthMonitor has not run (job too "
                              "young, or no leader elected)")
-        report["findings"] = []
+        # the doctor-local detectors read obs docs directly, so they
+        # still fire on monitor-less jobs (bench runs, early startup)
+        report["findings"] = _render_findings(
+            _live_resize_findings(obs, timeline), timeline, ())
+        if report["findings"]:
+            head = report["findings"][0]
+            report["summary"] += ("; %d doctor-local finding(s), "
+                                  "worst: %s — %s"
+                                  % (len(report["findings"]),
+                                     head["detector"], head["summary"]))
         report["slos"] = []
         return report
 
@@ -137,29 +242,10 @@ def diagnose(collected, now=None):
                                                    or now)), 1)
     report["monitor"] = health.get("monitor")
     report["pods"] = health.get("pods") or {}
-    findings = sorted(
-        health.get("findings") or (),
-        key=lambda f: (-health_mod.SEVERITY_RANK.get(f.get("severity"),
-                                                     0),
-                       _DETECTOR_RANK.get(f.get("detector"), 9)))
-    out_findings = []
-    for rank, f in enumerate(findings, 1):
-        events = _resolve_events(f, timeline,
-                                 health.get("events") or ())
-        out_findings.append({
-            "rank": rank,
-            "pod": f.get("pod"),
-            "detector": f.get("detector"),
-            "severity": f.get("severity"),
-            "summary": f.get("summary"),
-            "metric": f.get("metric"),
-            "value": f.get("value"),
-            "baseline": f.get("baseline"),
-            "threshold": f.get("threshold"),
-            "trace_id": f.get("trace_id"),
-            "chain": _chain(f, events),
-            "event_ids": f.get("event_ids") or [],
-        })
+    out_findings = _render_findings(
+        list(health.get("findings") or ())
+        + _live_resize_findings(obs, timeline),
+        timeline, health.get("events") or ())
     report["findings"] = out_findings
     report["slos"] = health.get("slos") or []
     report["preferred_victims"] = health.get("preferred_victims") or []

@@ -23,9 +23,18 @@ Arcs:
   default (--platform cpu, 2 -> 1 devices); the 8 -> 4 TPU run uses
   the same arcs on a multi-chip host (tools/measure_resize_tpu.sh).
 
+- live / stop_resume: the zero-downtime comparison. The ``live`` arc
+  drives the in-place reshard through the live-resize two-phase commit
+  (the worker process NEVER exits — kill_s and barrier_s are
+  structurally zero, the new ``reshard_s`` stage appears, and downtime
+  is just the training pause); ``stop_resume`` SIGKILLs the same worker
+  and respawns it on the shrunken world, the classic ladder.
+
     python -m edl_tpu.tools.measure_resize --arcs cold,warm
     python -m edl_tpu.tools.measure_resize --platform cpu \
         --arcs resize_prewarm_on,resize_prewarm_off
+    python -m edl_tpu.tools.measure_resize --platform cpu \
+        --from_devices 8 --arcs live,stop_resume
 
 Each arc prints one JSON line.
 """
@@ -258,8 +267,12 @@ def run_resize_arc(prewarm, args):
 # ``resize_timing_r<rank>`` record (SERVICE_METRICS; absolute unix
 # stamps align with this driver's clock).
 
+# reshard_s: in-place live-resize stage (drain + mesh rebuild + state
+# reshard); 0.0 for every stop-resume arc, which instead pays
+# kill/barrier/restore. Old resize_bench/v1 records simply lack the key
+# and _peer_result defaults it — the schema is append-only.
 BREAKDOWN_STAGES = ("detect_s", "kill_s", "barrier_s", "restore_s",
-                    "compile_s", "first_step_s")
+                    "reshard_s", "compile_s", "first_step_s")
 
 
 def _peer_result(tag, args, mode, total_s, breakdown, restore,
@@ -498,6 +511,237 @@ def run_peer_arc_micro(peer, args):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+# -- live vs stop-resume arcs (zero-downtime in-place resize) --------------
+#
+# live: one resize_worker process on --from_devices devices; the driver
+# plays the coordinator — claims the leader key, publishes a prepare
+# intent through the live-resize 2PC, waits for the worker's ack, and
+# commits. The worker drains, reshards IN PLACE, and keeps stepping;
+# "downtime" is the training pause (t_first_step - t_resume_start) —
+# kill_s and barrier_s are structurally 0 because no process dies.
+# A second intent grows the world back, proving the arc is reversible
+# within one process lifetime.
+#
+# stop_resume: the SAME worker, but the driver SIGKILLs it and respawns
+# on the shrunken world; the classic ladder (kill + detect + respawn +
+# restore + compile) measured with the same record plumbing. The pair
+# is the paper's headline comparison.
+
+
+def _spawn_worker(store_endpoint, job_id, log_dir, args, n_devices,
+                  cache_dir=None, prewarm_worlds="", ckpt="",
+                  who="bench_worker"):
+    env = dict(os.environ)
+    if args.platform == "cpu":
+        from edl_tpu.utils.cpu_mesh import force_cpu_env
+        # the process always SEES from_devices virtual devices; the
+        # worker meshes the first n of them — so a live shrink and a
+        # stop-resume respawn run in identical device environments
+        force_cpu_env(env, max(n_devices, args.from_devices))
+    env.update({"PYTHONPATH": REPO, "EDL_TPU_POD_IP": "127.0.0.1",
+                "EDL_TPU_TTL": "3"})
+    if cache_dir:
+        env["EDL_TPU_COMPILE_CACHE"] = cache_dir
+    os.makedirs(log_dir, exist_ok=True)
+    log = open(os.path.join(log_dir, "worker.log"), "ab")
+    cmd = [sys.executable, "-u", "-m", "edl_tpu.tools.resize_worker",
+           "--store_endpoints", store_endpoint, "--job_id", job_id,
+           "--who", who, "--n_devices", str(n_devices),
+           "--total_batch", str(args.batch)]
+    if prewarm_worlds:
+        cmd += ["--prewarm_worlds", prewarm_worlds]
+    if ckpt:
+        cmd += ["--ckpt", ckpt]
+    proc = subprocess.Popen(cmd, env=env, stdout=log,
+                            stderr=subprocess.STDOUT,
+                            preexec_fn=os.setsid)
+    log.close()
+    return proc
+
+
+def _read_worker_step(coord):
+    from edl_tpu.controller import constants as C
+    try:
+        raw = coord.get_value(C.SERVICE_METRICS, "worker_step")
+        return None if not raw else json.loads(raw)
+    except Exception:  # noqa: BLE001 — store may flap mid-restart
+        return None
+
+
+def _wait_worker_step(coord, pred, timeout, proc=None):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        rec = _read_worker_step(coord)
+        if rec is not None and pred(rec):
+            return rec
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError("worker exited rc=%r before the step "
+                               "predicate" % proc.returncode)
+        time.sleep(0.2)
+    raise TimeoutError("worker step predicate not reached in %.0fs"
+                       % timeout)
+
+
+def _drive_live_resize(coord, who, n_devices, timeout):
+    """Publish a prepare intent for ``who`` → wait for the ack → commit;
+    returns (t_intent, timing_rec). The caller must hold the leader key
+    as 'bench_driver'."""
+    import uuid
+
+    from edl_tpu.runtime import live_resize as live_mod
+
+    t_intent = time.time()
+    intent = live_mod.make_intent(uuid.uuid4().hex, [who],
+                                  devices=int(n_devices),
+                                  leader="bench_driver",
+                                  deadline_s=timeout)
+    if not live_mod.publish_prepare(coord, "bench_driver", intent):
+        raise RuntimeError("bench driver does not hold the leader key")
+    ok, acks = live_mod.wait_for_acks(coord, intent, timeout)
+    if not ok:
+        live_mod.abort(coord, "bench_driver", intent,
+                       reason="bench ack wait failed")
+        raise RuntimeError("live resize to %d not acked ok: %r"
+                           % (n_devices, acks))
+    live_mod.commit(coord, "bench_driver", intent)
+    rec = _read_resize_timing(coord, after_ts=t_intent, timeout=timeout)
+    if rec.get("mode") != "live":
+        raise RuntimeError("expected a live timing record, got %r"
+                           % rec.get("mode"))
+    return t_intent, rec
+
+
+def run_live_arc(args):
+    from edl_tpu.controller import constants as C
+    from edl_tpu.coordination.client import CoordClient
+
+    tag = "live"
+    n_hi = args.from_devices
+    n_lo = max(1, n_hi // 2)
+    tmp = tempfile.mkdtemp(prefix="measure_live_")
+    cache = os.path.join(tmp, "cache")
+    os.makedirs(cache)
+    store = _spawn_store()
+    job_id = "rz_live_%d" % os.getpid()
+    coord = CoordClient([store.endpoint], root=job_id)
+    worker = None
+    wait_s = min(args.timeout, 120.0)
+    try:
+        worker = _spawn_worker(store.endpoint, job_id,
+                               os.path.join(tmp, "logs"), args, n_hi,
+                               cache_dir=cache, prewarm_worlds=str(n_lo),
+                               ckpt=os.path.join(tmp, "ckpt"))
+        _wait_worker_step(coord, lambda r: r["step"] >= 3, args.timeout,
+                          worker)
+        coord.set_server_permanent(C.SERVICE_LEADER, C.LEADER_SERVER,
+                                   "bench_driver")
+        t_intent, rec = _drive_live_resize(coord, "bench_worker", n_lo,
+                                           wait_s)
+        pause = rec["t_first_step"] - rec["t_resume_start"]
+        breakdown = {
+            "detect_s": max(0.0, rec["t_resume_start"] - t_intent),
+            "kill_s": 0.0, "barrier_s": 0.0, "restore_s": 0.0,
+            "reshard_s": (rec.get("drain_s", 0.0)
+                          + rec.get("reshard_s", 0.0)),
+            "compile_s": rec.get("compile_s", 0.0),
+            "first_step_s": rec.get("first_step_s", 0.0),
+        }
+        restore = {"source": rec.get("restore_source"),
+                   "bytes": rec.get("restore_bytes"),
+                   "peers": rec.get("restore_peers"),
+                   "version": rec.get("version")}
+        # grow back to the full world: same process, second intent
+        _, rec_up = _drive_live_resize(coord, "bench_worker", n_hi,
+                                       wait_s)
+        alive = worker.poll() is None
+        out = _peer_result(
+            tag, args, "live", pause, breakdown, restore,
+            from_devices=n_hi, to_devices=n_lo,
+            prewarm=rec.get("prewarm"),
+            drain_s=round(rec.get("drain_s", 0.0), 3),
+            process_survived=alive,
+            grow={"to_devices": n_hi,
+                  "pause_s": round(rec_up["t_first_step"]
+                                   - rec_up["t_resume_start"], 3),
+                  "prewarm": rec_up.get("prewarm")})
+        if not alive:
+            out["warning"] = ("worker process exited during the live "
+                              "arc — the in-place path did not hold")
+        return out
+    finally:
+        if worker is not None:
+            _kill_group(worker)
+        store.stop()
+        if os.environ.get("MEASURE_RESIZE_KEEP"):
+            print("kept workdir: %s" % tmp, file=sys.stderr)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def run_stop_resume_arc(args):
+    import glob as glob_mod
+
+    from edl_tpu.coordination.client import CoordClient
+
+    tag = "stop_resume"
+    n_hi = args.from_devices
+    n_lo = max(1, n_hi // 2)
+    tmp = tempfile.mkdtemp(prefix="measure_stop_resume_")
+    cache = os.path.join(tmp, "cache")
+    os.makedirs(cache)
+    ckpt = os.path.join(tmp, "ckpt")
+    store = _spawn_store()
+    job_id = "rz_sr_%d" % os.getpid()
+    coord = CoordClient([store.endpoint], root=job_id)
+    worker = None
+    try:
+        worker = _spawn_worker(store.endpoint, job_id,
+                               os.path.join(tmp, "logs"), args, n_hi,
+                               cache_dir=cache, ckpt=ckpt)
+        # at least one committed checkpoint before the kill, or the
+        # respawn has nothing to resume (worker saves every 5 steps)
+        _wait_worker_step(coord, lambda r: r["step"] >= 7, args.timeout,
+                          worker)
+        t0 = time.monotonic()
+        while not glob_mod.glob(os.path.join(ckpt, "v_*")):
+            if time.monotonic() - t0 > args.timeout:
+                raise TimeoutError("no checkpoint committed before kill")
+            time.sleep(0.2)
+        t_kill = time.time()
+        _kill_group(worker)
+        t_killed = time.time()
+        t_spawn = time.time()
+        worker = _spawn_worker(store.endpoint, job_id,
+                               os.path.join(tmp, "logs2"), args, n_lo,
+                               cache_dir=cache, ckpt=ckpt)
+        rec = _read_resize_timing(coord, after_ts=t_kill,
+                                  timeout=args.timeout)
+        breakdown = {
+            "detect_s": t_spawn - t_killed,
+            "kill_s": t_killed - t_kill,
+            "barrier_s": max(0.0, rec["t_resume_start"] - t_spawn),
+            "restore_s": rec.get("restore_s", 0.0),
+            "reshard_s": 0.0,
+            "compile_s": rec.get("compile_s", 0.0),
+            "first_step_s": rec.get("first_step_s", 0.0),
+        }
+        restore = {"source": rec.get("restore_source"),
+                   "bytes": rec.get("restore_bytes"),
+                   "peers": rec.get("restore_peers"),
+                   "version": rec.get("version")}
+        return _peer_result(
+            tag, args, "stop_resume", rec["t_first_step"] - t_kill,
+            breakdown, restore, from_devices=n_hi, to_devices=n_lo)
+    finally:
+        if worker is not None:
+            _kill_group(worker)
+        store.stop()
+        if os.environ.get("MEASURE_RESIZE_KEEP"):
+            print("kept workdir: %s" % tmp, file=sys.stderr)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("measure kill->first-step recovery")
     p.add_argument("--arcs", default="cold,warm")
@@ -536,6 +780,10 @@ def main(argv=None):
                 if tag in ("peer_restore_on", "peer_restore_off"):
                     out = (run_peer_arc_micro if args.micro
                            else run_peer_arc)(tag.endswith("_on"), args)
+                elif tag == "live":
+                    out = run_live_arc(args)
+                elif tag == "stop_resume":
+                    out = run_stop_resume_arc(args)
                 elif tag in ("resize_prewarm_on", "resize_prewarm_off"):
                     out = run_resize_arc(tag.endswith("_on"), args)
                 else:

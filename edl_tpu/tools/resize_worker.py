@@ -1,0 +1,96 @@
+"""Live-resize bench worker: one single-process trainer that joins the
+live-resize protocol and publishes its progress.
+
+measure_resize's ``live`` / ``stop_resume`` arcs need a trainer whose
+world can change BOTH ways under the same driver:
+
+- live arc: the driver publishes a prepare intent through the store;
+  this worker's train_step drains, reshards in place, acks, and keeps
+  stepping — the process never exits, and the driver reads the
+  ``mode: live`` resize_timing record.
+- stop_resume arc: the driver SIGKILLs this process and respawns it
+  with a smaller ``--n_devices``; the fresh incarnation resumes from
+  the checkpoint and publishes the classic ``mode: stop_resume``
+  record.
+
+Every step writes a ``worker_step`` key under SERVICE_METRICS
+({"step", "world", "ts"}) so the driver can watch training progress
+without scraping logs. The model is the tiny linear fixture — the arcs
+time the RESIZE machinery, not the math.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("live-resize bench worker")
+    p.add_argument("--store_endpoints", required=True)
+    p.add_argument("--job_id", required=True)
+    p.add_argument("--who", default="bench_worker")
+    p.add_argument("--n_devices", type=int, required=True,
+                   help="initial mesh size (first n of jax.devices())")
+    p.add_argument("--total_batch", type=int, default=64)
+    p.add_argument("--steps", type=int, default=1000000)
+    p.add_argument("--save_every", type=int, default=5)
+    p.add_argument("--prewarm_worlds", default="",
+                   help="comma list of world sizes to AOT-compile "
+                        "before the step loop")
+    p.add_argument("--ckpt", default="")
+    args = p.parse_args(argv)
+
+    # the spawner owns the platform env (JAX_PLATFORMS / XLA_FLAGS
+    # virtual device count); import jax only after it is set
+    import jax
+    import optax
+
+    from edl_tpu.controller import constants
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.mesh import make_mesh
+    from edl_tpu.runtime.trainer import ElasticTrainer
+
+    coord = CoordClient(args.store_endpoints.split(","), root=args.job_id)
+    mesh = make_mesh(devices=jax.devices()[:args.n_devices])
+    trainer = ElasticTrainer(
+        linear.loss_fn, linear.init_params(), optax.sgd(0.05),
+        total_batch_size=args.total_batch, mesh=mesh, coord=coord,
+        checkpoint_dir=args.ckpt or None,
+        async_save=bool(args.ckpt))
+    resumed = trainer.resume() if args.ckpt else False
+    trainer.enable_live_resize(who=args.who)
+    print("worker up: pid=%d world=%d resumed=%s" %
+          (os.getpid(), args.n_devices, resumed), flush=True)
+
+    batch = linear.synthetic_batch(args.total_batch, seed=0)
+    prewarmed = False
+    for step in range(args.steps):
+        trainer.train_step(trainer.local_batch_slice(batch))
+        if args.prewarm_worlds and not prewarmed:
+            # the prewarm needs the batch structure, which the first
+            # train_step captured; compile the other worlds now so the
+            # live resize's executable swap is a cache hit
+            worlds = [int(w) for w in args.prewarm_worlds.split(",")
+                      if w]
+            trainer.prewarm_resize_compiles(worlds, block=True)
+            prewarmed = True
+        world = len(list(trainer.mesh.devices.flat))
+        try:
+            coord.set_server_permanent(
+                constants.SERVICE_METRICS, "worker_step",
+                json.dumps({"step": step + 1, "world": world,
+                            "pid": os.getpid(), "ts": time.time()}))
+        except Exception:  # noqa: BLE001 — progress key is best-effort
+            pass
+        if args.ckpt and args.save_every \
+                and (step + 1) % args.save_every == 0:
+            trainer.save()
+    trainer.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

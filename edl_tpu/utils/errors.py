@@ -111,6 +111,13 @@ class PeerRestoreError(EdlError):
     the caller restores wholesale from the shared FS."""
 
 
+class LiveResizeError(EdlError):
+    """The in-place live resize could not complete (out of scope,
+    drain/reshard failure, rolled back). The trainer is left on its
+    OLD mesh, numerically untouched; the caller falls back to the
+    stop-resume ladder."""
+
+
 _NAME_TO_CLS = None
 
 

@@ -186,6 +186,39 @@ def test_measure_resize_micro_peer_arc_cpu_schema(capsys):
     json.dumps(out)  # round-trips
 
 
+def test_measure_resize_live_arc_cpu_schema(capsys):
+    """Tier-1 smoke of the live in-place resize arc: one worker process
+    is resized 8→4→8 through the store 2PC without ever exiting, and
+    the emitted resize_bench/v1 record must show the live shape —
+    kill_s/barrier_s/restore_s structurally zero, reshard_s carrying
+    the pause, the process alive at the end. No live-vs-stop_resume
+    timing gate here — CI boxes are too noisy; the acceptance run
+    compares the two arcs offline."""
+    import json
+
+    from edl_tpu.tools import measure_resize
+
+    rc = measure_resize.main(["--arcs", "live", "--platform", "cpu",
+                              "--from_devices", "8", "--timeout", "120"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "error" not in out and "warning" not in out
+    assert out["schema"] == "resize_bench/v1"
+    assert out["metric"] == "resize_downtime_s_live"
+    assert out["arc"] == "live" and out["mode"] == "live"
+    assert set(out["breakdown"]) == set(measure_resize.BREAKDOWN_STAGES)
+    assert out["breakdown"]["kill_s"] == 0.0
+    assert out["breakdown"]["barrier_s"] == 0.0
+    assert out["breakdown"]["restore_s"] == 0.0
+    assert out["value"] > 0 and out["breakdown"]["reshard_s"] > 0
+    assert (out["from_devices"], out["to_devices"]) == (8, 4)
+    assert out["process_survived"] is True
+    assert out["grow"]["to_devices"] == 8  # same process grew back
+    json.dumps(out)  # round-trips
+
+
 def test_store_bench_micro_schema():
     """The replicated-store bench must keep working hermetically under
     tier-1 and honor its JSON contract (schema store_bench/v1): the

@@ -1,0 +1,566 @@
+"""Zero-downtime live resize: the in-place reshard engine, the 2PC
+store protocol, the generator/launcher integration, and the liveft
+transition classifier.
+
+The headline contract: a live 8→4→8 resize produces params + optimizer
+state BYTE-IDENTICAL to a stop-resume (kill / respawn / restore) over
+the same mesh sequence — the live path changes how fast a resize is,
+never what it computes. (Neither path is bitwise-comparable to a
+never-resized run: any world change reorders the allreduce.) The chaos
+drill proves the other half: a fault mid-reshard rolls back to the old
+mesh byte-identically and surfaces as LiveResizeError, so the
+stop-resume ladder stays the safety net.
+
+Runs on the conftest's 8 virtual CPU devices — single process, pure dp,
+replicated state: exactly the live-resize scope.
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants
+from edl_tpu.models import linear
+from edl_tpu.obs import events as obs_events
+from edl_tpu.robustness import faults
+from edl_tpu.runtime import live_resize as live_mod
+from edl_tpu.runtime.mesh import make_mesh
+from edl_tpu.runtime.trainer import ElasticTrainer
+from edl_tpu.utils.errors import LiveResizeError
+
+TOTAL_BATCH = 64
+BATCHES = [linear.synthetic_batch(TOTAL_BATCH, seed=i) for i in range(8)]
+
+
+def _trainer(n_devices, ckpt=None, coord=None, **kw):
+    return ElasticTrainer(
+        linear.loss_fn, linear.init_params(), optax.sgd(0.05),
+        total_batch_size=TOTAL_BATCH,
+        mesh=make_mesh(devices=jax.devices()[:n_devices]),
+        checkpoint_dir=ckpt, coord=coord, **kw)
+
+
+def _steps(trainer, batches):
+    for b in batches:
+        trainer.train_step(trainer.local_batch_slice(b))
+
+
+def _state_bytes(trainer):
+    return [np.asarray(x).tobytes()
+            for x in jax.tree_util.tree_leaves(trainer.train_state)]
+
+
+def _world(trainer):
+    return len(list(trainer.mesh.devices.flat))
+
+
+def _wait(pred, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within %ss" % timeout)
+
+
+# -- the engine: byte identity, rollback, edges ----------------------------
+
+
+def test_live_resize_byte_identical_to_stop_resume(tmp_path):
+    """The acceptance contract: live 8→4→8 == stop-resume 8→4→8,
+    byte for byte, over the same batch schedule."""
+    live = _trainer(8)
+    _steps(live, BATCHES[:2])
+    rec_dn = live.live_resize(4)
+    assert rec_dn["mode"] == "live"
+    assert (rec_dn["from_devices"], rec_dn["to_devices"]) == (8, 4)
+    assert _world(live) == 4
+    _steps(live, BATCHES[2:4])
+    rec_up = live.live_resize(8)
+    assert (rec_up["from_devices"], rec_up["to_devices"]) == (4, 8)
+    assert _world(live) == 8
+    _steps(live, BATCHES[4:6])
+
+    # the stop-resume chain: three incarnations over the same worlds
+    ckpt = str(tmp_path / "ckpt")
+    a = _trainer(8, ckpt=ckpt)
+    _steps(a, BATCHES[:2])
+    a.save()
+    b = _trainer(4, ckpt=ckpt)
+    assert b.resume()
+    _steps(b, BATCHES[2:4])
+    b.save()
+    c = _trainer(8, ckpt=ckpt)
+    assert c.resume()
+    _steps(c, BATCHES[4:6])
+
+    assert _state_bytes(live) == _state_bytes(c)
+
+
+@pytest.mark.parametrize("point", ["resize.live.drain",
+                                   "resize.live.reshard"])
+def test_live_resize_fault_rolls_back_byte_identical(point):
+    """The chaos drill: a fault at either live fault point rolls the
+    trainer back to the OLD mesh with state untouched (zero
+    divergence), raises LiveResizeError (the nack path), emits the
+    fallback event, and the trainer keeps training."""
+    tr = _trainer(8)
+    _steps(tr, BATCHES[:2])
+    before = _state_bytes(tr)
+    mark = obs_events.emit("test.live_resize.mark")
+    plane = faults.FaultPlane(seed=7)
+    plane.inject(point, "error", error="RpcError")
+    plane.install()
+    try:
+        with pytest.raises(LiveResizeError):
+            tr.live_resize(4)
+    finally:
+        plane.uninstall()
+    assert (point, "error") in plane.log  # the fault actually fired
+    assert _world(tr) == 8
+    assert _state_bytes(tr) == before
+    kinds = [e["kind"] for e in obs_events.EVENTS.snapshot(since_id=mark)]
+    assert "resize.live.fallback" in kinds
+    # numerically untouched AND still functional on the old mesh
+    _steps(tr, [BATCHES[2]])
+
+
+def test_live_resize_single_survivor_and_back():
+    """The 8→1→8 edge: one device is still a valid dp mesh; the reshard
+    is the pure zero-wire fast path (no store, no peers, no FS)."""
+    tr = _trainer(8)
+    _steps(tr, BATCHES[:1])
+    rec = tr.live_resize(1)
+    assert _world(tr) == 1
+    assert rec["restore_source"] == "local"
+    assert rec["restore_peers"] == 0
+    _steps(tr, BATCHES[1:2])
+    rec_up = tr.live_resize(8)
+    assert _world(tr) == 8
+    assert rec_up["restore_source"] == "local"
+    _steps(tr, BATCHES[2:3])
+
+
+def test_live_resize_noop_and_scope_rejections():
+    tr = _trainer(8)
+    _steps(tr, BATCHES[:1])
+    assert tr.live_resize(8).get("noop") is True
+    before = _state_bytes(tr)
+    for bad in (0, len(jax.devices()) + 1, 3):  # range, range, 64 % 3
+        with pytest.raises(LiveResizeError):
+            tr.live_resize(bad)
+    assert _world(tr) == 8
+    assert _state_bytes(tr) == before
+
+
+def test_live_resize_prewarm_hit(tmp_path, monkeypatch):
+    """With a compile cache and a prewarmed target world, the live
+    swap loads the AOT executable instead of recompiling — the record
+    says so, and that is what the doctor's prewarm_miss detector keys
+    off."""
+    monkeypatch.setenv("EDL_TPU_COMPILE_CACHE", str(tmp_path / "cache"))
+    tr = _trainer(8)
+    _steps(tr, BATCHES[:1])  # the prewarm needs the batch structure
+    assert tr.prewarm_resize_compiles([4], block=True) == [4]
+    rec = tr.live_resize(4)
+    assert rec["prewarm"] == "hit"
+    _steps(tr, BATCHES[1:2])
+    # the un-prewarmed grow leg is an honest miss, not "n/a"
+    assert tr.live_resize(8)["prewarm"] == "miss"
+
+
+# -- the store protocol ----------------------------------------------------
+
+
+def _cluster_key(coord):
+    return (coord.service_prefix(constants.SERVICE_CLUSTER)
+            + constants.CLUSTER_SERVER)
+
+
+def test_intent_protocol_roundtrip(coord):
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "gen_a")
+    intent = live_mod.make_intent("i1", ["w1", "w2"],
+                                  devices={"w1": 4, "w2": 4},
+                                  leader="gen_a", cluster_json="{}")
+    assert live_mod.publish_prepare(coord, "gen_a", intent)
+    assert live_mod.read_intent(coord)["phase"] == live_mod.PREPARE
+    # a deposed coordinator's writes are all no-ops
+    assert not live_mod.publish_prepare(coord, "gen_b", intent)
+    assert not live_mod.commit(coord, "gen_b", intent)
+    assert not live_mod.abort(coord, "gen_b", intent)
+    # acks are scoped by intent id: a stale ack from a previous resize
+    # never satisfies this one
+    live_mod.write_ack(coord, "w1", "i1", True, info={"world": 4})
+    live_mod.write_ack(coord, "w2", "i0_stale", True)
+    assert set(live_mod.read_acks(coord, "i1")) == {"w1"}
+    live_mod.write_ack(coord, "w2", "i1", True)
+    ok, acks = live_mod.wait_for_acks(coord, intent, timeout=5)
+    assert ok and set(acks) == {"w1", "w2"}
+    assert acks["w1"]["world"] == 4
+    # commit flips the phase AND installs the cluster map in ONE txn
+    assert live_mod.commit(coord, "gen_a", intent,
+                           extra_puts=[(_cluster_key(coord), "MAP")])
+    assert live_mod.read_intent(coord)["phase"] == live_mod.COMMIT
+    assert coord.get_value(constants.SERVICE_CLUSTER,
+                           constants.CLUSTER_SERVER) == "MAP"
+
+
+def test_nack_wait_and_abort(coord):
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "gen_a")
+    intent = live_mod.make_intent("i2", ["w1", "w2"], leader="gen_a")
+    assert live_mod.publish_prepare(coord, "gen_a", intent)
+    live_mod.write_ack(coord, "w1", "i2", True)
+    live_mod.write_ack(coord, "w2", "i2", False, reason="out of scope")
+    ok, acks = live_mod.wait_for_acks(coord, intent, timeout=5)
+    assert not ok and set(acks) == {"w1", "w2"}
+    assert live_mod.abort(coord, "gen_a", intent, reason="nack w2")
+    after = live_mod.read_intent(coord)
+    assert after["phase"] == live_mod.ABORT
+    assert after["abort_reason"] == "nack w2"
+    # a missing ack times out to not-ok too
+    intent3 = live_mod.make_intent("i3", ["w1", "ghost"], leader="gen_a")
+    assert live_mod.publish_prepare(coord, "gen_a", intent3)
+    live_mod.write_ack(coord, "w1", "i3", True)
+    ok, acks = live_mod.wait_for_acks(coord, intent3, timeout=0.5)
+    assert not ok and set(acks) == {"w1"}
+
+
+def test_live_resize_watcher(coord):
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "gen_a")
+    # a pre-existing intent is picked up at construction, not just via
+    # the watch
+    i1 = live_mod.make_intent("w_i1", ["me"], devices=4, leader="gen_a")
+    assert live_mod.publish_prepare(coord, "gen_a", i1)
+    w = live_mod.LiveResizeWatcher(coord, "me")
+    try:
+        assert _wait(lambda: w.pending())["id"] == "w_i1"
+        w.done("w_i1")
+        assert w.pending() is None
+        # a later intent arrives through the watch; one addressed to
+        # someone else never surfaces; an expired one is dropped
+        other = live_mod.make_intent("w_other", ["not_me"], leader="gen_a")
+        assert live_mod.publish_prepare(coord, "gen_a", other)
+        expired = live_mod.make_intent("w_exp", ["me"], leader="gen_a",
+                                       deadline_s=-1.0)
+        assert live_mod.publish_prepare(coord, "gen_a", expired)
+        time.sleep(0.3)
+        assert w.pending() is None
+        i2 = live_mod.make_intent("w_i2", ["me"], devices=8,
+                                  leader="gen_a")
+        assert live_mod.publish_prepare(coord, "gen_a", i2)
+        assert _wait(lambda: w.pending())["id"] == "w_i2"
+        # handled ids never come back, even if the key is re-delivered
+        w.done("w_i2")
+        assert live_mod.publish_prepare(coord, "gen_a", i2)
+        time.sleep(0.3)
+        assert w.pending() is None
+    finally:
+        w.stop()
+
+
+def test_capability_advertise_and_ready(coord):
+    reg = live_mod.advertise_capability(coord, "w1",
+                                        info={"devices": 8}, ttl=5)
+    assert reg is not None
+    try:
+        assert _wait(lambda: "w1" in live_mod.ready_participants(coord))
+    finally:
+        reg.stop()
+    _wait(lambda: "w1" not in live_mod.ready_participants(coord))
+
+
+# -- the generator's two-phase commit --------------------------------------
+
+
+def _pod():
+    import os
+
+    from edl_tpu.controller.env import JobEnv
+    from edl_tpu.controller.pod import Pod
+    os.environ["EDL_TPU_POD_IP"] = "127.0.0.1"
+    args = type("A", (), dict(
+        job_id="test_job", store_endpoints="x", nodes_range="1:4",
+        nproc_per_node=1, pod_ip="127.0.0.1", checkpoint_path=None,
+        log_dir=None, log_level=None))()
+    return Pod.from_env(JobEnv(args))
+
+
+def _cluster(pods):
+    c = cluster_mod.Cluster()
+    c.pods = list(pods)
+    c.assign_ranks()
+    return c
+
+
+def _acker(coord, verdicts, stop):
+    """Poll for a prepare intent and ack it like the survivors would."""
+    while not stop.is_set():
+        intent = live_mod.read_intent(coord)
+        if intent and intent.get("phase") == live_mod.PREPARE:
+            for who in intent["survivors"]:
+                live_mod.write_ack(coord, who, intent["id"],
+                                   verdicts.get(who, True),
+                                   reason=None if verdicts.get(who, True)
+                                   else "drill nack")
+            return
+        time.sleep(0.05)
+
+
+def test_generator_live_commit_two_phase(coord):
+    from edl_tpu.controller.cluster_generator import Generator
+    pod_a, pod_b = _pod(), _pod()
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, pod_a.id)
+    gen = Generator(coord, pod_a.id, min_nodes=1, max_nodes=2,
+                    live_ack_timeout=5.0)
+    new = _cluster([pod_a])  # shrink: pod_b leaves, pod_a survives
+    stop = threading.Event()
+    t = threading.Thread(target=_acker, args=(coord, {}, stop),
+                         daemon=True)
+    t.start()
+    try:
+        assert gen._try_live_commit(new, _cluster_key(coord))
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    intent = live_mod.read_intent(coord)
+    assert intent["phase"] == live_mod.COMMIT
+    assert intent["survivors"] == [pod_a.id]
+    assert intent["devices"][pod_a.id] >= 1
+    # the cluster map landed in the SAME transaction
+    installed = cluster_mod.load_from_store(coord)
+    assert installed is not None
+    assert installed.pod_ids() == [pod_a.id]
+
+
+def test_generator_live_nack_aborts_to_stop_resume(coord):
+    from edl_tpu.controller.cluster_generator import Generator
+    pod_a = _pod()
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, pod_a.id)
+    gen = Generator(coord, pod_a.id, min_nodes=1, max_nodes=2,
+                    live_ack_timeout=5.0)
+    new = _cluster([pod_a])
+    stop = threading.Event()
+    t = threading.Thread(target=_acker,
+                         args=(coord, {pod_a.id: False}, stop),
+                         daemon=True)
+    t.start()
+    try:
+        assert gen._try_live_commit(new, _cluster_key(coord)) is False
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    intent = live_mod.read_intent(coord)
+    assert intent["phase"] == live_mod.ABORT
+    assert pod_a.id in intent["abort_reason"]
+    # no map installed: the caller falls through to stop-resume commit
+    assert cluster_mod.load_from_store(coord) is None
+
+
+def test_generator_aborts_stale_foreign_intent(coord):
+    """Leader loss mid-reshard: the old coordinator published prepare
+    and died; the NEW leader's first generation pass aborts the orphan
+    so survivors stop draining and stop-resume runs."""
+    from edl_tpu.controller.cluster_generator import Generator
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "dead_gen")
+    orphan = live_mod.make_intent("orphan", ["w1"], leader="dead_gen")
+    assert live_mod.publish_prepare(coord, "dead_gen", orphan)
+    # leadership moves
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "gen_b")
+    Generator(coord, "gen_b", min_nodes=1,
+              max_nodes=2)._abort_stale_intent()
+    after = live_mod.read_intent(coord)
+    assert after["phase"] == live_mod.ABORT
+    assert "dead_gen" in after["abort_reason"]
+    # its own fresh prepare is NOT stale — a second pass leaves it alone
+    own = live_mod.make_intent("own", ["w1"], leader="gen_b")
+    assert live_mod.publish_prepare(coord, "gen_b", own)
+    Generator(coord, "gen_b", min_nodes=1,
+              max_nodes=2)._abort_stale_intent()
+    assert live_mod.read_intent(coord)["phase"] == live_mod.PREPARE
+
+
+def test_generator_live_eligibility(coord):
+    from edl_tpu.controller.cluster_generator import Generator
+    pod_a, pod_b, pod_c = _pod(), _pod(), _pod()
+    gen = Generator(coord, pod_a.id, min_nodes=1, max_nodes=3)
+    current = _cluster([pod_a, pod_b])
+    shrink = _cluster([pod_a])
+    grow = _cluster([pod_a, pod_b, pod_c])
+    # no current cluster yet → cold start is stop-resume
+    assert not gen._live_eligible(None, shrink)
+    # a joining pod has no process to reshape
+    assert not gen._live_eligible(current, grow)
+    # survivors-only, but nobody advertises the capability
+    assert not gen._live_eligible(current, shrink)
+    regs = [live_mod.advertise_capability(coord, p.id)
+            for p in (pod_a, pod_b)]
+    try:
+        assert _wait(lambda: gen._live_eligible(current, shrink))
+        assert gen._live_eligible(current, current)
+    finally:
+        for r in regs:
+            r.stop()
+
+
+# -- the launcher's adoption gate ------------------------------------------
+
+
+def test_launcher_live_intent_gating(coord):
+    from edl_tpu.controller.launcher import Launcher
+    pod = _pod()
+    launcher = Launcher.__new__(Launcher)
+    launcher._coord = coord
+    launcher._pod = pod
+    launcher._live_done = set()
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, "gen_a")
+    assert launcher._live_intent_for_pod() is None  # no intent at all
+    intent = live_mod.make_intent("L1", [pod.id], devices={pod.id: 4},
+                                  leader="gen_a")
+    assert live_mod.publish_prepare(coord, "gen_a", intent)
+    assert launcher._live_intent_for_pod() is None  # prepare ≠ commit
+    assert live_mod.commit(coord, "gen_a", intent)
+    assert launcher._live_intent_for_pod() is None  # no ok ack yet
+    live_mod.write_ack(coord, pod.id, "L1", False, reason="drill")
+    assert launcher._live_intent_for_pod() is None  # nack ≠ ok
+    live_mod.write_ack(coord, pod.id, "L1", True)
+    got = launcher._live_intent_for_pod()
+    assert got is not None and got["id"] == "L1"
+    launcher._live_done.add("L1")
+    assert launcher._live_intent_for_pod() is None  # consumed once
+    # an intent that excludes this pod is never adopted
+    foreign = live_mod.make_intent("L2", ["someone_else"], leader="gen_a")
+    assert live_mod.publish_prepare(coord, "gen_a", foreign)
+    assert live_mod.commit(coord, "gen_a", foreign)
+    assert launcher._live_intent_for_pod() is None
+
+
+# -- liveft: the transition classifier -------------------------------------
+
+
+def test_classify_transition():
+    from edl_tpu.liveft import elastic as el
+    assert el.classify_transition(["a", "b"], ["a"], "a") == el.SHRINK
+    assert el.classify_transition(["a"], ["a", "b"], "a") == el.GROW
+    # mixed join+leave is conservatively a SHRINK for survivors
+    assert el.classify_transition(["a", "b"], ["b", "c"], "b") == el.SHRINK
+    assert el.classify_transition(["a", "b"], ["b", "c"],
+                                  "a") == el.SELF_EVICTED
+    assert el.classify_transition(["a"], ["a"], "a") == el.UNCHANGED
+    assert el.classify_transition(None, ["a"], "b") == el.SELF_EVICTED
+
+
+def _manager(coord, host, np_target, seen):
+    from edl_tpu.liveft import elastic as el
+    m = el.ElasticManager(
+        coord, host, np_target,
+        on_transition=lambda k, old, new: seen.append((k, old, new)))
+    m._registered.set()  # no threads: drive watch() by hand
+    return m
+
+
+def _register_hosts(coord, hosts):
+    from edl_tpu.liveft import elastic as el
+    for h in hosts:
+        coord.set_server_permanent(el.SERVICE_NODES, h, "1")
+
+
+def test_elastic_manager_shrink_transition(coord):
+    from edl_tpu.liveft import elastic as el
+    seen = []
+    m = _manager(coord, "h1", 2, seen)
+    m._agreed_hosts = ["h1", "h2", "h3"]
+    _register_hosts(coord, ["h1", "h2"])
+    m._hosts_changed.set()
+    assert m.watch(poll=0.01) == el.RESTART
+    assert seen == [(el.SHRINK, ["h1", "h2", "h3"], ["h1", "h2"])]
+
+
+def test_elastic_manager_grow_transition(coord):
+    from edl_tpu.liveft import elastic as el
+    seen = []
+    m = _manager(coord, "h1", 2, seen)
+    m._agreed_hosts = ["h1"]
+    _register_hosts(coord, ["h1", "h2"])
+    m._np = 2
+    m._np_changed.set()
+    assert m.watch(poll=0.01) == el.RESTART
+    assert seen == [(el.GROW, ["h1"], ["h1", "h2"])]
+
+
+def test_elastic_manager_self_eviction_is_error(coord):
+    """The world settled at np WITHOUT us: ERROR, not the old
+    HOLD-forever."""
+    from edl_tpu.liveft import elastic as el
+    seen = []
+    m = _manager(coord, "h1", 2, seen)
+    m._agreed_hosts = ["h1", "h2"]
+    _register_hosts(coord, ["h2", "h3"])
+    m._hosts_changed.set()
+    assert m.watch(poll=0.01) == el.ERROR
+    assert seen == [(el.SELF_EVICTED, ["h1", "h2"], ["h2", "h3"])]
+
+
+def test_elastic_manager_flap_is_not_a_restart(coord):
+    """A watch event that settles back to the agreed membership (lease
+    blip, store failover) must neither RESTART nor notify."""
+    from edl_tpu.liveft import elastic as el
+    seen = []
+    m = _manager(coord, "h1", 2, seen)
+    m._agreed_hosts = ["h1", "h2"]
+    _register_hosts(coord, ["h1", "h2"])
+    m._hosts_changed.set()
+    assert m.watch(poll=0.01) == el.HOLD
+    assert not m._hosts_changed.is_set()  # the flap was consumed
+    assert seen == []
+
+
+# -- the doctor's live-resize detectors ------------------------------------
+
+
+def test_job_doctor_live_resize_findings():
+    from edl_tpu.tools import job_doctor
+    events = [
+        {"id": 1, "ts": 100.0, "kind": "resize.live.start", "cause": None,
+         "attrs": {"from_devices": 8, "to_devices": 4}},
+        {"id": 2, "ts": 101.0, "kind": "resize.live.fallback", "cause": 1,
+         "attrs": {"reason": "RpcError: fault injected",
+                   "from_devices": 8, "to_devices": 4}},
+    ]
+    obs_doc = {
+        "schema": "obs_pub/v1", "events": events,
+        "metrics": {"metrics": {"edl_resize_prewarm_misses_total": {
+            "series": [{"value": 3.0}]}}},
+    }
+    report = job_doctor.diagnose({"job_id": "j", "job_status": None,
+                                  "health": None,
+                                  "obs": {"pod-00": obs_doc}})
+    assert report["verdict"] == "unknown"
+    assert [f["detector"] for f in report["findings"]] == [
+        "live_resize_fallback", "prewarm_miss"]
+    fall = report["findings"][0]
+    assert fall["pod"] == "pod-00"
+    assert "RpcError" in fall["summary"]
+    # the chain links the fallback to its start event via the cause id
+    assert any("resize.live.start" in step for step in fall["chain"])
+    assert any("resize.live.fallback" in step for step in fall["chain"])
+    miss = report["findings"][1]
+    assert miss["metric"] == "edl_resize_prewarm_misses_total"
+    assert "EDL_TPU_COMPILE_CACHE" in miss["summary"]
+    assert "doctor-local" in report["summary"]
+    json.dumps(report)
+    job_doctor.render(report)  # the human surface renders the chains
