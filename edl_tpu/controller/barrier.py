@@ -12,6 +12,7 @@ import threading
 from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, leader
 from edl_tpu.controller.resource_pods import load_resource_pods
+from edl_tpu.obs import ledger as obs_ledger
 from edl_tpu.obs.publisher import MetricsPublisher
 from edl_tpu.robustness.policy import Deadline, RetryPolicy
 from edl_tpu.rpc.client import RpcClient
@@ -162,7 +163,8 @@ def barrier_wait(coord, pod_id, timeout=constants.BARRIER_TIMEOUT):
     the agreed Cluster. Raises TimeoutError_ after ``timeout`` seconds."""
     session = _BarrierSession(coord, pod_id)
     try:
-        return _BARRIER_RETRY.call(session.attempt,
-                                   deadline=Deadline(timeout))
+        with obs_ledger.LEDGER.state("barrier_wait"):
+            return _BARRIER_RETRY.call(session.attempt,
+                                       deadline=Deadline(timeout))
     finally:
         session.close()

@@ -18,6 +18,7 @@ from edl_tpu.controller.cluster_generator import Generator
 from edl_tpu.controller.cluster_watcher import ClusterWatcher
 from edl_tpu.controller.leader import LeaderElector
 from edl_tpu.controller.resource_pods import ResourceRegister
+from edl_tpu.obs import flight as obs_flight
 from edl_tpu.obs.health import HealthMonitor
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
@@ -67,6 +68,10 @@ class Launcher(object):
 
         self._pod_server = barrier_mod.PodServer(
             self._coord, self._pod, stats_fn=stats).start()
+        # the launcher's black box: SIGTERM-era pod deaths and observed
+        # trainer failures leave a blackbox/v1 behind for --postmortem
+        obs_flight.install(self._pod.id, coord=self._coord,
+                           sigterm=True)
         logger.info("pod %s serving barrier on port %d", self._pod.id,
                     self._pod.port)
         return self
@@ -220,6 +225,10 @@ class Launcher(object):
                     else:
                         logger.error("a trainer failed on pod %s",
                                      self._pod.id)
+                        # the child died without its own exit path (kill
+                        # -9, OOM): the launcher's observation is the
+                        # last evidence standing
+                        obs_flight.dump("trainer_exit")
                         return self._exit(False)
                 elif done:
                     logger.info("all trainers on pod %s finished",

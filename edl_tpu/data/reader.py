@@ -29,6 +29,7 @@ import threading
 import time
 
 from edl_tpu.controller import constants
+from edl_tpu.obs import ledger as obs_ledger
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.data.data_server import (END, BatchCache, DataPlaneServer,
                                       LeaderDataService)
@@ -613,7 +614,10 @@ class ElasticReader(object):
                 raise self._gen_error[0]
             t0 = time.monotonic()
             try:
-                kind, item = self._out_q.get(timeout=0.5)
+                # the consumer (training) thread is starved while this
+                # blocks: attributed data_wait on the time ledger
+                with obs_ledger.LEDGER.state("data_wait"):
+                    kind, item = self._out_q.get(timeout=0.5)
             except queue.Empty:
                 with self._stats_lock:
                     self._wait_s += time.monotonic() - t0

@@ -25,19 +25,32 @@ the env-gated stderr stopwatch, and the ad-hoc JSON blobs under
   :class:`~edl_tpu.obs.health.HealthMonitor`, which writes a
   ``health_report/v1`` verdict under ``SERVICE_HEALTH`` and feeds the
   cluster generator's scale-in victim choice.
+- :mod:`edl_tpu.obs.ledger` — goodput accounting: the per-process
+  :class:`~edl_tpu.obs.ledger.TimeLedger` classifies every wall-clock
+  second into exclusive states (``edl_time_seconds_total{state}``),
+  and the leader-side :class:`~edl_tpu.obs.ledger.GoodputMerger`
+  folds the fleet into a ``goodput/v1`` doc under ``SERVICE_HEALTH``.
+- :mod:`edl_tpu.obs.flight` — the crash flight recorder: on any
+  death path a bounded ``blackbox/v1`` artifact (event/trace tails,
+  metrics, ledger totals, all-thread tracebacks) survives the
+  process, for ``job_doctor --postmortem``.
 
 This package is a LEAF: it imports nothing from edl_tpu outside
 ``utils.logger``, so every plane (rpc, robustness, data, coordination)
 can instrument itself without import cycles.
 """
 
-from edl_tpu.obs import events, health, metrics, slo, trace
+from edl_tpu.obs import events, flight, health, ledger, metrics, slo, trace
 from edl_tpu.obs.events import EVENTS, emit
+from edl_tpu.obs.flight import FlightRecorder
 from edl_tpu.obs.health import HealthMonitor
+from edl_tpu.obs.ledger import LEDGER, GoodputMerger, TimeLedger
 from edl_tpu.obs.metrics import (REGISTRY, counter, gauge, histogram,
                                  mirror_stats, set_enabled)
 from edl_tpu.obs.publisher import MetricsPublisher
 
-__all__ = ["metrics", "trace", "events", "health", "slo", "REGISTRY",
-           "EVENTS", "counter", "gauge", "histogram", "mirror_stats",
-           "set_enabled", "emit", "MetricsPublisher", "HealthMonitor"]
+__all__ = ["metrics", "trace", "events", "health", "slo", "ledger",
+           "flight", "REGISTRY", "EVENTS", "LEDGER", "counter", "gauge",
+           "histogram", "mirror_stats", "set_enabled", "emit",
+           "MetricsPublisher", "HealthMonitor", "TimeLedger",
+           "GoodputMerger", "FlightRecorder"]

@@ -49,6 +49,7 @@ import time
 from collections import deque
 
 from edl_tpu.obs import events as events_mod
+from edl_tpu.obs import ledger as ledger_mod
 from edl_tpu.obs import slo as slo_mod
 from edl_tpu.utils.logger import logger
 
@@ -354,6 +355,10 @@ class HealthMonitor(object):
                             for fam, thr in saturation_gauges]
         self._evaluator = evaluator or slo_mod.BurnRateEvaluator(
             slos=slos, clock=clock)
+        # leader-side goodput accumulation over the published ledger
+        # counters (counter-reset re-anchored, like the detectors)
+        self._goodput = ledger_mod.GoodputMerger()
+        self._last_goodput = None
         # pod -> {"verdict", "event_id"} for transition detection
         self._pod_state = {}
         # pod -> event-id watermark + bounded recent-evidence ring
@@ -457,6 +462,13 @@ class HealthMonitor(object):
                 e["pod"] = pod
                 fresh_events.append(e)
 
+        # fold each pod's edl_time_seconds_total counters into the
+        # fleet goodput ledger (restart re-anchor inside the merger)
+        self._goodput.update_from_docs(docs)
+        for pod in self._goodput.pods():
+            if pod not in known:
+                self._goodput.forget(pod)
+
         findings = []
         for det in self._stragglers:
             samples = {}
@@ -505,8 +517,11 @@ class HealthMonitor(object):
         findings.sort(key=lambda f: (-SEVERITY_RANK.get(f["severity"], 0),
                                      f["pod"]))
         report = self._build_report(docs, findings, slo_rows, now)
+        gdoc = self._goodput.doc(now=now)
+        report["goodput"] = gdoc["fleet"]
         with self._lock:
             self._last_report = report
+            self._last_goodput = gdoc
             self._victims = list(report["preferred_victims"])
         return report
 
@@ -523,6 +538,14 @@ class HealthMonitor(object):
                     total += t
                     bad += b
                 self._evaluator.observe(slo.name, total, bad, now=now)
+        for slo in self._evaluator.slos:
+            if slo.kind == "goodput":
+                # the ledger is the denominator: cumulative fleet
+                # seconds, bad = everything that is not compute
+                total_s, bad_s = self._goodput.fleet_cumulative()
+                if total_s > 0:
+                    self._evaluator.observe(slo.name, total_s, bad_s,
+                                            now=now)
         for slo in self._evaluator.slos:
             if slo.kind == "event":
                 pairs = slo_mod.pair_event_durations(
@@ -649,6 +672,15 @@ class HealthMonitor(object):
                 self._service_health, HEALTH_KEY, json.dumps(report))
         except Exception as e:  # noqa: BLE001 — best-effort by contract
             logger.debug("health report write failed (will retry): %r", e)
+        with self._lock:
+            gdoc = self._last_goodput
+        if gdoc is not None:
+            try:
+                self._coord.set_server_permanent(
+                    self._service_health, ledger_mod.GOODPUT_KEY,
+                    json.dumps(gdoc))
+            except Exception as e:  # noqa: BLE001 — best-effort by contract
+                logger.debug("goodput write failed (will retry): %r", e)
         return report
 
     def last_report(self):

@@ -24,6 +24,7 @@ import threading
 import time
 
 from edl_tpu.obs import events as events_mod
+from edl_tpu.obs import ledger as ledger_mod
 from edl_tpu.obs import metrics as metrics_mod
 from edl_tpu.utils.logger import logger
 
@@ -60,6 +61,9 @@ class MetricsPublisher(object):
         fresh = self._events.snapshot(since_id=self._since)
         if len(fresh) > self._max_events:
             fresh = fresh[-self._max_events:]
+        # close the time ledger's open interval so the shipped
+        # edl_time_seconds_total counters cover right up to this tick
+        ledger_mod.LEDGER.flush()
         # "ts" is the staleness detector's liveness signal (obs/health):
         # a doc whose ts stops advancing means the publisher is dead or
         # partitioned, even though the stale doc itself stays readable

@@ -11,6 +11,12 @@ comes in two shapes:
 - **event**: a compliance target over durations derived from the causal
   event timeline ("90% of resizes complete <= 30s"), paired from a
   start/end event kind per pod.
+- **goodput**: a compliance target over the time ledger's wall-clock
+  attribution ("80% of fleet seconds are compute"). The HealthMonitor
+  feeds the evaluator the :class:`~edl_tpu.obs.ledger.GoodputMerger`'s
+  cumulative ``(total_s, badput_s)`` pair — the ledger IS the
+  denominator, so burning this SLO means the fleet is paying wall
+  clock to something other than training.
 
 :class:`BurnRateEvaluator` implements the SRE multi-window burn-rate
 alert: it keeps a ring of ``(ts, total, bad)`` samples per SLO (fed
@@ -44,7 +50,7 @@ class Slo(object):
     def __init__(self, name, plane, kind, target, family=None, labels=None,
                  threshold_ms=None, threshold_s=None, start_kind=None,
                  end_kind=None, description=""):
-        if kind not in ("latency", "event"):
+        if kind not in ("latency", "event", "goodput"):
             raise ValueError("unknown SLO kind %r" % kind)
         self.name = name
         self.plane = plane
@@ -72,6 +78,13 @@ class Slo(object):
                    end_kind=end_kind, threshold_s=float(threshold_s),
                    description=description)
 
+    @classmethod
+    def goodput(cls, name, plane, target, description=""):
+        """``target`` is the compliant fraction of wall-clock seconds
+        (good = ledger ``compute``; bad = every other state)."""
+        return cls(name, plane, "goodput", target,
+                   description=description)
+
     def declare(self):
         """JSON-able declaration (embedded in every evaluation row)."""
         out = {"name": self.name, "plane": self.plane, "kind": self.kind,
@@ -80,7 +93,7 @@ class Slo(object):
             out.update(family=self.family, threshold_ms=self.threshold_ms)
             if self.labels:
                 out["labels"] = dict(self.labels)
-        else:
+        elif self.kind == "event":
             out.update(start_kind=self.start_kind, end_kind=self.end_kind,
                        threshold_s=self.threshold_s)
         return out
@@ -110,6 +123,9 @@ DEFAULT_SLOS = (
               start_kind="store.stepdown", end_kind="store.leader_elected",
               threshold_s=5.0, target=0.90,
               description="90% of store failovers re-elect <= 5s"),
+    Slo.goodput("train_goodput", "train", target=0.80,
+                description="80% of fleet wall-clock seconds are "
+                            "compute (time-ledger attribution)"),
 )
 
 

@@ -37,7 +37,10 @@ from edl_tpu.utils.logger import logger
 #: and the dispatch runs under a server span adopting it as parent.
 #: obs.metrics: the ``__metrics__`` method serves this process's
 #: registry snapshot / Prometheus text.
-FEATURES = ("rpc.pipeline", "obs.trace", "obs.metrics")
+#: obs.profile: the ``__profile__`` method captures an on-demand
+#: chrome-trace window (jax.profiler when available, else the tracer
+#: ring) — ``job_doctor --profile`` fans it out fleet-wide.
+FEATURES = ("rpc.pipeline", "obs.trace", "obs.metrics", "obs.profile")
 
 _REQS = obs_metrics.counter(
     "edl_rpc_server_requests_total", "requests dispatched",
@@ -75,6 +78,77 @@ def _metrics_method(fmt="json", events_since=0):
         return obs_metrics.REGISTRY.prometheus_text()
     return {"metrics": obs_metrics.REGISTRY.snapshot(),
             "events": obs_events.EVENTS.snapshot(since_id=events_since)}
+
+
+#: cap on trace events shipped per __profile__ response: a busy device
+#: window can emit hundreds of thousands; the RPC reply must stay
+#: deliverable through the framing limits
+MAX_PROFILE_EVENTS = 20000
+
+#: cap on the requested capture window
+MAX_PROFILE_S = 60.0
+
+
+def _try_jax_profile(duration_s):
+    """Capture ``duration_s`` of ``jax.profiler`` activity into a temp
+    dir and parse the chrome trace back out. Returns the trace dict or
+    None wherever any part is unavailable (no jax, no profiler plugin,
+    no trace file emitted) — callers fall back to the tracer ring."""
+    import glob
+    import gzip
+    import shutil
+    import tempfile
+    try:
+        import jax
+        tmp = tempfile.mkdtemp(prefix="edl_profile_")
+        try:
+            jax.profiler.start_trace(tmp)
+            time.sleep(duration_s)
+            jax.profiler.stop_trace()
+            paths = sorted(glob.glob(
+                os.path.join(tmp, "**", "*.trace.json.gz"),
+                recursive=True))
+            if not paths:
+                return None
+            with gzip.open(paths[-1], "rt") as f:
+                import json
+                doc = json.load(f)
+            events = doc.get("traceEvents") or []
+            if len(events) > MAX_PROFILE_EVENTS:
+                events = events[:MAX_PROFILE_EVENTS]
+            return {"traceEvents": events, "displayTimeUnit": "ms"}
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except BaseException as e:  # noqa: BLE001 — any failure => fallback
+        logger.debug("jax.profiler capture unavailable: %r", e)
+        return None
+
+
+def _profile_method(duration_s=2.0, source="auto"):
+    """Auto-registered ``__profile__``: on-demand profiling of THIS
+    process. ``source``: "auto" tries ``jax.profiler`` first and falls
+    back to the span tracer's ring; "tracer" skips straight to the
+    ring (cheap — no device profiling session). Returns a
+    ``profile/v1`` doc whose ``trace`` is chrome-trace JSON either
+    way, so ``job_doctor --profile`` merges pods into one Perfetto
+    file without caring which path answered."""
+    duration_s = max(0.0, min(float(duration_s), MAX_PROFILE_S))
+    trace = None
+    used = "tracer_ring"
+    if source == "auto":
+        trace = _try_jax_profile(duration_s)
+        if trace is not None:
+            used = "jax.profiler"
+    if trace is None:
+        # ring fallback: wait out the window so activity DURING it is
+        # in the ring, then snapshot (older spans ride along — the
+        # ring is bounded, not windowed)
+        if duration_s > 0:
+            time.sleep(duration_s)
+        trace = obs_trace.TRACER.chrome_trace()
+    return {"schema": "profile/v1", "ts": time.time(),
+            "pid": os.getpid(), "duration_s": duration_s,
+            "source": used, "trace": trace}
 
 
 def _default_workers():
@@ -231,6 +305,7 @@ class RpcServer(object):
         self.register("__features__", lambda: list(FEATURES))
         self.register("__identity__", self._identity)
         self.register("__metrics__", _metrics_method)
+        self.register("__profile__", _profile_method)
 
     def _identity(self):
         """Who answers on this listener: the bind host + bound TCP

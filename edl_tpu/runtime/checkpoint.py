@@ -61,6 +61,7 @@ import jax
 import numpy as np
 
 from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import ledger as obs_ledger
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.runtime.fs import get_fs
 from edl_tpu.utils.logger import logger
@@ -428,7 +429,11 @@ class CheckpointManager(object):
         with self._async_lock:
             h, self._inflight = self._inflight, None
         if h is not None:
-            h.wait()
+            # drain() runs on the TRAINING thread (step boundary, resize
+            # drain): the wait is attributed checkpoint-blocked time.
+            # The writer pool's own concurrency is never ledgered.
+            with obs_ledger.LEDGER.state("ckpt_block"):
+                h.wait()
             if h.exception() is not None:
                 logger.error("async checkpoint v%d failed: %r",
                              h.version, h.exception())
@@ -494,6 +499,10 @@ class CheckpointManager(object):
 
     def save(self, version, tree, meta=None):
         """Write checkpoint ``version``; commit is the MANIFEST write."""
+        with obs_ledger.LEDGER.state("ckpt_block"):
+            return self._save(version, tree, meta=meta)
+
+    def _save(self, version, tree, meta=None):
         t0 = time.monotonic()
         vdir = self._vdir(version)
         self._fs.delete_tree(vdir)  # clear any half-written attempt
@@ -700,7 +709,9 @@ class CheckpointManager(object):
         the manifest commit."""
         self.drain()
         t0 = time.perf_counter()
-        entries, dtypes = self._snapshot_dense(tree)
+        # the snapshot is the async save's only training-thread cost
+        with obs_ledger.LEDGER.state("ckpt_block"):
+            entries, dtypes = self._snapshot_dense(tree)
         handle = SaveHandle(version)
         handle.blocked_s = time.perf_counter() - t0
 

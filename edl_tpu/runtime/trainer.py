@@ -31,6 +31,8 @@ from edl_tpu.controller import train_status as train_status_mod
 from edl_tpu.controller.env import TrainerEnv
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import flight as obs_flight
+from edl_tpu.obs import ledger as obs_ledger
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.robustness import faults
 from edl_tpu.runtime import checkpoint as checkpoint_mod
@@ -859,6 +861,13 @@ class ElasticTrainer(object):
 
     def train_step(self, host_batch, rng=None):
         t0 = time.perf_counter()
+        if not self._stamp_first_step:
+            # steady state: the step boundary re-claims the clock for
+            # compute. After a resize the clock stays on resize_pause /
+            # restore until the first step's result is READY (stamped
+            # below) — the ledger's pause must agree with measure_resize,
+            # which measures to first-step completion, not dispatch.
+            obs_ledger.LEDGER.transition("compute")
         if self._last_step_start is not None:
             self._step_intervals.append(t0 - self._last_step_start)
             del self._step_intervals[:-self._STEP_WINDOW]
@@ -892,6 +901,9 @@ class ElasticTrainer(object):
             jax.block_until_ready(loss)
             self._resize_timing["first_step_s"] = time.perf_counter() - c1
             self._resize_timing["t_first_step"] = time.time()
+            # close the pause HERE so the published ledger snapshot
+            # already carries the full resize_pause for this arc
+            obs_ledger.LEDGER.transition("compute")
             self._publish_resize_timing()
             obs_events.emit("resize.first_step",
                             rank=self.env.global_rank,
@@ -1051,6 +1063,10 @@ class ElasticTrainer(object):
             return {"mode": "live", "noop": True,
                     "from_devices": old_n, "to_devices": n_devices}
         saved = self._snapshot_bindings()
+        # training is paused from here until the first post-reshard
+        # step result (train_step closes the pause when it stamps);
+        # the drain below nests ckpt_block over this and returns here
+        obs_ledger.LEDGER.transition("resize_pause")
         try:
             t0 = time.perf_counter()
             if faults.PLANE is not None:
@@ -1087,6 +1103,10 @@ class ElasticTrainer(object):
             reshard_s = time.perf_counter() - t1
         except Exception as e:  # noqa: BLE001 — ANY failure rolls back
             self._restore_bindings(saved)
+            # black-box the rollback: the evidence (drain/reshard spans,
+            # fault firings) lives in rings this incarnation may not
+            # survive once the stop-resume ladder takes over
+            obs_flight.dump("live_resize_rollback", e)
             reason = "%s: %s" % (type(e).__name__, e)
             obs_events.emit("resize.live.fallback", cause=start_id,
                             rank=self.env.global_rank, reason=reason,
@@ -1221,6 +1241,26 @@ class ElasticTrainer(object):
         if not self._preempt_armed:
             self.install_preemption_handler(signals=signals,
                                             coordinated=coordinated)
+        # arm the black box for this incarnation: any death path out of
+        # fit() (preemption exit, unhandled exception via the chained
+        # excepthook) leaves a blackbox/v1 artifact behind
+        if obs_flight.RECORDER is None:
+            obs_flight.install("trainer_r%d" % self.env.global_rank,
+                               coord=self.coord)
+        obs_flight.RECORDER.register_provider(
+            "resize_timing", lambda: dict(self._resize_timing))
+        # the fleet view is built from obs_* publications, and the
+        # launcher's PodServer publisher only covers the supervisor
+        # process — the ledger/step counters that make goodput live
+        # HERE, so the training process ships its own registry
+        publisher = None
+        if self.coord is not None:
+            from edl_tpu.obs.publisher import MetricsPublisher
+            pod_key = ("%s_r%d" % (self.env.pod_id,
+                                   self.env.global_rank)
+                       if self.env.pod_id
+                       else "trainer_r%d" % self.env.global_rank)
+            publisher = MetricsPublisher(self.coord, pod_key).start()
         resumed = self.resume() if resume else False
         start_epoch = self.state.next_epoch() if resumed else 0
         say = log_fn or logger.info
@@ -1246,11 +1286,22 @@ class ElasticTrainer(object):
                 if eval_fn is not None and self.env.global_rank == 0:
                     eval_fn(self, epoch)
         except PreemptedError as e:
+            # the exit-101 path never reaches sys.excepthook (SystemExit
+            # is special-cased), so the box must be dumped here
+            obs_flight.dump("preempted", e)
             say("fit: preempted: %s" % e)
             if preemption_exit_code is None:
                 raise
             import sys
             sys.exit(preemption_exit_code)
+        finally:
+            # whatever happens, the training thread's clock is no
+            # longer compute; close the interval so the final publish
+            # (or the black box) carries the full attribution
+            obs_ledger.LEDGER.transition("idle")
+            obs_ledger.LEDGER.flush()
+            if publisher is not None:
+                publisher.stop()  # final flush ships the full ledger
         self.report_status(train_status_mod.TrainStatus.SUCCEED)
         return {"resumed": resumed, "steps": self.global_step,
                 "final_loss": None if loss is None else float(loss),
@@ -1751,11 +1802,17 @@ class ElasticTrainer(object):
             return
         import json as _json
         from edl_tpu.controller import constants
+        # ride the ledger totals along: trainer subprocesses run no
+        # MetricsPublisher, so this doc is how measure_resize (and the
+        # pause-agreement test) reads the worker's time attribution
+        doc = dict(self._resize_timing)
+        doc["ledger"] = {s: round(v, 6) for s, v
+                        in obs_ledger.LEDGER.totals().items()}
         try:
             self.coord.set_server_permanent(
                 constants.SERVICE_METRICS,
                 "resize_timing_r%d" % self.env.global_rank,
-                _json.dumps(self._resize_timing))
+                _json.dumps(doc))
         except Exception:
             logger.exception("resize timing publish failed")
 
@@ -1781,6 +1838,7 @@ class ElasticTrainer(object):
         target = jax.tree_util.tree_map(_spec, dict(self.train_state))
         restored = None
         self._resize_timing["t_resume_start"] = time.time()
+        obs_ledger.LEDGER.transition("restore")
         obs_events.emit("resize.resume_start", rank=self.env.global_rank,
                         world_size=self.world_size)
         for version in reversed(self._ckpt.versions()):
@@ -1809,6 +1867,7 @@ class ElasticTrainer(object):
                 logger.warning("checkpoint v%d unusable (%r); trying older",
                                version, e)
         if restored is None:
+            obs_ledger.LEDGER.transition("idle")
             return False
         version, tree, meta = restored
         self.train_state = tree
@@ -1825,6 +1884,9 @@ class ElasticTrainer(object):
             self.state.adjust(self.world_size)
         self._host_step = self.global_step
         self._resumed_version = version
+        # restore is done; the remainder of the pause (compile + first
+        # dispatch) is charged to resize_pause until train_step stamps
+        obs_ledger.LEDGER.transition("resize_pause")
         self._resize_timing["t_resume_end"] = time.time()
         self._resize_timing["restore_s"] = (
             self._resize_timing["t_resume_end"]

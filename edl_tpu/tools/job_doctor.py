@@ -14,9 +14,21 @@ exact ``fault.fired`` injection) without grepping logs. Output is a
 the acceptance harness parse this) or a human rendering; ``--watch N``
 re-diagnoses every N seconds.
 
+Two further modes share the same rendering:
+
+- ``--postmortem``: read every dead pod's ``blackbox/v1`` flight-
+  recorder artifact (store copies, plus local files via ``--blackbox``)
+  and render each as a causal chain ending at the actual cause — under
+  chaos drills, the exact seeded ``fault.fired`` point.
+- ``--profile T``: fan the on-demand ``__profile__`` RPC out to every
+  live pod, capture T seconds each, and merge the answers into ONE
+  chrome-trace/Perfetto file (``--out``) with per-pod process lanes.
+
 CLI:
   python -m edl_tpu.tools.job_doctor --store_endpoints 127.0.0.1:2379 \
-      --job_id myjob [--json] [--watch 10]
+      --job_id myjob [--json] [--watch 10] \
+      [--postmortem [--blackbox f.json ...]] \
+      [--profile 2.0 [--out fleet_trace.json]]
 """
 
 import argparse
@@ -27,16 +39,19 @@ import time
 from edl_tpu.controller import constants, status
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import flight as flight_mod
 from edl_tpu.obs import health as health_mod
 from edl_tpu.obs.publisher import KEY_PREFIX as _OBS_KEY_PREFIX
 
-#: ranking: detector class when severities tie — liveness first (a dead
-#: publisher hides every other signal from that pod), then stragglers
-#: (they gate the whole synchronous step), then fleet-wide burn, then
-#: the warn-level plumbing signals
-_DETECTOR_RANK = {"stale_publisher": 0, "straggler": 1, "slo_burn": 2,
-                  "breaker_flap": 3, "queue_saturation": 4,
-                  "live_resize_fallback": 5, "prewarm_miss": 6}
+#: ranking: detector class when severities tie — a dead pod's black box
+#: first (it IS the outage), then liveness (a dead publisher hides
+#: every other signal from that pod), then stragglers (they gate the
+#: whole synchronous step), then fleet-wide burn, then the warn-level
+#: plumbing signals
+_DETECTOR_RANK = {"flight_recorder": 0, "stale_publisher": 1,
+                  "straggler": 2, "slo_burn": 3, "breaker_flap": 4,
+                  "queue_saturation": 5, "live_resize_fallback": 6,
+                  "prewarm_miss": 7}
 
 
 def collect(coord):
@@ -261,6 +276,162 @@ def diagnose(collected, now=None):
     return report
 
 
+def _load_local_blackboxes(paths):
+    """``blackbox/v1`` docs from local files (the launcher always lands
+    one on disk even when the store copy failed)."""
+    out = {}
+    for p in paths or ():
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (IOError, OSError, ValueError):
+            print("warning: %s is not a readable blackbox/v1 file" % p,
+                  file=sys.stderr)
+            continue
+        if isinstance(doc, dict) and doc.get("schema") == "blackbox/v1":
+            out[doc.get("pod") or p] = doc
+    return out
+
+
+def _blackbox_finding(pod, box):
+    """One black box -> one finding in the ordinary causal-chain shape.
+    The summary names the REAL cause when one is recorded: the seeded
+    chaos fault first (that's what a drill verifies), else the dying
+    exception."""
+    events = box.get("events") or []
+    exc = box.get("exception") or {}
+    reason = box.get("reason")
+    fault = next((e for e in reversed(events)
+                  if e.get("kind") == "fault.fired"), None)
+    if fault is not None:
+        attrs = fault.get("attrs") or {}
+        summary = ("pod died (%s); chaos fault %s injected at %s"
+                   % (reason, attrs.get("fault"), attrs.get("point")))
+    elif exc:
+        summary = ("pod died (%s): %s: %s"
+                   % (reason, exc.get("type"), exc.get("message")))
+    else:
+        summary = "pod died (%s); no exception recorded" % reason
+    tail = events[-8:]
+    ledger = box.get("ledger") or {}
+    total = sum(ledger.values())
+    finding = {
+        "pod": pod,
+        "detector": "flight_recorder",
+        "severity": "critical",
+        "summary": summary,
+        "events": tail,
+        "event_ids": [e.get("id") for e in tail
+                      if e.get("id") is not None],
+        "trace_id": next((s.get("trace_id")
+                          for s in reversed(box.get("spans") or [])
+                          if s.get("trace_id")), None),
+    }
+    if total > 0:
+        top = max(ledger, key=ledger.get)
+        finding["metric"] = "edl_time_seconds_total"
+        finding["value"] = round(ledger.get(top, 0.0), 3)
+        finding["threshold"] = None
+        finding["summary"] += ("; final ledger: %.1fs total, most in "
+                               "%s" % (total, top))
+    return finding
+
+
+def postmortem(boxes, now=None):
+    """Pure: ``{pod: blackbox/v1}`` -> a ``doctor_report/v1`` doc whose
+    findings are the dead pods' rendered black boxes."""
+    now = time.time() if now is None else now
+    findings = [_blackbox_finding(pod, box)
+                for pod, box in sorted(boxes.items())]
+    rendered = _render_findings(findings, [], ())
+    report = {
+        "schema": "doctor_report/v1",
+        "ts": now,
+        "mode": "postmortem",
+        "verdict": "critical" if rendered else "ok",
+        "findings": rendered,
+        "slos": [],
+        "boxes": {pod: {"reason": box.get("reason"),
+                        "ts": box.get("ts"),
+                        "pid": box.get("pid"),
+                        "exception": box.get("exception"),
+                        "ledger": box.get("ledger") or {},
+                        "context": box.get("context") or {}}
+                  for pod, box in sorted(boxes.items())},
+    }
+    if rendered:
+        head = rendered[0]
+        report["summary"] = ("%d black box(es); worst: %s — %s"
+                             % (len(rendered), head["pod"],
+                                head["summary"]))
+    else:
+        report["summary"] = ("no blackbox/v1 artifacts found (store "
+                             "empty and no --blackbox paths given)")
+    return report
+
+
+def merge_profiles(profiles):
+    """``{pod: profile/v1}`` -> one chrome-trace doc. Every (pod, pid)
+    pair gets a fresh merged pid plus a ``process_name`` metadata row,
+    so Perfetto shows one labeled lane per source process."""
+    merged = []
+    next_pid = 1
+    for pod, prof in sorted(profiles.items()):
+        trace = (prof or {}).get("trace") or {}
+        pid_map = {}
+        for e in trace.get("traceEvents") or ():
+            if not isinstance(e, dict):
+                continue
+            orig = e.get("pid", 0)
+            if orig not in pid_map:
+                pid_map[orig] = next_pid
+                merged.append({"name": "process_name", "ph": "M",
+                               "pid": next_pid, "tid": 0,
+                               "args": {"name": "%s (%s)"
+                                        % (pod,
+                                           (prof or {}).get("source"))}})
+                next_pid += 1
+            e = dict(e)
+            e["pid"] = pid_map[orig]
+            merged.append(e)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def profile_fleet(coord, duration_s, timeout_margin=30.0):
+    """Fan ``__profile__`` out to every live pod concurrently; returns
+    ``(profiles, errors)`` — ``{pod: profile/v1}`` and ``{pod: repr}``.
+    Store-discovered endpoints (SERVICE_RESOURCE), so only launchers
+    that are actually alive are dialed."""
+    from concurrent.futures import ThreadPoolExecutor
+    from edl_tpu.controller.resource_pods import load_resource_pods
+    from edl_tpu.rpc import client as rpc_client
+
+    pods = load_resource_pods(coord)
+    profiles, errs = {}, {}
+
+    def one(pod):
+        return rpc_client.call(pod.endpoint, "__profile__",
+                               duration_s,
+                               timeout=duration_s + timeout_margin)
+
+    if not pods:
+        return profiles, errs
+    with ThreadPoolExecutor(max_workers=min(16, len(pods))) as pool:
+        futs = {pod_id: pool.submit(one, pod)
+                for pod_id, pod in sorted(pods.items())}
+        for pod_id, fut in futs.items():
+            try:
+                doc = fut.result()
+                if isinstance(doc, dict) \
+                        and doc.get("schema") == "profile/v1":
+                    profiles[pod_id] = doc
+                else:
+                    errs[pod_id] = "unexpected reply: %r" % (doc,)
+            except Exception as e:  # noqa: BLE001 — per-pod best-effort
+                errs[pod_id] = repr(e)
+    return profiles, errs
+
+
 def render(report, width=76):
     """Human rendering of a doctor_report/v1 doc."""
     lines = []
@@ -298,8 +469,49 @@ def main(argv=None):
                     help="emit doctor_report/v1 JSON instead of text")
     ap.add_argument("--watch", type=float, default=None, metavar="SEC",
                     help="re-diagnose every SEC seconds until ^C")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="render every dead pod's blackbox/v1 flight-"
+                         "recorder artifact instead of live diagnosis")
+    ap.add_argument("--blackbox", action="append", default=[],
+                    metavar="PATH",
+                    help="also read a local blackbox/v1 file "
+                         "(repeatable; used with --postmortem)")
+    ap.add_argument("--profile", type=float, default=None, metavar="SEC",
+                    help="capture SEC seconds of __profile__ from every "
+                         "live pod and merge into one chrome trace")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="output path for the merged --profile trace "
+                         "(default: fleet_trace.json)")
     args = ap.parse_args(argv)
     coord = CoordClient(args.store_endpoints.split(","), root=args.job_id)
+    if args.postmortem:
+        boxes = flight_mod.load_blackboxes(coord)
+        boxes.update(_load_local_blackboxes(args.blackbox))
+        report = postmortem(boxes)
+        report["job_id"] = args.job_id
+        if args.json:
+            print(json.dumps(report, indent=2, sort_keys=True))
+        else:
+            print(render(report))
+        return 0 if report["verdict"] == "ok" else 2
+    if args.profile is not None:
+        profiles, errs = profile_fleet(coord, args.profile)
+        out_path = args.out or "fleet_trace.json"
+        merged = merge_profiles(profiles)
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+        for pod_id, prof in sorted(profiles.items()):
+            print("pod %s: %d event(s) via %s"
+                  % (pod_id,
+                     len((prof.get("trace") or {})
+                         .get("traceEvents") or ()),
+                     prof.get("source")))
+        for pod_id, err in sorted(errs.items()):
+            print("pod %s: profile failed: %s" % (pod_id, err),
+                  file=sys.stderr)
+        print("merged %d pod profile(s) -> %s (open in "
+              "ui.perfetto.dev)" % (len(profiles), out_path))
+        return 0 if profiles or not errs else 1
     while True:
         report = diagnose(collect(coord))
         if args.json:

@@ -26,6 +26,7 @@ from edl_tpu.controller.resource_pods import load_resource_pods
 from edl_tpu.coordination.client import CoordClient
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import health as obs_health
+from edl_tpu.obs import ledger as obs_ledger
 from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.obs.publisher import KEY_PREFIX as _OBS_KEY_PREFIX
 from edl_tpu.rpc.client import RpcClient
@@ -128,6 +129,8 @@ def collect_job_stats(coord, rpc_timeout=5.0):
         {pod: doc.get("events") or [] for pod, doc in obs_pub.items()})
     # the leader monitor's latest verdict doc (None until it has run)
     out["health"] = obs_health.load_report(coord)
+    # the leader monitor's fleet time-attribution doc (same cadence)
+    out["goodput"] = obs_ledger.load_goodput(coord)
     return out
 
 
@@ -187,6 +190,24 @@ def format_fleet(doc, width=72):
         if victims:
             lines.append("  preferred scale-in victims: %s"
                          % ", ".join(victims))
+    goodput = doc.get("goodput")
+    if goodput:
+        fl = goodput.get("fleet") or {}
+        pct = fl.get("goodput_pct")
+        lines.append("goodput: %s%% of %.1fs fleet wall clock is "
+                     "compute"
+                     % ("?" if pct is None else pct,
+                        fl.get("total_s") or 0.0))
+        for b in (fl.get("badput") or ())[:3]:
+            lines.append("  badput %s: %.1fs (%.1f%%)"
+                         % (b.get("state"), b.get("seconds") or 0.0,
+                            b.get("share_pct") or 0.0))
+        for pod, cell in sorted((goodput.get("pods") or {}).items()):
+            lines.append("  [%s] %s%% compute, top badput: %s"
+                         % (pod,
+                            "?" if cell.get("goodput_pct") is None
+                            else cell.get("goodput_pct"),
+                            cell.get("top_badput") or "none"))
     timeline = doc.get("timeline") or []
     if timeline:
         lines.append("timeline (last %d of %d events):"

@@ -659,6 +659,7 @@ def run_live_arc(args):
             from_devices=n_hi, to_devices=n_lo,
             prewarm=rec.get("prewarm"),
             drain_s=round(rec.get("drain_s", 0.0), 3),
+            ledger=rec.get("ledger"),
             process_survived=alive,
             grow={"to_devices": n_hi,
                   "pause_s": round(rec_up["t_first_step"]
@@ -729,9 +730,15 @@ def run_stop_resume_arc(args):
                    "bytes": rec.get("restore_bytes"),
                    "peers": rec.get("restore_peers"),
                    "version": rec.get("version")}
+        # pause_in_process_s: the respawned trainer's own restore +
+        # first-step window — the portion of the downtime its time
+        # ledger can see (kill/respawn time belongs to no process)
         return _peer_result(
             tag, args, "stop_resume", rec["t_first_step"] - t_kill,
-            breakdown, restore, from_devices=n_hi, to_devices=n_lo)
+            breakdown, restore, from_devices=n_hi, to_devices=n_lo,
+            pause_in_process_s=round(
+                rec["t_first_step"] - rec["t_resume_start"], 3),
+            ledger=rec.get("ledger"))
     finally:
         if worker is not None:
             _kill_group(worker)

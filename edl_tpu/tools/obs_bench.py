@@ -17,6 +17,11 @@ at observation time. This bench quantifies both halves:
   numbers; the arc delta is noisy on shared CI boxes, which is why the
   tier-1 guard checks the schema only and the <2% acceptance number is
   measured offline (same policy as every other bench in the tree).
+- ``ledger`` — the time ledger's hot-loop cost: a synthetic step loop
+  (one ``transition`` + one nested wait scope + simulated work per
+  iteration, the exact shape of the instrumented trainer loop) with
+  the kill switch on vs off; ``overhead_pct`` against the <1%
+  acceptance criterion for the goodput ledger.
 - ``detectors`` — the ACTIVE layer's cost and latency: one
   HealthMonitor.evaluate() tick over a synthetic fleet of ``pods``
   snapshot docs, timed per window (``overhead_pct_of_interval`` is the
@@ -85,6 +90,54 @@ def bench_primitives(n=_PRIMITIVE_N):
         finally:
             obs_metrics.set_enabled(prev)
     return out
+
+
+def bench_ledger(iters=20_000, work_us=1000.0, repeats=3):
+    """Time-ledger hot-loop arc: ``iters`` synthetic steps, each one
+    ``transition("compute")`` + a ``data_wait`` scope + ``work_us`` of
+    spinning (the instrumented trainer-loop shape), ledger enabled vs
+    disabled. Min-of-repeats per arc (the standard noise floor for
+    shared CI boxes); ``overhead_pct`` is the enabled-arc slowdown —
+    the <1% acceptance criterion, measured offline like every other
+    bench number (the tier-1 guard checks the schema only)."""
+    from edl_tpu.obs import ledger as obs_ledger
+
+    led = obs_ledger.TimeLedger()
+    spin_until = time.perf_counter  # alias: one attr lookup per call
+
+    def one_arc():
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            led.transition("compute")
+            with led.state("data_wait"):
+                pass
+            end = spin_until() + work_us * 1e-6
+            while spin_until() < end:
+                pass
+        return time.perf_counter() - t0
+
+    out = {}
+    for state in ("enabled", "disabled"):
+        prev = obs_metrics.set_enabled(state == "enabled")
+        try:
+            one_arc()  # warm
+            led.reset()
+            out[state] = min(one_arc() for _ in range(repeats))
+        finally:
+            obs_metrics.set_enabled(prev)
+    led.reset()
+    on_s, off_s = out["enabled"], out["disabled"]
+    return {
+        "iters": iters,
+        "work_us": work_us,
+        "repeats": repeats,
+        "enabled_s": round(on_s, 6),
+        "disabled_s": round(off_s, 6),
+        "step_overhead_ns": round((on_s - off_s) * 1e9 / iters, 1),
+        "overhead_pct": (round((on_s / off_s - 1.0) * 100.0, 3)
+                         if off_s > 0 else None),
+        "criterion_pct": 1.0,
+    }
 
 
 def _synth_fleet_docs(pods, window, step_ms_by_pod, state, base_ts,
@@ -225,6 +278,8 @@ def run(mode="micro", **cfg):
         "off": arcs["off"],
         "overhead_pct": overhead,
         "primitives": bench_primitives(),
+        "ledger": (bench_ledger(iters=1_000, work_us=100.0)
+                   if mode == "micro" else bench_ledger()),
         "detectors": bench_detectors(),
     }
 

@@ -217,6 +217,51 @@ def test_measure_resize_live_arc_cpu_schema(capsys):
     assert out["process_survived"] is True
     assert out["grow"]["to_devices"] == 8  # same process grew back
     json.dumps(out)  # round-trips
+    # time-ledger agreement: the worker's published ledger must
+    # attribute the live pause. resize_pause owns the window except
+    # the drain (nested ckpt_block) and any first-batch data_wait, so
+    # resize_pause alone can't exceed the pause, and with the drain
+    # added back it must cover it to within 10% (+50ms clock noise).
+    ledger = out["ledger"]
+    assert ledger is not None, "worker published no ledger totals"
+    pause = out["value"]
+    tol = 0.10 * pause + 0.05
+    assert ledger["resize_pause"] <= pause + tol
+    assert ledger["resize_pause"] + out["drain_s"] >= pause - tol, (
+        ledger, out["value"], out["drain_s"])
+
+
+def test_measure_resize_stop_resume_ledger_agreement(capsys):
+    """Stop-resume arc + time-ledger agreement: the respawned trainer's
+    restore + resize_pause must account for the in-process portion of
+    the downtime (t_first_step - t_resume_start) to within 10%. The
+    full bench value additionally counts kill/respawn wall time that
+    belongs to no process — invisible to a per-process ledger by
+    construction, which is why the record carries pause_in_process_s."""
+    import json
+
+    from edl_tpu.tools import measure_resize
+
+    rc = measure_resize.main(["--arcs", "stop_resume", "--platform",
+                              "cpu", "--from_devices", "8",
+                              "--timeout", "120"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    out = json.loads(lines[0])
+    assert "error" not in out
+    assert out["schema"] == "resize_bench/v1"
+    assert out["arc"] == "stop_resume"
+    assert out["value"] >= out["pause_in_process_s"] > 0
+    ledger = out["ledger"]
+    assert ledger is not None, "worker published no ledger totals"
+    pause = out["pause_in_process_s"]
+    tol = 0.10 * pause + 0.05
+    attributed = ledger["restore"] + ledger["resize_pause"]
+    # the only other state that can own part of the window is the
+    # first batch's data_wait; 10% bounds it
+    assert attributed <= pause + tol, (ledger, out)
+    assert attributed + ledger["data_wait"] >= pause - tol, (ledger,
+                                                            out)
 
 
 def test_store_bench_micro_schema():
@@ -376,3 +421,72 @@ def test_doctor_report_schema():
     assert doc["summary"]
     json.dumps(doc)
     job_doctor.render(doc)  # the human surface renders without a report
+
+
+def test_obs_bench_ledger_section_schema():
+    """obs_bench "ledger" section contract: both arcs timed, per-step
+    overhead derived, and the acceptance criterion (<1%) carried in
+    the record. No overhead gate here — CI boxes are too noisy; the
+    <1% number is measured offline like every other bench figure."""
+    import json
+
+    from edl_tpu.obs import metrics as obs_metrics
+    from edl_tpu.tools import obs_bench
+
+    out = obs_bench.bench_ledger(iters=200, work_us=50.0, repeats=2)
+    assert out["iters"] == 200 and out["repeats"] == 2
+    assert out["enabled_s"] > 0 and out["disabled_s"] > 0
+    assert out["overhead_pct"] is not None
+    assert out["criterion_pct"] == 1.0
+    assert obs_metrics.enabled()  # the bench must restore the switch
+    json.dumps(out)
+
+
+def test_goodput_doc_schema():
+    """goodput/v1 contract: every field job_stats --pretty and the
+    doctor read, produced by a real GoodputMerger fold."""
+    import json
+
+    from edl_tpu.obs import ledger as obs_ledger
+
+    m = obs_ledger.GoodputMerger()
+    m.update("pod-00", {"compute": 80.0, "ckpt_block": 15.0,
+                        "idle": 5.0})
+    m.update("pod-01", {"compute": 95.0, "data_wait": 5.0})
+    doc = m.doc(now=1_000_000.0)
+    assert doc["schema"] == "goodput/v1"
+    fleet = doc["fleet"]
+    for field in ("total_s", "goodput_s", "goodput_pct", "badput"):
+        assert field in fleet
+    assert fleet["badput"] == sorted(fleet["badput"],
+                                     key=lambda b: -b["seconds"])
+    for b in fleet["badput"]:
+        assert set(b) == {"state", "seconds", "share_pct"}
+    for pod, cell in doc["pods"].items():
+        for field in ("total_s", "goodput_s", "goodput_pct",
+                      "top_badput", "states"):
+            assert field in cell
+    assert set(doc["spread"]) == {"goodput_pct_min", "goodput_pct_max",
+                                  "states"}
+    json.dumps(doc)
+
+
+def test_blackbox_doc_schema(tmp_path):
+    """blackbox/v1 contract: every field --postmortem renders, produced
+    by a real FlightRecorder dump; bounded and JSON-round-trippable."""
+    import json
+
+    from edl_tpu.obs import flight as obs_flight
+
+    rec = obs_flight.FlightRecorder("guard-pod", out_dir=str(tmp_path))
+    path = rec.dump("trainer_exit", RuntimeError("guard"))
+    with open(path) as f:
+        box = json.load(f)
+    assert box["schema"] == "blackbox/v1"
+    for field in ("ts", "pod", "pid", "reason", "exception", "events",
+                  "spans", "metrics", "ledger", "threads", "context"):
+        assert field in box
+    assert len(box["events"]) <= obs_flight.MAX_EVENTS
+    assert len(box["spans"]) <= obs_flight.MAX_SPANS
+    assert len(box["threads"]) <= obs_flight.MAX_THREAD_DUMP
+    json.dumps(box)

@@ -1,0 +1,198 @@
+"""FlightRecorder black box + job_doctor --postmortem (obs/flight.py).
+
+THE contract under test: a dump NEVER masks the original failure —
+including when the ``obs.flight.dump`` chaos point makes the recorder
+itself fail. The postmortem path must resolve a chaos-killed pod's
+artifact back to the exact seeded fault point.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import flight as flight_mod
+from edl_tpu.obs.flight import FlightRecorder
+from edl_tpu.robustness import faults
+from edl_tpu.robustness.faults import FaultPlane
+from edl_tpu.tools import job_doctor
+
+
+@pytest.fixture()
+def plane():
+    p = FaultPlane(seed=20260805).install()
+    yield p
+    p.uninstall()
+    assert faults.PLANE is None
+
+
+@pytest.fixture(autouse=True)
+def _clean_events():
+    obs_events.EVENTS.clear()
+    yield
+    obs_events.EVENTS.clear()
+
+
+def test_dump_writes_parseable_blackbox(tmp_path):
+    rec = FlightRecorder("pod-0_r1", out_dir=str(tmp_path))
+    rec.register_provider("resize_timing", lambda: {"pause_s": 1.25})
+    try:
+        raise RuntimeError("boom at step 42")
+    except RuntimeError as e:
+        path = rec.dump("unhandled_exception", e)
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        box = json.load(f)
+    assert box["schema"] == "blackbox/v1"
+    assert box["pod"] == "pod-0_r1"
+    assert box["reason"] == "unhandled_exception"
+    assert box["exception"]["type"] == "RuntimeError"
+    assert "boom at step 42" in box["exception"]["message"]
+    assert "RuntimeError" in box["exception"]["traceback"]
+    assert set(box["ledger"]) == set(
+        ("compute", "data_wait", "ckpt_block", "resize_pause",
+         "restore", "barrier_wait", "idle"))
+    assert box["context"]["resize_timing"] == {"pause_s": 1.25}
+    # the thread dump must at least see this (the main) thread
+    assert "Current thread" in box["threads"] \
+        or "Thread" in box["threads"]
+
+
+def test_failing_provider_does_not_fail_the_dump(tmp_path):
+    rec = FlightRecorder("p", out_dir=str(tmp_path))
+    rec.register_provider("bad", lambda: 1 / 0)
+    path = rec.dump("trainer_exit")
+    with open(path) as f:
+        box = json.load(f)
+    assert "provider_error" in box["context"]["bad"]
+
+
+def test_chaos_at_dump_point_never_masks_the_original(plane, tmp_path):
+    """Seed obs.flight.dump with an error fault: the recorder fails,
+    returns None, raises NOTHING — the original exception path is
+    byte-identical. The fault counter proves the point actually
+    fired (the hook is first, covering the entire dump path)."""
+    f = plane.inject("obs.flight.dump", "error")
+    rec = FlightRecorder("p", out_dir=str(tmp_path))
+    original = ValueError("the real crash")
+    caught = None
+    try:
+        try:
+            raise original
+        except ValueError as e:
+            assert rec.dump("unhandled_exception", e) is None
+            raise
+    except ValueError as e:
+        caught = e
+    assert caught is original
+    assert f.fired == 1
+    assert os.listdir(str(tmp_path)) == []  # nothing half-written
+
+
+def test_dump_does_not_reenter(tmp_path):
+    rec = FlightRecorder("p", out_dir=str(tmp_path))
+    inner = []
+    rec.register_provider("evil", lambda: inner.append(
+        rec.dump("recursive")) or "ok")
+    path = rec.dump("outer")
+    assert path is not None
+    assert inner == [None]  # the nested dump refused to re-enter
+
+
+def test_excepthook_chains_to_previous(tmp_path):
+    rec = FlightRecorder("p", out_dir=str(tmp_path))
+    seen = []
+    prev_hook = sys.excepthook
+    sys.excepthook = lambda t, e, tb: seen.append((t, e))
+    try:
+        rec.install_excepthook()
+        err = RuntimeError("late crash")
+        sys.excepthook(RuntimeError, err, None)
+    finally:
+        rec.uninstall()
+        sys.excepthook = prev_hook
+    # the previous hook ran with the SAME exception, after the dump
+    assert seen == [(RuntimeError, err)]
+    assert rec.last_path is not None
+
+
+def test_postmortem_resolves_seeded_fault_point(plane, tmp_path):
+    """The full drill in-process: a seeded fault kills the 'pod', the
+    box lands on disk, and --postmortem names the exact injected
+    point — not just 'pod died'."""
+    plane.inject("ckpt.save.write", "error")
+    rec = FlightRecorder("pod-3", out_dir=str(tmp_path))
+    try:
+        faults.PLANE.fire("ckpt.save.write")  # emits fault.fired, raises
+        raise AssertionError("fault should have fired")
+    except faults.errors.EdlError as e:
+        path = rec.dump("trainer_exit", e)
+    boxes = job_doctor._load_local_blackboxes([path])
+    assert list(boxes) == ["pod-3"]
+    report = job_doctor.postmortem(boxes, now=1000.0)
+    assert report["schema"] == "doctor_report/v1"
+    assert report["mode"] == "postmortem"
+    assert report["verdict"] == "critical"
+    head = report["findings"][0]
+    assert head["detector"] == "flight_recorder"
+    assert head["rank"] == 1
+    assert "ckpt.save.write" in head["summary"]
+    assert "error" in head["summary"]
+    assert "ckpt.save.write" in report["summary"]
+    # the rendered text (what the operator reads) names the point too
+    assert "ckpt.save.write" in job_doctor.render(report)
+
+
+def test_postmortem_without_fault_names_the_exception(tmp_path):
+    rec = FlightRecorder("pod-9", out_dir=str(tmp_path))
+    try:
+        raise KeyError("missing shard")
+    except KeyError as e:
+        path = rec.dump("trainer_exit", e)
+    report = job_doctor.postmortem(
+        job_doctor._load_local_blackboxes([path]))
+    assert "KeyError" in report["findings"][0]["summary"]
+
+
+def test_load_local_blackboxes_skips_garbage(tmp_path, capsys):
+    bad = tmp_path / "junk.json"
+    bad.write_text("not json")
+    assert job_doctor._load_local_blackboxes([str(bad)]) == {}
+    assert "not a readable" in capsys.readouterr().err
+
+
+def test_module_dump_is_noop_before_install():
+    assert flight_mod.RECORDER is None or True  # state-agnostic guard
+    prev = flight_mod.RECORDER
+    flight_mod.RECORDER = None
+    try:
+        assert flight_mod.dump("whatever") is None
+    finally:
+        flight_mod.RECORDER = prev
+
+
+def test_merge_profiles_remaps_pids_per_pod():
+    profiles = {
+        "pod-a": {"schema": "profile/v1", "source": "tracer_ring",
+                  "trace": {"traceEvents": [
+                      {"name": "x", "ph": "X", "pid": 77, "tid": 1,
+                       "ts": 0, "dur": 5},
+                      {"name": "y", "ph": "X", "pid": 77, "tid": 2,
+                       "ts": 5, "dur": 5}]}},
+        "pod-b": {"schema": "profile/v1", "source": "jax.profiler",
+                  "trace": {"traceEvents": [
+                      {"name": "z", "ph": "X", "pid": 77, "tid": 1,
+                       "ts": 0, "dur": 1}]}},
+    }
+    merged = job_doctor.merge_profiles(profiles)
+    evs = merged["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert [m["args"]["name"] for m in meta] == [
+        "pod-a (tracer_ring)", "pod-b (jax.profiler)"]
+    # same original pid on two pods -> two distinct merged pids
+    pids = {e["pid"] for e in evs if e["ph"] == "X"}
+    assert len(pids) == 2
+    assert all(e["pid"] == meta[0]["pid"] for e in evs
+               if e.get("name") in ("x", "y"))

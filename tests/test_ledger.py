@@ -1,0 +1,226 @@
+"""TimeLedger state machine + GoodputMerger (edl_tpu/obs/ledger.py).
+
+The exclusive-states invariant is the whole point: every wall-clock
+second belongs to exactly one state, so the totals sum to elapsed time
+and goodput % is well-defined. The merger side mirrors PR 8's
+counter-reset discipline: a restarted pod re-zeroes its counters and
+the fold must re-anchor, never subtract.
+"""
+
+import json
+
+from edl_tpu.obs import ledger as ledger_mod
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs.ledger import GoodputMerger, TimeLedger
+
+
+class _Clock(object):
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_states_are_exclusive_and_sum_to_elapsed():
+    clk = _Clock()
+    led = TimeLedger(clock=clk)
+    led.transition("compute")
+    clk.advance(2.0)
+    led.transition("data_wait")
+    clk.advance(1.0)
+    led.transition("compute")
+    clk.advance(4.0)
+    totals = led.totals()
+    assert totals["compute"] == 6.0
+    assert totals["data_wait"] == 1.0
+    # exclusive: everything since the first touch is accounted, once
+    assert sum(totals.values()) == 7.0
+    assert led.current() == "compute"
+
+
+def test_scopes_nest_and_restore_the_outer_state():
+    clk = _Clock()
+    led = TimeLedger(clock=clk)
+    led.transition("resize_pause")
+    clk.advance(5.0)
+    with led.state("ckpt_block"):
+        assert led.current() == "ckpt_block"
+        clk.advance(3.0)
+    # a drain inside a resize returns to resize_pause, not idle
+    assert led.current() == "resize_pause"
+    clk.advance(2.0)
+    totals = led.totals()
+    assert totals["resize_pause"] == 7.0
+    assert totals["ckpt_block"] == 3.0
+
+
+def test_scope_exits_on_exception():
+    clk = _Clock()
+    led = TimeLedger(clock=clk)
+    led.transition("compute")
+    try:
+        with led.state("data_wait"):
+            clk.advance(1.0)
+            raise KeyError("queue.Empty analog")
+    except KeyError:
+        pass
+    assert led.current() == "compute"
+    assert led.totals()["data_wait"] == 1.0
+
+
+def test_kill_switch_stops_accrual():
+    clk = _Clock()
+    led = TimeLedger(clock=clk)
+    led.transition("compute")
+    clk.advance(2.0)
+    prev = obs_metrics.set_enabled(False)
+    try:
+        led.transition("data_wait")  # no-op: state unchanged
+        clk.advance(50.0)
+        led.flush()
+    finally:
+        obs_metrics.set_enabled(prev)
+    # totals() re-arms on the next enabled touch; the disabled 50s
+    # were never accrued anywhere
+    led.flush()
+    totals = led.totals()
+    assert totals["data_wait"] == 0.0
+    assert sum(totals.values()) <= 52.0
+
+
+def test_flush_syncs_registry_counters_incrementally():
+    clk = _Clock()
+    led = TimeLedger(clock=clk)
+
+    def _registry_value(state):
+        fam = obs_metrics.REGISTRY.snapshot()["metrics"][
+            "edl_time_seconds_total"]
+        for s in fam["series"]:
+            if s["labels"]["state"] == state:
+                return s["value"]
+        return 0.0
+
+    base = _registry_value("barrier_wait")
+    led.transition("barrier_wait")
+    clk.advance(3.0)
+    # hot path has NOT touched the registry yet
+    assert _registry_value("barrier_wait") == base
+    led.flush()
+    assert _registry_value("barrier_wait") == base + 3.0
+    clk.advance(1.5)
+    led.flush()  # delta-synced: no double count
+    assert _registry_value("barrier_wait") == base + 4.5
+
+
+def test_reset_zeroes_totals_and_returns_to_idle():
+    clk = _Clock()
+    led = TimeLedger(clock=clk)
+    led.transition("compute")
+    clk.advance(2.0)
+    led.reset()
+    assert led.current() == "idle"
+    assert all(v == 0.0 for v in led.totals().values())
+
+
+def test_pod_states_extraction_and_absent_is_none():
+    doc = {"metrics": {"metrics": {"edl_time_seconds_total": {
+        "kind": "counter",
+        "series": [
+            {"labels": {"state": "compute"}, "value": 12.5},
+            {"labels": {"state": "idle"}, "value": 2.0},
+        ]}}}}
+    assert ledger_mod.pod_states(doc) == {"compute": 12.5, "idle": 2.0}
+    # absent is not zero: pods predating the ledger are skipped
+    assert ledger_mod.pod_states({"metrics": {"metrics": {}}}) is None
+    assert ledger_mod.pod_states({}) is None
+
+
+def test_unengaged_ledger_never_manufactures_idle():
+    # a supervisor process (the launcher) imports the ledger but no
+    # instrumentation point ever touches it; publisher flush ticks
+    # must not turn that into accrued idle time
+    clk = _Clock()
+    led = TimeLedger(clock=clk)
+    clk.advance(30.0)
+    led.flush()
+    clk.advance(30.0)
+    led.flush()
+    assert all(v == 0.0 for v in led.totals().values())
+
+
+def test_merger_skips_all_zero_pods():
+    # the launcher's doc carries the zero-valued series (children are
+    # materialized at import); it has no time to attribute and must
+    # not pad pods_reporting
+    def _doc(compute, idle):
+        return {"metrics": {"metrics": {"edl_time_seconds_total": {
+            "kind": "counter",
+            "series": [
+                {"labels": {"state": "compute"}, "value": compute},
+                {"labels": {"state": "idle"}, "value": idle},
+            ]}}}}
+    m = GoodputMerger()
+    m.update_from_docs({"launcher": _doc(0.0, 0.0),
+                        "pod_r0": _doc(12.0, 3.0)})
+    assert m.pods() == ["pod_r0"]
+
+
+def test_merger_accumulates_deltas_and_reanchors_on_restart():
+    m = GoodputMerger()
+    m.update("p0", {"compute": 10.0, "data_wait": 2.0})  # first: whole
+    m.update("p0", {"compute": 15.0, "data_wait": 2.0})  # +5 compute
+    # restart: counters re-zero; the backwards sum must re-anchor —
+    # fold the new incarnation in whole, never subtract
+    m.update("p0", {"compute": 3.0, "data_wait": 1.0})
+    total, bad = m.fleet_cumulative()
+    assert total == 10.0 + 2.0 + 5.0 + 3.0 + 1.0
+    assert bad == 2.0 + 1.0
+
+
+def test_goodput_doc_shape_and_ranked_badput():
+    m = GoodputMerger()
+    m.update("p0", {"compute": 60.0, "ckpt_block": 30.0,
+                    "data_wait": 10.0})
+    m.update("p1", {"compute": 90.0, "ckpt_block": 5.0,
+                    "data_wait": 5.0})
+    doc = m.doc(now=123.0)
+    assert doc["schema"] == "goodput/v1"
+    assert doc["ts"] == 123.0
+    assert doc["pods_reporting"] == ["p0", "p1"]
+    fleet = doc["fleet"]
+    assert fleet["total_s"] == 200.0
+    assert fleet["goodput_s"] == 150.0
+    assert fleet["goodput_pct"] == 75.0
+    # badput ranked by fleet seconds, largest first
+    assert [b["state"] for b in fleet["badput"]] == ["ckpt_block",
+                                                     "data_wait"]
+    assert fleet["badput"][0]["seconds"] == 35.0
+    pods = doc["pods"]
+    assert pods["p0"]["top_badput"] == "ckpt_block"
+    assert pods["p0"]["goodput_pct"] == 60.0
+    assert doc["spread"]["goodput_pct_min"] == 60.0
+    assert doc["spread"]["goodput_pct_max"] == 90.0
+    assert doc["spread"]["states"]["ckpt_block"] == {"min_s": 5.0,
+                                                     "max_s": 30.0}
+    # the doc round-trips through the store encoding
+    json.loads(json.dumps(doc))
+
+
+def test_merger_forget_drops_the_pod():
+    m = GoodputMerger()
+    m.update("p0", {"compute": 1.0})
+    m.update("p1", {"compute": 2.0})
+    m.forget("p0")
+    assert m.pods() == ["p1"]
+    total, _ = m.fleet_cumulative()
+    assert total == 2.0
+
+
+def test_service_health_constant_matches_controller():
+    # obs is an import leaf: the constant is inlined, guard the drift
+    from edl_tpu.controller import constants
+    assert ledger_mod.SERVICE_HEALTH == constants.SERVICE_HEALTH

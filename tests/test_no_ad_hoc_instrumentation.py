@@ -59,3 +59,75 @@ def test_lint_ignores_benign_timing():
         "    return time.monotonic() - t0\n"
         "def g():\n"
         "    print('hello')\n") == []
+
+
+def _pair_finder(src, relpath="edl_tpu/runtime/x.py"):
+    sys.path.insert(0, os.path.dirname(TOOL))
+    try:
+        import check_no_ad_hoc_instrumentation as lint
+    finally:
+        sys.path.pop(0)
+    f = lint._Finder(relpath)
+    f.visit(ast.parse(src))
+    return f.pair_hits
+
+
+def test_pair_rule_flags_unledgered_stopwatch_delta():
+    """A raw t0 = perf_counter() … x - t0 pair whose delta lands in a
+    plain variable (or a log line) is a ledger bypass in runtime/."""
+    hits = _pair_finder(
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.perf_counter()\n"
+        "    work()\n"
+        "    elapsed = time.perf_counter() - t0\n"
+        "    logger.info('took %.1fs', elapsed)\n")
+    assert hits == [("edl_tpu/runtime/x.py", "f", 5)]
+
+
+def test_pair_rule_out_of_scope_outside_runtime():
+    """The pair rule applies to edl_tpu/runtime/ only — the same code
+    elsewhere passes (the ledger invariant lives in runtime)."""
+    src = ("import time\n"
+           "def f():\n"
+           "    t0 = time.monotonic()\n"
+           "    d = time.monotonic() - t0\n"
+           "    return d\n")
+    assert _pair_finder(src) != []
+    assert _pair_finder(src, relpath="edl_tpu/data/x.py") == []
+
+
+def test_pair_rule_passes_deadline_math():
+    """deadline = monotonic() + x is a BinOp assignment, never tracked,
+    so deadline - monotonic() and remaining-time checks pass."""
+    assert _pair_finder(
+        "import time\n"
+        "def f(timeout):\n"
+        "    deadline = time.monotonic() + timeout\n"
+        "    while time.monotonic() < deadline:\n"
+        "        remaining = deadline - time.monotonic()\n"
+        "        wait(remaining)\n") == []
+
+
+def test_pair_rule_passes_sanctioned_sinks():
+    """A delta consumed directly inside .observe()/.inc()/.set()/
+    .time_ms() already lands in the registry — not a bypass."""
+    assert _pair_finder(
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.perf_counter()\n"
+        "    work()\n"
+        "    _STEP_MS.observe(1000.0 * (time.perf_counter() - t0))\n"
+        "    _RETRIES.inc(time.perf_counter() - t0)\n") == []
+
+
+def test_pair_rule_tracking_is_per_function():
+    """A stopwatch variable from one function must not taint a Sub in a
+    sibling function that reuses the name."""
+    assert _pair_finder(
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.perf_counter()\n"
+        "    use(t0)\n"
+        "def g(t0, t1):\n"
+        "    return t1 - t0\n") == []

@@ -14,6 +14,17 @@ only the timed-then-printed combination in one function is flagged.
 ``edl_tpu/obs`` (the sanctioned sink) and ``edl_tpu/tools`` (benches
 print reports by design) are out of scope.
 
+A second, stricter rule applies to ``edl_tpu/runtime/`` only: a raw
+stopwatch PAIR (``t0 = time.monotonic()`` … ``<x> - t0``) whose delta
+goes anywhere but a sanctioned sink (``observe`` / ``inc`` / ``set`` /
+``time_ms``) is wall-clock attribution bypassing the time ledger — the
+seconds it measures are invisible to ``goodput/v1``. Route the
+interval through :class:`edl_tpu.obs.ledger.TimeLedger` (or a registry
+histogram) instead. Deadline math (``deadline = monotonic() + x`` /
+``deadline - monotonic()``) passes automatically: the deadline variable
+is not a bare stopwatch read, so it is never tracked. Remaining
+legitimate sites live in STOPWATCH_ALLOWLIST with a justification.
+
 Pre-existing sites are grandfathered in ALLOWLIST, keyed by
 ``(relative path, enclosing function)`` so ordinary line drift does not
 churn the list. Runs as a tier-1 test
@@ -36,6 +47,42 @@ STOPWATCHES = {"monotonic", "perf_counter"}
 # this lint correctly no longer sees as a raw console write.
 ALLOWLIST = {}
 
+#: only this subtree is held to the stopwatch-pair rule — it is where
+#: the time ledger's exclusive-state invariant lives
+PAIR_SCAN_PREFIX = "edl_tpu/runtime/"
+
+#: calls whose argument position is a sanctioned destination for a
+#: stopwatch delta (registry handles and the span tracer)
+SINK_METHODS = {"observe", "inc", "set", "time_ms"}
+
+# (relpath, enclosing function) -> why this raw stopwatch pair may
+# bypass the ledger. Keep justifications specific: the next reader
+# decides whether a new site belongs here by analogy.
+STOPWATCH_ALLOWLIST = {
+    ("edl_tpu/runtime/trainer.py", "train_step"):
+        "step_s feeds _STEP_MS.observe and the cadence estimator; the "
+        "interval itself is ledgered as the compute state",
+    ("edl_tpu/runtime/trainer.py", "live_resize"):
+        "drain_s/reshard_s are resize_bench/v1 stage stamps published "
+        "via _resize_timing; the wall clock is ledgered resize_pause",
+    ("edl_tpu/runtime/trainer.py", "compile_all"):
+        "prewarm compiles run on a background thread (never ledgered "
+        "by design); the duration is a log line only",
+    ("edl_tpu/runtime/trainer.py", "_try_load_prewarmed_step"):
+        "AOT-load duration log line inside an interval already "
+        "ledgered resize_pause",
+    ("edl_tpu/runtime/checkpoint.py", "save_async"):
+        "blocked_s stamps the snapshot cost onto the SaveHandle; the "
+        "interval itself is ledgered ckpt_block",
+    ("edl_tpu/runtime/checkpoint.py", "save_sharded_async"):
+        "blocked_s stamps the snapshot cost onto the SaveHandle; the "
+        "interval itself is ledgered ckpt_block",
+    ("edl_tpu/runtime/checkpoint.py", "persist"):
+        "the async persist driver is a background thread whose "
+        "concurrency is deliberately NOT ledgered; persist_s lands on "
+        "the SaveHandle and _SAVE_MS",
+}
+
 
 class _Finder(ast.NodeVisitor):
     """Per-function pairing of stopwatch reads and console writes."""
@@ -43,8 +90,15 @@ class _Finder(ast.NodeVisitor):
     def __init__(self, relpath):
         self.relpath = relpath
         self.hits = []  # (relpath, func, lineno)
+        self.pair_hits = []  # (relpath, func, lineno) — ledger-bypass
         # stack of [name, stopwatch_lineno, console_lineno]
         self._funcs = [["<module>", None, None]]
+        # per-function sets of plain names assigned from a BARE
+        # stopwatch read (deadline math assigns a BinOp, so deadline
+        # variables never land here)
+        self._tracked = [set()]
+        self._sink_depth = 0
+        self.check_pairs = relpath.startswith(PAIR_SCAN_PREFIX)
         self.time_aliases = {"time"}
         self.clock_aliases = set()
 
@@ -61,7 +115,9 @@ class _Finder(ast.NodeVisitor):
 
     def _in_func(self, node):
         self._funcs.append([node.name, None, None])
+        self._tracked.append(set())
         self.generic_visit(node)
+        self._tracked.pop()
         name, clock, console = self._funcs.pop()
         if clock is not None and console is not None:
             self.hits.append((self.relpath, name, console))
@@ -95,11 +151,39 @@ class _Finder(ast.NodeVisitor):
             frame[1] = node.lineno
         if frame[2] is None and self._is_console_write(node):
             frame[2] = node.lineno
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in SINK_METHODS:
+            # a delta consumed inside .observe()/.inc()/… is already
+            # landing in the registry — not a ledger bypass
+            self._sink_depth += 1
+            try:
+                self.generic_visit(node)
+            finally:
+                self._sink_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        if self.check_pairs and isinstance(node.value, ast.Call) \
+                and self._is_stopwatch(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._tracked[-1].add(t.id)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node):
+        if self.check_pairs and self._sink_depth == 0 \
+                and isinstance(node.op, ast.Sub) \
+                and isinstance(node.right, ast.Name) \
+                and node.right.id in self._tracked[-1]:
+            self.pair_hits.append((self.relpath, self._funcs[-1][0],
+                                   node.lineno))
         self.generic_visit(node)
 
 
 def scan():
     hits = []
+    pair_hits = []
     root = os.path.join(REPO, SCAN_ROOT)
     for dirpath, _, files in os.walk(root):
         rel_dir = os.path.relpath(dirpath, REPO)
@@ -116,18 +200,28 @@ def scan():
             finder = _Finder(relpath)
             finder.visit(tree)
             hits.extend(finder.hits)
-    return hits
+            pair_hits.extend(finder.pair_hits)
+    return hits, pair_hits
 
 
 def main():
-    hits = scan()
+    hits, pair_hits = scan()
     violations = [(rel, func, line) for rel, func, line in hits
                   if (rel, func) not in ALLOWLIST]
+    pair_violations = [(rel, func, line) for rel, func, line in pair_hits
+                       if (rel, func) not in STOPWATCH_ALLOWLIST]
     stale = sorted(set(ALLOWLIST) - {(rel, func) for rel, func, _ in hits})
+    stale_pairs = sorted(set(STOPWATCH_ALLOWLIST)
+                         - {(rel, func) for rel, func, _ in pair_hits})
     if stale:
         print("stale ALLOWLIST entries (site no longer exists — remove "
               "them):")
         for rel, func in stale:
+            print("  %s :: %s" % (rel, func))
+    if stale_pairs:
+        print("stale STOPWATCH_ALLOWLIST entries (site no longer exists "
+              "— remove them):")
+        for rel, func in stale_pairs:
             print("  %s :: %s" % (rel, func))
     if violations:
         print("ad-hoc instrumentation (stopwatch + console write in one "
@@ -139,10 +233,19 @@ def main():
               "allowlist the site in "
               "tools/check_no_ad_hoc_instrumentation.py with a "
               "justification.")
-    if violations or stale:
+    if pair_violations:
+        print("raw stopwatch pair bypassing the time ledger "
+              "(edl_tpu/runtime only):")
+        for rel, func, line in pair_violations:
+            print("  %s:%d in %s()" % (rel, line, func))
+        print("attribute the interval through edl_tpu.obs.ledger "
+              "(LEDGER.state/transition) or a registry histogram, or "
+              "add the site to STOPWATCH_ALLOWLIST with a "
+              "justification.")
+    if violations or pair_violations or stale or stale_pairs:
         return 1
-    print("ok: no ad-hoc stopwatch+print instrumentation outside the "
-          "allowlist")
+    print("ok: no ad-hoc stopwatch+print instrumentation and no "
+          "unledgered stopwatch pairs outside the allowlists")
     return 0
 
 
