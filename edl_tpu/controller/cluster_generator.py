@@ -27,7 +27,8 @@ from edl_tpu.utils.logger import logger
 class Generator(object):
     def __init__(self, coord, pod_id, min_nodes, max_nodes,
                  topology_valid=None, below_min_grace=None,
-                 preferred_victims=None, live_ack_timeout=10.0):
+                 preferred_victims=None, live_ack_timeout=10.0,
+                 scale_out_gate=None):
         self._coord = coord
         self._pod_id = pod_id
         self._min = min_nodes
@@ -36,6 +37,16 @@ class Generator(object):
         # advisory hook (obs/health.HealthMonitor.preferred_victims):
         # when a shrink must drop pods, flagged stragglers go first
         self._preferred_victims = preferred_victims
+        # optional veto hook (obs/autopilot.Autopilot.scale_out_allowed):
+        # False suppresses adding joinable pods this pass. Fail-open —
+        # a broken gate must not freeze growth.
+        self._scale_out_gate = scale_out_gate
+        # directed evictions (autopilot): pod -> monotonic expiry. A
+        # directed pod is treated as gone on the next pass and excluded
+        # from joinable until the directive expires (it stays REGISTERED
+        # until its launcher exits, so without the exclusion the very
+        # next pass would re-add it — the evict→rejoin flap).
+        self._directed = {}
         self._stop = threading.Event()
         self._thread = None
         self._lock = threading.Lock()
@@ -127,14 +138,44 @@ class Generator(object):
         "missing from resources" as "dead" during the re-registration
         window would evict live pods from their own cluster. Explicit
         FAILED statuses still count; only absence is forgiven."""
-        try:
-            from edl_tpu.coordination.standby import FAILOVER_GUARD_KEY
-            return self._coord.get_key(FAILOVER_GUARD_KEY) is not None
-        except errors.EdlError:
-            return False
+        from edl_tpu.coordination.standby import failover_guard_active
+        return failover_guard_active(self._coord)
+
+    # -- directed eviction (the autopilot's actuator) ------------------------
+
+    def direct_evict(self, pod_id, ttl_s=30.0):
+        """Direct the next generation pass to drop ``pod_id`` from the
+        cluster (and keep it out of joinable for ``ttl_s``, since the
+        evicted pod stays store-registered until its launcher exits —
+        re-adding it immediately would be the evict→rejoin flap). The
+        ordinary shrink/backfill machinery does the rest: the cluster
+        re-forms without the pod, and a standby (surplus registered pod)
+        backfills through the usual scale-out. Refuses to evict the pod
+        hosting this generator — decapitating the leader to save the
+        job is never a remediation."""
+        if pod_id == self._pod_id:
+            raise errors.EdlError(
+                "refusing directed self-eviction of leader pod %s"
+                % pod_id)
+        with self._lock:
+            self._directed[pod_id] = time.monotonic() + float(ttl_s)
+        logger.warning("directed eviction: pod %s will be dropped on the "
+                       "next generation pass (rejoin blocked %.0fs)",
+                       pod_id, ttl_s)
+        return True
+
+    def _directed_evictions(self):
+        """Live directed-eviction set; expired directives pruned."""
+        now = time.monotonic()
+        with self._lock:
+            expired = [p for p, t in self._directed.items() if t <= now]
+            for pod in expired:
+                del self._directed[pod]
+            return set(self._directed)
 
     def _next_cluster(self, current, resources, statuses):
         hold = self._failover_hold()
+        directed = self._directed_evictions()
         alive, gone, finished = [], [], []
         for pod in current.pods:
             if statuses.get(pod.id) == status.Status.SUCCEED:
@@ -143,6 +184,10 @@ class Generator(object):
                 # never answer a barrier again)
                 finished.append(pod.id)
             elif statuses.get(pod.id) == status.Status.FAILED:
+                gone.append(pod.id)
+            elif pod.id in directed:
+                # autopilot-directed eviction: drop it even though it is
+                # still registered and running
                 gone.append(pod.id)
             elif pod.id not in resources:
                 if hold:
@@ -178,7 +223,8 @@ class Generator(object):
         if not finished and self._scale_out_allowed(statuses):
             room = self._max - len(alive)
             joinable = sorted(i for i in resources
-                              if i not in set(current.pod_ids()))
+                              if i not in set(current.pod_ids())
+                              and i not in directed)
             for pod_id in joinable[:max(0, room)]:
                 added.append(resources[pod_id])
 
@@ -260,7 +306,9 @@ class Generator(object):
 
     def _scale_out_allowed(self, statuses):
         """Don't bother scaling out when training is nearly done
-        (reference parity: doc/edl_collective_design_doc.md:27)."""
+        (reference parity: doc/edl_collective_design_doc.md:27), or
+        while the autopilot's goodput-payback gate vetoes growth
+        (fail-open: a broken gate never blocks)."""
         if status.Status.SUCCEED in statuses.values():
             return False
         all_ts = self._coord.get_service(constants.SERVICE_TRAIN_STATUS)
@@ -268,6 +316,15 @@ class Generator(object):
             if ts in (train_status.TrainStatus.NEARTHEEND,
                       train_status.TrainStatus.SUCCEED):
                 return False
+        if self._scale_out_gate is not None:
+            try:
+                if self._scale_out_gate() is False:
+                    logger.info("scale-out vetoed by autopilot gate "
+                                "(goodput payback outside horizon)")
+                    return False
+            except Exception:
+                logger.exception("scale_out_gate failed; allowing "
+                                 "scale-out (fail open)")
         return True
 
     # -- live resize: the leader-coordinated two-phase commit ----------------

@@ -24,6 +24,9 @@ SERVICE_STATE_SERVER = "state_server"
 # acks, and the trainers' live-capability keys
 # (edl_tpu/runtime/live_resize.py)
 SERVICE_LIVE_RESIZE = "live_resize"
+# goodput autopilot's action/v1 journal and filed postmortem bundles
+# (edl_tpu/obs/autopilot.py)
+SERVICE_AUTOPILOT = "autopilot"
 
 LEADER_SERVER = "0"          # the single leader key
 CLUSTER_SERVER = "cluster"   # the single cluster-map key

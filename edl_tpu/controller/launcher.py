@@ -18,8 +18,10 @@ from edl_tpu.controller.cluster_generator import Generator
 from edl_tpu.controller.cluster_watcher import ClusterWatcher
 from edl_tpu.controller.leader import LeaderElector
 from edl_tpu.controller.resource_pods import ResourceRegister
+from edl_tpu.obs import autopilot as autopilot_mod
 from edl_tpu.obs import flight as obs_flight
 from edl_tpu.obs.health import HealthMonitor
+from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
@@ -45,6 +47,7 @@ class Launcher(object):
         self._elector = None
         self._generator = None
         self._health = None
+        self._autopilot = None
         self._watcher = None
         self._procs = []
         self._cluster = None
@@ -90,12 +93,28 @@ class Launcher(object):
         self._resource_register = ResourceRegister(self._coord, self._pod)
         # the health monitor is leader-hosted alongside the generator:
         # its verdicts advise the generator's scale-in victim choice,
-        # and exactly one monitor writes the fleet's health_report/v1
-        self._health = HealthMonitor(self._coord, self._pod.id)
+        # and exactly one monitor writes the fleet's health_report/v1.
+        # The autopilot (opt-in via EDL_TPU_AUTOPILOT) rides the
+        # monitor tick and turns verdicts into journaled actions; it
+        # has no thread of its own, so elections start/stop nothing
+        # extra — no leader means no monitor tick means no actions.
+        mode = autopilot_mod.mode_from_env()
+        if mode != autopilot_mod.MODE_OFF:
+            self._autopilot = autopilot_mod.Autopilot(
+                self._coord, self._pod.id, mode=mode,
+                evict_fn=lambda pod: self._generator.direct_evict(pod),
+                knobs_fn=self._broadcast_knobs,
+                hold_fn=self._failover_hold)
+        self._health = HealthMonitor(
+            self._coord, self._pod.id,
+            on_report=(self._autopilot.on_report
+                       if self._autopilot else None))
         self._generator = Generator(
             self._coord, self._pod.id, je.min_nodes, je.max_nodes,
             topology_valid=self._topology_valid,
-            preferred_victims=self._health.preferred_victims)
+            preferred_victims=self._health.preferred_victims,
+            scale_out_gate=(self._autopilot.scale_out_allowed
+                            if self._autopilot else None))
         self._elector = LeaderElector(
             self._coord, self._pod.id,
             on_elected=lambda: (self._generator.start(),
@@ -417,6 +436,44 @@ class Launcher(object):
                                              json.dumps(history))
         except Exception:
             logger.exception("resize metric write failed")
+
+    def _failover_hold(self):
+        """The autopilot's hold probe: True while the post-failover
+        settle window is open (see standby.failover_guard_active)."""
+        try:
+            from edl_tpu.coordination.standby import failover_guard_active
+            return failover_guard_active(self._coord)
+        except Exception:  # noqa: BLE001 — fail open, like the guard
+            return False
+
+    def _broadcast_knobs(self, knobs):
+        """The autopilot's tune_knobs actuator: fan ``set_knobs`` out
+        to every reader's DataPlaneServer. Discovery is the data
+        leader's ``ds_stats`` (its ``endpoints`` map — registered
+        readers and where they serve). Per-pod failures are reported,
+        not raised: tuning the survivors beats tuning no one. Raises
+        only when there is no data leader to discover through (the
+        action is then journaled ``failed``)."""
+        leader_ep = self._coord.get_value(constants.SERVICE_READER,
+                                          "reader")
+        if not leader_ep:
+            raise errors.NotFoundError(
+                "no data leader registered; cannot broadcast knobs")
+        client = RpcClient(leader_ep, timeout=5.0)
+        try:
+            stats = client.call("ds_stats")
+        finally:
+            client.close()
+        out = {}
+        for pod, ep in sorted((stats.get("endpoints") or {}).items()):
+            c = RpcClient(ep, timeout=5.0)
+            try:
+                out[pod] = c.call("set_knobs", knobs)
+            except Exception as e:  # noqa: BLE001 — tune the survivors
+                out[pod] = {"error": repr(e)}
+            finally:
+                c.close()
+        return out
 
     def _exit(self, ok):
         """Write the pod flag; the leader aggregates all flags into the job

@@ -97,6 +97,20 @@ _WITNESS_PROBE_TIMEOUT = 3.0
 FAILOVER_GUARD_KEY = "__edl_failover_guard__"
 
 
+def failover_guard_active(coord):
+    """True while the post-failover settle window is open (the leased
+    guard key promote() plants still exists). The one probe every
+    remediation consumer shares: the cluster generator holds shrink
+    decisions behind it and the autopilot holds ALL actions — a
+    failover's mass registration drop must never read as a fleet-wide
+    health event. Fail open (False) on any store error: an unreadable
+    guard must not freeze elasticity forever."""
+    try:
+        return coord.get_key(FAILOVER_GUARD_KEY) is not None
+    except Exception:  # noqa: BLE001 — fail open by contract
+        return False
+
+
 class StandbyServer(object):
     """``primary_endpoints``: where the live primary serves.
     ``auto_promote``: take over after ``promote_after`` seconds of

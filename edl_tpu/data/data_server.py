@@ -249,6 +249,10 @@ class LeaderDataService(object):
                 "consumed": len(self._consumed),
                 "stolen": self._stolen,
                 "readers": {p: r["done"] for p, r in self._readers.items()},
+                # where each reader's DataPlaneServer answers set_knobs
+                # (the autopilot's knob-broadcast discovery surface)
+                "endpoints": {p: r["endpoint"]
+                              for p, r in self._readers.items()},
             }
 
 
@@ -323,12 +327,17 @@ class DataPlaneServer(object):
     this process is the job's data leader — the LeaderDataService too."""
 
     def __init__(self, cache, leader_service=None, host="0.0.0.0", port=0,
-                 pod_id=None):
+                 pod_id=None, knobs_fn=None):
         self._rpc = RpcServer(host=host, port=port)
         self._cache = cache
         self._pod_id = str(pod_id) if pod_id is not None else ""
         self._rpc.register("get_batch", self._get_batch)
         self._rpc.register("get_batches", self._get_batches)
+        if knobs_fn is not None:
+            # runtime tuning surface (the autopilot's tune_knobs
+            # actuator broadcasts here): apply {knob: value}, return
+            # {knob: applied_value}
+            self._rpc.register("set_knobs", knobs_fn)
         if leader_service is not None:
             svc = leader_service
             self._rpc.register("ds_register_reader", svc.register_reader)

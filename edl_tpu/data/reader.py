@@ -179,7 +179,8 @@ class ElasticReader(object):
                           if is_leader else None)
         self._server = DataPlaneServer(self._cache,
                                        leader_service=leader_service,
-                                       pod_id=pod_id).start()
+                                       pod_id=pod_id,
+                                       knobs_fn=self.apply_knobs).start()
         if is_leader:
             if coord is not None:
                 register_data_leader(coord, reader_name,
@@ -380,6 +381,32 @@ class ElasticReader(object):
         if f is not None:
             return errors.ConnectError("fault: %s dropped" % point)
         return None
+
+    def apply_knobs(self, knobs):
+        """Runtime tuning surface, served as the ``set_knobs`` RPC on
+        this reader's DataPlaneServer (the autopilot's ``tune_knobs``
+        actuator broadcasts here when ``data_wait`` dominates the fleet
+        ledger). Applies known knobs, ignores unknown ones, and returns
+        ``{knob: value_actually_applied}``.
+
+        ``fetch_ahead`` (clamped to [1, 64]) takes effect on the next
+        ``ds_get_assignment`` call — it is passed per call. The output
+        queue's bound is fixed at construction, so raising fetch_ahead
+        above it deepens the leader assignment, not the local buffer;
+        that is the useful half when data_wait means "assignments too
+        shallow"."""
+        if not isinstance(knobs, dict):
+            return {}
+        applied = {}
+        if "fetch_ahead" in knobs:
+            try:
+                value = max(1, min(64, int(knobs["fetch_ahead"])))
+            except (TypeError, ValueError):
+                value = None
+            if value is not None:
+                self._fetch_ahead = value
+                applied["fetch_ahead"] = value
+        return applied
 
     def _get_assignment(self):
         fault = self._fire_fault("data.assign", endpoint=self._leader_ep)

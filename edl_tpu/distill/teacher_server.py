@@ -126,6 +126,7 @@ class TeacherServer(object):
         self._rpc.register("get_feed_fetch", self.get_feed_fetch)
         self._rpc.register("predict", self._predict_rpc)
         self._rpc.register("stats", self.stats)
+        self._rpc.register("set_knobs", self.apply_knobs)
 
     def get_feed_fetch(self):
         features = list(_RPC_FEATURES)
@@ -134,6 +135,27 @@ class TeacherServer(object):
         return {"feed": self._feed_specs, "fetch": self._fetch_specs,
                 "max_batch": self._max_batch, "features": features,
                 "batch_timeout_ms": self._batch_timeout * 1000.0}
+
+    def apply_knobs(self, knobs):
+        """Runtime tuning surface (``set_knobs`` RPC — the same contract
+        as the reader's: apply known knobs, ignore unknown ones, return
+        what was applied). ``batch_timeout_ms`` (clamped >= 0, <= 1000)
+        retunes the device thread's coalescing wait on the fly; the
+        thread reads it per batch, so the new value takes effect on the
+        next coalescing round."""
+        if not isinstance(knobs, dict):
+            return {}
+        applied = {}
+        if "batch_timeout_ms" in knobs:
+            try:
+                ms = max(0.0, min(1000.0,
+                                  float(knobs["batch_timeout_ms"])))
+            except (TypeError, ValueError):
+                ms = None
+            if ms is not None:
+                self._batch_timeout = ms / 1000.0
+                applied["batch_timeout_ms"] = ms
+        return applied
 
     def stats(self):
         """Batch-occupancy counters: ``occupancy`` is the fraction of

@@ -34,13 +34,21 @@ the env-gated stderr stopwatch, and the ad-hoc JSON blobs under
   death path a bounded ``blackbox/v1`` artifact (event/trace tails,
   metrics, ledger totals, all-thread tracebacks) survives the
   process, for ``job_doctor --postmortem``.
+- :mod:`edl_tpu.obs.autopilot` — the policy engine that closes the
+  observe→act loop: leader-hosted on the HealthMonitor tick, it maps
+  verdicts to journaled, rate-limited, dry-runnable ``action/v1``
+  remediations (straggler eviction + backfill, resize trigger/veto by
+  goodput payback, data-plane knob tuning, crash-loop postmortems)
+  under ``SERVICE_AUTOPILOT``.
 
 This package is a LEAF: it imports nothing from edl_tpu outside
 ``utils.logger``, so every plane (rpc, robustness, data, coordination)
 can instrument itself without import cycles.
 """
 
-from edl_tpu.obs import events, flight, health, ledger, metrics, slo, trace
+from edl_tpu.obs import (autopilot, events, flight, health, ledger, metrics,
+                         slo, trace)
+from edl_tpu.obs.autopilot import Autopilot
 from edl_tpu.obs.events import EVENTS, emit
 from edl_tpu.obs.flight import FlightRecorder
 from edl_tpu.obs.health import HealthMonitor
@@ -50,7 +58,7 @@ from edl_tpu.obs.metrics import (REGISTRY, counter, gauge, histogram,
 from edl_tpu.obs.publisher import MetricsPublisher
 
 __all__ = ["metrics", "trace", "events", "health", "slo", "ledger",
-           "flight", "REGISTRY", "EVENTS", "LEDGER", "counter", "gauge",
-           "histogram", "mirror_stats", "set_enabled", "emit",
-           "MetricsPublisher", "HealthMonitor", "TimeLedger",
-           "GoodputMerger", "FlightRecorder"]
+           "flight", "autopilot", "REGISTRY", "EVENTS", "LEDGER",
+           "counter", "gauge", "histogram", "mirror_stats", "set_enabled",
+           "emit", "MetricsPublisher", "HealthMonitor", "TimeLedger",
+           "GoodputMerger", "FlightRecorder", "Autopilot"]

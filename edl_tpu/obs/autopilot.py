@@ -1,0 +1,616 @@
+"""Goodput autopilot: the policy engine that closes the observe→act loop.
+
+PRs 7–10 made the fleet observable — straggler verdicts, SLO burn
+rates, per-state time ledgers, resize-pause benchmarks, crash
+blackboxes — but every actuator except the advisory scale-in victim
+ranking was a human reading ``job_doctor``. This module turns verdicts
+into bounded, auditable ACTIONS. :class:`Autopilot` is leader-hosted
+(it runs on the :class:`~edl_tpu.obs.health.HealthMonitor` tick via the
+monitor's ``on_report`` hook, so it acts exactly when and where the
+verdicts are produced) and maps each fresh ``health_report/v1`` to at
+most a handful of journaled actions:
+
+- ``evict`` — a confirmed straggler (top ``preferred_victims`` entry
+  for ``evict_streak`` CONSECUTIVE reports) is evicted through the
+  cluster generator's directed-eviction actuator; the generator's
+  ordinary scale-out then backfills from standby (surplus PENDING
+  launchers re-barriering to be scaled in). Promotes the PR 8 victim
+  ranking from advisory to acted-upon.
+- ``resize`` — trigger/veto gate for scale-out: the projected resize
+  pause (median ``recovery_s`` over the per-pod resize histories the
+  launchers journal under ``SERVICE_METRICS``) must be repaid by
+  marginal goodput (from the report's embedded ``goodput/v1`` fleet
+  section) within ``payback_horizon_s`` — see
+  :func:`edl_tpu.obs.ledger.resize_payback_s`. The decision feeds the
+  generator's ``scale_out_gate``; only decision CHANGES are journaled.
+- ``tune_knobs`` — when ``data_wait`` dominates the fleet ledger
+  (top-ranked badput state above ``data_wait_share_pct``), the data
+  plane's ``fetch_ahead`` is doubled (bounded) through the injected
+  knob actuator (the launcher broadcasts ``set_knobs`` to every
+  reader's data-plane server).
+- ``postmortem`` — a crash loop (>= ``crash_loop_boxes`` recent
+  ``blackbox/v1`` artifacts inside ``crash_window_s``) auto-files a
+  postmortem bundle (box summaries + the doctor's evidence chain)
+  under ``SERVICE_AUTOPILOT`` so the forensics are captured while the
+  boxes are still fresh.
+
+Safety model — the engine must provably never flap:
+
+- Every action is an ``action/v1`` record appended to the bounded
+  store journal under ``SERVICE_AUTOPILOT``/``JOURNAL_KEY`` with a
+  cause chain (``report_ts`` → detector/finding summary → causal
+  ``evidence_ids`` from the health report) linking the action back to
+  the evidence that triggered it.
+- Per-action-kind rate limits: a cooldown between actions of the same
+  kind AND a burst bound (at most ``burst`` per ``burst_window_s``).
+- Hysteresis: an evict needs ``evict_streak`` consecutive confirming
+  reports, and an evicted pod cannot be re-evicted for
+  ``reevict_block_s`` — so evict→backfill→re-flag cannot oscillate.
+- Global dry-run: ``EDL_TPU_AUTOPILOT=dry`` journals the identical
+  action stream while applying NOTHING (actuators never called, the
+  scale-out gate always allows); ``off`` (the default) disables the
+  engine entirely; ``on`` applies.
+- The apply step fires the ``autopilot.apply`` chaos point BEFORE the
+  actuator and runs under the standard
+  :class:`~edl_tpu.robustness.policy.RetryPolicy` — a failed apply is
+  journaled ``outcome: failed`` and, because the fault point precedes
+  the actuator inside the retried callable, a retried apply can never
+  double-apply.
+- All actions hold while a store-failover settle window is open
+  (``hold_fn``, wired to ``coordination.standby.failover_guard_active``
+  by the launcher): a failover's mass re-registration must not read as
+  a fleet-wide health event.
+
+This package is a LEAF — ``SERVICE_AUTOPILOT`` is inlined here (value
+of ``controller.constants.SERVICE_AUTOPILOT``, drift-guarded by a
+test), the coordination client and every actuator are injected, and
+the robustness imports are lazy (robustness imports obs).
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from edl_tpu.obs import flight as flight_mod
+from edl_tpu.obs import ledger as ledger_mod
+from edl_tpu.obs import publisher as publisher_mod
+from edl_tpu.utils.logger import logger
+
+#: value of controller.constants.SERVICE_AUTOPILOT, inlined so obs
+#: stays a leaf package (guarded by a test against drift)
+SERVICE_AUTOPILOT = "autopilot"
+
+#: the single bounded action journal under SERVICE_AUTOPILOT
+#: (leader-written, last-writer-wins — there is at most one autopilot,
+#: hosted next to the one elected HealthMonitor)
+JOURNAL_KEY = "journal"
+
+#: filed postmortem bundles: ``postmortem_<seq>`` under SERVICE_AUTOPILOT
+POSTMORTEM_PREFIX = "postmortem_"
+
+ENV_VAR = "EDL_TPU_AUTOPILOT"
+MODE_OFF = "off"
+MODE_DRY = "dry"
+MODE_ON = "on"
+
+ACTION_KINDS = ("evict", "resize", "tune_knobs", "postmortem")
+
+
+def mode_from_env(value=None):
+    """Resolve the global mode from ``EDL_TPU_AUTOPILOT`` (or an
+    explicit ``value``): ``on`` applies, ``dry`` journals without
+    applying, anything else is ``off`` (the default — the engine adds
+    zero behavior unless deliberately enabled)."""
+    raw = (os.environ.get(ENV_VAR, MODE_OFF)
+           if value is None else value)
+    raw = str(raw).strip().lower()
+    if raw in (MODE_ON, "1", "true", "enabled"):
+        return MODE_ON
+    if raw in (MODE_DRY, "dry_run", "dryrun"):
+        return MODE_DRY
+    return MODE_OFF
+
+
+class Autopilot(object):
+    """The leader-hosted policy engine (see module docstring).
+
+    ``on_report(report)`` is the whole runtime surface: the
+    HealthMonitor calls it after each published tick, the policies run
+    synchronously (they are dict folds over the report — the
+    ``autopilot`` arc of ``obs_bench`` measures the tick cost against
+    the <2%-of-interval criterion), and every decision lands in the
+    store journal. There is no thread of its own and no store polling
+    loop: no leader, no monitor tick, no actions.
+
+    Actuators (all injected, all optional — a policy without its
+    actuator journals ``outcome: failed`` rather than silently doing
+    nothing):
+
+    - ``evict_fn(pod_id)`` — the generator's ``direct_evict``.
+    - ``knobs_fn(knobs_dict)`` — the launcher's ``set_knobs``
+      broadcast; returns ``{pod: applied}``.
+    - ``hold_fn()`` — True while actions must hold (failover settle).
+    """
+
+    def __init__(self, coord, pod_id, mode=None, interval=10.0,
+                 evict_fn=None, knobs_fn=None, hold_fn=None,
+                 evict_streak=2, reevict_block_s=None,
+                 payback_horizon_s=600.0, data_wait_share_pct=30.0,
+                 fetch_ahead_base=2, fetch_ahead_max=16,
+                 crash_loop_boxes=2, crash_window_s=600.0,
+                 cooldowns=None, burst=3, burst_window_s=None,
+                 journal_cap=64, retry=None, clock=time.time):
+        self._coord = coord
+        self._pod_id = pod_id
+        self._mode = mode_from_env(mode)
+        self._interval = float(interval)
+        self._evict_fn = evict_fn
+        self._knobs_fn = knobs_fn
+        self._hold_fn = hold_fn
+        self._clock = clock
+        # hysteresis / rate-limit knobs (defaults scale with the
+        # monitor interval so one tick can never fire twice)
+        self._evict_streak = max(1, int(evict_streak))
+        self._reevict_block_s = (float(reevict_block_s)
+                                 if reevict_block_s is not None
+                                 else 30.0 * self._interval)
+        self._payback_horizon_s = float(payback_horizon_s)
+        self._data_wait_share_pct = float(data_wait_share_pct)
+        self._fetch_ahead_target = max(1, int(fetch_ahead_base))
+        self._fetch_ahead_max = max(1, int(fetch_ahead_max))
+        self._crash_loop_boxes = max(1, int(crash_loop_boxes))
+        self._crash_window_s = float(crash_window_s)
+        self._cooldowns = {
+            "evict": 6.0 * self._interval,
+            "resize": 3.0 * self._interval,
+            "tune_knobs": 12.0 * self._interval,
+            "postmortem": 30.0 * self._interval,
+        }
+        self._cooldowns.update(cooldowns or {})
+        self._burst = max(1, int(burst))
+        self._burst_window_s = (float(burst_window_s)
+                                if burst_window_s is not None
+                                else 60.0 * self._interval)
+        self._journal_cap = max(1, int(journal_cap))
+        if retry is None:
+            # lazy: robustness imports obs, so obs must not import it
+            # at module scope (same idiom as flight.py's fault hook)
+            from edl_tpu.robustness.policy import RetryPolicy
+            retry = RetryPolicy(max_attempts=3, base_delay=0.05,
+                                max_delay=0.5, jitter=0.0)
+        self._retry = retry
+
+        self._lock = threading.Lock()
+        self._seq = None  # lazily anchored on the stored journal
+        self._actions = []  # in-memory mirror of this engine's records
+        self._last_action_ts = {}   # kind -> ts of last journaled action
+        self._recent = {k: deque() for k in ACTION_KINDS}
+        # evict hysteresis state
+        self._streak_pod = None
+        self._streak_n = 0
+        self._no_reevict_until = {}  # pod -> ts
+        # resize gate state: None until first decision; True = allow
+        self._scale_out_ok = None
+        self._last_resize_decision = None
+        # postmortem dedup: signature of the last filed crash loop
+        self._filed_signature = None
+
+    # -- public surface ----------------------------------------------------
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def actions(self):
+        """Records journaled by THIS engine instance (in order)."""
+        with self._lock:
+            return list(self._actions)
+
+    def scale_out_allowed(self):
+        """The generator's ``scale_out_gate``: False only when the
+        engine is ``on`` AND the payback model currently vetoes growth.
+        Dry-run and off apply nothing; any error fails open."""
+        if self._mode != MODE_ON:
+            return True
+        with self._lock:
+            return self._scale_out_ok is not False
+
+    def on_report(self, report):
+        """One policy pass over a fresh ``health_report/v1``; returns
+        the ``action/v1`` records journaled this tick. Never raises —
+        the monitor tick must survive any policy bug."""
+        if self._mode == MODE_OFF or not isinstance(report, dict):
+            return []
+        now = self._clock()
+        if self._held():
+            logger.info("autopilot: failover settle window open; "
+                        "holding all actions")
+            return []
+        out = []
+        for policy in (self._policy_evict, self._policy_resize,
+                       self._policy_knobs, self._policy_postmortem):
+            try:
+                out.extend(policy(report, now))
+            except Exception:  # noqa: BLE001 — one policy must not
+                logger.exception("autopilot policy %s failed",
+                                 policy.__name__)  # kill the others
+        return out
+
+    # -- guards ------------------------------------------------------------
+
+    def _held(self):
+        if self._hold_fn is None:
+            return False
+        try:
+            return bool(self._hold_fn())
+        except Exception:  # noqa: BLE001 — a hold probe failure must
+            return False   # not freeze the engine forever: fail open
+
+    def _gate_ok(self, kind, now):
+        """Per-kind rate limit: cooldown since the last action of this
+        kind AND at most ``burst`` actions per ``burst_window_s``."""
+        last = self._last_action_ts.get(kind)
+        if last is not None and now - last < self._cooldowns.get(kind,
+                                                                 0.0):
+            return False
+        ring = self._recent[kind]
+        while ring and now - ring[0] > self._burst_window_s:
+            ring.popleft()
+        return len(ring) < self._burst
+
+    def _gate_record(self, kind, now):
+        self._last_action_ts[kind] = now
+        self._recent[kind].append(now)
+
+    # -- the apply step ----------------------------------------------------
+
+    def _apply(self, kind, actuator, *args):
+        """Apply one action through its actuator. Returns
+        ``(outcome, attempts, error, result)``. The ``autopilot.apply``
+        chaos point fires INSIDE the retried callable, BEFORE the
+        actuator — an injected failure therefore aborts the attempt
+        with the actuator untouched, and a retry that then succeeds
+        has applied exactly once (the never-double-applied contract).
+        Dry-run short-circuits: nothing fires, nothing applies."""
+        if self._mode == MODE_DRY:
+            return "dry_run", 0, None, None
+        if actuator is None:
+            return "failed", 0, "no actuator bound for %r" % kind, None
+        from edl_tpu.robustness import faults
+        attempts = [0]
+
+        def once():
+            attempts[0] += 1
+            if faults.PLANE is not None:
+                # ctx key is ``action`` (not ``kind``): inject()'s own
+                # ``kind`` parameter would shadow the filter otherwise
+                faults.PLANE.fire("autopilot.apply", action=kind,
+                                  pod=self._pod_id)
+            return actuator(*args)
+
+        try:
+            result = self._retry.call(once)
+            return "applied", attempts[0], None, result
+        except Exception as e:  # noqa: BLE001 — journaled, not raised
+            return "failed", attempts[0], repr(e), None
+
+    # -- journaling --------------------------------------------------------
+
+    def _next_seq(self):
+        # caller holds self._lock; anchor once on the stored journal so
+        # a re-elected leader's engine continues the sequence
+        if self._seq is None:
+            self._seq = 0
+            try:
+                for a in load_actions(self._coord):
+                    self._seq = max(self._seq, int(a.get("seq", 0)))
+            except Exception:  # noqa: BLE001 — fresh store: start at 0
+                pass
+        self._seq += 1
+        return self._seq
+
+    def _record(self, kind, target, reason, cause, outcome, attempts,
+                error, result, now, extra=None):
+        with self._lock:
+            seq = self._next_seq()
+            action = {
+                "schema": "action/v1",
+                "id": "act-%d" % seq,
+                "seq": seq,
+                "ts": now,
+                "kind": kind,
+                "mode": ("dry_run" if self._mode == MODE_DRY
+                         else "applied"),
+                "actor": self._pod_id,
+                "target": target,
+                "reason": reason,
+                "cause": cause,
+                "outcome": outcome,
+                "attempts": attempts,
+                "error": error,
+                "result": result,
+            }
+            if extra:
+                action.update(extra)
+            self._actions.append(action)
+            self._gate_record(kind, now)
+        try:
+            raw = self._coord.get_value(SERVICE_AUTOPILOT, JOURNAL_KEY) \
+                or "[]"
+            journal = json.loads(raw)
+            if not isinstance(journal, list):
+                journal = []
+        except Exception:  # noqa: BLE001 — corrupt/absent: restart it
+            journal = []
+        journal = journal[-(self._journal_cap - 1):]
+        journal.append(action)
+        try:
+            self._coord.set_server_permanent(SERVICE_AUTOPILOT,
+                                             JOURNAL_KEY,
+                                             json.dumps(journal))
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            logger.debug("autopilot journal write failed: %r", e)
+        logger.warning("autopilot %s: %s %s -> %s%s", self._mode, kind,
+                       target, outcome,
+                       (" (%s)" % error) if error else "")
+        return action
+
+    @staticmethod
+    def _cause_from_finding(report, finding):
+        cause = {"report_ts": report.get("ts"),
+                 "detector": None, "summary": None, "evidence_ids": []}
+        if finding:
+            cause["detector"] = finding.get("detector")
+            cause["summary"] = finding.get("summary")
+            cause["evidence_ids"] = [i for i in
+                                     (finding.get("event_ids") or ())
+                                     if i is not None]
+        return cause
+
+    # -- policies ----------------------------------------------------------
+
+    def _policy_evict(self, report, now):
+        victims = list(report.get("preferred_victims") or ())
+        if not victims:
+            self._streak_pod, self._streak_n = None, 0
+            return []
+        top = victims[0]
+        if top == self._pod_id:  # never self-decapitate (belt and
+            return []            # braces; the monitor excludes itself)
+        if top == self._streak_pod:
+            self._streak_n += 1
+        else:
+            self._streak_pod, self._streak_n = top, 1
+        if self._streak_n < self._evict_streak:
+            return []
+        if now < self._no_reevict_until.get(top, 0.0):
+            return []
+        if not self._gate_ok("evict", now):
+            return []
+        finding = next(
+            (f for f in report.get("findings") or ()
+             if f.get("pod") == top and f.get("severity") == "critical"),
+            None)
+        cause = self._cause_from_finding(report, finding)
+        cause["streak"] = self._streak_n
+        outcome, attempts, error, result = self._apply(
+            "evict", self._evict_fn, top)
+        # the block applies in EVERY mode and on failure too: dry-run
+        # must journal the identical stream (one action per episode),
+        # and a failing actuator must not hot-loop the same victim
+        self._no_reevict_until[top] = now + self._reevict_block_s
+        reason = ("confirmed straggler for %d consecutive reports; "
+                  "evicting and backfilling from standby"
+                  % self._streak_n)
+        return [self._record("evict", top, reason, cause, outcome,
+                             attempts, error, result, now)]
+
+    def _projected_pause_s(self):
+        """Median ``recovery_s`` over the per-pod resize histories the
+        launchers journal under SERVICE_METRICS — the store-runtime
+        analogue of the ``resize_bench/v1`` pause numbers. None with no
+        history (the payback model then fails open)."""
+        samples = []
+        try:
+            pairs = self._coord.get_service(
+                publisher_mod.SERVICE_METRICS)
+        except Exception:  # noqa: BLE001 — no store view: no estimate
+            return None
+        for key, raw in pairs:
+            if key.startswith(publisher_mod.KEY_PREFIX):
+                continue  # obs_pub docs, not resize histories
+            try:
+                history = json.loads(raw)
+            except (TypeError, ValueError):
+                continue
+            if not isinstance(history, list):
+                continue
+            for entry in history[-20:]:
+                if isinstance(entry, dict) and "recovery_s" in entry:
+                    try:
+                        samples.append(float(entry["recovery_s"]))
+                    except (TypeError, ValueError):
+                        pass
+        if not samples:
+            return None
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    def _policy_resize(self, report, now):
+        """Trigger/veto gate for scale-out, journaled on decision
+        CHANGE only (the gate itself is consulted every generator
+        pass). Fail-open: without a pause projection or a goodput
+        fraction there is no model, so growth stays allowed."""
+        goodput = report.get("goodput") or {}
+        gp_pct = goodput.get("goodput_pct")
+        world = (report.get("fleet") or {}).get("pods_total") or 0
+        pause = self._projected_pause_s()
+        if pause is None or gp_pct is None or world <= 0:
+            allow, why, payback = True, "no pause/goodput history " \
+                "(fail open)", None
+        else:
+            payback = ledger_mod.resize_payback_s(
+                pause, world, world + 1, gp_pct / 100.0)
+            allow = payback <= self._payback_horizon_s
+            why = ("projected pause %.2fs at world %d->%d, goodput "
+                   "%.1f%%: payback %.0fs vs horizon %.0fs"
+                   % (pause, world, world + 1, gp_pct,
+                      payback, self._payback_horizon_s))
+        prev = self._last_resize_decision
+        self._last_resize_decision = allow
+        if prev is None:
+            # the initial state is not a decision change; the gate
+            # simply starts in the computed position
+            with self._lock:
+                self._scale_out_ok = allow
+            return []
+        if allow == prev:
+            return []
+        if not self._gate_ok("resize", now):
+            # rate-limited: keep the PREVIOUS gate position — a
+            # decision the journal cannot record must not act either
+            self._last_resize_decision = prev
+            return []
+        cause = {"report_ts": report.get("ts"), "detector": "goodput",
+                 "summary": why, "evidence_ids": [],
+                 "payback_s": (round(payback, 1)
+                               if payback not in (None, float("inf"))
+                               else None)}
+
+        def flip():
+            with self._lock:
+                self._scale_out_ok = allow
+            return {"scale_out_allowed": allow}
+
+        outcome, attempts, error, result = self._apply("resize", flip)
+        verb = "trigger" if allow else "veto"
+        return [self._record(
+            "resize", "cluster",
+            "%s scale-out: %s" % (verb, why), cause, outcome,
+            attempts, error, result, now,
+            extra={"decision": "allow" if allow else "veto"})]
+
+    def _policy_knobs(self, report, now):
+        goodput = report.get("goodput") or {}
+        badput = goodput.get("badput") or []
+        if not badput or badput[0].get("state") != "data_wait":
+            return []
+        share = badput[0].get("share_pct") or 0.0
+        if share < self._data_wait_share_pct:
+            return []
+        if self._fetch_ahead_target >= self._fetch_ahead_max:
+            return []  # already at the ceiling: nothing left to tune
+        if not self._gate_ok("tune_knobs", now):
+            return []
+        target = min(self._fetch_ahead_max,
+                     self._fetch_ahead_target * 2)
+        knobs = {"fetch_ahead": target}
+        cause = {"report_ts": report.get("ts"), "detector": "goodput",
+                 "summary": "data_wait is %.1f%% of fleet badput "
+                            "(threshold %.1f%%)"
+                            % (share, self._data_wait_share_pct),
+                 "evidence_ids": []}
+        outcome, attempts, error, result = self._apply(
+            "tune_knobs", self._knobs_fn, knobs)
+        if outcome in ("applied", "dry_run"):
+            # advance in dry-run too: the journaled stream (each action
+            # doubling from the last target) must match the on-mode one
+            self._fetch_ahead_target = target
+        reason = ("data_wait dominates the fleet ledger (%.1f%%); "
+                  "raising fetch_ahead to %d" % (share, target))
+        return [self._record("tune_knobs", "data_plane", reason, cause,
+                             outcome, attempts, error, result, now,
+                             extra={"knobs": knobs})]
+
+    def _policy_postmortem(self, report, now):
+        boxes = flight_mod.load_blackboxes(self._coord)
+        recent = {k: b for k, b in boxes.items()
+                  if isinstance(b, dict)
+                  and now - (b.get("ts") or 0.0) <= self._crash_window_s}
+        if len(recent) < self._crash_loop_boxes:
+            return []
+        signature = tuple(sorted(
+            (k, round(b.get("ts") or 0.0, 1)) for k, b in recent.items()))
+        if signature == self._filed_signature:
+            return []  # this crash loop is already filed
+        if not self._gate_ok("postmortem", now):
+            return []
+        findings = list(report.get("findings") or ())[:8]
+        bundle = {
+            "schema": "postmortem/v1",
+            "ts": now,
+            "boxes": {k: {"reason": b.get("reason"),
+                          "ts": b.get("ts"),
+                          "exception": (b.get("exception") or {}).get(
+                              "type")}
+                      for k, b in sorted(recent.items())},
+            "findings": [{"detector": f.get("detector"),
+                          "pod": f.get("pod"),
+                          "severity": f.get("severity"),
+                          "summary": f.get("summary"),
+                          "event_ids": f.get("event_ids") or []}
+                         for f in findings],
+            "hint": "job_doctor --postmortem renders the full boxes",
+        }
+        evidence = sorted({i for f in findings
+                           for i in (f.get("event_ids") or ())
+                           if i is not None})
+        cause = {"report_ts": report.get("ts"), "detector": "crash_loop",
+                 "summary": "%d blackboxes within %.0fs: %s"
+                            % (len(recent), self._crash_window_s,
+                               ", ".join(sorted(recent))),
+                 "evidence_ids": evidence}
+
+        def file_bundle():
+            with self._lock:
+                seq = (self._seq or 0) + 1
+            key = "%s%d" % (POSTMORTEM_PREFIX, seq)
+            self._coord.set_server_permanent(SERVICE_AUTOPILOT, key,
+                                             json.dumps(bundle))
+            return {"key": key}
+
+        outcome, attempts, error, result = self._apply("postmortem",
+                                                       file_bundle)
+        self._filed_signature = signature
+        reason = ("crash loop detected (%d recent blackboxes); filed "
+                  "postmortem bundle" % len(recent))
+        return [self._record("postmortem", "fleet", reason, cause,
+                             outcome, attempts, error, result, now,
+                             extra={"bundle": bundle})]
+
+
+def load_actions(coord, service=SERVICE_AUTOPILOT):
+    """The stored ``action/v1`` journal (oldest first), or []."""
+    try:
+        raw = coord.get_value(service, JOURNAL_KEY)
+        if not raw:
+            return []
+        journal = json.loads(raw)
+        if not isinstance(journal, list):
+            return []
+        return [a for a in journal
+                if isinstance(a, dict) and a.get("schema") == "action/v1"]
+    except Exception as e:  # noqa: BLE001 — absent store == no journal
+        logger.debug("autopilot journal read failed: %r", e)
+        return []
+
+
+def load_postmortems(coord, service=SERVICE_AUTOPILOT):
+    """Filed ``postmortem/v1`` bundles: ``{key: doc}``."""
+    out = {}
+    try:
+        for key, raw in coord.get_service(service):
+            if not key.startswith(POSTMORTEM_PREFIX):
+                continue
+            try:
+                doc = json.loads(raw)
+            except (TypeError, ValueError):
+                continue
+            if isinstance(doc, dict) \
+                    and doc.get("schema") == "postmortem/v1":
+                out[key] = doc
+    except Exception as e:  # noqa: BLE001 — absent store == no bundles
+        logger.debug("postmortem read failed: %r", e)
+    return out

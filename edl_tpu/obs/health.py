@@ -335,10 +335,20 @@ class HealthMonitor(object):
                  saturation_gauges=(("edl_reader_out_queue_depth", 16.0),
                                     ("edl_teacher_queue_depth", 64.0)),
                  slos=slo_mod.DEFAULT_SLOS, evaluator=None, events=None,
-                 clock=time.time, max_transitions=64):
+                 clock=time.time, max_transitions=64, ttl_s=None,
+                 on_report=None):
         self._coord = coord
         self._pod_id = pod_id
         self._interval = float(interval)
+        # verdict freshness bound: past it, consumers (scale-in victim
+        # ranking, the autopilot) must treat the report as expired and
+        # fail open — a dead leader's stale verdict must not keep
+        # biasing eviction (reports are stamped with this value)
+        self._ttl_s = (float(ttl_s) if ttl_s is not None
+                       else 3.0 * self._interval)
+        # called with each fresh report AFTER it is published — the
+        # autopilot's tick (must never raise into the monitor loop)
+        self._on_report = on_report
         self._service_metrics = service_metrics
         self._service_health = service_health
         self._key_prefix = key_prefix
@@ -648,6 +658,7 @@ class HealthMonitor(object):
         return {
             "schema": "health_report/v1",
             "ts": now,
+            "ttl_s": self._ttl_s,
             "monitor": self._pod_id,
             "interval_s": self._interval,
             "fleet": {"verdict": fleet_verdict,
@@ -681,6 +692,11 @@ class HealthMonitor(object):
                     json.dumps(gdoc))
             except Exception as e:  # noqa: BLE001 — best-effort by contract
                 logger.debug("goodput write failed (will retry): %r", e)
+        if self._on_report is not None:
+            try:
+                self._on_report(report)
+            except Exception:  # noqa: BLE001 — a policy bug must not
+                logger.exception("on_report hook failed")  # kill ticks
         return report
 
     def last_report(self):
@@ -689,9 +705,17 @@ class HealthMonitor(object):
 
     def preferred_victims(self):
         """Ranked advisory eviction order (worst straggler first) from
-        the latest tick; empty when the fleet is healthy."""
+        the latest tick; empty when the fleet is healthy OR when the
+        latest report has aged past its TTL (fail open: a verdict the
+        monitor stopped refreshing must not keep biasing eviction)."""
         with self._lock:
-            return list(self._victims)
+            report = self._last_report
+            victims = list(self._victims)
+        if report is None:
+            return []
+        if self._clock() - (report.get("ts") or 0.0) > self._ttl_s:
+            return []
+        return victims
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -719,16 +743,29 @@ class HealthMonitor(object):
             self._thread = None
 
 
-def load_report(coord, service=SERVICE_HEALTH):
-    """Latest ``health_report/v1`` from the store, or None."""
+def load_report(coord, service=SERVICE_HEALTH, fresh_only=False,
+                now=None):
+    """Latest ``health_report/v1`` from the store, or None.
+
+    ``fresh_only=True`` additionally returns None when the report has
+    aged past its stamped ``ttl_s`` — the mode remediation consumers
+    must use (a dead leader's verdict expires; tooling that renders
+    history keeps the default and shows staleness instead)."""
     try:
         raw = coord.get_value(service, HEALTH_KEY)
         if not raw:
             return None
         doc = json.loads(raw)
-        if isinstance(doc, dict) \
-                and doc.get("schema") == "health_report/v1":
-            return doc
+        if not isinstance(doc, dict) \
+                or doc.get("schema") != "health_report/v1":
+            return None
+        if fresh_only:
+            ttl = doc.get("ttl_s")
+            if ttl is not None:
+                now = time.time() if now is None else now
+                if now - (doc.get("ts") or 0.0) > float(ttl):
+                    return None
+        return doc
     except Exception as e:  # noqa: BLE001 — absent store == no report
         logger.debug("health report read failed: %r", e)
     return None

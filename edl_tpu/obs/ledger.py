@@ -334,6 +334,27 @@ class GoodputMerger(object):
         }
 
 
+def resize_payback_s(pause_s, world_from, world_to, goodput_frac):
+    """Seconds until a resize pause is repaid by marginal goodput.
+
+    The pause idles all ``world_from`` pods outright, costing
+    ``pause_s * world_from`` compute-seconds. After the resize the
+    fleet gains ``(world_to - world_from) * goodput_frac``
+    compute-seconds per wall-clock second (the marginal pods convert
+    wall time into goodput at the fleet's observed rate). The payback
+    horizon is cost / gain-rate; the autopilot triggers a scale-out
+    only when this falls inside its configured horizon.
+
+    Returns ``inf`` when the resize gains nothing (``world_to <=
+    world_from``), when the fleet converts no time into compute
+    (``goodput_frac <= 0``), or on a nonsensical negative pause —
+    an infinite horizon is an automatic veto."""
+    gain = (float(world_to) - float(world_from)) * float(goodput_frac)
+    if gain <= 0.0 or pause_s < 0.0 or goodput_frac <= 0.0:
+        return float("inf")
+    return float(pause_s) * float(world_from) / gain
+
+
 def load_goodput(coord, service=SERVICE_HEALTH):
     """Latest ``goodput/v1`` doc from the store, or None."""
     try:

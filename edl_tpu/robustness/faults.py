@@ -60,6 +60,12 @@ resize.live.reshard      in live_resize after the new mesh is built,
                          crash drill; rollback must leave the old mesh
                          byte-identical and the 2PC must abort to
                          stop-resume
+autopilot.apply          before an autopilot action's actuator runs
+                         (ctx: action, pod) — fired INSIDE the retried
+                         apply step, so ``error_once`` proves the
+                         failed→retried→never-double-applied contract
+                         and ``error`` proves a persistent failure is
+                         journaled ``outcome: failed``
 ======================== ===============================================
 
 Fault kinds:

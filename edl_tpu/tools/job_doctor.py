@@ -38,10 +38,12 @@ import time
 
 from edl_tpu.controller import constants, status
 from edl_tpu.coordination.client import CoordClient
+from edl_tpu.obs import autopilot as autopilot_mod
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import flight as flight_mod
 from edl_tpu.obs import health as health_mod
 from edl_tpu.obs.publisher import KEY_PREFIX as _OBS_KEY_PREFIX
+from edl_tpu.tools.job_stats import format_autopilot
 
 #: ranking: detector class when severities tie — a dead pod's black box
 #: first (it IS the outage), then liveness (a dead publisher hides
@@ -76,6 +78,9 @@ def collect(coord):
     except Exception:
         pass
     out["obs"] = obs_pub
+    # the autopilot's action/v1 journal: what the engine DID about the
+    # findings above (empty when the engine is off)
+    out["autopilot"] = autopilot_mod.load_actions(coord)
     return out
 
 
@@ -233,6 +238,9 @@ def diagnose(collected, now=None):
         "job_id": collected.get("job_id"),
         "job_status": collected.get("job_status"),
         "pods_published": sorted(obs),
+        # the remediation record: each entry chains evidence ids ->
+        # action -> outcome (dry-run actions carry mode "dry_run")
+        "autopilot": collected.get("autopilot") or [],
     }
     if health is None:
         report["verdict"] = "unknown"
@@ -457,6 +465,7 @@ def render(report, width=76):
     if victims:
         lines.append("preferred scale-in victims: %s"
                      % ", ".join(victims))
+    lines.extend(format_autopilot(report.get("autopilot")))
     return "\n".join(lines)
 
 

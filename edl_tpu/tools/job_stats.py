@@ -24,6 +24,7 @@ from edl_tpu.controller import cluster as cluster_mod
 from edl_tpu.controller import constants, status
 from edl_tpu.controller.resource_pods import load_resource_pods
 from edl_tpu.coordination.client import CoordClient
+from edl_tpu.obs import autopilot as obs_autopilot
 from edl_tpu.obs import events as obs_events
 from edl_tpu.obs import health as obs_health
 from edl_tpu.obs import ledger as obs_ledger
@@ -131,7 +132,37 @@ def collect_job_stats(coord, rpc_timeout=5.0):
     out["health"] = obs_health.load_report(coord)
     # the leader monitor's fleet time-attribution doc (same cadence)
     out["goodput"] = obs_ledger.load_goodput(coord)
+    # the autopilot's action/v1 journal (empty when the engine is off)
+    out["autopilot"] = obs_autopilot.load_actions(coord)
     return out
+
+
+def format_autopilot(actions, limit=10):
+    """Render the autopilot's ``action/v1`` journal as cause chains
+    (evidence ids → action → outcome), dry-run actions marked ``[dry]``
+    — shared by the job_stats fleet summary and the doctor report."""
+    if not actions:
+        return []
+    applied = sum(1 for a in actions if a.get("outcome") == "applied")
+    dry = sum(1 for a in actions if a.get("outcome") == "dry_run")
+    failed = sum(1 for a in actions if a.get("outcome") == "failed")
+    lines = ["autopilot journal (%d actions: %d applied, %d dry-run, "
+             "%d failed):" % (len(actions), applied, dry, failed)]
+    for a in actions[-limit:]:
+        cause = a.get("cause") or {}
+        evidence = cause.get("evidence_ids") or []
+        chain = ("evidence=%s -> " % evidence) if evidence else ""
+        tag = "[dry] " if a.get("mode") == "dry_run" else ""
+        line = ("  %s#%s %s%s %s -> %s" %
+                (tag, a.get("seq"), chain, a.get("kind"),
+                 a.get("target"), a.get("outcome")))
+        if a.get("error"):
+            line += " (%s)" % a["error"]
+        lines.append(line)
+        detail = cause.get("summary") or a.get("reason")
+        if detail:
+            lines.append("      cause: %s" % detail)
+    return lines
 
 
 def format_fleet(doc, width=72):
@@ -208,6 +239,7 @@ def format_fleet(doc, width=72):
                             "?" if cell.get("goodput_pct") is None
                             else cell.get("goodput_pct"),
                             cell.get("top_badput") or "none"))
+    lines.extend(format_autopilot(doc.get("autopilot")))
     timeline = doc.get("timeline") or []
     if timeline:
         lines.append("timeline (last %d of %d events):"

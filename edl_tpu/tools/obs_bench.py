@@ -30,6 +30,13 @@ at observation time. This bench quantifies both halves:
   time is multiplied from a known window on and the bench reports how
   many windows the straggler detector took to flag it (and that the
   clean warm-up windows produced zero findings).
+- ``autopilot`` — the policy engine's cost and action latency on the
+  same synthetic fleet: each window runs evaluate() PLUS the
+  autopilot's on_report() policy pass (``overhead_pct_of_interval`` is
+  the combined tick against the same <2% criterion), and reports how
+  many windows after the verdict the evict action landed
+  (``action_latency_windows``, the ≤2-publish-intervals criterion)
+  plus the clean-window action count (must be 0).
 
 Usage:
     JAX_PLATFORMS=cpu python -m edl_tpu.tools.obs_bench --micro
@@ -238,6 +245,102 @@ def bench_detectors(pods=8, windows=24, interval_s=10.0,
     }
 
 
+class _BenchStore(object):
+    """Minimal coord fake for the autopilot arc: the journal and the
+    postmortem bundles land in ``store``; no resize histories and no
+    blackboxes exist, so the resize and postmortem policies stay on
+    their fail-open paths."""
+
+    def __init__(self):
+        self.store = {}
+        self.root = "bench"
+
+    def set_server_permanent(self, service, server, value):
+        self.store[(service, server)] = value
+
+    def get_value(self, service, server):
+        return self.store.get((service, server))
+
+    def get_service(self, service):
+        return [(srv, v) for (svc, srv), v in sorted(self.store.items())
+                if svc == service]
+
+
+def bench_autopilot(pods=8, windows=24, interval_s=10.0,
+                    base_step_ms=100.0, slow_factor=6.0):
+    """Policy-engine arc: the detector fleet with an Autopilot riding
+    every tick (see module docstring)."""
+    from edl_tpu.obs import autopilot as obs_autopilot
+    from edl_tpu.obs import events as obs_events
+    from edl_tpu.obs import health as obs_health
+
+    base_ts = 1_000_000.0
+    vclock = [base_ts]
+    monitor = obs_health.HealthMonitor(
+        coord=None, pod_id="bench-monitor", interval=interval_s,
+        events=obs_events.EventLog(),
+        clock=lambda: vclock[0])
+    ap = obs_autopilot.Autopilot(
+        _BenchStore(), "bench-monitor", mode="on", interval=interval_s,
+        evict_fn=lambda pod: True, clock=lambda: vclock[0])
+    victim = "pod-%02d" % (pods - 1)
+    inject_at = windows // 2
+    state = {}
+    tick_s = []
+    detected_window = None
+    action_window = None
+    clean_actions = 0
+    actions_total = 0
+    for w in range(windows):
+        vclock[0] = base_ts + w * interval_s
+        step_ms_by_pod = {
+            "pod-%02d" % p: (base_step_ms * slow_factor
+                             if w >= inject_at
+                             and "pod-%02d" % p == victim
+                             else base_step_ms)
+            for p in range(pods)}
+        docs = _synth_fleet_docs(pods, w, step_ms_by_pod, state,
+                                 base_ts, interval_s)
+        t0 = time.perf_counter()
+        report = monitor.evaluate(docs, now=vclock[0])
+        acted = ap.on_report(report)
+        tick_s.append(time.perf_counter() - t0)
+        actions_total += len(acted)
+        if w < inject_at:
+            clean_actions += len(acted)
+        stragglers = {f["pod"] for f in report["findings"]
+                      if f["detector"] == "straggler"}
+        if detected_window is None and victim in stragglers:
+            detected_window = w
+        if action_window is None and any(a["kind"] == "evict"
+                                         and a["target"] == victim
+                                         for a in acted):
+            action_window = w
+    tick_sorted = sorted(tick_s)
+    tick_p50 = tick_sorted[len(tick_sorted) // 2]
+    return {
+        "pods": pods,
+        "windows": windows,
+        "interval_s": interval_s,
+        "tick_ms_p50": round(tick_p50 * 1e3, 4),
+        "tick_ms_max": round(tick_sorted[-1] * 1e3, 4),
+        "overhead_pct_of_interval": round(
+            100.0 * tick_p50 / interval_s, 4),
+        "straggler": {
+            "victim": victim,
+            "injected_window": inject_at,
+            "detected_window": detected_window,
+            "action_window": action_window,
+            "action_latency_windows": (action_window - detected_window
+                                       if action_window is not None
+                                       and detected_window is not None
+                                       else None),
+        },
+        "clean_actions": clean_actions,
+        "actions_total": actions_total,
+    }
+
+
 def _run_data_arc(cfg):
     """One pipelined-columnar data_bench arc over fresh on-disk data;
     returns the arc's stats dict (records_s is the headline)."""
@@ -281,6 +384,7 @@ def run(mode="micro", **cfg):
         "ledger": (bench_ledger(iters=1_000, work_us=100.0)
                    if mode == "micro" else bench_ledger()),
         "detectors": bench_detectors(),
+        "autopilot": bench_autopilot(),
     }
 
 

@@ -1,0 +1,894 @@
+"""Goodput autopilot: the observe→act loop must be journaled, rate
+-limited, provably flap-free, dry-runnable, and chaos-drillable — a
+seeded straggler is evicted (that pod exactly, within 2 publish
+intervals of detection) and backfilled from standby; a clean fleet
+produces zero actions; ``dry`` journals the identical stream while
+applying nothing; an injected apply failure is retried without ever
+double-applying."""
+
+import json
+import time
+import types
+
+import pytest
+
+from edl_tpu.controller import cluster as cluster_mod
+from edl_tpu.controller import constants, status
+from edl_tpu.controller.cluster_generator import Generator
+from edl_tpu.controller.resource_pods import ResourceRegister
+from edl_tpu.data.data_server import BatchCache, DataPlaneServer
+from edl_tpu.data.reader import ElasticReader
+from edl_tpu.distill.teacher_server import TeacherServer
+from edl_tpu.obs import autopilot as obs_autopilot
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import health as obs_health
+from edl_tpu.obs import ledger as obs_ledger
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs.publisher import MetricsPublisher
+from edl_tpu.robustness import faults
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.tools import job_doctor, job_stats
+from edl_tpu.utils import errors
+
+
+class _FleetCoord(object):
+    """The store surface the publisher, monitor and autopilot share."""
+
+    def __init__(self):
+        self.store = {}
+        self.root = "test_job"
+
+    def set_server_permanent(self, service, server, value):
+        self.store[(service, server)] = value
+
+    def get_service(self, service):
+        return [(server, v) for (s, server), v in sorted(self.store.items())
+                if s == service]
+
+    def get_value(self, service, server):
+        return self.store.get((service, server))
+
+
+def _report(victims=(), ts=None, findings=None, goodput=None,
+            pods_total=3):
+    return {
+        "schema": "health_report/v1",
+        "ts": 0.0 if ts is None else ts,
+        "monitor": "monitor-pod",
+        "fleet": {"verdict": "critical" if victims else "ok",
+                  "pods_total": pods_total,
+                  "pods_degraded": sorted(victims)},
+        "pods": {},
+        "findings": findings if findings is not None else [
+            {"detector": "straggler", "pod": v, "severity": "critical",
+             "summary": "%s is slow" % v, "event_ids": [41, 42]}
+            for v in victims],
+        "slos": [],
+        "preferred_victims": list(victims),
+        "goodput": goodput or {},
+        "events": [],
+    }
+
+
+def _engine(coord=None, clock=None, mode="on", **kw):
+    kw.setdefault("interval", 1.0)
+    return obs_autopilot.Autopilot(coord or _FleetCoord(), "monitor-pod",
+                                   mode=mode,
+                                   clock=clock or (lambda: 0.0), **kw)
+
+
+# -- constants / mode ------------------------------------------------------
+
+
+def test_service_autopilot_constant_matches_controller():
+    """Drift guard: obs is a leaf, the constant is inlined there."""
+    assert obs_autopilot.SERVICE_AUTOPILOT == constants.SERVICE_AUTOPILOT
+
+
+def test_mode_from_env(monkeypatch):
+    assert obs_autopilot.mode_from_env("on") == obs_autopilot.MODE_ON
+    assert obs_autopilot.mode_from_env("ON ") == obs_autopilot.MODE_ON
+    assert obs_autopilot.mode_from_env("1") == obs_autopilot.MODE_ON
+    assert obs_autopilot.mode_from_env("dry") == obs_autopilot.MODE_DRY
+    assert obs_autopilot.mode_from_env("dry_run") == obs_autopilot.MODE_DRY
+    assert obs_autopilot.mode_from_env("off") == obs_autopilot.MODE_OFF
+    assert obs_autopilot.mode_from_env("bogus") == obs_autopilot.MODE_OFF
+    monkeypatch.delenv(obs_autopilot.ENV_VAR, raising=False)
+    assert obs_autopilot.mode_from_env() == obs_autopilot.MODE_OFF
+    monkeypatch.setenv(obs_autopilot.ENV_VAR, "dry")
+    assert obs_autopilot.mode_from_env() == obs_autopilot.MODE_DRY
+
+
+def test_off_mode_is_inert():
+    coord = _FleetCoord()
+    ap = _engine(coord, mode="off")
+    for _ in range(5):
+        assert ap.on_report(_report(victims=["pod-x", "pod-x"])) == []
+    assert ap.actions() == []
+    assert obs_autopilot.load_actions(coord) == []
+    assert ap.scale_out_allowed() is True
+
+
+# -- evict policy: hysteresis, rate limits, flap-proofing ------------------
+
+
+def test_evict_needs_consecutive_streak_then_applies():
+    coord = _FleetCoord()
+    t = [100.0]
+    evicted = []
+    ap = _engine(coord, clock=lambda: t[0],
+                 evict_fn=lambda pod: evicted.append(pod) or True)
+    assert ap.on_report(_report(victims=["pod-c"])) == []  # streak 1
+    out = ap.on_report(_report(victims=["pod-c"]))         # streak 2
+    assert [a["kind"] for a in out] == ["evict"]
+    a = out[0]
+    assert a["schema"] == "action/v1"
+    assert a["target"] == "pod-c"
+    assert a["outcome"] == "applied" and a["mode"] == "applied"
+    assert a["attempts"] == 1 and a["error"] is None
+    assert evicted == ["pod-c"]
+    # cause chain: back to the health evidence ids of the finding
+    assert a["cause"]["detector"] == "straggler"
+    assert a["cause"]["evidence_ids"] == [41, 42]
+    assert a["cause"]["streak"] == 2
+    # the journal round-trips through the store
+    stored = obs_autopilot.load_actions(coord)
+    assert [s["id"] for s in stored] == [a["id"]]
+
+
+def test_evict_streak_resets_when_victim_changes_or_clears():
+    ap = _engine(evict_fn=lambda pod: True)
+    assert ap.on_report(_report(victims=["pod-a"])) == []
+    assert ap.on_report(_report(victims=["pod-b"])) == []  # reset
+    assert ap.on_report(_report()) == []                   # reset
+    assert ap.on_report(_report(victims=["pod-b"])) == []  # streak 1
+    assert len(ap.on_report(_report(victims=["pod-b"]))) == 1
+
+
+def test_evict_never_targets_the_engine_host():
+    evicted = []
+    ap = _engine(evict_fn=lambda pod: evicted.append(pod))
+    for _ in range(5):
+        assert ap.on_report(_report(victims=["monitor-pod"])) == []
+    assert evicted == []
+
+
+def test_evict_reevict_block_and_cooldown_prevent_flapping():
+    """The evict→backfill→re-flag oscillation: after one eviction the
+    SAME pod cannot be re-evicted for reevict_block_s even though the
+    monitor keeps naming it (the backfilled standby warms up, the EWMA
+    re-anchors), and no second evict of ANY pod lands inside the
+    per-kind cooldown."""
+    t = [0.0]
+    evicted = []
+    ap = _engine(clock=lambda: t[0], interval=1.0,
+                 evict_fn=lambda pod: evicted.append(pod) or True)
+    # interval 1.0 -> reevict block 30s, evict cooldown 6s
+    ap.on_report(_report(victims=["pod-c"]))
+    assert len(ap.on_report(_report(victims=["pod-c"]))) == 1
+    for _ in range(20):  # the flap window: report keeps flagging pod-c
+        t[0] += 1.0
+        assert ap.on_report(_report(victims=["pod-c"])) == []
+    assert evicted == ["pod-c"]
+    # a DIFFERENT straggler is still actionable once the cooldown ends
+    t[0] += 10.0
+    ap.on_report(_report(victims=["pod-d"]))
+    out = ap.on_report(_report(victims=["pod-d"]))
+    assert [a["target"] for a in out] == ["pod-d"]
+    # and pod-c itself becomes eligible again only after the block
+    t[0] += 40.0
+    ap.on_report(_report(victims=["pod-c"]))
+    out = ap.on_report(_report(victims=["pod-c"]))
+    assert [a["target"] for a in out] == ["pod-c"]
+    assert evicted == ["pod-c", "pod-d", "pod-c"]
+
+
+def test_evict_burst_ring_bounds_actions_per_window():
+    t = [0.0]
+    ap = _engine(clock=lambda: t[0], evict_streak=1,
+                 reevict_block_s=0.0, cooldowns={"evict": 0.0},
+                 burst=2, burst_window_s=100.0,
+                 evict_fn=lambda pod: True)
+    assert len(ap.on_report(_report(victims=["p1"]))) == 1
+    t[0] += 1.0
+    assert len(ap.on_report(_report(victims=["p2"]))) == 1
+    t[0] += 1.0  # third distinct victim inside the window: suppressed
+    assert ap.on_report(_report(victims=["p3"])) == []
+    t[0] += 200.0  # the window drains
+    assert len(ap.on_report(_report(victims=["p4"]))) == 1
+
+
+# -- dry-run parity --------------------------------------------------------
+
+
+def test_dry_run_journals_identically_and_applies_nothing():
+    t = [0.0]
+    seq = ([_report(victims=["pod-c"])] * 3
+           + [_report()] * 2
+           + [_report(victims=["pod-c"])] * 3)
+
+    def run(mode):
+        coord = _FleetCoord()
+        applied = []
+        ap = _engine(coord, clock=lambda: t[0], mode=mode,
+                     evict_fn=lambda pod: applied.append(pod) or True)
+        for r in seq:
+            ap.on_report(r)
+        return coord, ap.actions(), applied
+
+    _, on_actions, on_applied = run("on")
+    coord, dry_actions, dry_applied = run("dry")
+    # identical action stream: same kinds, targets, sequence numbers
+    key = lambda acts: [(a["kind"], a["target"], a["seq"])  # noqa: E731
+                        for a in acts]
+    assert key(dry_actions) == key(on_actions)
+    assert on_applied == ["pod-c"]
+    assert dry_applied == []                       # NOTHING applied
+    for a in dry_actions:
+        assert a["mode"] == "dry_run"
+        assert a["outcome"] == "dry_run"
+        assert a["attempts"] == 0 and a["result"] is None
+    # the dry journal still lands in the store for the tooling
+    stored = obs_autopilot.load_actions(coord)
+    assert key(stored) == key(on_actions)
+
+
+def test_dry_run_never_vetoes_scale_out():
+    coord = _FleetCoord()
+    coord.set_server_permanent("metrics", "pod-x",
+                               json.dumps([{"recovery_s": 50.0}]))
+    ap = _engine(coord, mode="dry", payback_horizon_s=1.0)
+    ap.on_report(_report(goodput={"goodput_pct": 50.0}, pods_total=4))
+    ap.on_report(_report(goodput={"goodput_pct": 50.0}, pods_total=4))
+    assert ap.scale_out_allowed() is True  # dry applies nothing
+
+
+# -- the apply step under chaos --------------------------------------------
+
+
+def test_apply_fault_retried_never_double_applied():
+    """autopilot.apply fires INSIDE the retried closure BEFORE the
+    actuator: an error_once kills attempt 1 with the actuator untouched,
+    the retry succeeds, and the actuator has run exactly once."""
+    calls = []
+    ap = _engine(evict_fn=lambda pod: calls.append(pod) or True)
+    plane = faults.FaultPlane(seed=7)
+    plane.inject("autopilot.apply", "error_once", action="evict")
+    plane.install()
+    try:
+        ap.on_report(_report(victims=["pod-c"]))
+        out = ap.on_report(_report(victims=["pod-c"]))
+    finally:
+        plane.uninstall()
+    a = out[0]
+    assert a["outcome"] == "applied"
+    assert a["attempts"] == 2          # failed once, retried once
+    assert calls == ["pod-c"]          # applied exactly ONCE
+    assert ("autopilot.apply", "error_once") in plane.log
+
+
+def test_apply_persistent_fault_journals_failed_without_hot_loop():
+    calls = []
+    t = [0.0]
+    ap = _engine(clock=lambda: t[0],
+                 evict_fn=lambda pod: calls.append(pod) or True)
+    plane = faults.FaultPlane(seed=7)
+    plane.inject("autopilot.apply", "error", action="evict")
+    plane.install()
+    try:
+        ap.on_report(_report(victims=["pod-c"]))
+        out = ap.on_report(_report(victims=["pod-c"]))
+        a = out[0]
+        assert a["outcome"] == "failed"
+        assert a["attempts"] == 3      # RetryPolicy max_attempts
+        assert "ConnectError" in a["error"]
+        assert calls == []             # the actuator NEVER ran
+        # the reevict block applies on failure too: the next ticks must
+        # not hammer the same failing apply
+        for _ in range(5):
+            t[0] += 1.0
+            assert ap.on_report(_report(victims=["pod-c"])) == []
+    finally:
+        plane.uninstall()
+
+
+def test_apply_without_actuator_is_a_journaled_failure():
+    ap = _engine()  # no evict_fn bound
+    ap.on_report(_report(victims=["pod-c"]))
+    a = ap.on_report(_report(victims=["pod-c"]))[0]
+    assert a["outcome"] == "failed"
+    assert "no actuator" in a["error"]
+
+
+# -- resize trigger/veto gate ----------------------------------------------
+
+
+def test_resize_payback_model():
+    # 10s pause idling 4 pods = 40 compute-seconds; one new pod at 80%
+    # goodput repays 0.8 compute-seconds per second -> 50s payback
+    assert obs_ledger.resize_payback_s(10.0, 4, 5, 0.8) \
+        == pytest.approx(50.0)
+    assert obs_ledger.resize_payback_s(10.0, 4, 4, 0.8) == float("inf")
+    assert obs_ledger.resize_payback_s(10.0, 5, 4, 0.8) == float("inf")
+    assert obs_ledger.resize_payback_s(10.0, 4, 5, 0.0) == float("inf")
+    assert obs_ledger.resize_payback_s(-1.0, 4, 5, 0.8) == float("inf")
+    assert obs_ledger.resize_payback_s(0.0, 4, 5, 0.8) == 0.0
+
+
+def test_resize_gate_journals_decision_changes_only():
+    coord = _FleetCoord()
+    # launcher-journaled resize history: median recovery 20s
+    coord.set_server_permanent("metrics", "pod-a",
+                               json.dumps([{"recovery_s": 20.0}]))
+    t = [0.0]
+    ap = _engine(coord, clock=lambda: t[0], payback_horizon_s=600.0)
+    # payback = 20*4/gp_frac: 100s at 80% (allow), 800s at 10% (veto)
+    good = _report(goodput={"goodput_pct": 80.0}, pods_total=4)
+    bad = _report(goodput={"goodput_pct": 10.0}, pods_total=4)
+    # the initial position is set silently — a clean fleet journals 0
+    assert ap.on_report(good) == []
+    assert ap.scale_out_allowed() is True
+    t[0] += 100.0
+    out = ap.on_report(bad)            # allow -> veto: journaled
+    assert [a["kind"] for a in out] == ["resize"]
+    assert out[0]["decision"] == "veto"
+    assert out[0]["cause"]["payback_s"] == pytest.approx(800.0)
+    assert ap.scale_out_allowed() is False
+    t[0] += 100.0
+    assert ap.on_report(bad) == []     # steady state: no duplicate
+    assert ap.scale_out_allowed() is False
+    t[0] += 100.0
+    out = ap.on_report(good)           # veto -> allow: journaled
+    assert out[0]["decision"] == "allow"
+    assert ap.scale_out_allowed() is True
+
+
+def test_resize_gate_fails_open_without_history_or_goodput():
+    ap = _engine()  # empty store: no pause projection
+    assert ap.on_report(_report(goodput={"goodput_pct": 5.0},
+                                pods_total=4)) == []
+    assert ap.scale_out_allowed() is True
+    coord = _FleetCoord()
+    coord.set_server_permanent("metrics", "pod-a",
+                               json.dumps([{"recovery_s": 20.0}]))
+    ap2 = _engine(coord)
+    assert ap2.on_report(_report(pods_total=4)) == []  # no goodput pct
+    assert ap2.scale_out_allowed() is True
+
+
+def test_resize_gate_rate_limited_change_keeps_previous_position():
+    coord = _FleetCoord()
+    coord.set_server_permanent("metrics", "pod-a",
+                               json.dumps([{"recovery_s": 20.0}]))
+    ap = _engine(coord, cooldowns={"resize": 1e9}, burst=1,
+                 burst_window_s=1e9)
+    good = _report(goodput={"goodput_pct": 80.0}, pods_total=4)
+    bad = _report(goodput={"goodput_pct": 10.0}, pods_total=4)
+    ap.on_report(good)                     # initial: allow (silent)
+    ap.on_report(bad)                      # veto journaled (first)
+    assert ap.scale_out_allowed() is False
+    ap.on_report(good)                     # rate-limited: CANNOT journal
+    # a decision the journal cannot record must not act either
+    assert ap.scale_out_allowed() is False
+
+
+# -- knob tuning -----------------------------------------------------------
+
+
+def _data_wait_report(share_pct):
+    return _report(goodput={"goodput_pct": 40.0, "badput": [
+        {"state": "data_wait", "seconds": 60.0, "share_pct": share_pct}]})
+
+
+def test_knobs_double_fetch_ahead_until_ceiling():
+    t = [0.0]
+    applied = []
+    ap = _engine(clock=lambda: t[0], fetch_ahead_base=2,
+                 fetch_ahead_max=8,
+                 knobs_fn=lambda knobs: applied.append(dict(knobs))
+                 or {"pod": knobs})
+    out = ap.on_report(_data_wait_report(55.0))
+    assert [a["kind"] for a in out] == ["tune_knobs"]
+    assert out[0]["knobs"] == {"fetch_ahead": 4}
+    t[0] += 100.0
+    out = ap.on_report(_data_wait_report(55.0))
+    assert out[0]["knobs"] == {"fetch_ahead": 8}
+    t[0] += 100.0  # at the ceiling: nothing left to tune
+    assert ap.on_report(_data_wait_report(55.0)) == []
+    assert applied == [{"fetch_ahead": 4}, {"fetch_ahead": 8}]
+
+
+def test_knobs_respect_threshold_cooldown_and_dominance():
+    t = [0.0]
+    ap = _engine(clock=lambda: t[0], knobs_fn=lambda knobs: {})
+    assert ap.on_report(_data_wait_report(10.0)) == []  # under threshold
+    other = _report(goodput={"badput": [
+        {"state": "ckpt_block", "seconds": 90.0, "share_pct": 90.0},
+        {"state": "data_wait", "seconds": 50.0, "share_pct": 50.0}]})
+    assert ap.on_report(other) == []  # data_wait must RANK FIRST
+    assert len(ap.on_report(_data_wait_report(55.0))) == 1
+    t[0] += 1.0  # inside the tune_knobs cooldown (12 * interval)
+    assert ap.on_report(_data_wait_report(55.0)) == []
+
+
+def test_knobs_dry_run_advances_the_same_target_ladder():
+    t = [0.0]
+    ap = _engine(clock=lambda: t[0], mode="dry", fetch_ahead_base=2,
+                 fetch_ahead_max=8)
+    out = ap.on_report(_data_wait_report(55.0))
+    assert out[0]["knobs"] == {"fetch_ahead": 4}
+    t[0] += 100.0
+    out = ap.on_report(_data_wait_report(55.0))
+    assert out[0]["knobs"] == {"fetch_ahead": 8}  # same ladder as on
+
+
+# -- postmortem filing -----------------------------------------------------
+
+
+def _box(coord, pod, ts, reason="trainer crash"):
+    coord.set_server_permanent(
+        "health", "blackbox_%s" % pod,
+        json.dumps({"schema": "blackbox/v1", "ts": ts, "pod": pod,
+                    "pid": 1, "reason": reason,
+                    "exception": {"type": "RuntimeError",
+                                  "message": "boom"},
+                    "events": [], "spans": [], "metrics": {}}))
+
+
+def test_postmortem_filed_once_per_crash_loop():
+    coord = _FleetCoord()
+    t = [1000.0]
+    ap = _engine(coord, clock=lambda: t[0], crash_loop_boxes=2,
+                 crash_window_s=600.0)
+    _box(coord, "pod-a", 990.0)
+    assert ap.on_report(_report()) == []  # one box is not a loop
+    _box(coord, "pod-b", 995.0)
+    out = ap.on_report(_report(victims=["pod-a"]))
+    kinds = [a["kind"] for a in out]
+    assert "postmortem" in kinds
+    a = next(x for x in out if x["kind"] == "postmortem")
+    assert a["outcome"] == "applied"
+    assert sorted(a["bundle"]["boxes"]) == ["pod-a", "pod-b"]
+    assert a["cause"]["detector"] == "crash_loop"
+    assert a["cause"]["evidence_ids"] == [41, 42]  # finding evidence
+    bundles = obs_autopilot.load_postmortems(coord)
+    assert len(bundles) == 1
+    bundle = list(bundles.values())[0]
+    assert bundle["schema"] == "postmortem/v1"
+    assert bundle["findings"][0]["pod"] == "pod-a"
+    # the same crash loop is never re-filed, however many ticks pass
+    for _ in range(5):
+        t[0] += 100.0
+        assert all(x["kind"] != "postmortem"
+                   for x in ap.on_report(_report()))
+    # a NEW box changes the signature: a fresh loop files a fresh bundle
+    _box(coord, "pod-c", t[0] - 1.0)
+    out = ap.on_report(_report())
+    assert [x["kind"] for x in out] == ["postmortem"]
+    assert len(obs_autopilot.load_postmortems(coord)) == 2
+
+
+def test_postmortem_ignores_stale_boxes():
+    coord = _FleetCoord()
+    _box(coord, "pod-a", 100.0)
+    _box(coord, "pod-b", 120.0)
+    ap = _engine(coord, clock=lambda: 10000.0, crash_window_s=600.0)
+    assert ap.on_report(_report()) == []
+
+
+# -- failover hold ---------------------------------------------------------
+
+
+def test_hold_fn_freezes_all_actions_until_released():
+    held = [True]
+    evicted = []
+    ap = _engine(hold_fn=lambda: held[0],
+                 evict_fn=lambda pod: evicted.append(pod) or True)
+    for _ in range(4):
+        assert ap.on_report(_report(victims=["pod-c"])) == []
+    assert evicted == []
+    held[0] = False  # settle window closed: the streak rebuilds
+    ap.on_report(_report(victims=["pod-c"]))
+    assert len(ap.on_report(_report(victims=["pod-c"]))) == 1
+
+
+def test_hold_fn_failure_fails_open():
+    def boom():
+        raise RuntimeError("witness gone")
+
+    ap = _engine(hold_fn=boom, evict_fn=lambda pod: True)
+    ap.on_report(_report(victims=["pod-c"]))
+    assert len(ap.on_report(_report(victims=["pod-c"]))) == 1
+
+
+# -- preferred_victims TTL (the satellite fix) -----------------------------
+
+
+def _straggler_docs(steps, cum, ts):
+    bounds = [10.0, 100.0, 1000.0]
+    out = {}
+    for pod, step in steps.items():
+        st = cum.setdefault(pod, {"sum": 0.0, "count": 0})
+        st["sum"] += step * 10
+        st["count"] += 10
+        out[pod] = {
+            "schema": "obs_pub/v1", "key": "obs_" + pod, "ts": ts,
+            "metrics": {"schema": "obs_snapshot/v1", "ts": ts, "pid": 1,
+                        "series_dropped": 0,
+                        "metrics": {"edl_train_step_ms": {
+                            "kind": "histogram", "help": "",
+                            "labelnames": [], "bounds": bounds,
+                            "series": [{"labels": {},
+                                        "buckets": [0, 0, 0, 0],
+                                        "sum": st["sum"],
+                                        "count": st["count"]}]}}},
+            "events": []}
+    return out
+
+
+def test_preferred_victims_fail_open_past_report_ttl():
+    """Regression: a dead monitor's last verdict must stop biasing
+    eviction once it ages past ttl_s — the hook returns [] instead of a
+    stale victim list."""
+    t = [1000.0]
+    monitor = obs_health.HealthMonitor(
+        _FleetCoord(), "mon", interval=10, ttl_s=5.0, stale_after=1e9,
+        events=obs_events.EventLog(), clock=lambda: t[0])
+    cum = {}
+    steps = {"w1": 100.0, "w2": 100.0, "w3": 600.0}
+    report = None
+    for _ in range(4):
+        report = monitor.evaluate(_straggler_docs(steps, cum, t[0]))
+    assert report["preferred_victims"] == ["w3"]
+    assert report["ttl_s"] == 5.0      # reports are TTL-stamped
+    assert monitor.preferred_victims() == ["w3"]  # fresh: honored
+    t[0] += 100.0                      # the monitor stops ticking
+    assert monitor.preferred_victims() == []      # expired: fail open
+
+
+def test_load_report_fresh_only_expires_on_ttl():
+    coord = _FleetCoord()
+    doc = {"schema": "health_report/v1", "ts": 1000.0, "ttl_s": 5.0}
+    coord.set_server_permanent(obs_health.SERVICE_HEALTH,
+                               obs_health.HEALTH_KEY, json.dumps(doc))
+    assert obs_health.load_report(coord)["ts"] == 1000.0
+    assert obs_health.load_report(coord, fresh_only=True,
+                                  now=1003.0) is not None
+    assert obs_health.load_report(coord, fresh_only=True,
+                                  now=1010.0) is None
+    # a pre-TTL doc (no ttl_s) is never expired (render-history path)
+    del doc["ttl_s"]
+    coord.set_server_permanent(obs_health.SERVICE_HEALTH,
+                               obs_health.HEALTH_KEY, json.dumps(doc))
+    assert obs_health.load_report(coord, fresh_only=True,
+                                  now=1e9) is not None
+
+
+# -- the generator's directed-eviction actuator ----------------------------
+
+
+def _pod():
+    import os
+    os.environ["EDL_TPU_POD_IP"] = "127.0.0.1"
+    from edl_tpu.controller.env import JobEnv
+    from edl_tpu.controller.pod import Pod
+    args = type("A", (), dict(
+        job_id="test_job", store_endpoints="x", nodes_range="1:4",
+        nproc_per_node=1, pod_ip="127.0.0.1", checkpoint_path=None,
+        log_dir=None, log_level=None))()
+    return Pod.from_env(JobEnv(args))
+
+
+class _NullCoord(object):
+    def get_key(self, key):
+        return None
+
+    def get_service(self, service):
+        return []
+
+
+def _cluster_of(pods):
+    c = cluster_mod.Cluster()
+    c.pods = list(pods)
+    return c
+
+
+def test_direct_evict_drops_pod_and_blocks_rejoin():
+    a, b, c = _pod(), _pod(), _pod()
+    gen = Generator(_NullCoord(), a.id, min_nodes=1, max_nodes=5)
+    assert gen.direct_evict(c.id, ttl_s=30.0) is True
+    resources = {p.id: p for p in (a, b, c)}  # c still REGISTERED
+    new = gen._next_cluster(_cluster_of([a, b, c]), resources, {})
+    assert new is not None
+    # dropped AND excluded from joinable: no evict->rejoin flap
+    assert set(p.id for p in new.pods) == {a.id, b.id}
+
+
+def test_direct_evict_refuses_the_leader():
+    a = _pod()
+    gen = Generator(_NullCoord(), a.id, min_nodes=1, max_nodes=5)
+    with pytest.raises(errors.EdlError):
+        gen.direct_evict(a.id)
+
+
+def test_direct_evict_directive_expires():
+    a, b, c = _pod(), _pod(), _pod()
+    gen = Generator(_NullCoord(), a.id, min_nodes=1, max_nodes=5)
+    gen.direct_evict(c.id, ttl_s=0.01)
+    time.sleep(0.05)
+    resources = {p.id: p for p in (a, b, c)}
+    # expired directive: membership unchanged -> no new cluster at all
+    assert gen._next_cluster(_cluster_of([a, b, c]), resources, {}) \
+        is None
+
+
+def test_scale_out_gate_vetoes_and_fails_open():
+    a, b = _pod(), _pod()
+    gate = [False]
+    gen = Generator(_NullCoord(), a.id, min_nodes=1, max_nodes=5,
+                    scale_out_gate=lambda: gate[0])
+    resources = {p.id: p for p in (a, b)}  # b is joinable
+    assert gen._next_cluster(_cluster_of([a]), resources, {}) is None
+    gate[0] = True
+    new = gen._next_cluster(_cluster_of([a]), resources, {})
+    assert set(p.id for p in new.pods) == {a.id, b.id}
+
+    def boom():
+        raise RuntimeError("autopilot gone")
+
+    gen2 = Generator(_NullCoord(), a.id, min_nodes=1, max_nodes=5,
+                     scale_out_gate=boom)
+    new2 = gen2._next_cluster(_cluster_of([a]), resources, {})
+    assert set(p.id for p in new2.pods) == {a.id, b.id}  # fail open
+
+
+def test_generator_loop_directed_evict_backfills_from_standby(coord):
+    """End to end against the store: the autopilot's actuator evicts a
+    running pod and the standby (a registered pod over max_nodes)
+    backfills through the ordinary scale-out — in the SAME pass, so the
+    cluster never dips below min."""
+    def _wait(pred, timeout=15.0, interval=0.1):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = pred()
+            if v:
+                return v
+            time.sleep(interval)
+        raise AssertionError("condition not met within %ss" % timeout)
+
+    a, b, c, d = (_pod() for _ in range(4))
+    regs = [ResourceRegister(coord, p) for p in (a, b, c)]
+    coord.set_server_permanent(constants.SERVICE_LEADER,
+                               constants.LEADER_SERVER, a.id)
+    gen = Generator(coord, a.id, min_nodes=3, max_nodes=3,
+                    below_min_grace=8.0).start()
+    try:
+        c1 = _wait(lambda: (lambda cl: cl if cl and len(cl.pods) == 3
+                            else None)(cluster_mod.load_from_store(coord)))
+        assert set(c1.pod_ids()) == {a.id, b.id, c.id}
+        regs.append(ResourceRegister(coord, d))  # the standby
+        time.sleep(1.5)  # at max: the standby stays out
+        assert set(cluster_mod.load_from_store(coord).pod_ids()) \
+            == {a.id, b.id, c.id}
+        gen.direct_evict(b.id)
+        c2 = _wait(lambda: (lambda cl: cl if cl
+                            and b.id not in cl.pod_ids() else None)(
+            cluster_mod.load_from_store(coord)))
+        assert set(c2.pod_ids()) == {a.id, c.id, d.id}  # backfilled
+        assert status.load_job_status(coord) != status.Status.FAILED
+    finally:
+        gen.stop()
+        for r in regs:
+            r.stop()
+
+
+# -- the knob RPC plane ----------------------------------------------------
+
+
+def test_set_knobs_rpc_end_to_end():
+    seen = []
+    server = DataPlaneServer(
+        BatchCache(capacity=4), pod_id="p",
+        knobs_fn=lambda knobs: seen.append(knobs) or {"fetch_ahead": 8}
+    ).start()
+    try:
+        client = RpcClient(server.endpoint)
+        assert client.call("set_knobs", {"fetch_ahead": 8}) \
+            == {"fetch_ahead": 8}
+        client.close()
+        assert seen == [{"fetch_ahead": 8}]
+    finally:
+        server.stop()
+
+
+def test_reader_apply_knobs_clamps_and_ignores_unknown():
+    ns = types.SimpleNamespace(_fetch_ahead=2)
+    assert ElasticReader.apply_knobs(ns, {"fetch_ahead": 999}) \
+        == {"fetch_ahead": 64}
+    assert ns._fetch_ahead == 64
+    assert ElasticReader.apply_knobs(ns, {"fetch_ahead": 0}) \
+        == {"fetch_ahead": 1}
+    assert ElasticReader.apply_knobs(ns, {"bogus": 3}) == {}
+    assert ElasticReader.apply_knobs(ns, "nonsense") == {}
+    assert ElasticReader.apply_knobs(ns, {"fetch_ahead": "x"}) == {}
+
+
+def test_teacher_apply_knobs_clamps_batch_timeout():
+    ns = types.SimpleNamespace(_batch_timeout=0.005)
+    assert TeacherServer.apply_knobs(ns, {"batch_timeout_ms": 5000}) \
+        == {"batch_timeout_ms": 1000.0}
+    assert ns._batch_timeout == pytest.approx(1.0)
+    assert TeacherServer.apply_knobs(ns, {"batch_timeout_ms": -5}) \
+        == {"batch_timeout_ms": 0.0}
+    assert TeacherServer.apply_knobs(ns, {"other": 1}) == {}
+
+
+# -- tooling renders the journal -------------------------------------------
+
+
+def test_format_autopilot_marks_dry_and_counts_outcomes():
+    actions = [
+        {"schema": "action/v1", "seq": 1, "kind": "evict",
+         "target": "pod-c", "mode": "dry_run", "outcome": "dry_run",
+         "cause": {"evidence_ids": [7], "summary": "slow"}},
+        {"schema": "action/v1", "seq": 2, "kind": "tune_knobs",
+         "target": "data_plane", "mode": "applied", "outcome": "failed",
+         "error": "ConnectError('x')", "reason": "data_wait dominates",
+         "cause": {}},
+    ]
+    lines = job_stats.format_autopilot(actions)
+    text = "\n".join(lines)
+    assert "2 actions: 0 applied, 1 dry-run, 1 failed" in text
+    assert "[dry] #1 evidence=[7] -> evict pod-c -> dry_run" in text
+    assert "cause: slow" in text
+    assert "ConnectError" in text
+    assert job_stats.format_autopilot([]) == []
+    assert job_stats.format_autopilot(None) == []
+
+
+# -- the acceptance drill --------------------------------------------------
+
+
+def _pub(coord, pod, registry, log):
+    return MetricsPublisher(coord, pod, interval=999, registry=registry,
+                            events=log)
+
+
+def _autopilot_drill(mode, faulted=True, windows=4, fetches=4,
+                     delay_s=0.04):
+    """The PR-8 chaos drill with the loop CLOSED: the autopilot rides
+    the monitor's on_report hook. Returns
+    (coord, autopilot, evicted, flagged_at, acted_at)."""
+    coord = _FleetCoord()
+    pods = ["pod-a", "pod-b", "pod-c"]
+    victim = "pod-c"
+    obs_events.EVENTS.clear()
+    servers, pubs, hists, clients = {}, {}, {}, {}
+    plane = None
+    evicted = []
+    ap = obs_autopilot.Autopilot(coord, "monitor-pod", mode=mode,
+                                 interval=999,
+                                 evict_fn=lambda pod:
+                                 evicted.append(pod) or True)
+    try:
+        for p in pods:
+            servers[p] = DataPlaneServer(BatchCache(capacity=8),
+                                         pod_id=p).start()
+            reg = obs_metrics.MetricsRegistry()
+            log = (obs_events.EVENTS if p == victim
+                   else obs_events.EventLog())
+            pubs[p] = _pub(coord, p, reg, log)
+            hists[p] = reg.histogram("edl_reader_fetch_ms",
+                                     "batch fetch wire ms")
+            clients[p] = RpcClient(servers[p].endpoint)
+
+        monitor = obs_health.HealthMonitor(coord, "monitor-pod",
+                                           interval=999, stale_after=1e9,
+                                           events=obs_events.EventLog(),
+                                           on_report=ap.on_report)
+
+        def window(w):
+            for p in pods:
+                for i in range(fetches):
+                    with hists[p].time_ms():
+                        clients[p].call("get_batches",
+                                        ["w%d-%d" % (w, i)])
+                pubs[p].publish_once()
+            return monitor.check_once()
+
+        window(0)  # anchor: establishes cumulative baselines
+        if faulted:
+            plane = faults.FaultPlane(seed=7)
+            plane.inject("data.fetch.delay", "delay", seconds=delay_s,
+                         pod=victim)
+            plane.install()
+        flagged_at = acted_at = None
+        for w in range(1, windows + 1):
+            report = window(w)
+            stragglers = {f["pod"] for f in report["findings"]
+                          if f["detector"] == "straggler"}
+            if stragglers and flagged_at is None:
+                flagged_at = w
+                assert stragglers == {victim}
+            if ap.actions() and acted_at is None:
+                acted_at = w
+        return coord, ap, evicted, flagged_at, acted_at
+    finally:
+        if plane is not None:
+            plane.uninstall()
+        for cl in clients.values():
+            cl.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_autopilot_drill_evicts_exactly_the_faulted_pod():
+    """The acceptance drill, mode=on: the seeded straggler is evicted —
+    that pod exactly, within 2 publish intervals of detection — with a
+    full cause chain back to the health evidence, and the doctor/stats
+    tooling renders the journal."""
+    coord, ap, evicted, flagged_at, acted_at = _autopilot_drill("on")
+    assert flagged_at is not None and flagged_at <= 2
+    assert acted_at is not None and acted_at - flagged_at <= 1
+    assert evicted == ["pod-c"]                    # exactly one, exactly it
+    actions = ap.actions()
+    assert [a["kind"] for a in actions] == ["evict"]
+    a = actions[0]
+    assert a["target"] == "pod-c" and a["outcome"] == "applied"
+    # cause chain: detector verdict + causal evidence ids from the
+    # health report (the fault firings ride the victim's event ring)
+    assert a["cause"]["detector"] == "straggler"
+    assert a["cause"]["evidence_ids"]
+    assert a["cause"]["streak"] >= 2
+    # the store journal is the same stream the tooling loads
+    stored = obs_autopilot.load_actions(coord)
+    assert [s["id"] for s in stored] == [a["id"]]
+    doc = job_doctor.diagnose(job_doctor.collect(coord))
+    assert [x["kind"] for x in doc["autopilot"]] == ["evict"]
+    rendered = job_doctor.render(doc)
+    assert "autopilot journal" in rendered
+    assert "evict pod-c -> applied" in rendered
+    stats = job_stats.collect_job_stats(_StatsCoord(coord))
+    pretty = job_stats.format_fleet(stats)
+    assert "autopilot journal" in pretty
+    json.dumps(doc)  # the machine surface round-trips
+
+
+class _StatsCoord(object):
+    """_FleetCoord plus the extra surface collect_job_stats touches."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.root = inner.root
+
+    def get_service(self, service):
+        return self._inner.get_service(service)
+
+    def get_value(self, service, server):
+        return self._inner.get_value(service, server)
+
+    def get_key(self, key):
+        return None
+
+
+def test_autopilot_drill_dry_run_journals_but_applies_nothing():
+    coord, ap, evicted, flagged_at, acted_at = _autopilot_drill("dry")
+    assert flagged_at is not None and acted_at is not None
+    assert evicted == []                           # NOTHING applied
+    actions = ap.actions()
+    assert [(a["kind"], a["target"]) for a in actions] \
+        == [("evict", "pod-c")]                    # identical stream
+    assert actions[0]["outcome"] == "dry_run"
+    assert actions[0]["mode"] == "dry_run"
+    # the dry journal is stored and rendered with the [dry] marker
+    rendered = job_doctor.render(job_doctor.diagnose(
+        job_doctor.collect(coord)))
+    assert "[dry]" in rendered
+
+
+def test_autopilot_drill_clean_fleet_produces_zero_actions():
+    coord, ap, evicted, flagged_at, acted_at = _autopilot_drill(
+        "on", faulted=False)
+    assert flagged_at is None and acted_at is None
+    assert evicted == [] and ap.actions() == []
+    assert obs_autopilot.load_actions(coord) == []

@@ -490,3 +490,90 @@ def test_blackbox_doc_schema(tmp_path):
     assert len(box["spans"]) <= obs_flight.MAX_SPANS
     assert len(box["threads"]) <= obs_flight.MAX_THREAD_DUMP
     json.dumps(box)
+
+
+def test_obs_bench_autopilot_arc_schema():
+    """obs_bench "autopilot" section contract: the policy-engine arc
+    rides every monitor tick over the synthetic fleet, the seeded
+    straggler draws an evict within 2 windows of detection, the clean
+    half of the run produces ZERO actions, and the combined
+    evaluate+on_report tick cost is carried for the <2%-of-interval
+    criterion (measured offline — no timing gate here)."""
+    import json
+
+    from edl_tpu.tools import obs_bench
+
+    out = obs_bench.bench_autopilot(pods=6, windows=12)
+    assert out["pods"] == 6 and out["windows"] == 12
+    assert out["interval_s"] > 0
+    assert out["tick_ms_p50"] > 0
+    assert out["tick_ms_max"] >= out["tick_ms_p50"]
+    assert out["overhead_pct_of_interval"] >= 0
+    strag = out["straggler"]
+    for field in ("victim", "injected_window", "detected_window",
+                  "action_window", "action_latency_windows"):
+        assert field in strag
+    assert strag["detected_window"] is not None
+    assert strag["action_window"] is not None
+    # the acceptance bound: the evict lands within 2 windows of the
+    # detection verdict (virtual clock — not host-noisy)
+    assert strag["action_latency_windows"] <= 2
+    assert out["clean_actions"] == 0   # quiet fleet -> quiet engine
+    assert out["actions_total"] >= 1   # the straggler WAS acted on
+    json.dumps(out)
+
+
+def test_action_record_schema():
+    """action/v1 contract: every field job_stats/job_doctor render and
+    load_actions filters on, produced by a real Autopilot apply pass
+    and round-tripped through the store journal."""
+    import json
+
+    from edl_tpu.obs import autopilot as obs_autopilot
+
+    class _Store(object):
+        def __init__(self):
+            self.store = {}
+
+        def set_server_permanent(self, service, server, value):
+            self.store[(service, server)] = value
+
+        def get_value(self, service, server):
+            return self.store.get((service, server))
+
+        def get_service(self, service):
+            return [(srv, v) for (svc, srv), v in self.store.items()
+                    if svc == service]
+
+    coord = _Store()
+    ap = obs_autopilot.Autopilot(coord, "guard-monitor", mode="on",
+                                 evict_fn=lambda pod: True,
+                                 clock=lambda: 1_000_000.0)
+    report = {"schema": "health_report/v1", "ts": 1_000_000.0,
+              "fleet": {"verdict": "critical", "pods_total": 3,
+                        "pods_degraded": ["pod-x"]},
+              "findings": [{"detector": "straggler", "pod": "pod-x",
+                            "severity": "critical", "summary": "slow",
+                            "event_ids": [7]}],
+              "preferred_victims": ["pod-x"], "goodput": {},
+              "events": []}
+    ap.on_report(report)
+    actions = ap.on_report(report)
+    assert len(actions) == 1
+    a = actions[0]
+    assert a["schema"] == "action/v1"
+    for field in ("id", "seq", "ts", "kind", "mode", "actor", "target",
+                  "reason", "cause", "outcome", "attempts", "error",
+                  "result"):
+        assert field in a
+    assert a["kind"] in obs_autopilot.ACTION_KINDS
+    assert a["mode"] in ("applied", "dry_run")
+    assert a["outcome"] in ("applied", "dry_run", "failed")
+    cause = a["cause"]
+    for field in ("report_ts", "detector", "summary", "evidence_ids"):
+        assert field in cause
+    assert cause["evidence_ids"] == [7]
+    # the stored journal round-trips and filters on the schema tag
+    assert [x["id"] for x in obs_autopilot.load_actions(coord)] \
+        == [a["id"]]
+    json.dumps(a)
