@@ -10,10 +10,16 @@ Reference parity: edl/distill/balance_table.py Service.rebalance (:139-338)
   its next heartbeat ships the new list.
 """
 
+import json
 import threading
 import time
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.utils.logger import logger
+
+_REASSIGNMENTS = obs_metrics.counter(
+    "edl_balance_reassignments_total",
+    "existing client->server assignments moved by the balancer")
 
 # heartbeats arrive every 2s (discovery_client.py); a client silent for
 # 5 intervals is gone — elastic resizes restart trainers with fresh pids,
@@ -41,10 +47,12 @@ class Service(object):
         self.name = name
         self._lock = threading.Lock()
         self._servers = {}   # endpoint -> set(client_id)
+        self._info = {}      # endpoint -> registration info dict
         self._clients = {}   # client_id -> _Client
         self._client_ttl = client_ttl
         self._clock = clock
         self._rebalances = 0
+        self._reassigned = 0
         self._evicted = 0
 
     def _evict_stale_locked(self):
@@ -65,10 +73,39 @@ class Service(object):
 
     # -- membership ------------------------------------------------------------
 
+    @staticmethod
+    def _parse_info(value):
+        """Registration values arrive as the registry's JSON string
+        (or already as a dict from in-process callers). Unparseable
+        info degrades to {} — an opaque teacher is weight 1.0."""
+        if isinstance(value, dict):
+            return value
+        if isinstance(value, bytes):
+            value = value.decode("utf-8", "replace")
+        if isinstance(value, str) and value:
+            try:
+                out = json.loads(value)
+                return out if isinstance(out, dict) else {}
+            except ValueError:
+                return {}
+        return {}
+
     def set_servers(self, endpoints):
+        """``endpoints`` is either an iterable of endpoint strings (all
+        weight 1.0) or a dict ``{endpoint: info}`` — the registry's
+        registration values, whose ``capacity`` (relative weight) and
+        ``draining`` fields make the balancer load-aware: a draining
+        teacher's connection cap drops to zero so its clients move off
+        before the TTL even lapses."""
+        if isinstance(endpoints, dict):
+            info = {ep: self._parse_info(v)
+                    for ep, v in endpoints.items()}
+        else:
+            info = {ep: {} for ep in endpoints}
         with self._lock:
             self._evict_stale_locked()
-            endpoints = set(endpoints)
+            self._info = info
+            endpoints = set(info)
             for ep in list(self._servers):
                 if ep not in endpoints:
                     for cid in self._servers.pop(ep):
@@ -76,6 +113,7 @@ class Service(object):
                         if c is not None:
                             c.servers.discard(ep)
                             c.version += 1
+                            self._count_move()
             for ep in endpoints:
                 self._servers.setdefault(ep, set())
             self._rebalance()
@@ -116,8 +154,35 @@ class Service(object):
 
     # -- the balancing core (callers hold the lock) -----------------------------
 
+    def _count_move(self):
+        self._reassigned += 1
+        _REASSIGNMENTS.inc()
+
+    def _weight(self, ep):
+        """Relative capacity weight from the registration info: a
+        draining teacher weighs 0 (its clients move off immediately —
+        the load-aware half of the drain protocol), a ``capacity``
+        field scales the connection cap, anything else is 1.0."""
+        info = self._info.get(ep) or {}
+        if info.get("draining"):
+            return 0.0
+        try:
+            w = float(info.get("capacity", 1.0))
+        except (TypeError, ValueError):
+            w = 1.0
+        return max(0.0, w)
+
+    def _server_cap(self, ep, per_server):
+        w = self._weight(ep)
+        if w <= 0.0:
+            return 0
+        if w == 1.0:
+            return per_server
+        return max(1, int(round(per_server * w)))
+
     def _caps(self):
-        n_servers = len(self._servers)
+        n_servers = sum(1 for ep in self._servers
+                        if self._weight(ep) > 0.0)
         n_clients = len(self._clients)
         if n_servers == 0 or n_clients == 0:
             return 0, 0
@@ -126,6 +191,13 @@ class Service(object):
         return per_server, per_client
 
     def _rebalance(self):
+        """Churn-minimal greedy rebalance: existing links are touched
+        ONLY when a cap forces it (server over its weighted cap, client
+        over its allowance, draining server emptying), so an unchanged
+        server set moves nothing and a single join/leave moves ~1/N of
+        the assignments (regression-tested). Every moved link of a
+        pre-existing client counts in ``edl_balance_reassignments_total``
+        — assignment churn is an operator-visible cost."""
         self._rebalances += 1
         per_server, per_client = self._caps()
         if per_server == 0:
@@ -137,14 +209,17 @@ class Service(object):
                 self._servers[ep].clear()
             return
 
-        # 1. unlink: servers over cap / clients over their allowance
+        # 1. unlink: servers over their weighted cap / clients over
+        #    their allowance — the only step that moves existing links
         for ep, linked in self._servers.items():
-            while len(linked) > per_server:
+            cap = self._server_cap(ep, per_server)
+            while len(linked) > cap:
                 cid = max(linked,
                           key=lambda i: len(self._clients[i].servers))
                 linked.discard(cid)
                 self._clients[cid].servers.discard(ep)
                 self._clients[cid].version += 1
+                self._count_move()
         for c in self._clients.values():
             allowance = min(per_client, c.require)
             while len(c.servers) > allowance:
@@ -152,24 +227,30 @@ class Service(object):
                 c.servers.discard(ep)
                 self._servers[ep].discard(c.id)
                 c.version += 1
+                self._count_move()
 
-        # 2. link: starved clients to least-loaded servers
+        # 2. link: starved clients to least-loaded servers with
+        #    weighted headroom
         for c in self._clients.values():
             allowance = min(per_client, c.require)
             while len(c.servers) < allowance:
-                candidates = [ep for ep, linked in self._servers.items()
-                              if ep not in c.servers
-                              and len(linked) < per_server]
+                candidates = [
+                    ep for ep, linked in self._servers.items()
+                    if ep not in c.servers
+                    and len(linked) < self._server_cap(ep, per_server)]
                 if not candidates:
                     break
                 ep = min(candidates, key=lambda e: len(self._servers[e]))
                 c.servers.add(ep)
                 self._servers[ep].add(c.id)
                 c.version += 1
-        # 3. every client gets at least one server if any exist
+        # 3. every client gets at least one server if any can take it
+        #    (draining/zero-weight servers are a last resort only)
         for c in self._clients.values():
             if not c.servers and self._servers:
-                ep = min(self._servers,
+                live = [ep for ep in self._servers
+                        if self._weight(ep) > 0.0]
+                ep = min(live or self._servers,
                          key=lambda e: len(self._servers[e]))
                 c.servers.add(ep)
                 self._servers[ep].add(c.id)
@@ -195,6 +276,7 @@ class Service(object):
                     "satisfaction": (round(sum(sats) / len(sats), 4)
                                      if sats else 1.0),
                     "rebalances": self._rebalances,
+                    "reassignments": self._reassigned,
                     "evicted": self._evicted,
                 },
             }

@@ -25,12 +25,25 @@ class ConsistentHash(object):
         if nodes:
             self.update(nodes)
 
-    def update(self, nodes):
-        """Replace the node set (copy-on-write: readers see old or new)."""
+    def update(self, nodes, weights=None):
+        """Replace the node set (copy-on-write: readers see old or new).
+
+        ``weights`` ({node: relative capacity}) scales each node's
+        virtual-node count, so a capacity-2.0 teacher owns ~2x the key
+        space and a draining one (weight 0) owns none — the hash-ring
+        half of load-aware balancing. Unlisted nodes weigh 1.0; a
+        positive weight always gets at least one vnode."""
         nodes = set(nodes)
+        weights = weights or {}
         ring = []
         for node in nodes:
-            for i in range(self.VIRTUAL_NODES):
+            try:
+                w = float(weights.get(node, 1.0))
+            except (TypeError, ValueError):
+                w = 1.0
+            vnodes = 0 if w <= 0.0 else max(1, int(round(
+                self.VIRTUAL_NODES * w)))
+            for i in range(vnodes):
                 ring.append((_hash("%s#%d" % (node, i)), node))
         ring.sort()
         with self._lock:

@@ -12,7 +12,8 @@ import uuid
 
 from edl_tpu.distill import discovery_server as ds
 from edl_tpu.robustness import faults
-from edl_tpu.robustness.policy import Deadline, RetryPolicy
+from edl_tpu.robustness.policy import CircuitBreaker, Deadline, \
+    RetryPolicy
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
@@ -37,13 +38,15 @@ class DiscoveryClient(object):
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
-        # backoff for re-register attempts after a failed heartbeat;
-        # capped at the heartbeat interval so a recovered discovery
-        # server is re-joined within one period
-        self._reconnect = RetryPolicy(base_delay=min(0.5,
-                                                     heartbeat_interval),
-                                      max_delay=heartbeat_interval,
-                                      jitter=0.5)
+        # discovery-outage degradation (stale-but-serving): a dead
+        # discovery server opens this breaker — exactly one
+        # ``breaker.open`` event per outage — and re-register probes
+        # run at the bounded half-open rate (one per heartbeat
+        # interval) instead of hammering; the last-known teacher table
+        # keeps serving untouched the whole time, and a returned
+        # server is re-joined within one probe period
+        self._breaker = CircuitBreaker(failure_threshold=1,
+                                       reset_timeout=heartbeat_interval)
         self._poll = RetryPolicy(base_delay=0.2, max_delay=1.0,
                                  multiplier=1.5, jitter=0.5)
 
@@ -69,6 +72,7 @@ class DiscoveryClient(object):
                 with self._lock:
                     self._version = resp["version"]
                     self._servers = list(resp.get("servers", []))
+                self._breaker.record_success(self._endpoint)
                 return
             raise errors.RpcError("register failed: %r" % resp)
         raise errors.RpcError("too many discovery redirects")
@@ -84,13 +88,24 @@ class DiscoveryClient(object):
         return self
 
     def _heartbeat_loop(self):
-        failures = 0
         while not self._stop.wait(self._interval):
+            if self._breaker.state(self._endpoint) \
+                    != CircuitBreaker.CLOSED:
+                # outage mode: the last-known table keeps serving; a
+                # re-register probe runs at the breaker's bounded
+                # half-open rate (one per interval) — a returned
+                # server closes the breaker inside _register()
+                if self._breaker.allow(self._endpoint):
+                    try:
+                        self._register()
+                    except errors.EdlError:
+                        self._breaker.record_failure(self._endpoint)
+                continue
             try:
                 resp = self._rpc.call("heartbeat", self.client_id,
                                       self._service, self._version)
                 code = resp.get("code")
-                failures = 0
+                self._breaker.record_success(self._endpoint)
                 if code == ds.CODE_REDIRECT:
                     self._connect(resp["endpoint"])
                     self._register()
@@ -104,12 +119,14 @@ class DiscoveryClient(object):
                         self._version = resp["version"]
                         self._servers = list(resp["servers"])
             except errors.EdlError as e:
+                # the table in self._servers is NOT cleared: clients
+                # keep routing on the last-known membership while the
+                # discovery server is away (stale-but-serving). The
+                # closed→open transition logs exactly ONE breaker.open
+                # event per outage (half-open re-probes mark
+                # ``reopened`` instead).
                 logger.warning("discovery heartbeat error: %r", e)
-                try:
-                    self._register()
-                except errors.EdlError:
-                    failures += 1
-                    self._reconnect.sleep(failures)
+                self._breaker.record_failure(self._endpoint)
 
     def get_servers(self):
         if faults.PLANE is not None:

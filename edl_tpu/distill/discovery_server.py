@@ -108,7 +108,10 @@ class DiscoveryServer(object):
             svc = self._table.service(service_name)
 
             def on_change(added, removed, all_servers, _svc=svc):
-                _svc.set_servers(all_servers.keys())
+                # the full {endpoint: info} map: registration info
+                # carries capacity weights and draining flags, which
+                # make the balancer load-aware (balance.Service)
+                _svc.set_servers(dict(all_servers))
 
             self._watchers[service_name] = self._coord.watch_service(
                 registry.teacher_service(service_name), on_change,

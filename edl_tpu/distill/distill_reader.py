@@ -270,6 +270,14 @@ class DistillReader(object):
             self._track(endpoint, task, add=True)
             try:
                 fut = conn.predict_async(feed)
+            except errors.OverloadedError as e:
+                # the teacher SHED this task (typed, with a retry-after
+                # hint): the task is fine, the endpoint is saturated —
+                # requeue for another teacher and back off this one
+                self._track(endpoint, task, add=False)
+                self._in_q.put(task)
+                self._back_off_teacher(endpoint, e)
+                return False
             except errors.DataAccessError as e:
                 # the task itself is poisoned (empty/malformed feed):
                 # requeueing would ping-pong it between teachers forever,
@@ -293,6 +301,19 @@ class DistillReader(object):
         the next worker must dial fresh."""
         self._breaker.record_failure(endpoint)
         self._pool.retire(endpoint)
+
+    def _back_off_teacher(self, endpoint, e):
+        """A typed shed (OverloadedError) opens the breaker — the
+        manage loop gates the endpoint for ``teacher_backoff`` before
+        a half-open probe — but the connection is HEALTHY (the teacher
+        answered, fast), so the pooled client stays: backing off must
+        not force a redial storm against an overloaded server."""
+        hint = e.retry_after_s
+        logger.warning("teacher %s shed work (%r); backing off%s",
+                       endpoint, e,
+                       "" if hint is None
+                       else " (server hints %.2fs)" % hint)
+        self._breaker.record_failure(endpoint)
 
     def _predict_loop(self, endpoint, stop_ev):
         try:
@@ -321,6 +342,14 @@ class DistillReader(object):
             try:
                 with tl.span("predict@%s" % endpoint):
                     preds = fut.result()
+            except errors.OverloadedError as e:
+                # typed shed from admission control: requeue elsewhere,
+                # open the breaker, keep the (healthy) pooled client
+                self._track(endpoint, task, add=False)
+                self._in_q.put(task)
+                self._back_off_teacher(endpoint, e)
+                ok = False
+                break
             except errors.DataAccessError as e:
                 self._track(endpoint, task, add=False)
                 self._post_result(epoch, task_id, _TASK_ERROR, e)

@@ -35,7 +35,9 @@ class TeacherRegister(object):
         self._info = json.dumps(info or {})
         self._ttl = ttl
         self._lease = None
+        self._lease_lock = threading.Lock()
         self._stop = threading.Event()
+        self._draining = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="teacher-register")
 
@@ -43,34 +45,67 @@ class TeacherRegister(object):
         self._thread.start()
         return self
 
+    def drain(self):
+        """Stop advertising NOW: revoke the lease and never
+        re-register — step 1 of the drain-safe decommission protocol
+        (serve/drain.py). Discovery stops handing this endpoint to new
+        clients immediately; clients already holding it age it out
+        within one TTL."""
+        self._draining.set()
+        with self._lease_lock:
+            lease, self._lease = self._lease, None
+        if lease is not None:
+            try:
+                self._coord.lease_revoke(lease)
+                logger.info("teacher %s draining; deregistered from %s",
+                            self._endpoint, self._service)
+            except errors.EdlError as e:
+                # the TTL is the backstop: an unreachable store just
+                # means the lease lapses on its own
+                logger.warning("drain revoke failed (TTL will lapse): "
+                               "%r", e)
+
+    @property
+    def draining(self):
+        return self._draining.is_set()
+
     def _run(self):
         while not self._stop.is_set():
-            alive = is_server_alive(self._endpoint, timeout=2)
+            alive = (not self._draining.is_set()
+                     and is_server_alive(self._endpoint, timeout=2))
             try:
-                if alive and self._lease is None:
-                    self._lease = self._coord.set_server_with_lease(
+                with self._lease_lock:
+                    lease = self._lease
+                if alive and lease is None:
+                    lease = self._coord.set_server_with_lease(
                         self._service, self._endpoint, self._info, self._ttl)
+                    with self._lease_lock:
+                        self._lease = lease
                     logger.info("teacher %s registered in %s",
                                 self._endpoint, self._service)
                 elif alive:
                     self._coord.refresh_server(self._service, self._endpoint,
-                                               self._lease)
-                elif self._lease is not None:
+                                               lease)
+                elif lease is not None and not self._draining.is_set():
                     logger.warning("teacher %s dead; deregistering",
                                    self._endpoint)
-                    self._coord.lease_revoke(self._lease)
-                    self._lease = None
+                    self._coord.lease_revoke(lease)
+                    with self._lease_lock:
+                        self._lease = None
             except errors.EdlError as e:
                 logger.warning("teacher register error: %r", e)
-                self._lease = None
+                with self._lease_lock:
+                    self._lease = None
             self._stop.wait(self._ttl / 3.0)
 
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=self._ttl)
-        if self._lease is not None:
+        with self._lease_lock:
+            lease, self._lease = self._lease, None
+        if lease is not None:
             try:
-                self._coord.lease_revoke(self._lease)
+                self._coord.lease_revoke(lease)
             except errors.EdlError:
                 pass
 

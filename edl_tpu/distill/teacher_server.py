@@ -34,9 +34,12 @@ import time
 import numpy as np
 
 from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.robustness import faults
+from edl_tpu.robustness.policy import Deadline
 from edl_tpu.rpc import ndarray as nd
 from edl_tpu.rpc.server import FEATURES as _RPC_FEATURES
 from edl_tpu.rpc.server import RpcServer
+from edl_tpu.serve.admission import AdmissionController
 from edl_tpu.utils import errors
 from edl_tpu.utils.logger import logger
 
@@ -75,12 +78,14 @@ class _ItemFuture(object):
 
 
 class _BatchItem(object):
-    __slots__ = ("feed", "n", "future")
+    __slots__ = ("feed", "n", "future", "admitted_at", "deadline_ms")
 
-    def __init__(self, feed, n):
+    def __init__(self, feed, n, admitted_at=None, deadline_ms=None):
         self.feed = feed
         self.n = n
         self.future = _ItemFuture()
+        self.admitted_at = admitted_at
+        self.deadline_ms = deadline_ms
 
 
 class TeacherServer(object):
@@ -105,8 +110,19 @@ class TeacherServer(object):
 
     def __init__(self, predict_fn, feed_specs, fetch_specs, max_batch=128,
                  host="0.0.0.0", port=0, adaptive_batch=True,
-                 batch_timeout_ms=0.0):
+                 batch_timeout_ms=0.0, admission=None):
         self._fn = predict_fn
+        # admission control (serve/admission.py): None/True builds the
+        # default controller (bounded queue only — no rate limit, no
+        # projection shed until configured, so plain fleets behave as
+        # before); False disables it; an AdmissionController instance
+        # is used as-is (the serve-plane configuration surface)
+        if admission is False:
+            self._admission = None
+        elif admission is None or admission is True:
+            self._admission = AdmissionController()
+        else:
+            self._admission = admission
         self._feed_specs = {k: (list(s), d) for k, (s, d)
                             in feed_specs.items()}
         self._fetch_specs = {k: (list(s), d) for k, (s, d)
@@ -127,11 +143,14 @@ class TeacherServer(object):
         self._rpc.register("predict", self._predict_rpc)
         self._rpc.register("stats", self.stats)
         self._rpc.register("set_knobs", self.apply_knobs)
+        self._rpc.register("drain", self.drain)
 
     def get_feed_fetch(self):
         features = list(_RPC_FEATURES)
         if self._adaptive:
             features.append("adaptive_batch")
+        if self._admission is not None:
+            features.append("serve.admission")
         return {"feed": self._feed_specs, "fetch": self._fetch_specs,
                 "max_batch": self._max_batch, "features": features,
                 "batch_timeout_ms": self._batch_timeout * 1000.0}
@@ -158,36 +177,92 @@ class TeacherServer(object):
         return applied
 
     def stats(self):
-        """Batch-occupancy counters: ``occupancy`` is the fraction of
-        compiled-batch rows that carried real requests (1.0 = every
-        device execution ran completely full)."""
+        """Batch-occupancy counters (``occupancy`` is the fraction of
+        compiled-batch rows that carried real requests) plus — with
+        admission control on — the serving-plane signals the
+        ``ServeScaler`` folds: queue depth, pending rows, projected
+        queue wait, shed counters, and the draining flag. Served as a
+        plain (non-pipelined) RPC the substrate dispatches inline on
+        the connection read thread, so this stays answerable while the
+        device queue is saturated — observability survives overload."""
         with self._stats_lock:
             batches, rows = self._batches, self._rows
         cap = batches * self._max_batch
-        return obs_metrics.mirror_stats("edl_teacher", {
+        out = {
             "batches": batches, "rows": rows,
             "max_batch": self._max_batch,
-            "occupancy": (rows / cap) if cap else 0.0})
+            "occupancy": (rows / cap) if cap else 0.0,
+            "queue_depth": self._queue.qsize(),
+        }
+        if self._admission is not None:
+            out.update(self._admission.stats())
+        return obs_metrics.mirror_stats("edl_teacher", out)
+
+    def drain(self, deadline_s=30.0):
+        """Drain-safe shutdown, step 3 of the decommission protocol
+        (serve/drain.py): flip admission to ``draining`` (new predicts
+        get a typed OverloadedError the reader requeues elsewhere),
+        then wait until the device queue and every admitted row have
+        resolved. Returns a report; ``drained: False`` means in-flight
+        work outlived ``deadline_s`` — the caller decides whether to
+        stop anyway (the device loop's shutdown drain still resolves
+        every queued future, so nothing is ever silently lost)."""
+        if faults.PLANE is not None:
+            faults.PLANE.fire("serve.drain", endpoint=self.endpoint,
+                              pending=self._queue.qsize())
+        if self._admission is not None:
+            self._admission.set_draining(True)
+        deadline = Deadline(deadline_s if deadline_s else 30.0)
+        served_before = self._rows
+        while not self._drained():
+            if not deadline.sleep(0.02):
+                break
+        with self._stats_lock:
+            served = self._rows - served_before
+        return {"drained": self._drained(),
+                "endpoint": self.endpoint,
+                "queue_depth": self._queue.qsize(),
+                "pending_rows": (0 if self._admission is None
+                                 else self._admission.stats()
+                                 ["pending_rows"]),
+                "served_during_drain": served}
+
+    def _drained(self):
+        if self._adaptive and self._queue.qsize() > 0:
+            return False
+        return self._admission is None or self._admission.idle()
 
     def _validate(self, feed):
+        """Reject malformed feeds with a typed FeedSpecError naming the
+        offending spec and shape. FeedSpecError subclasses
+        DataAccessError, so the reader surfaces it to the consumer in
+        order (poisoned task, never retried) — retrying a permanently
+        bad feed against other teachers would ping-pong it forever."""
         missing = set(self._feed_specs) - set(feed)
         if missing:
-            raise errors.DataAccessError("missing feeds: %s"
-                                         % sorted(missing))
-        n = None
+            name = sorted(missing)[0]
+            raise errors.FeedSpecError(
+                "missing feeds: %s" % sorted(missing), spec=name,
+                shape=tuple(self._feed_specs[name][0]))
+        n, first = None, None
         for name, arr in feed.items():
             if n is None:
-                n = len(arr)
+                n, first = len(arr), name
             elif len(arr) != n:
-                raise errors.DataAccessError("feed batch mismatch")
+                raise errors.FeedSpecError(
+                    "feed batch mismatch: %s has %d rows, %s has %d"
+                    % (first, n, name, len(arr)), spec=name,
+                    shape=tuple(np.asarray(arr).shape))
         if n == 0:
-            raise errors.DataAccessError("empty batch")
+            raise errors.FeedSpecError("empty batch", spec=first,
+                                       shape=(0,))
         if n > self._max_batch:
-            raise errors.DataAccessError(
-                "batch %d exceeds max_batch %d" % (n, self._max_batch))
+            raise errors.FeedSpecError(
+                "batch %d exceeds max_batch %d" % (n, self._max_batch),
+                spec=first, shape=tuple(np.asarray(feed[first]).shape))
         return n
 
-    def _predict_rpc(self, feed_encoded):
+    def _predict_rpc(self, feed_encoded, deadline_ms=None):
         # v2 tensor frames deliver feeds as owned arrays recv'd
         # straight off the socket (framing.py MAGIC_V2); decode_tree
         # is then a no-op but keeps pre-v2 senders (tagged-dict
@@ -196,9 +271,23 @@ class TeacherServer(object):
         feed = nd.decode_tree(feed_encoded, copy=False)
         feed = {k: np.asarray(v) for k, v in feed.items()}
         n = self._validate(feed)
+        # the admission decision (serve/admission.py): shed NOW with a
+        # typed OverloadedError instead of queueing work the SLO has
+        # already lost; ``deadline_ms`` is the caller's per-request
+        # budget — the device loop sheds dead-on-arrival items
+        admitted_at = None
+        if self._admission is not None:
+            admitted_at = self._admission.admit(n)
         if not self._adaptive:
-            return self._predict_serial(feed, n)
-        item = _BatchItem(feed, n)
+            t0 = time.monotonic()
+            try:
+                return self._predict_serial(feed, n)
+            finally:
+                if self._admission is not None:
+                    self._admission.release(
+                        n, service_s=time.monotonic() - t0)
+        item = _BatchItem(feed, n, admitted_at=admitted_at,
+                          deadline_ms=deadline_ms)
         self._queue.put(item)
         _TEACHER_QUEUE.set(self._queue.qsize())
         # generous rendezvous bound: the device thread always resolves
@@ -248,6 +337,17 @@ class TeacherServer(object):
                 for name, trail, dt in key}
         return bufs
 
+    def _dead_on_arrival(self, item):
+        """Shed a queued item whose per-request deadline elapsed while
+        it waited — running it would burn device time on a reply the
+        caller has already abandoned."""
+        if (self._admission is None or item.admitted_at is None
+                or not self._admission.expired(item.admitted_at,
+                                               item.deadline_ms)):
+            return False
+        item.future.set(error=self._admission.shed_expired(item.n))
+        return True
+
     def _device_loop(self):
         carry = None
         while not self._stop_ev.is_set():
@@ -258,6 +358,8 @@ class TeacherServer(object):
                     item = self._queue.get(timeout=0.2)
                 except queue.Empty:
                     continue
+            if self._dead_on_arrival(item):
+                continue
             key = self._group_key(item.feed)
             group, rows = [item], item.n
             deadline = time.monotonic() + self._batch_timeout
@@ -270,6 +372,8 @@ class TeacherServer(object):
                         timeout=max(0.0, deadline - time.monotonic()))
                 except queue.Empty:
                     break
+                if self._dead_on_arrival(nxt):
+                    continue
                 if (self._group_key(nxt.feed) != key
                         or rows + nxt.n > self._max_batch):
                     carry = nxt  # incompatible: heads the next batch
@@ -286,8 +390,12 @@ class TeacherServer(object):
                 break
         for item in pending:
             item.future.set(error=errors.StopError("teacher stopping"))
+            if self._admission is not None and item.admitted_at \
+                    is not None:
+                self._admission.release(item.n)
 
     def _run_group(self, key, group, rows):
+        t0 = time.monotonic()
         try:
             if len(group) == 1 and rows == self._max_batch:
                 feed = group[0].feed  # already full: run it in place
@@ -324,7 +432,14 @@ class TeacherServer(object):
         except Exception as e:  # noqa: BLE001 — fail every waiter, keep serving
             for item in group:
                 item.future.set(error=e)
+            if self._admission is not None:
+                self._admission.release(rows)
             return
+        if self._admission is not None:
+            # the device wall time of this batch feeds the queue-wait
+            # projection (the EWMA admission sheds against)
+            self._admission.release(rows,
+                                    service_s=time.monotonic() - t0)
         lo = 0
         for item in group:
             item.future.set(value={k: v[lo:lo + item.n]

@@ -66,6 +66,14 @@ autopilot.apply          before an autopilot action's actuator runs
                          failed→retried→never-double-applied contract
                          and ``error`` proves a persistent failure is
                          journaled ``outcome: failed``
+serve.admit              before the teacher admission controller decides
+                         (ctx: rows, pending) — an armed ``error`` turns
+                         every predict into a typed shed; ``delay``
+                         inflates queue wait so the SLO projection trips
+serve.drain              when a teacher starts draining (ctx: endpoint,
+                         pending) — arm ``delay`` to hold the drain
+                         window open or ``error`` to drill a teacher
+                         dying mid-decommission
 ======================== ===============================================
 
 Fault kinds:

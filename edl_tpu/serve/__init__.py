@@ -1,0 +1,27 @@
+"""SLO-guarded serving plane for the teacher fleet.
+
+The robustness layer between raw traffic and the distill data plane
+(docs/distill_dataplane.md §"The serving plane"):
+
+- :mod:`~edl_tpu.serve.admission` — bounded admission queue, token
+  -bucket rate limiting, and queue-wait-projection load shedding in
+  front of :class:`~edl_tpu.distill.teacher_server.TeacherServer`;
+  sheds are a typed :class:`~edl_tpu.utils.errors.OverloadedError`
+  with a retry-after hint, never a timeout pile-up.
+- :mod:`~edl_tpu.serve.scaler` — the leader-hosted SLO-driven
+  autoscaler (journaled ``action/v1`` records, off|dry|on modes,
+  cooldowns + hysteresis).
+- :mod:`~edl_tpu.serve.drain` — the drain-safe decommission protocol:
+  stop advertising → let the discovery TTL lapse → finish in-flight
+  work → exit, with zero stranded requests.
+
+Fault points ``serve.admit`` / ``serve.drain`` put both halves under
+seeded chaos (docs/fault_tolerance.md).
+"""
+
+from edl_tpu.serve.admission import AdmissionController
+from edl_tpu.serve.drain import decommission
+from edl_tpu.serve.scaler import ServeScaler, load_actions
+
+__all__ = ["AdmissionController", "ServeScaler", "decommission",
+           "load_actions"]

@@ -1,0 +1,527 @@
+"""Serving-plane benchmark: admission control, load shedding, and the
+SLO-driven autoscaler under an open-loop load generator.
+
+One in-process fleet of deliberately-slow teachers (each device batch
+sleeps ``service_ms``, so capacity is ``max_batch / service_ms`` rows/s
+per teacher) is driven through a forced cycle::
+
+    low load  ->  overload  ->  shed  ->  scale-out  ->  low load
+              ->  drain-safe scale-in
+
+under seeded FaultPlane chaos (``serve.admit`` delays on the admission
+path, a ``serve.drain`` delay holding the decommission window open).
+The generator is OPEN-LOOP: arrivals are clock-paced and never slow
+down because the fleet is struggling — exactly the regime where an
+unprotected server builds an unbounded queue and times everything out.
+
+What the record (schema ``serve_bench/v1``) proves:
+
+- at saturation every refused request is a typed ``OverloadedError``
+  (``shed.total`` > 0, ``untyped_errors`` == 0, ``timeouts`` == 0 —
+  never a timeout pile-up);
+- **zero stranded requests**: every request ever sent resolves
+  (``stranded`` == 0), including across the scale-in drain
+  (``drain.zero_stranded``);
+- the ``ServeScaler`` closes the loop: ``scaler.scale_out`` >= 1 from
+  the overload phase, ``scaler.scale_in`` >= 1 from the idle phase via
+  the drain-safe decommission protocol;
+- dry-run parity: replaying the recorded per-tick stats into a
+  ``dry``-mode scaler journals the IDENTICAL action stream
+  (``dry_parity_ok``);
+- a clean fleet at low load produces ZERO scaler actions and ZERO
+  sheds (the ``clean`` section).
+
+``stats()`` is scraped over RPC throughout — including while the
+device queue is saturated — so ``stats_rpc_ms`` doubles as the proof
+that observability RPCs keep strict priority over predict work.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m edl_tpu.tools.serve_bench
+    python -m edl_tpu.tools.serve_bench --mode full
+
+Emits one JSON object (schema "serve_bench/v1").
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+from edl_tpu.distill.teacher_server import TeacherServer
+from edl_tpu.robustness import faults
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.serve import drain as serve_drain
+from edl_tpu.serve.admission import AdmissionController
+from edl_tpu.serve.scaler import ServeScaler, load_actions
+from edl_tpu.utils import errors
+
+#: knob presets; micro must stay tier-1-smoke cheap (~7s wall).
+#: row_service_ms is charged PER REAL ROW (not per device batch), so
+#: the capacity ceiling — max_batch / (max_batch * row_service_ms) =
+#: 1/row_service_ms rows/s — and the admission projection are exact
+#: and identical on any host
+MODES = {
+    "micro": dict(row_service_ms=5.0, max_batch=4, max_queue_rows=64,
+                  slo_ms=50.0, interval=0.22, out_streak=2, in_streak=3,
+                  max_teachers=2,
+                  phases=((1.0, 20.0), (2.2, 500.0), (2.4, 20.0)),
+                  clean_s=1.2, clean_rps=20.0),
+    "full": dict(row_service_ms=5.0, max_batch=8, max_queue_rows=256,
+                 slo_ms=100.0, interval=0.5, out_streak=2, in_streak=4,
+                 max_teachers=4,
+                 phases=((4.0, 50.0), (8.0, 1000.0), (8.0, 50.0)),
+                 clean_s=4.0, clean_rps=50.0),
+}
+
+#: pinned RPC worker-pool size for the bench's teachers: admitted
+#: predicts BLOCK a pool worker while the device thread serves them,
+#: so the pool size bounds how much queue pressure admission can ever
+#: see — leaving it at the cpu-derived default would make shed
+#: behavior machine-dependent
+BENCH_RPC_WORKERS = 32
+
+
+class _MemCoord(object):
+    """The minimal in-process store surface the scaler journal needs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._store = {}
+
+    def set_server_permanent(self, service, server, value):
+        with self._lock:
+            self._store[(service, server)] = value
+
+    def get_value(self, service, server):
+        with self._lock:
+            return self._store.get((service, server))
+
+    def get_service(self, service):
+        with self._lock:
+            return [(srv, v) for (svc, srv), v in self._store.items()
+                    if svc == service]
+
+
+def _make_teacher(row_service_ms, max_batch, max_queue_rows, slo_ms):
+    """A slow nop teacher charging ``row_service_ms`` per REAL row (the
+    feed is ones, the pad tail zeros — count_nonzero recovers the real
+    row count from the padded staging buffer), so the per-row service
+    time the admission EWMA learns is constant across coalescing
+    regimes and hosts."""
+
+    def fn(feed):
+        rows = int(np.count_nonzero(feed["x"]))
+        time.sleep(rows * row_service_ms / 1000.0)
+        return {"y": np.zeros((len(feed["x"]), 1), np.float32)}
+
+    adm = AdmissionController(max_queue_rows=max_queue_rows,
+                              slo_ms=slo_ms)
+    return TeacherServer(fn, {"x": ([1], "<f4")}, {"y": ([1], "<f4")},
+                         max_batch=max_batch, host="127.0.0.1",
+                         adaptive_batch=True, admission=adm)
+
+
+class _Fleet(object):
+    """In-process teacher fleet: the scaler's two actuators plus the
+    endpoint list the generator routes over."""
+
+    def __init__(self, make_teacher, timeout=30.0):
+        self._make = make_teacher
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._teachers = {}
+        self._clients = {}
+        self._draining = set()
+        self.drain_reports = []
+
+    def scale_out(self):
+        t = self._make().start()
+        with self._lock:
+            self._teachers[t.endpoint] = t
+            self._clients[t.endpoint] = RpcClient(t.endpoint,
+                                                  timeout=self._timeout)
+        return t.endpoint
+
+    def live_endpoints(self):
+        with self._lock:
+            return sorted(ep for ep in self._teachers
+                          if ep not in self._draining)
+
+    def client(self, ep):
+        with self._lock:
+            return self._clients.get(ep)
+
+    def clients(self):
+        with self._lock:
+            return list(self._clients.items())
+
+    def decommission(self, ep):
+        """The drain-safe scale-in actuator: stop routing, settle the
+        send race, run the protocol (serve/drain.py), then retire the
+        connection."""
+        with self._lock:
+            teacher = self._teachers.get(ep)
+            self._draining.add(ep)
+        if teacher is None:
+            raise errors.NotFoundError("no teacher at %s" % ep)
+        time.sleep(0.05)  # in-flight sends land before admission flips
+        report = serve_drain.decommission(teacher, register=None,
+                                          ttl_s=0.0, deadline_s=10.0)
+        with self._lock:
+            self._teachers.pop(ep, None)
+            client = self._clients.pop(ep, None)
+            self._draining.discard(ep)
+        if client is not None:
+            client.close()
+        self.drain_reports.append(report)
+        return report
+
+    def stop_all(self):
+        with self._lock:
+            teachers = list(self._teachers.values())
+            clients = list(self._clients.values())
+            self._teachers.clear()
+            self._clients.clear()
+        for c in clients:
+            c.close()
+        for t in teachers:
+            t.stop()
+
+
+def _generate(fleet, phases, records, rec_lock):
+    """Open-loop arrivals: clock-paced sends, round-robin over the live
+    endpoints, never waiting on completions."""
+    feed = {"x": np.ones((1, 1), np.float32)}
+    rr = 0
+    for phase_i, (duration_s, rate_rps) in enumerate(phases):
+        period = 1.0 / float(rate_rps)
+        t_end = time.monotonic() + float(duration_s)
+        nxt = time.monotonic()
+        while time.monotonic() < t_end:
+            eps = fleet.live_endpoints()
+            if eps:
+                ep = eps[rr % len(eps)]
+                rr += 1
+                client = fleet.client(ep)
+                rec = {"t0": time.monotonic(), "phase": phase_i,
+                       "ep": ep}
+                try:
+                    rec["fut"] = client.call_async("predict", feed)
+                except errors.EdlError as e:
+                    rec["err"] = e
+                with rec_lock:
+                    records.append(rec)
+            nxt += period
+            delay = nxt - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                nxt = time.monotonic()  # fell behind: no arrival debt
+
+
+def _classify(rec, err, out):
+    phase = out["per_phase"][rec["phase"]]
+    if err is None:
+        out["ok"] += 1
+        phase["ok"] += 1
+        out["ok_lat_ms"].append(
+            (time.monotonic() - rec["t0"]) * 1e3)
+        return
+    if isinstance(err, errors.OverloadedError):
+        reason = str(err).split("overloaded: ", 1)[-1].split(" (")[0]
+        out["shed_by_reason"][reason] = \
+            out["shed_by_reason"].get(reason, 0) + 1
+        if err.retry_after_s is not None:
+            out["shed_with_hint"] += 1
+        out["shed"] += 1
+        phase["shed"] += 1
+        return
+    if isinstance(err, (errors.TimeoutError_,)) \
+            or "timed out" in str(err):
+        out["timeouts"] += 1
+    else:
+        out["untyped_errors"] += 1
+
+
+def _collect(records, rec_lock, out, gen_done, grace_s=10.0):
+    """Sweep the outstanding futures, timestamping each resolution.
+    Anything still unresolved ``grace_s`` after the generator finished
+    is STRANDED — the failure mode the drain protocol exists to
+    prevent."""
+    outstanding = []
+    idx = 0
+    deadline = None
+    while True:
+        with rec_lock:
+            new = records[idx:]
+            idx += len(new)
+        outstanding.extend(new)
+        still = []
+        for rec in outstanding:
+            if "err" in rec:
+                _classify(rec, rec["err"], out)
+            elif rec["fut"].done():
+                try:
+                    rec["fut"].result(0)
+                    _classify(rec, None, out)
+                except Exception as e:  # noqa: BLE001 — counted, typed-checked
+                    _classify(rec, e, out)
+            else:
+                still.append(rec)
+        outstanding = still
+        if gen_done.is_set():
+            if deadline is None:
+                deadline = time.monotonic() + grace_s
+            if not outstanding:
+                break
+            if time.monotonic() > deadline:
+                out["stranded"] = len(outstanding)
+                break
+        time.sleep(0.002)
+
+
+def _scaler_loop(scaler, fleet, stop_ev, interval, snapshots, stats_ms):
+    """Scrape ``stats()`` over RPC each tick (the strict-priority path),
+    convert cumulative occupancy to a per-tick window, tick the scaler,
+    and record the exact (now, snapshot) pairs for the dry replay."""
+    prev = {}
+    while not stop_ev.wait(interval):
+        snap = {}
+        for ep, client in fleet.clients():
+            t0 = time.monotonic()
+            try:
+                s = client.call("stats", timeout=5.0)
+            except errors.EdlError:
+                continue  # draining teacher going away mid-scrape
+            stats_ms.append((time.monotonic() - t0) * 1e3)
+            s = dict(s)
+            batches, rows = s.get("batches", 0), s.get("rows", 0)
+            pb, pr = prev.get(ep, (0, 0))
+            cap = (batches - pb) * s.get("max_batch", 1)
+            s["occupancy"] = ((rows - pr) / cap) if cap > 0 else 0.0
+            prev[ep] = (batches, rows)
+            snap[ep] = s
+        now = time.time()
+        snapshots.append((now, snap))
+        scaler.tick(snap, now=now)
+
+
+def _pct(values, q):
+    if not values:
+        return None
+    return round(float(np.percentile(np.asarray(values), q)), 3)
+
+
+def _run_cycle(knobs, seed, phases, scaler_mode="on", chaos=True,
+               max_teachers=None):
+    """One full generator+scaler cycle; returns the raw accounting."""
+    plane = None
+    fired = {}
+    if chaos:
+        plane = faults.FaultPlane(seed=seed)
+        admit_f = plane.inject("serve.admit", "delay", seconds=0.001,
+                               prob=0.02)
+        drain_f = plane.inject("serve.drain", "delay", seconds=0.05)
+        plane.install()
+    coord = _MemCoord()
+
+    def make_teacher():
+        return _make_teacher(knobs["row_service_ms"], knobs["max_batch"],
+                             knobs["max_queue_rows"], knobs["slo_ms"])
+
+    fleet = _Fleet(make_teacher)
+    interval = knobs["interval"]
+    scaler = ServeScaler(
+        coord, "serve-bench", mode=scaler_mode, interval=interval,
+        scale_out_fn=fleet.scale_out, scale_in_fn=fleet.decommission,
+        min_teachers=1,
+        max_teachers=(max_teachers if max_teachers is not None
+                      else knobs["max_teachers"]),
+        occupancy_high=0.8, occupancy_low=0.4,
+        out_streak=knobs["out_streak"], in_streak=knobs["in_streak"],
+        cooldowns={"scale_out": 2 * interval,
+                   "scale_in": 4 * interval})
+    out = {"ok": 0, "shed": 0, "timeouts": 0, "untyped_errors": 0,
+           "stranded": 0, "shed_with_hint": 0, "shed_by_reason": {},
+           "ok_lat_ms": [],
+           "per_phase": [{"ok": 0, "shed": 0} for _ in phases]}
+    records, rec_lock = [], threading.Lock()
+    snapshots, stats_ms = [], []
+    gen_done, scaler_stop = threading.Event(), threading.Event()
+    try:
+        fleet.scale_out()  # the seed teacher
+        # warm the service-time EWMA so admission projections are live
+        warm = fleet.client(fleet.live_endpoints()[0])
+        warm.call("predict", {"x": np.ones((1, 1), np.float32)})
+        scaler_thread = threading.Thread(
+            target=_scaler_loop,
+            args=(scaler, fleet, scaler_stop, interval, snapshots,
+                  stats_ms), name="serve-bench-scaler")
+        collector = threading.Thread(
+            target=_collect, args=(records, rec_lock, out, gen_done),
+            name="serve-bench-collector")
+        scaler_thread.start()
+        collector.start()
+        t0 = time.monotonic()
+        _generate(fleet, phases, records, rec_lock)
+        gen_done.set()
+        collector.join(timeout=30.0)
+        wall_s = time.monotonic() - t0
+        # a couple more ticks so a pending scale-in can land
+        time.sleep(2 * interval)
+        scaler_stop.set()
+        scaler_thread.join(timeout=15.0)
+    finally:
+        scaler_stop.set()
+        gen_done.set()
+        fleet.stop_all()
+        if plane is not None:
+            plane.uninstall()
+    if chaos:
+        fired = {"serve.admit": admit_f.fired,
+                 "serve.drain": drain_f.fired}
+    out.update({
+        "sent": len(records),
+        "wall_s": wall_s,
+        "snapshots": snapshots,
+        "stats_ms": stats_ms,
+        "actions": scaler.actions(),
+        "journal": load_actions(coord),
+        "drain_reports": fleet.drain_reports,
+        "faults_fired": fired,
+        "scaler_params": dict(interval=interval,
+                              out_streak=knobs["out_streak"],
+                              in_streak=knobs["in_streak"]),
+    })
+    return out
+
+
+def _dry_replay(knobs, cycle, max_teachers=None):
+    """Feed the live run's recorded (now, stats) ticks to a dry-mode
+    scaler and return its journaled action signatures — the identical
+    -stream half of the dry≡on parity criterion."""
+    interval = knobs["interval"]
+    scaler = ServeScaler(
+        _MemCoord(), "serve-bench", mode="dry", interval=interval,
+        min_teachers=1,
+        max_teachers=(max_teachers if max_teachers is not None
+                      else knobs["max_teachers"]),
+        occupancy_high=0.8, occupancy_low=0.4,
+        out_streak=knobs["out_streak"], in_streak=knobs["in_streak"],
+        cooldowns={"scale_out": 2 * interval,
+                   "scale_in": 4 * interval})
+    for now, snap in cycle["snapshots"]:
+        scaler.tick(snap, now=now)
+    return _signatures(scaler.actions())
+
+
+def _signatures(actions):
+    """The mode-independent identity of a journaled action: everything
+    but mode/outcome/attempts (which differ between dry and on by
+    design — dry applies nothing)."""
+    return [(a["seq"], a["kind"], a["target"], a.get("decision"))
+            for a in actions]
+
+
+def run(mode="micro", seed=7):
+    knobs = MODES[mode]
+    prev_workers = os.environ.get("EDL_TPU_RPC_WORKERS")
+    os.environ["EDL_TPU_RPC_WORKERS"] = str(BENCH_RPC_WORKERS)
+    try:
+        return _run(knobs, mode, seed)
+    finally:
+        if prev_workers is None:
+            os.environ.pop("EDL_TPU_RPC_WORKERS", None)
+        else:
+            os.environ["EDL_TPU_RPC_WORKERS"] = prev_workers
+
+
+def _run(knobs, mode, seed):
+    cycle = _run_cycle(knobs, seed, knobs["phases"])
+    live_sigs = _signatures(cycle["actions"])
+    dry_sigs = _dry_replay(knobs, cycle)
+
+    # the clean-fleet control: low load, chaos off — the scaler and the
+    # admission controller must both stay silent
+    clean = _run_cycle(knobs, seed, ((knobs["clean_s"],
+                                      knobs["clean_rps"]),),
+                       chaos=False)
+
+    kinds = [a["kind"] for a in cycle["actions"]]
+    sent = cycle["sent"]
+    drains = cycle["drain_reports"]
+    report = {
+        "schema": "serve_bench/v1",
+        "mode": mode,
+        "seed": seed,
+        "phases": [{"duration_s": d, "rate_rps": r}
+                   for d, r in knobs["phases"]],
+        "sent": sent,
+        "ok": cycle["ok"],
+        "goodput_rps": (round(cycle["ok"] / cycle["wall_s"], 2)
+                        if cycle["wall_s"] else None),
+        "shed": {
+            "total": cycle["shed"],
+            "rate": round(cycle["shed"] / sent, 4) if sent else 0.0,
+            "by_reason": cycle["shed_by_reason"],
+            "with_retry_after_hint": cycle["shed_with_hint"],
+        },
+        "stranded": cycle["stranded"],
+        "timeouts": cycle["timeouts"],
+        "untyped_errors": cycle["untyped_errors"],
+        "latency_ms": {"p50": _pct(cycle["ok_lat_ms"], 50),
+                       "p99": _pct(cycle["ok_lat_ms"], 99)},
+        "stats_rpc_ms": {"p50": _pct(cycle["stats_ms"], 50),
+                         "p99": _pct(cycle["stats_ms"], 99)},
+        "per_phase": cycle["per_phase"],
+        "scaler": {
+            "mode": "on",
+            "scale_out": kinds.count("scale_out"),
+            "scale_in": kinds.count("scale_in"),
+            "actions": [{k: a[k] for k in ("seq", "kind", "target",
+                                           "outcome", "reason")}
+                        for a in cycle["actions"]],
+            "journaled": len(cycle["journal"]),
+        },
+        "drain": {
+            "reports": drains,
+            "zero_stranded": (cycle["stranded"] == 0
+                              and all(r.get("drained")
+                                      and r.get("pending_rows") == 0
+                                      for r in drains)),
+        },
+        "dry_parity_ok": live_sigs == dry_sigs,
+        "live_action_stream": live_sigs,
+        "dry_action_stream": dry_sigs,
+        "faults_fired": cycle["faults_fired"],
+        "clean": {
+            "sent": clean["sent"],
+            "ok": clean["ok"],
+            "shed_total": clean["shed"],
+            "stranded": clean["stranded"],
+            "scaler_actions": len(clean["actions"]),
+        },
+        "wall_s": round(cycle["wall_s"], 3),
+    }
+    return report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", default="micro", choices=sorted(MODES))
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args(argv)
+    out = run(mode=args.mode, seed=args.seed)
+    json.dump(out, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    healthy = (out["stranded"] == 0 and out["timeouts"] == 0
+               and out["untyped_errors"] == 0 and out["dry_parity_ok"])
+    return 0 if healthy else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

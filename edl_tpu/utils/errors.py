@@ -5,6 +5,7 @@ by class name) and edl/utils/error_utils.py:22-39 (@handle_errors_until_timeout)
 """
 
 import functools
+import re as _re
 
 
 class EdlError(Exception):
@@ -70,6 +71,56 @@ class TrainProcessError(EdlError):
 
 class DataAccessError(EdlError):
     pass
+
+
+class FeedSpecError(DataAccessError):
+    """A predict feed violates the teacher's declared spec (missing
+    feed, batch mismatch, empty, over max_batch). Subclass of
+    DataAccessError so the reader's poisoned-task path surfaces it to
+    the consumer in order instead of retrying a permanently bad feed.
+    ``spec``/``shape`` name the offending feed; both are folded into
+    the message so they survive the wire (only the message string is
+    serialized)."""
+
+    def __init__(self, message, spec=None, shape=None):
+        if spec is not None:
+            message = "%s [spec=%s shape=%s]" % (message, spec, shape)
+        super(FeedSpecError, self).__init__(message)
+        self.spec = spec if spec is not None else self._parse("spec")
+        self.shape = shape if shape is not None else self._parse("shape")
+
+    def _parse(self, field):
+        # rebuilt from the wire: recover the field from the message
+        m = _re.search(r"\[spec=(\S+) shape=(.*?)\]$", str(self))
+        if m is None:
+            return None
+        return m.group(1) if field == "spec" else m.group(2)
+
+
+class OverloadedError(EdlError):
+    """The serving tier shed this request — admission queue full, rate
+    limited, past its deadline, projected queue wait over the SLO, or
+    the server is draining. Retryable AGAINST ANOTHER SERVER: the
+    reader requeues the task and opens the endpoint's breaker so it
+    backs off instead of hammering. Carries a ``retry_after_s=`` hint
+    in the message (messages are all that survive serialization)."""
+
+    @classmethod
+    def shed(cls, reason, retry_after_s=None):
+        msg = "overloaded: %s" % reason
+        if retry_after_s is not None:
+            msg += " (retry_after_s=%.3f)" % max(0.0, retry_after_s)
+        return cls(msg)
+
+    @property
+    def retry_after_s(self):
+        m = _re.search(r"retry_after_s=([0-9.]+)", str(self))
+        if m is None:
+            return None
+        try:
+            return float(m.group(1))
+        except ValueError:
+            return None
 
 
 class DataEndError(EdlError):

@@ -577,3 +577,60 @@ def test_action_record_schema():
     assert [x["id"] for x in obs_autopilot.load_actions(coord)] \
         == [a["id"]]
     json.dumps(a)
+
+
+def test_serve_bench_micro_schema():
+    """Tier-1 pin of the serving-plane bench contract (schema
+    serve_bench/v1): the micro mode must force a full
+    scale-out -> overload -> shed -> scale-in cycle under seeded chaos
+    and prove the serving-plane guarantees — saturation sheds are
+    typed OverloadedErrors with retry-after hints (never a timeout
+    pile-up), the drain-safe decommission strands zero requests, the
+    scaler's dry replay journals the identical action stream, and a
+    clean low-load fleet produces zero scaler actions and zero sheds.
+    The shed-rate and zero-stranded fields are MANDATORY: a report
+    without them is a schema break, not a passing run."""
+    import json
+
+    from edl_tpu.tools import serve_bench
+
+    out = serve_bench.run(mode="micro", seed=7)
+    assert out["schema"] == "serve_bench/v1"
+    assert out["sent"] > 0 and out["ok"] > 0
+    assert out["goodput_rps"] > 0
+
+    # overload produced typed sheds, and ONLY typed sheds: no timeout
+    # pile-up, no untyped errors at saturation
+    assert out["shed"]["total"] > 0
+    assert out["shed"]["rate"] > 0
+    assert out["shed"]["with_retry_after_hint"] > 0
+    assert sum(out["shed"]["by_reason"].values()) == out["shed"]["total"]
+    assert out["timeouts"] == 0
+    assert out["untyped_errors"] == 0
+
+    # zero stranded requests, by count AND by drain report
+    assert out["stranded"] == 0
+    assert out["drain"]["zero_stranded"] is True
+    assert all(r["drained"] and r["pending_rows"] == 0
+               for r in out["drain"]["reports"])
+
+    # the forced cycle really scaled out and back in, and the drain
+    # chaos drill fired on the real drain path
+    assert out["scaler"]["scale_out"] >= 1
+    assert out["scaler"]["scale_in"] >= 1
+    assert out["faults_fired"].get("serve.drain", 0) >= 1
+
+    # dry mode journals the IDENTICAL action stream to on mode
+    assert out["dry_parity_ok"] is True
+    assert out["live_action_stream"] == out["dry_action_stream"]
+
+    # stats RPCs stayed answerable under overload (strict priority)
+    assert out["stats_rpc_ms"]["p99"] is not None
+    assert out["stats_rpc_ms"]["p99"] < out["latency_ms"]["p99"]
+
+    # a clean fleet at low load: zero sheds, zero scaler actions
+    assert out["clean"]["shed_total"] == 0
+    assert out["clean"]["scaler_actions"] == 0
+    assert out["clean"]["stranded"] == 0
+
+    json.dumps(out)  # the whole report is JSON-serializable

@@ -1,0 +1,698 @@
+"""Serving-plane tests: admission control + load shedding, typed
+overload/feed-spec errors over the wire, drain-safe decommission, the
+SLO-driven ServeScaler, and load-aware balancing.
+
+The acceptance properties this file pins down (ISSUE 12):
+
+- saturation produces typed :class:`OverloadedError` sheds with
+  retry-after hints, never timeout pile-ups;
+- the reader treats a shed as "requeue elsewhere + back off" (breaker,
+  no redial) and a bad feed as a poisoned task (surfaced in order,
+  never retried);
+- drain-safe decommission strands zero requests, with the
+  ``serve.drain`` fault point on the real drain path;
+- a discovery outage degrades to stale-but-serving with exactly ONE
+  ``breaker.open`` per outage and recovery within one probe period;
+- the ServeScaler provably never flaps (hysteresis dead band, streaks,
+  cooldowns) and journals the identical action stream in dry and on
+  modes;
+- one server joining a balanced service moves only ~1/N assignments,
+  and draining/capacity weights shift load off a teacher.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from edl_tpu.distill.balance import Service
+from edl_tpu.distill.consistent_hash import ConsistentHash
+from edl_tpu.distill.discovery_client import DiscoveryClient
+from edl_tpu.distill.discovery_server import DiscoveryServer
+from edl_tpu.distill.distill_reader import DistillReader, _TeacherConn
+from edl_tpu.distill.registry import TeacherRegister, list_teachers
+from edl_tpu.distill.teacher_server import TeacherServer
+from edl_tpu.obs import events as obs_events
+from edl_tpu.robustness.faults import FaultPlane
+from edl_tpu.robustness.policy import CircuitBreaker
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.serve import drain as serve_drain
+from edl_tpu.serve.admission import AdmissionController
+from edl_tpu.serve.scaler import ServeScaler, load_actions
+from edl_tpu.utils import errors
+
+
+def _echo_teacher(scale, admission=None, fn_sleep=0.0, max_batch=8):
+    def fn(feed):
+        if fn_sleep:
+            time.sleep(fn_sleep)
+        return {"soft": feed["img"] * scale}
+
+    return TeacherServer(fn, {"img": ([2], "<f4")},
+                         {"soft": ([2], "<f4")}, max_batch=max_batch,
+                         host="127.0.0.1", admission=admission).start()
+
+
+# -- admission control ----------------------------------------------------
+
+
+def test_admission_cold_server_admits_freely():
+    """The queue-wait projection needs a service-time estimate; before
+    the first completed batch a cold server must not shed on SLO."""
+    ac = AdmissionController(max_queue_rows=100, slo_ms=1.0)
+    for _ in range(5):
+        ac.admit(10)  # 50 rows x any row_ms would blow a 1ms SLO
+    assert ac.stats()["pending_rows"] == 50
+    assert ac.stats()["shed_total"] == 0
+
+
+def test_admission_idle_server_recovers_from_poisoned_estimate():
+    """Liveness: a first-batch compile spike must not shed forever.
+
+    The EWMA only updates when admitted work completes, so an SLO shed
+    at pending == 0 would freeze a poisoned estimate — no admissions,
+    no releases, no recovery. An idle server must always admit, and
+    serving at real (fast) speed must heal the projection."""
+    ac = AdmissionController(max_queue_rows=100, slo_ms=50.0)
+    # batch 1: jit compile — 20s for 8 rows poisons row_ms to 2500
+    ac.admit(8)
+    ac.release(8, service_s=20.0)
+    # the poisoned estimate projects 2500ms >> 50ms for ANY row, but
+    # the queue is empty: the next batch must still be admitted
+    ac.admit(8)
+    # a queued burst behind it IS shed (pending > 0, projection honest)
+    with pytest.raises(errors.OverloadedError) as ei:
+        ac.admit(8)
+    assert "slo" in str(ei.value)
+    # batches keep completing at real speed: the EWMA heals until the
+    # projection clears and pipelined admits flow again
+    ac.release(8, service_s=0.008)  # 1ms/row
+    for _ in range(40):
+        if ac.stats()["row_ms"] * 16 <= 50.0:
+            break
+        ac.admit(8)
+        ac.release(8, service_s=0.008)
+    ac.admit(8)
+    ac.admit(8)  # pending 16 rows projects under the SLO: no shed
+    assert ac.stats()["pending_rows"] == 16
+
+
+def test_admission_shed_reasons_and_retry_hints():
+    """Every shed reason is a typed OverloadedError carrying a
+    retry-after hint that survives the message-only wire format."""
+    now = [0.0]
+    clock = lambda: now[0]  # noqa: E731
+
+    # draining: the first check — an admitted-elsewhere signal
+    ac = AdmissionController(clock=clock)
+    ac.set_draining(True)
+    with pytest.raises(errors.OverloadedError) as ei:
+        ac.admit(1)
+    assert "draining" in str(ei.value)
+    assert ei.value.retry_after_s is not None
+
+    # queue_full: the bounded admission queue
+    ac = AdmissionController(max_queue_rows=4, slo_ms=None, clock=clock)
+    ac.admit(4)
+    with pytest.raises(errors.OverloadedError) as ei:
+        ac.admit(1)
+    assert "queue_full" in str(ei.value)
+
+    # rate_limit: empty token bucket; hint == the bucket refill time
+    ac = AdmissionController(rate=10.0, burst=4.0, slo_ms=None,
+                             clock=clock)
+    ac.admit(4)
+    with pytest.raises(errors.OverloadedError) as ei:
+        ac.admit(2)
+    assert "rate_limit" in str(ei.value)
+    assert ei.value.retry_after_s == pytest.approx(0.2)
+    now[0] += 1.0  # refill
+    ac.admit(4)
+
+    # slo: queue-wait projection over the predict-latency SLO
+    ac = AdmissionController(max_queue_rows=100, slo_ms=50.0,
+                             clock=clock)
+    ac.admit(10)
+    ac.release(10, service_s=0.1)  # row_ms EWMA = 10ms
+    ac.admit(4)                    # projected 40ms <= 50ms
+    with pytest.raises(errors.OverloadedError) as ei:
+        ac.admit(2)                # projected 60ms > 50ms
+    assert "slo" in str(ei.value)
+    assert ei.value.retry_after_s == pytest.approx(0.01)
+
+    # deadline: a queued item whose per-request budget elapsed
+    admitted_at = ac.admit(1)
+    now[0] += 1.0
+    assert ac.expired(admitted_at, deadline_ms=500)
+    err = ac.shed_expired(1)
+    assert isinstance(err, errors.OverloadedError)
+    assert "deadline" in str(err)
+
+    stats = ac.stats()
+    assert stats["shed"]["slo"] == 1
+    assert stats["shed"]["deadline"] == 1
+    # a round-tripped error keeps its class AND its hint
+    name, detail = errors.serialize_error(err)
+    back = errors.deserialize_error(name, detail)
+    assert isinstance(back, errors.OverloadedError)
+
+
+def test_typed_errors_round_trip_wire():
+    """Only the message string survives the RPC envelope; the typed
+    fields must be recoverable from it on the far side."""
+    shed = errors.OverloadedError.shed("slo", retry_after_s=0.25)
+    back = errors.deserialize_error(*errors.serialize_error(shed))
+    assert isinstance(back, errors.OverloadedError)
+    assert back.retry_after_s == pytest.approx(0.25)
+
+    spec = errors.FeedSpecError("missing feeds: ['img']", spec="img",
+                                shape=(2,))
+    back = errors.deserialize_error(*errors.serialize_error(spec))
+    assert isinstance(back, errors.FeedSpecError)
+    assert isinstance(back, errors.DataAccessError)
+    assert back.spec == "img"
+    assert back.shape == "(2,)"
+
+
+def test_teacher_rejects_bad_feed_with_typed_spec_error():
+    """A malformed feed comes back as FeedSpecError naming the
+    offending spec — typed across the wire, not a generic RpcError."""
+    srv = _echo_teacher(1.0)
+    try:
+        conn = _TeacherConn(srv.endpoint)
+        with pytest.raises(errors.FeedSpecError) as ei:
+            conn.predict({"wrong": np.ones((2, 2), np.float32)})
+        assert ei.value.spec == "img"
+        assert ei.value.shape is not None
+        conn.close()
+    finally:
+        srv.stop()
+
+
+def test_reader_surfaces_feed_spec_error_not_retried():
+    """A permanently bad feed is a poisoned task: the reader surfaces
+    it to the consumer in order instead of ping-ponging it between
+    teachers forever."""
+    srv = _echo_teacher(1.0)
+
+    def gen():
+        for i in range(3):
+            yield (np.full((2, 2), i, np.float32),)
+
+    dr = DistillReader(ins=["wrong"], predicts=["soft"], max_in_flight=2)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([srv.endpoint])
+    try:
+        with pytest.raises(errors.DataAccessError) as ei:
+            for _ in dr():
+                pass
+        assert isinstance(ei.value, errors.FeedSpecError)
+    finally:
+        dr.stop()
+        srv.stop()
+
+
+def test_reader_backs_off_overloaded_teacher():
+    """A typed shed requeues the task elsewhere, opens the endpoint's
+    breaker, and keeps the healthy pooled client (no redial storm) —
+    the epoch still completes with every batch delivered."""
+    shed_ac = AdmissionController()
+    shed_ac.set_draining(True)  # t1 sheds every predict, typed
+    t1 = _echo_teacher(2.0, admission=shed_ac)
+    t2 = _echo_teacher(2.0, fn_sleep=0.05)
+
+    def gen():
+        for i in range(12):
+            yield (np.full((2, 2), i, np.float32),)
+
+    dr = DistillReader(ins=["img"], predicts=["soft"], max_in_flight=4,
+                       teacher_backoff=60, pipeline_depth=1)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([t1.endpoint, t2.endpoint])
+    retired = []
+    orig_retire = dr._pool.retire
+    dr._pool.retire = lambda ep: (retired.append(ep), orig_retire(ep))[1]
+    try:
+        dr._ensure_started()
+        dr._sync_workers()  # both workers parked on the task queue
+        time.sleep(0.2)
+        seen = []
+        for img, soft in dr():
+            np.testing.assert_allclose(soft, img * 2.0)
+            seen.append(int(img[0, 0]))
+        assert seen == list(range(12))  # nothing lost to the shed
+        # t1 really shed work and its breaker opened for the backoff
+        assert shed_ac.stats()["shed"]["draining"] >= 1
+        assert dr._breaker.state(t1.endpoint) == CircuitBreaker.OPEN
+        # ... but the pooled client was NOT retired: the connection is
+        # healthy, backing off must not force a redial
+        assert t1.endpoint not in retired
+    finally:
+        dr.stop()
+        t1.stop()
+        t2.stop()
+
+
+def test_predict_deadline_sheds_dead_on_arrival():
+    """A queued predict whose per-request deadline elapsed while it
+    waited is shed as ``deadline`` instead of burning device time."""
+
+    def slow(feed):
+        time.sleep(0.25)
+        return {"out": feed["x"]}
+
+    srv = TeacherServer(slow, {"x": ([1], "<f4")}, {"out": ([1], "<f4")},
+                        max_batch=1, host="127.0.0.1",
+                        admission=AdmissionController()).start()
+    cl = RpcClient(srv.endpoint, timeout=30)
+    try:
+        feed = {"x": np.ones((1, 1), np.float32)}
+        f1 = cl.call_async("predict", feed)
+        time.sleep(0.1)  # the device thread is now busy with f1
+        f2 = cl.call_async("predict", feed, deadline_ms=50)
+        assert f1.result(timeout=10)["out"].shape == (1, 1)
+        with pytest.raises(errors.OverloadedError) as ei:
+            f2.result(timeout=10)
+        assert "deadline" in str(ei.value)
+    finally:
+        cl.close()
+        srv.stop()
+
+
+# -- drain-safe decommission ----------------------------------------------
+
+
+def test_drain_safe_decommission_zero_stranded():
+    """The four-step drain protocol: every in-flight request resolves
+    (served or typed shed), the queue is provably empty before the
+    exit, and ``serve.drain`` fires on the real drain path."""
+    plane = FaultPlane(seed=3)
+    fault = plane.inject("serve.drain", "delay", seconds=0.01)
+    plane.install()
+
+    def slow(feed):
+        time.sleep(0.05)
+        return {"out": feed["x"] * 2.0}
+
+    srv = TeacherServer(slow, {"x": ([1], "<f4")}, {"out": ([1], "<f4")},
+                        max_batch=2, host="127.0.0.1",
+                        admission=AdmissionController()).start()
+    cl = RpcClient(srv.endpoint, timeout=30)
+    try:
+        feed = {"x": np.ones((1, 1), np.float32)}
+        futs = [cl.call_async("predict", feed) for _ in range(6)]
+        time.sleep(0.02)
+        report = serve_drain.decommission(srv, register=None, ttl_s=0.0,
+                                          deadline_s=10.0)
+        assert report["drained"] is True
+        assert report["pending_rows"] == 0
+        assert report["queue_depth"] == 0
+        assert fault.fired == 1
+        served = shed = 0
+        for f in futs:
+            try:
+                out = f.result(timeout=10)
+                np.testing.assert_allclose(out["out"], 2.0)
+                served += 1
+            except errors.OverloadedError as e:
+                assert "draining" in str(e)
+                shed += 1
+        # zero stranded: every future resolved, served or typed shed
+        assert served + shed == 6
+        assert served >= 1
+    finally:
+        cl.close()
+        srv.stop()
+        plane.uninstall()
+
+
+def test_teacher_kill_mid_predict_zero_lost():
+    """Chaos drill: a teacher dies mid-predict (stop() severs live
+    connections — SIGKILL semantics). The drain protocol is the
+    optimization; the reader's requeue is the delivery backstop, and
+    it must lose zero predicts."""
+    t1 = _echo_teacher(3.0, admission=AdmissionController())
+    t2 = _echo_teacher(3.0, admission=AdmissionController())
+
+    def gen():
+        for i in range(24):
+            yield (np.full((2, 2), i, np.float32),)
+
+    dr = DistillReader(ins=["img"], predicts=["soft"], max_in_flight=4,
+                       teacher_backoff=60)
+    dr.set_batch_generator(gen)
+    dr.set_fixed_teacher([t1.endpoint, t2.endpoint])
+    killed = False
+    seen = []
+    try:
+        for i, (img, soft) in enumerate(dr()):
+            np.testing.assert_allclose(soft, img * 3.0)
+            seen.append(int(img[0, 0]))
+            if i == 3 and not killed:
+                t1.stop()
+                killed = True
+        assert seen == list(range(24))
+    finally:
+        dr.stop()
+        t2.stop()
+        if not killed:
+            t1.stop()
+
+
+def test_registry_drain_stops_advertising(coord):
+    """TeacherRegister.drain(): the lease is revoked NOW (no TTL wait)
+    and the register loop never re-registers the endpoint."""
+    teacher = _echo_teacher(1.0)
+    reg = TeacherRegister(coord, "svc_drain", teacher.endpoint,
+                          ttl=2).start()
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline \
+                and not list_teachers(coord, "svc_drain"):
+            time.sleep(0.1)
+        assert list(list_teachers(coord, "svc_drain")) \
+            == [teacher.endpoint]
+        reg.drain()
+        assert reg.draining
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and list_teachers(coord, "svc_drain"):
+            time.sleep(0.05)
+        assert list_teachers(coord, "svc_drain") == {}
+        # several refresh ticks later: still gone (never re-registers,
+        # even though the teacher's port still answers TCP)
+        time.sleep(1.5)
+        assert list_teachers(coord, "svc_drain") == {}
+    finally:
+        reg.stop()
+        teacher.stop()
+
+
+def test_discovery_outage_stale_but_serving(coord):
+    """Discovery dies mid-stream: clients keep routing on the
+    last-known table (zero lost predicts), the outage logs exactly ONE
+    closed->open ``breaker.open`` (re-probes are ``reopened``), and a
+    server returning at the same endpoint is re-joined within a probe
+    period."""
+    teacher = _echo_teacher(1.0)
+    reg = TeacherRegister(coord, "svc_out", teacher.endpoint,
+                          ttl=2).start()
+    disc = DiscoveryServer(coord, host="127.0.0.1").start()
+    client = None
+    disc2 = None
+    conn = None
+    try:
+        client = DiscoveryClient(disc.endpoint, "svc_out",
+                                 require_num=1,
+                                 heartbeat_interval=0.3).start()
+        assert client.wait_for_servers(timeout=20) == [teacher.endpoint]
+        disc_ep = disc.endpoint
+        port = int(disc_ep.rsplit(":", 1)[1])
+        mark = obs_events.emit("test.serve.outage.mark")
+        disc.stop()  # the outage
+
+        conn = _TeacherConn(teacher.endpoint)
+        opened = []
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            # stale-but-serving: the table is never cleared, and
+            # predicts against it keep succeeding through the outage
+            assert client.get_servers() == [teacher.endpoint]
+            out = conn.predict({"img": np.ones((2, 2), np.float32)})
+            np.testing.assert_allclose(out["soft"], 1.0)
+            opened = [e for e in obs_events.EVENTS.snapshot(
+                          since_id=mark, kinds=("breaker.open",))
+                      if e["attrs"].get("key") == disc_ep]
+            if len(opened) >= 2:  # the trip + >=1 gated re-probe
+                break
+            time.sleep(0.2)
+        assert len(opened) >= 2
+        first = [e for e in opened if not e["attrs"].get("reopened")]
+        assert len(first) == 1  # exactly one closed->open per outage
+
+        # recovery: a discovery server returns at the SAME endpoint
+        disc2 = DiscoveryServer(coord, host="127.0.0.1",
+                                port=port).start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if (client._breaker.state(disc_ep)
+                    == CircuitBreaker.CLOSED
+                    and client.get_servers() == [teacher.endpoint]):
+                break
+            time.sleep(0.2)
+        assert client._breaker.state(disc_ep) \
+            == CircuitBreaker.CLOSED
+        assert client.get_servers() == [teacher.endpoint]
+    finally:
+        if conn is not None:
+            conn.close()
+        if client is not None:
+            client.stop()
+        if disc2 is not None:
+            disc2.stop()
+        reg.stop()
+        teacher.stop()
+
+
+# -- the ServeScaler ------------------------------------------------------
+
+
+class _FakeCoord(object):
+    """The two store calls the scaler journal needs."""
+
+    def __init__(self):
+        self.kv = {}
+
+    def get_value(self, service, key):
+        return self.kv.get((service, key))
+
+    def set_server_permanent(self, service, key, value):
+        self.kv[(service, key)] = value
+
+
+def _stat(occ, pending=0, shed=0, draining=False):
+    return {"occupancy": occ, "pending_rows": pending,
+            "queue_frac": 0.0, "projected_wait_ms": 0.0,
+            "slo_ms": 100.0, "shed_total": shed, "draining": draining}
+
+
+def _scaler(coord, mode, calls=None, **kw):
+    calls = calls if calls is not None else []
+    kw.setdefault("interval", 1.0)
+    kw.setdefault("out_streak", 2)
+    kw.setdefault("in_streak", 3)
+    return ServeScaler(
+        coord, "pod-test", mode=mode,
+        scale_out_fn=lambda: (calls.append("out"), "ep-new")[1],
+        scale_in_fn=lambda ep: (calls.append(ep), True)[1], **kw), calls
+
+
+def test_scaler_off_mode_is_inert():
+    coord = _FakeCoord()
+    sc, calls = _scaler(coord, "off")
+    for t in range(6):
+        assert sc.tick({"t0": _stat(0.99)}, now=float(t)) == []
+    assert calls == []
+    assert load_actions(coord) == []
+
+
+def test_scaler_scale_out_streak_and_cooldown():
+    """Scale-out needs ``out_streak`` CONSECUTIVE overloaded ticks and
+    then waits out its cooldown — two actions across six hot ticks at
+    the default 3-interval cooldown, never a burst."""
+    coord = _FakeCoord()
+    sc, calls = _scaler(coord, "on")
+    acts = []
+    for t in range(6):
+        acts += sc.tick({"t0": _stat(0.95)}, now=float(t))
+    assert [a["kind"] for a in acts] == ["scale_out", "scale_out"]
+    assert [a["ts"] for a in acts] == [1.0, 4.0]  # streak 2, then
+    assert calls == ["out", "out"]                # cooldown + streak
+    assert all(a["outcome"] == "applied" for a in acts)
+    assert all(a["schema"] == "action/v1" for a in acts)
+    assert [a["seq"] for a in acts] == [1, 2]
+    assert [a.get("seq") for a in load_actions(coord)] == [1, 2]
+
+
+def test_scaler_scale_in_drains_least_loaded():
+    coord = _FakeCoord()
+    sc, calls = _scaler(coord, "on", in_streak=4)
+    fleet = {"t0": _stat(0.05), "t1": _stat(0.2, pending=3)}
+    acts = []
+    for t in range(4):
+        acts += sc.tick(fleet, now=float(t))
+    assert [a["kind"] for a in acts] == ["scale_in"]
+    assert acts[0]["target"] == "t0"  # deterministic: least loaded
+    assert calls == ["t0"]
+
+
+def test_scaler_never_flaps():
+    """Opposite signals reset each other's streaks and the dead band
+    decays both — an oscillating fleet produces ZERO actions."""
+    coord = _FakeCoord()
+    sc, calls = _scaler(coord, "on", out_streak=2, in_streak=2)
+    hot = {"t0": _stat(0.95), "t1": _stat(0.9)}
+    idle = {"t0": _stat(0.05), "t1": _stat(0.1)}
+    mid = {"t0": _stat(0.5), "t1": _stat(0.5)}
+    acts = []
+    for t, stats in enumerate([hot, idle] * 5 + [hot, mid] * 5):
+        acts += sc.tick(stats, now=float(t))
+    assert acts == []
+    assert calls == []
+
+
+def test_scaler_clean_fleet_zero_actions():
+    """A clean single-teacher fleet at low load: no sheds, no burn, no
+    headroom to shrink below min — the scaler does nothing."""
+    coord = _FakeCoord()
+    sc, calls = _scaler(coord, "on", min_teachers=1)
+    for t in range(12):
+        assert sc.tick({"t0": _stat(0.1)}, now=float(t)) == []
+    assert calls == []
+    assert load_actions(coord) == []
+
+
+def test_scaler_burn_severity_triggers_scale_out():
+    """The predict_p99 burn-rate evaluator is an overload signal on its
+    own: a bad-latency burn scales out even at low occupancy."""
+    coord = _FakeCoord()
+    sc, calls = _scaler(coord, "on")
+    low = {"t0": _stat(0.1)}
+    assert sc.tick(low, predict_sample=(0, 0), now=0.0) == []
+    assert sc.tick(low, predict_sample=(1000, 500), now=1.0) == []
+    acts = sc.tick(low, predict_sample=(2000, 1000), now=2.0)
+    assert [a["kind"] for a in acts] == ["scale_out"]
+    assert acts[0]["cause"]["burn_severity"] == "critical"
+    assert calls == ["out"]
+
+
+def test_scaler_dry_mode_journals_identical_stream():
+    """dry and on modes fed the identical tick stream journal the
+    identical (seq, kind, target, decision) action stream; dry applies
+    nothing."""
+    two_idle = {"t0": _stat(0.05), "t1": _stat(0.1)}
+    stream = ([{"t0": _stat(0.95)}] * 2
+              + [two_idle] * 4)
+
+    def run(mode):
+        coord = _FakeCoord()
+        sc, calls = _scaler(coord, mode, in_streak=3,
+                            cooldowns={"scale_out": 2.0,
+                                       "scale_in": 2.0})
+        acts = []
+        for t, stats in enumerate(stream):
+            acts += sc.tick(stats, now=float(t))
+        return sc, calls, acts
+
+    _, on_calls, on_acts = run("on")
+    _, dry_calls, dry_acts = run("dry")
+
+    def sig(actions):
+        return [(a["seq"], a["kind"], a["target"], a.get("decision"))
+                for a in actions]
+
+    assert sig(on_acts) == sig(dry_acts)
+    assert [a["kind"] for a in on_acts] == ["scale_out", "scale_in"]
+    assert on_calls == ["out", "t0"]
+    assert dry_calls == []  # dry NEVER touches the fleet
+    assert all(a["mode"] == "dry_run" and a["outcome"] == "dry_run"
+               for a in dry_acts)
+
+
+def test_scaler_seq_anchors_on_stored_journal():
+    """A re-elected host's scaler continues the stored sequence instead
+    of restarting at 1 — the journal stays totally ordered."""
+    coord = _FakeCoord()
+    coord.set_server_permanent("serve", "journal", json.dumps(
+        [{"schema": "action/v1", "seq": 5, "kind": "scale_out",
+          "target": "fleet"}]))
+    sc, _ = _scaler(coord, "on")
+    acts = []
+    for t in range(2):
+        acts += sc.tick({"t0": _stat(0.95)}, now=float(t))
+    assert [a["seq"] for a in acts] == [6]
+    assert [a.get("seq") for a in load_actions(coord)] == [5, 6]
+
+
+# -- load-aware balancing -------------------------------------------------
+
+
+def test_balance_single_join_moves_one_nth():
+    """Churn-minimal rebalance: one server joining a 12-client/3-server
+    service moves EXACTLY clients/new_count = 3 assignments, and every
+    move lands in edl_balance_reassignments_total."""
+    now = [0.0]
+    svc = Service("churn", clock=lambda: now[0])
+    svc.set_servers(["s0", "s1", "s2"])
+    for i in range(12):
+        svc.register_client("c%02d" % i, 1)
+    before = svc.stats()
+    assert before["fairness"]["reassignments"] == 0  # joins move nothing
+    assignments = {cid: eps[0] for cid, eps in before["clients"].items()}
+    assert sorted(before["servers"].values()) == [4, 4, 4]
+
+    svc.set_servers(["s0", "s1", "s2", "s3"])
+    after = svc.stats()
+    moved = [cid for cid, eps in after["clients"].items()
+             if eps[0] != assignments[cid]]
+    assert len(moved) == 3  # ~1/N: 12 clients / 4 servers
+    assert after["fairness"]["reassignments"] == 3
+    assert sorted(after["servers"].values()) == [3, 3, 3, 3]
+
+
+def test_balance_draining_server_sheds_clients():
+    """A draining teacher weighs 0: its connection cap collapses and
+    clients move off before the discovery TTL even lapses."""
+    now = [0.0]
+    svc = Service("drainw", clock=lambda: now[0])
+    svc.set_servers(["a", "b"])
+    for i in range(4):
+        svc.register_client("c%d" % i, 1)
+    assert sorted(svc.stats()["servers"].values()) == [2, 2]
+
+    svc.set_servers({"a": {}, "b": {"draining": True}})
+    stats = svc.stats()
+    assert stats["servers"]["b"] == 0
+    assert stats["servers"]["a"] == 4
+    assert stats["fairness"]["reassignments"] == 2
+    # every client still has a teacher (nobody starves during a drain)
+    assert all(eps for eps in stats["clients"].values())
+
+
+def test_balance_capacity_weights_connection_cap():
+    """A capacity weight scales a server's connection cap: halving one
+    server's weight pushes its overflow to peers with headroom."""
+    now = [0.0]
+    svc = Service("capw", clock=lambda: now[0])
+    svc.set_servers(["a", "b", "c"])
+    for i in range(5):
+        svc.register_client("c%d" % i, 1)
+    # per_server cap 2 -> loads {2, 2, 1} (which server holds 1 is
+    # iteration-order dependent; the weighted endpoint below is not)
+    assert sorted(svc.stats()["servers"].values()) == [1, 2, 2]
+
+    svc.set_servers({"a": {"capacity": 0.5}, "b": {}, "c": {}})
+    stats = svc.stats()
+    # a's cap halves to 1; its overflow (if any) moved to the peer
+    # that still had weighted headroom — never back onto a
+    assert stats["servers"]["a"] == 1
+    assert sorted(stats["servers"].values()) == [1, 2, 2]
+    # every client still has exactly its entitled one teacher
+    assert all(len(eps) == 1 for eps in stats["clients"].values())
+
+
+def test_weighted_hash_vnode_distribution():
+    """Capacity-weighted vnodes: a 2.0-weight node owns ~2x the key
+    space, a 0-weight (draining) node owns none."""
+    ch = ConsistentHash()
+    ch.update(["a", "b", "c"], weights={"a": 2.0, "c": 0.0})
+    counts = {"a": 0, "b": 0, "c": 0}
+    for i in range(4000):
+        node, _ = ch.get_node("key-%d" % i)
+        counts[node] += 1
+    assert counts["c"] == 0
+    assert counts["a"] + counts["b"] == 4000
+    ratio = counts["a"] / float(counts["b"])
+    assert 1.5 < ratio < 2.7, counts
