@@ -27,6 +27,13 @@ SERVICE_LIVE_RESIZE = "live_resize"
 # goodput autopilot's action/v1 journal and filed postmortem bundles
 # (edl_tpu/obs/autopilot.py)
 SERVICE_AUTOPILOT = "autopilot"
+# watch-relay fan-out tree: each pod's WatchRelay advertises its
+# endpoint here under a TTL lease; children resolve ancestors from
+# this registry and fall through to direct store long-polls when no
+# relay is advertised (edl_tpu/coordination/relay.py — the value is
+# inlined there to keep coordination below controller; drift-guarded
+# by tests/test_relay.py)
+SERVICE_RELAY = "relay"
 
 LEADER_SERVER = "0"          # the single leader key
 CLUSTER_SERVER = "cluster"   # the single cluster-map key

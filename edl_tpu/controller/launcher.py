@@ -49,6 +49,7 @@ class Launcher(object):
         self._health = None
         self._autopilot = None
         self._watcher = None
+        self._relay = None
         self._procs = []
         self._cluster = None
         # live-resize intents this launcher already adopted (ids); a
@@ -138,11 +139,75 @@ class Launcher(object):
             return True
         status.save_pod_status(self._coord, self._pod.id,
                                status.Status.RUNNING)
+        # host + attach this pod's watch relay BEFORE the watcher
+        # starts, so the cluster watch long-poll rides the tree from
+        # its first poll (EDL_TPU_RELAY=0 keeps everything flat)
+        self._start_relay()
         self._watcher = ClusterWatcher(self._coord, self._cluster)
         self._procs = train_process.start_trainers(
             je, self._pod, self._cluster, self._script, self._script_args,
             je.log_dir)
         return self._supervise()
+
+    # -- watch relay tree ----------------------------------------------------
+
+    def _start_relay(self):
+        """Host this pod's WatchRelay and attach the shared coord
+        client to it: long-polls, keepalive beats, and obs publishes
+        ride the deterministic B-ary fan-out tree computed from the
+        cluster map, falling through to direct store calls whenever no
+        relay answers. Strictly best-effort — a pod that cannot host or
+        attach simply stays on the flat direct path."""
+        from edl_tpu.coordination import relay as relay_mod
+        if not relay_mod.enabled() or self._relay is not None:
+            return
+        try:
+            self._relay = relay_mod.WatchRelay(
+                self._coord, self._pod.id,
+                service=constants.SERVICE_RELAY,
+                register_ttl=constants.ETCD_TTL)
+            self._relay.update_tree(self._cluster.pod_ids())
+            self._relay.start(register=True)
+            self._coord.attach_relay(relay_mod.RelayAttachment(
+                self._relay.attachment_candidates,
+                pod_id=self._pod.id))
+            logger.info("pod %s relaying on %s (tree over %d pods)",
+                        self._pod.id, self._relay.endpoint,
+                        self._cluster.world_size())
+        except Exception:
+            logger.exception("watch relay unavailable on pod %s; "
+                             "staying on direct store path",
+                             self._pod.id)
+            self._stop_relay()
+
+    def _update_relay_tree(self):
+        """Recompute the relay tree from the post-resize cluster map
+        and drop sticky endpoints so attachments re-resolve parents."""
+        if self._relay is None:
+            return
+        try:
+            self._relay.update_tree(self._cluster.pod_ids())
+            att = self._coord.relay_attachment
+            if att is not None:
+                att.invalidate()
+        except Exception:
+            logger.exception("relay tree update failed on pod %s",
+                             self._pod.id)
+
+    def _stop_relay(self):
+        att = None
+        try:
+            att = self._coord.detach_relay()
+        except AttributeError:
+            pass
+        if att is not None:
+            att.close()
+        relay, self._relay = self._relay, None
+        if relay is not None:
+            try:
+                relay.stop()
+            except Exception:
+                logger.exception("relay stop failed for %r", relay)
 
     def _join_cluster(self):
         """Barrier until a cluster that *includes this pod* is agreed;
@@ -367,6 +432,7 @@ class Launcher(object):
         self._cluster = cluster
         if not self._update_local_pod():
             return False
+        self._update_relay_tree()
         self._watcher.stop()
         self._watcher = ClusterWatcher(self._coord, self._cluster)
         recovery_s = time.monotonic() - t0
@@ -406,6 +472,7 @@ class Launcher(object):
             raise errors.BarrierError("job failed during resize barrier")
         if not self._update_local_pod():
             return False
+        self._update_relay_tree()
         self._watcher = ClusterWatcher(self._coord, self._cluster)
         self._procs = train_process.start_trainers(
             self._job_env, self._pod, self._cluster, self._script,
@@ -514,6 +581,11 @@ class Launcher(object):
     def _cleanup(self):
         if self._procs:
             train_process.terminate_trainers(self._procs)
+        # detach + stop the relay FIRST: the components below still
+        # hold long-polls/leases through it, and must fall through to
+        # the direct path while they shut down rather than hang on a
+        # half-dead local relay
+        self._stop_relay()
         for closer in (self._watcher, self._generator, self._health,
                        self._elector, self._resource_register,
                        self._pod_server):

@@ -133,6 +133,29 @@ class CoordClient(object):
         # primary in lockstep every 0.5s
         self._retry = RetryPolicy(base_delay=0.25, max_delay=2.0,
                                   multiplier=2.0, jitter=0.5)
+        # optional relay.RelayAttachment: long-polls, keepalive beats
+        # and obs publishes ride the fan-out tree when one is attached;
+        # every relayed path falls through to the direct store path the
+        # moment the attachment declines (None) or no relay answers
+        self._relay_att = None
+
+    # -- relay attachment ----------------------------------------------------
+
+    def attach_relay(self, attachment):
+        """Route ``wait_events`` / ``lease_refresh_many`` /
+        ``publish_obs`` through a relay tree (coordination/relay.py).
+        Reads, writes, registrations and transactions stay direct —
+        only the O(N)-per-beat traffic is worth relaying."""
+        self._relay_att = attachment
+        return attachment
+
+    def detach_relay(self):
+        att, self._relay_att = self._relay_att, None
+        return att
+
+    @property
+    def relay_attachment(self):
+        return self._relay_att
 
     # -- key namespace ------------------------------------------------------
 
@@ -291,7 +314,20 @@ class CoordClient(object):
     def revision(self):
         return self._call("store_revision")
 
-    def wait_events(self, prefix, since_rev, poll_timeout):
+    def wait_events(self, prefix, since_rev, poll_timeout, relay=True):
+        """Long-poll for events under ``prefix`` past ``since_rev``.
+
+        Rides the relay tree when an attachment is present (``relay=
+        False`` forces the direct store path — the relays themselves
+        use it for their upstream polls so a tree can never loop).
+        Because the caller keeps its own ``since_rev``, the fall-
+        through mid-stream is lossless: the direct poll resumes exactly
+        where the dead relay left off."""
+        att = self._relay_att
+        if relay and att is not None:
+            out = att.wait_events(prefix, since_rev, poll_timeout)
+            if out is not None:
+                return out
         return self._call("store_wait_events", prefix, since_rev,
                           poll_timeout, timeout=poll_timeout + 30)
 
@@ -306,13 +342,21 @@ class CoordClient(object):
     def lease_refresh(self, lease_id):
         return self._call("store_lease_refresh", lease_id)
 
-    def lease_refresh_many(self, lease_ids):
-        """Batched keepalive; returns {lease_id: ok}. Falls back to
-        per-id refreshes against peers that predate the batched RPC
-        (feature ``store.lease_refresh_many``)."""
+    def lease_refresh_many(self, lease_ids, relay=True):
+        """Batched keepalive; returns {lease_id: ok}. Rides the relay
+        tree when attached (the relay coalesces children's beats into
+        one upstream batch; ``relay=False`` is the relays' own
+        loop-free upstream path). Falls back to per-id refreshes
+        against peers that predate the batched RPC (feature
+        ``store.lease_refresh_many``)."""
         lease_ids = list(lease_ids)
         if not lease_ids:
             return {}
+        att = self._relay_att
+        if relay and att is not None:
+            res = att.lease_refresh_many(lease_ids)
+            if res is not None:
+                return res
         try:
             pairs = self._call("store_lease_refresh_many", lease_ids)
             return {int(lid): bool(ok) for lid, ok in pairs}
@@ -343,6 +387,17 @@ class CoordClient(object):
 
     def set_server_permanent(self, service, server, value):
         return self.put(self._key(service, server), value)
+
+    def publish_obs(self, service, server, value):
+        """Publish an observability doc: hand it to the relay tree for
+        subtree aggregation when attached (one ``obs_agg/v1`` store
+        write per subtree per tick instead of one per pod), else write
+        it directly like ``set_server_permanent`` always did."""
+        att = self._relay_att
+        if att is not None and att.obs_publish(service, server, value):
+            return True
+        self.set_server_permanent(service, server, value)
+        return True
 
     def set_server_not_exists(self, service, server, value, ttl):
         """Put-if-absent with a fresh TTL lease — the election primitive.

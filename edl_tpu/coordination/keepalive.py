@@ -17,6 +17,13 @@ A lease the store reports as gone (expired or revoked behind our back)
 triggers the component's ``on_lost`` callback exactly once and is
 dropped from the hub; the component decides whether to re-register or
 die, exactly as its private refresh loop used to.
+
+When the bound client has a relay attachment (coordination/relay.py),
+the hub's single beat rides ``CoordClient.lease_refresh_many``'s
+relayed path: the pod-local relay folds every child's beat into ONE
+upstream batch per coalesce window, so store-side refresh traffic per
+TTL window drops from O(N) to O(N/B + log N) across the tree.  The
+hub itself needs no relay awareness — routing lives in the client.
 """
 
 import threading

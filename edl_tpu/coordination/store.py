@@ -19,8 +19,17 @@ import threading
 import time
 from collections import deque
 
+from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.robustness import faults
 from edl_tpu.utils.logger import logger
+
+# the store.watch.deliver drop branch silently mimics a timed-out
+# long-poll by design; this counter is the observable trace of it, so
+# chaos drills can assert "deliveries were dropped AND nothing was
+# lost" from metrics instead of logs
+_WATCH_DROPPED = obs_metrics.counter(
+    "edl_store_watch_dropped_total", "watch deliveries suppressed by "
+    "the store.watch.deliver drop fault")
 
 
 class KeyValue(object):
@@ -498,6 +507,7 @@ class Store(object):
             if f is not None and f.kind == "drop":
                 # delivery dropped: look like a timed-out long-poll; the
                 # watcher keeps its position and polls again
+                _WATCH_DROPPED.inc()
                 return [], since_rev
         deadline = time.monotonic() + timeout
         with self._lock:

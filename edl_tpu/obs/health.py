@@ -383,9 +383,21 @@ class HealthMonitor(object):
 
     # -- doc plumbing ------------------------------------------------------
 
+    def _strip_key(self, key):
+        return (key[len(self._key_prefix):]
+                if key.startswith(self._key_prefix) else key)
+
     def _read_docs(self):
-        """{pod: obs_pub doc} from the store; best-effort."""
-        docs = {}
+        """{pod: obs_pub doc} from the store; best-effort.
+
+        Accepts both publication schemas: flat per-pod ``obs_pub/v1``
+        docs AND relay-folded ``obs_agg/v1`` docs, whose per-pod cells
+        are expanded back into individual obs_pub docs — the detectors
+        keep seeing every pod regardless of the fan-in topology.  A pod
+        appearing via both paths (e.g. mid-failover, when its doc
+        rides a stale agg AND a fresh direct publish) resolves to the
+        freshest ``ts``."""
+        docs, doc_ts = {}, {}
         try:
             for key, raw in self._coord.get_service(self._service_metrics):
                 if not key.startswith(self._key_prefix):
@@ -394,9 +406,23 @@ class HealthMonitor(object):
                     doc = json.loads(raw)
                 except ValueError:
                     continue
-                if isinstance(doc, dict) \
-                        and doc.get("schema") == "obs_pub/v1":
-                    docs[key[len(self._key_prefix):]] = doc
+                if not isinstance(doc, dict):
+                    continue
+                if doc.get("schema") == "obs_pub/v1":
+                    cells = [(self._strip_key(key), doc)]
+                elif doc.get("schema") == "obs_agg/v1":
+                    cells = [(self._strip_key(cell_key), cell)
+                             for cell_key, cell
+                             in sorted((doc.get("pods") or {}).items())
+                             if isinstance(cell, dict)
+                             and cell.get("schema") == "obs_pub/v1"]
+                else:
+                    continue
+                for pod, cell in cells:
+                    ts = cell.get("ts") or 0
+                    if pod not in docs or ts > doc_ts[pod]:
+                        docs[pod] = cell
+                        doc_ts[pod] = ts
         except Exception as e:  # noqa: BLE001 — best-effort by contract
             logger.debug("health: obs doc read failed: %r", e)
         return docs

@@ -71,8 +71,16 @@ class MetricsPublisher(object):
                "ts": time.time(),
                "metrics": self._registry.snapshot(),
                "events": fresh}
-        self._coord.set_server_permanent(self._service, self._key,
-                                         json.dumps(doc))
+        # publish_obs routes through the relay tree when the client has
+        # one attached (subtree aggregation into obs_agg/v1 — one store
+        # write per subtree per tick); plain clients and the fakes in
+        # tests take the permanent-put path unchanged
+        sink = getattr(self._coord, "publish_obs", None)
+        if sink is not None:
+            sink(self._service, self._key, json.dumps(doc))
+        else:
+            self._coord.set_server_permanent(self._service, self._key,
+                                             json.dumps(doc))
         if fresh:
             self._since = fresh[-1]["id"]
         return doc

@@ -74,6 +74,17 @@ serve.drain              when a teacher starts draining (ctx: endpoint,
                          pending) — arm ``delay`` to hold the drain
                          window open or ``error`` to drill a teacher
                          dying mid-decommission
+relay.attach             child side, when a relay attachment adopts a
+                         candidate endpoint (ctx: endpoint, pod) — an
+                         armed ``error`` skips the candidate, driving
+                         the fall-through to the grandparent / direct
+                         store path
+relay.forward            relay side, before a child's wait_events
+                         long-poll is served from the cache (ctx:
+                         prefix, child) — ``drop`` mimics a timed-out
+                         poll (delay, never loss), ``error`` forces
+                         the child through the since_rev-lossless
+                         reattach path
 ======================== ===============================================
 
 Fault kinds:

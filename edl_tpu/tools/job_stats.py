@@ -84,7 +84,31 @@ def collect_job_stats(coord, rpc_timeout=5.0):
             except ValueError:
                 continue
             if key.startswith(_OBS_KEY_PREFIX):
-                obs_pub[key[len(_OBS_KEY_PREFIX):]] = val
+                if isinstance(val, dict) \
+                        and val.get("schema") == "obs_agg/v1":
+                    # relay-folded subtree doc: expand the per-pod
+                    # cells so the fleet view is topology-agnostic
+                    # (freshest ts wins when a pod also published a
+                    # flat doc, e.g. mid relay-failover)
+                    for cell_key, cell in sorted(
+                            (val.get("pods") or {}).items()):
+                        if not isinstance(cell, dict):
+                            continue
+                        pod = (cell_key[len(_OBS_KEY_PREFIX):]
+                               if cell_key.startswith(_OBS_KEY_PREFIX)
+                               else cell_key)
+                        prev = obs_pub.get(pod)
+                        if prev is None or ((cell.get("ts") or 0)
+                                            > (prev.get("ts") or 0)):
+                            obs_pub[pod] = cell
+                else:
+                    pod = key[len(_OBS_KEY_PREFIX):]
+                    prev = obs_pub.get(pod)
+                    if not isinstance(prev, dict) \
+                            or ((val.get("ts") or 0) if isinstance(
+                                val, dict) else 0) \
+                            >= (prev.get("ts") or 0):
+                        obs_pub[pod] = val
             elif key.startswith("preempt_missed"):
                 missed[key] = val
             else:

@@ -19,10 +19,18 @@ swing +-40% run to run under core contention; treat them as floors.
 Across 3 runs the native server led every single-client op (put up to
 44.3k vs 24.8k ops/s, watch latency 0.05-0.11 ms vs 0.2-0.5 ms).
 
+``--micro`` runs the hermetic arcs instead (one ``store_bench/v1``
+JSON line): 3-replica failover, fleet keepalive coalescing, and the
+fleet-watch relay-tree arc (direct vs relay store RPCs per membership
+event / per obs tick, publish->leaf p50/p99, zero-loss relay-kill
+drill) at ``--pods`` fake pods (default 2048).
+
 Run: python -m edl_tpu.tools.store_bench [--n 2000]
+     python -m edl_tpu.tools.store_bench --micro --pods 2048
 """
 
 import argparse
+import collections
 import json
 import statistics
 import threading
@@ -115,8 +123,285 @@ def _bench_backend(name, endpoint, n):
     return rows
 
 
-def run(writes=120, pods=64, replicas=3, election_timeout=(0.2, 0.4),
-        seed=0):
+def _wrap_store_counting(rpc_server, calls):
+    """Re-register every ``store_*`` handler behind a per-method call
+    counter — the store-side-RPC ruler for the fleet-watch arc. The
+    wrapper is registered into the same ``methods`` dict the live
+    dispatch reads, so it covers TCP and UDS alike."""
+    for name, fn in list(rpc_server.methods.items()):
+        if not name.startswith("store_"):
+            continue
+
+        def _wrap(n, f):
+            def counted(*a, **kw):
+                calls[n] += 1
+                return f(*a, **kw)
+            return counted
+
+        rpc_server.register(name, _wrap(name, fn))
+
+
+def _pctl_ms(samples, q):
+    if not samples:
+        return None
+    s = sorted(samples)
+    return round(s[min(len(s) - 1, int(q * len(s)))] * 1e3, 2)
+
+
+def _fleet_watch(pods=2048, branching=None, watchers=64, events=12,
+                 kill_events=8):
+    """The O(N) -> O(N/B + log N) control-plane arc (``fleet_watch``
+    section of ``store_bench/v1``).
+
+    ``pods`` fake pods form the deterministic B-ary relay tree; a
+    depth-2 slice of it (store -> root relay -> mid relay -> leaves) is
+    instantiated for real, with ``watchers`` threaded leaf long-polls
+    (capped at 64 — enough for stable percentiles without 2048 OS
+    threads). Store-side RPCs are counted by wrapping the store's own
+    handlers, so the direct-vs-relay comparison is measured, not
+    modeled:
+
+    - membership fan-out: publish ``events`` keys under a watched
+      prefix in direct mode (every leaf long-polls the store) and in
+      relay mode (leaves poll the mid relay; ONE root pump polls the
+      store), recording publish -> leaf latency per event per watcher
+      and store ``wait_events`` invocations per event.  The direct
+      figure extrapolates the per-watcher rate to ``pods`` (each pod
+      holds exactly one poll loop); the relay figure needs no
+      extrapolation — one store poll per tree, independent of N.
+    - obs ticks: direct mode writes one ``obs_pub/v1`` store doc per
+      pod per tick; relay mode folds leaf docs through the mid and
+      root relays into ONE ``obs_agg/v1`` store write.
+    - kill drill: mid-stream ``mid.stop()`` while leaves watch through
+      it; every leaf must reattach to the grandparent (root) and
+      replay from its own ``since_rev`` with ZERO lost events.
+    """
+    from edl_tpu.coordination import relay as relay_mod
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.coordination.embedded import EmbeddedStore
+
+    n = int(pods)
+    b = int(branching or relay_mod.DEFAULT_BRANCHING)
+    k = max(2, min(int(watchers), 64, n))
+    e = int(events)
+    ids = ["p%04d" % i for i in range(n)]
+    calls = collections.Counter()
+
+    emb = EmbeddedStore()
+    _wrap_store_counting(emb._server._rpc, calls)
+    emb.start()
+    root = mid = None
+    mid_stopped = False
+    try:
+        store_ep = emb.endpoint
+        pub = CoordClient([store_ep], root="bench")
+        prefix = "/bench/fw/nodes/"
+        pub_t = {}  # raw key -> perf_counter at publish
+
+        def _watch_loop(poll, since, expect, lats, got):
+            deadline = time.monotonic() + 30.0
+            while len(got) < len(expect) \
+                    and time.monotonic() < deadline:
+                try:
+                    evs, since = poll(since)
+                except Exception:  # noqa: BLE001 — killed relay mid-poll
+                    continue
+                now = time.perf_counter()
+                for ev in evs or ():
+                    if ev.get("type") == "reset":
+                        continue
+                    key = ev.get("key", "")
+                    t0 = pub_t.get(key)
+                    if key in expect and key not in got:
+                        got.add(key)
+                        if t0 is not None:
+                            lats.append(now - t0)
+
+        def _publish(keys, pace=0.04):
+            for key in keys:
+                pub_t[key] = time.perf_counter()
+                pub.put(key, b"beat")
+                time.sleep(pace)
+
+        def _run_watchers(make_poll, expect):
+            lats, gots, threads = [], [], []
+            for w in range(k):
+                got = set()
+                gots.append(got)
+                t = threading.Thread(
+                    target=_watch_loop,
+                    args=(make_poll(w), rev0, expect, lats, got),
+                    daemon=True)
+                threads.append(t)
+                t.start()
+            time.sleep(0.3)  # let every poll park before publishing
+            marks = dict(calls)
+            _publish(expect)
+            for t in threads:
+                t.join(timeout=35.0)
+            polls = calls["store_wait_events"] \
+                - marks.get("store_wait_events", 0)
+            return lats, gots, polls
+
+        # -- direct mode: every leaf long-polls the store ---------------
+        rev0 = pub.revision()
+        d_keys = [prefix + "m%04d" % i for i in range(e)]
+
+        def _direct_poll(_w):
+            c = CoordClient([store_ep], root="bench")
+            return lambda since: c.wait_events(prefix, since, 1.0,
+                                               relay=False)
+
+        d_lats, d_gots, d_polls = _run_watchers(_direct_poll,
+                                                set(d_keys))
+        d_lost = sum(len(set(d_keys)) - len(g) for g in d_gots)
+
+        marks = dict(calls)
+        for w in range(k):
+            pub.set_server_permanent(
+                "metrics", "obs_w%03d" % w,
+                json.dumps({"schema": "obs_pub/v1", "ts": time.time(),
+                            "metrics": {}}))
+        d_obs_writes = calls["store_put"] - marks.get("store_put", 0)
+
+        # -- relay mode: a real depth-2 slice of the tree ---------------
+        root = relay_mod.WatchRelay(
+            CoordClient([store_ep], root="bench"), ids[0], branching=b,
+            register_ttl=5.0, obs_interval=3600.0)
+        root.update_tree(ids)
+        root.start(register=True)
+        mid = relay_mod.WatchRelay(
+            CoordClient([store_ep], root="bench"), ids[1], branching=b,
+            register_ttl=5.0, obs_interval=3600.0)
+        mid.update_tree(ids)
+        mid.start(register=True)
+        relay_eps = [mid.endpoint, root.endpoint]
+
+        rev0 = pub.revision()
+        r_keys = [prefix + "r%04d" % i for i in range(e)]
+        fallback = CoordClient([store_ep], root="bench")
+
+        def _make_attached_poll(att):
+            def poll(since):
+                out = att.wait_events(prefix, since, 1.0)
+                if out is None:  # no relay usable: direct fall-through
+                    return fallback.wait_events(prefix, since, 1.0,
+                                                relay=False)
+                return out
+            return poll
+
+        atts = [relay_mod.RelayAttachment(lambda: list(relay_eps),
+                                          pod_id="w%03d" % w)
+                for w in range(k)]
+        r_lats, r_gots, r_polls = _run_watchers(
+            lambda w: _make_attached_poll(atts[w]), set(r_keys))
+        r_lost = sum(len(set(r_keys)) - len(g) for g in r_gots)
+        for att in atts:
+            att.close()
+
+        obs_att = relay_mod.RelayAttachment(lambda: [mid.endpoint],
+                                            pod_id="obs-src")
+        marks = dict(calls)
+        for w in range(k):
+            obs_att.obs_publish(
+                "metrics", "obs_w%03d" % w,
+                json.dumps({"schema": "obs_pub/v1", "ts": time.time(),
+                            "metrics": {}}))
+        mid.flush_once()   # fold leaves -> push obs_agg/v1 to root
+        root.flush_once()  # fold subtree -> ONE store write
+        r_obs_writes = calls["store_put"] - marks.get("store_put", 0)
+        obs_att.close()
+
+        # -- kill drill: mid dies mid-stream; zero loss required --------
+        rev0 = pub.revision()
+        k_keys = [prefix + "k%04d" % i for i in range(kill_events)]
+        half = kill_events // 2
+        katts = [relay_mod.RelayAttachment(lambda: list(relay_eps),
+                                           pod_id="kw%03d" % w)
+                 for w in range(k)]
+        lats, gots, threads = [], [], []
+        for w in range(k):
+            got = set()
+            gots.append(got)
+            t = threading.Thread(
+                target=_watch_loop,
+                args=(_make_attached_poll(katts[w]), rev0, set(k_keys),
+                      lats, got),
+                daemon=True)
+            threads.append(t)
+            t.start()
+        time.sleep(0.3)
+        _publish(k_keys[:half])
+        deadline = time.monotonic() + 15.0
+        while (any(len(g) < half for g in gots)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        mid.stop()  # every leaf watches through mid right now
+        mid_stopped = True
+        _publish(k_keys[half:])
+        for t in threads:
+            t.join(timeout=35.0)
+        lost = sum(len(set(k_keys)) - len(g) for g in gots)
+        reattached = sum(1 for att in katts
+                         if att.current() == root.endpoint)
+        for att in katts:
+            att.close()
+
+        per_watcher = d_polls / max(1, k * e)
+        direct_rpcs = round(per_watcher * n, 1)
+        relay_rpcs = round(r_polls / max(1, e), 2)
+        return {
+            "pods": n,
+            "branching": b,
+            "depth": relay_mod.tree_depth(n, b),
+            "interior_relays": -(-max(0, n - 1) // b),
+            "watchers": k,
+            "events": e,
+            "direct": {
+                "publish_p50_ms": _pctl_ms(d_lats, 0.50),
+                "publish_p99_ms": _pctl_ms(d_lats, 0.99),
+                "sampled_store_polls": d_polls,
+                "lost_events": d_lost,
+                # each pod holds exactly one poll loop: the sampled
+                # per-watcher rate (~1 wake+rearm per event) times N
+                "store_rpcs_per_event": direct_rpcs,
+                "store_writes_per_obs_tick": round(
+                    d_obs_writes / k * n, 1),
+            },
+            "relay": {
+                "publish_p50_ms": _pctl_ms(r_lats, 0.50),
+                "publish_p99_ms": _pctl_ms(r_lats, 0.99),
+                "sampled_store_polls": r_polls,
+                # ONE root pump polls the store per tree: measured
+                # absolute, independent of N — no extrapolation
+                "store_rpcs_per_event": relay_rpcs,
+                "store_writes_per_obs_tick": r_obs_writes,
+                "lost_events": lost,
+                "kill_events": kill_events,
+                "reattached_watchers": reattached,
+            },
+            "rpc_reduction_x": round(direct_rpcs
+                                     / max(relay_rpcs, 1e-6), 1),
+            "obs_reduction_x": round((d_obs_writes / k * n)
+                                     / max(r_obs_writes, 1), 1),
+        }
+    finally:
+        if mid is not None and not mid_stopped:
+            try:
+                mid.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if root is not None:
+            try:
+                root.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        emb.stop()
+
+
+def run(writes=120, pods=2048, replicas=3, election_timeout=(0.2, 0.4),
+        seed=0, branching=None, watchers=64, watch_events=12,
+        arcs=("replication", "fleet", "fleet_watch")):
     """Hermetic replication + fleet-sim arcs -> one ``store_bench/v1``
     record (the tier-1 smoke path; ``--micro`` on the CLI).
 
@@ -131,7 +416,29 @@ def run(writes=120, pods=64, replicas=3, election_timeout=(0.2, 0.4),
     Fleet-sim arc: ``pods`` fake pods' leases kept alive from one
     process, comparing one coalesced ``lease_refresh_many`` beat
     against per-lease refresh RPCs.
+
+    Fleet-watch arc (:func:`_fleet_watch`): the relay-tree
+    direct-vs-relay comparison — store-side RPCs per membership event
+    and per obs tick, publish->leaf propagation percentiles, and the
+    zero-loss relay-kill drill.  ``arcs`` selects which sections run
+    (the schema guard runs ``("fleet_watch",)`` alone, skipping the
+    replica set entirely).
     """
+    out = {"schema": "store_bench/v1", "mode": "micro"}
+    arcs = tuple(arcs)
+    if "replication" in arcs or "fleet" in arcs:
+        out.update(_replication_and_fleet(
+            writes=writes, pods=pods, replicas=replicas,
+            election_timeout=election_timeout, seed=seed, arcs=arcs))
+    if "fleet_watch" in arcs:
+        out["fleet_watch"] = _fleet_watch(
+            pods=pods, branching=branching, watchers=watchers,
+            events=watch_events)
+    return out
+
+
+def _replication_and_fleet(writes, pods, replicas, election_timeout,
+                           seed, arcs):
     import random as _random
 
     from edl_tpu.coordination.client import CoordClient
@@ -140,7 +447,7 @@ def run(writes=120, pods=64, replicas=3, election_timeout=(0.2, 0.4),
     from edl_tpu.utils import errors
 
     _random.seed(seed)
-    out = {"schema": "store_bench/v1", "mode": "micro"}
+    out = {}
 
     reps = start_local_replica_set(replicas,
                                    election_timeout=election_timeout)
@@ -204,22 +511,23 @@ def run(writes=120, pods=64, replicas=3, election_timeout=(0.2, 0.4),
         }
 
         # fleet-sim: coalesced vs per-lease keepalive
-        lids = [c.lease_grant(30.0) for _ in range(pods)]
-        t0 = time.perf_counter()
-        res = c.lease_refresh_many(lids)
-        coalesced_ms = (time.perf_counter() - t0) * 1e3
-        t0 = time.perf_counter()
-        per = [c.lease_refresh(lid) for lid in lids]
-        per_lease_ms = (time.perf_counter() - t0) * 1e3
-        out["fleet"] = {
-            "pods": pods,
-            "refreshes_ok": sum(1 for ok in res.values() if ok),
-            "per_lease_ok": sum(1 for ok in per if ok),
-            "coalesced_ms": round(coalesced_ms, 2),
-            "per_lease_ms": round(per_lease_ms, 2),
-            "coalesce_speedup": round(per_lease_ms
-                                      / max(coalesced_ms, 1e-6), 2),
-        }
+        if "fleet" in arcs:
+            lids = [c.lease_grant(30.0) for _ in range(pods)]
+            t0 = time.perf_counter()
+            res = c.lease_refresh_many(lids)
+            coalesced_ms = (time.perf_counter() - t0) * 1e3
+            t0 = time.perf_counter()
+            per = [c.lease_refresh(lid) for lid in lids]
+            per_lease_ms = (time.perf_counter() - t0) * 1e3
+            out["fleet"] = {
+                "pods": pods,
+                "refreshes_ok": sum(1 for ok in res.values() if ok),
+                "per_lease_ok": sum(1 for ok in per if ok),
+                "coalesced_ms": round(coalesced_ms, 2),
+                "per_lease_ms": round(per_lease_ms, 2),
+                "coalesce_speedup": round(per_lease_ms
+                                          / max(coalesced_ms, 1e-6), 2),
+            }
         return out
     finally:
         for r in reps:
@@ -234,14 +542,28 @@ def main(argv=None):
     p.add_argument("--n", type=int, default=2000)
     p.add_argument("--backends", default="py,native")
     p.add_argument("--micro", action="store_true",
-                   help="hermetic 3-replica failover + fleet-sim arcs "
-                        "(one store_bench/v1 JSON line)")
+                   help="hermetic 3-replica failover + fleet-sim + "
+                        "fleet-watch arcs (one store_bench/v1 JSON "
+                        "line)")
     p.add_argument("--writes", type=int, default=120)
-    p.add_argument("--pods", type=int, default=64)
+    p.add_argument("--pods", type=int, default=2048,
+                   help="fake-fleet size for the fleet and fleet_watch "
+                        "arcs (sweepable)")
+    p.add_argument("--branch", type=int, default=None,
+                   help="relay-tree branching factor B (default: "
+                        "EDL_TPU_RELAY_BRANCH or 16)")
+    p.add_argument("--watchers", type=int, default=64,
+                   help="real threaded leaf watchers for the "
+                        "fleet_watch percentiles (capped at 64)")
+    p.add_argument("--arcs", default="replication,fleet,fleet_watch",
+                   help="comma list of micro arcs to run")
     args = p.parse_args(argv)
 
     if args.micro:
-        print(json.dumps(run(writes=args.writes, pods=args.pods)),
+        print(json.dumps(run(
+            writes=args.writes, pods=args.pods, branching=args.branch,
+            watchers=args.watchers,
+            arcs=tuple(a for a in args.arcs.split(",") if a))),
               flush=True)
         return 0
 

@@ -298,6 +298,46 @@ def test_store_bench_micro_schema():
     json.dumps(out)  # the whole report is JSON-serializable
 
 
+def test_store_bench_fleet_watch_schema():
+    """The fleet-watch arc must keep working hermetically under tier-1
+    and honor its store_bench/v1 contract at the acceptance fleet size:
+    both paths report propagation p50/p99 and store_rpcs_per_event, the
+    relay tree beats the direct fan-out by the O(log N) margin (>=8x
+    for RPCs per event AND store writes per obs tick at 2048 pods), the
+    relay-kill drill loses zero events and reattaches its watchers. No
+    latency gate — CI boxes are too noisy; the acceptance run reads
+    propagation p99 offline."""
+    import json
+
+    from edl_tpu.tools import store_bench
+
+    out = store_bench.run(pods=2048, watchers=8, watch_events=6,
+                          arcs=("fleet_watch",))
+    assert out["schema"] == "store_bench/v1"
+    assert out["mode"] == "micro"
+    fw = out["fleet_watch"]
+    assert fw["pods"] == 2048
+    assert fw["depth"] >= 2          # the drill needs a mid relay
+    assert fw["interior_relays"] >= 1
+    for path in ("direct", "relay"):
+        assert fw[path]["publish_p50_ms"] is not None
+        assert fw[path]["publish_p99_ms"] is not None
+        assert fw[path]["publish_p99_ms"] >= fw[path]["publish_p50_ms"]
+        assert fw[path]["lost_events"] == 0
+        assert fw[path]["store_rpcs_per_event"] > 0
+    # the O(log N) claim: one upstream pump per tree vs one poll loop
+    # per pod, and one folded obs write vs N flat writes
+    assert fw["relay"]["store_rpcs_per_event"] \
+        < fw["direct"]["store_rpcs_per_event"]
+    assert fw["rpc_reduction_x"] >= 8
+    assert fw["obs_reduction_x"] >= 8
+    # the relay-kill drill: lossless by since_rev resume, and the
+    # orphaned watchers re-adopted a live ancestor
+    assert fw["relay"]["kill_events"] > 0
+    assert fw["relay"]["reattached_watchers"] >= 1
+    json.dumps(out)  # the whole report is JSON-serializable
+
+
 def test_data_bench_micro_schema():
     """The elastic data-plane bench must keep working in a tiny CPU
     config under tier-1 and honor its JSON contract (schema
