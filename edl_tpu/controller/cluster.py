@@ -20,6 +20,11 @@ class Cluster(Serializable):
         self.stage = unique_name.uid()
         self.pods = []
         self.status = Status.INITIAL
+        # the generator's planned (dp, tp, pp, ep) factorization for
+        # this stage's device count ({axis: size}, or None = flat dp);
+        # rides the live-resize intent so survivors rebuild THIS mesh,
+        # and the cluster map so stop-resume restarts do too
+        self.mesh = None
 
     def new_stage(self):
         self.stage = unique_name.uid()

@@ -28,12 +28,18 @@ class Generator(object):
     def __init__(self, coord, pod_id, min_nodes, max_nodes,
                  topology_valid=None, below_min_grace=None,
                  preferred_victims=None, live_ack_timeout=10.0,
-                 scale_out_gate=None):
+                 scale_out_gate=None, mesh_planner=None):
         self._coord = coord
         self._pod_id = pod_id
         self._min = min_nodes
         self._max = max_nodes
         self._topology_valid = topology_valid or (lambda n: True)
+        # optional roofline hook (parallel/costmodel.make_planner):
+        # callable(total_devices, current_factors) -> {axis: size} or
+        # None. With it, a new world commits the best-scored legal
+        # (dp, tp, pp, ep) factorization instead of flat dp; without
+        # it, cluster.mesh stays None and nothing changes.
+        self._mesh_planner = mesh_planner
         # advisory hook (obs/health.HealthMonitor.preferred_victims):
         # when a shrink must drop pods, flagged stragglers go first
         self._preferred_victims = preferred_victims
@@ -108,7 +114,38 @@ class Generator(object):
         if new is None:
             return
         new.assign_ranks()
+        self._plan_mesh(new, current)
         self._commit(new, current=current)
+
+    @staticmethod
+    def _cluster_devices(cluster):
+        """Total accelerator count of a cluster map (trainer devices
+        when assigned, else the pod's own device list)."""
+        return sum((sum(len(t.devices) for t in p.trainers)
+                    or len(getattr(p, "devices", ()) or ()))
+                   for p in cluster.pods)
+
+    def _plan_mesh(self, new, current):
+        """Attach the planner's (dp, tp, pp, ep) choice for the new
+        world's device count. The planner sees the mesh the fleet is
+        currently ON, so its score includes the reshard cost of moving
+        away from it. Fail-open: a broken planner means flat dp, never
+        a blocked commit."""
+        if self._mesh_planner is None:
+            new.mesh = getattr(current, "mesh", None) \
+                if current is not None else None
+            return
+        cur = getattr(current, "mesh", None) \
+            if current is not None else None
+        try:
+            new.mesh = self._mesh_planner(self._cluster_devices(new),
+                                          cur)
+            if new.mesh is not None:
+                logger.info("mesh plan for stage %s: %s", new.stage,
+                            new.mesh)
+        except Exception:
+            logger.exception("mesh planner failed; committing flat dp")
+            new.mesh = None
 
     def _initial_cluster(self, resources):
         if len(resources) < self._min:
@@ -381,6 +418,7 @@ class Generator(object):
         intent = live_mod.make_intent(
             uuid.uuid4().hex, new.pod_ids(), devices=devices,
             leader=self._pod_id, cluster_json=new.to_json(),
+            mesh=getattr(new, "mesh", None),
             deadline_s=self._live_ack_timeout + 10.0)
         if not live_mod.publish_prepare(self._coord, self._pod_id, intent):
             raise errors.NotLeaderError(

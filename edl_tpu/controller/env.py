@@ -85,6 +85,18 @@ class TrainerEnv(object):
             if x != ""]
         self.cluster_stage = e.get("EDL_TPU_CLUSTER_STAGE")
         self.checkpoint_path = e.get("EDL_TPU_CHECKPOINT_PATH", "")
+        # the generator's planned mesh factorization ({axis: size}),
+        # None when no planner ran — training scripts pass it to
+        # make_mesh so a restart lands on the scored factorization
+        self.mesh = None
+        raw = e.get("EDL_TPU_MESH")
+        if raw:
+            try:
+                import json
+                self.mesh = {str(k): int(v)
+                             for k, v in json.loads(raw).items()}
+            except (ValueError, TypeError, AttributeError):
+                self.mesh = None
 
     @property
     def is_rank0(self):

@@ -14,8 +14,12 @@ The env contract (read back by edl_tpu.controller.env.TrainerEnv):
   EDL_TPU_TRAINER_ENDPOINTS                  all trainer endpoints (csv)
   EDL_TPU_LOCAL_DEVICES                      local chip indices (csv)
   EDL_TPU_CLUSTER_STAGE                      stage uuid of this incarnation
+  EDL_TPU_MESH                               planned (dp, tp, pp, ep)
+                                             factorization (json), when
+                                             the generator ran a planner
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -60,6 +64,11 @@ def start_trainers(job_env, pod, cluster, training_script, script_args,
         })
         if job_env.checkpoint_path:
             env["EDL_TPU_CHECKPOINT_PATH"] = job_env.checkpoint_path
+        if getattr(cluster, "mesh", None):
+            # the generator's planned (dp, tp, pp, ep) factorization —
+            # a stop-resume restart builds the SAME mesh the roofline
+            # scored, not a flat dp default
+            env["EDL_TPU_MESH"] = json.dumps(cluster.mesh)
         log_path = os.path.join(log_dir,
                                 "workerlog.%d" % t.rank_in_pod)
         log_file = open(log_path, "ab", buffering=0)

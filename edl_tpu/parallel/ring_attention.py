@@ -18,9 +18,9 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
+from edl_tpu.parallel.shard_map_compat import shard_map
 from edl_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 _NEG_INF = -1e30

@@ -1,0 +1,24 @@
+"""shard_map compatibility across jax versions.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the top
+level and renamed its replication-check kwarg ``check_rep`` ->
+``check_vma``. The parallel package targets the new spelling; this
+shim lets it run on an older runtime too (the CPU test environment
+pins one) instead of failing at import.
+"""
+
+try:  # jax >= 0.4.35
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: the experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, **kwargs):
+    try:
+        return _shard_map(f, **kwargs)
+    except TypeError:
+        if "check_vma" not in kwargs:
+            raise
+        kwargs = dict(kwargs)
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, **kwargs)

@@ -19,23 +19,40 @@ def _path_str(path):
                     for p in path)
 
 
-def match_partition_rules(rules, params):
+def match_partition_rules(rules, params, allow_unmatched_rules=False):
     """Return a pytree of PartitionSpec matching ``params``.
 
     rules: ordered [(regex, PartitionSpec)]; first match wins; scalars and
-    size-1 leaves are always replicated.
+    size-1 leaves are always replicated. A rule whose regex matches no
+    leaf path at all raises ValueError — a dead rule is almost always a
+    renamed module silently falling back to replicated (pass
+    ``allow_unmatched_rules=True`` for intentionally-generic tables).
     """
+    matched = [False] * len(rules)
+
     def spec_for(path, leaf):
+        name = _path_str(path)
+        hit = None
+        for i, (regex, spec) in enumerate(rules):
+            if re.search(regex, name):
+                matched[i] = True
+                if hit is None:
+                    hit = spec
         shape = getattr(leaf, "shape", ())
         if len(shape) == 0 or int(np.prod(shape)) == 1:
             return P()
-        name = _path_str(path)
-        for regex, spec in rules:
-            if re.search(regex, name):
-                return spec
-        return P()
+        return P() if hit is None else hit
 
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+    out = jax.tree_util.tree_map_with_path(spec_for, params)
+    if not allow_unmatched_rules:
+        dead = [rules[i][0] for i, m in enumerate(matched) if not m]
+        if dead:
+            raise ValueError(
+                "partition rule(s) matched no parameter path: %s — "
+                "either the module was renamed (fix the regex) or the "
+                "rule is intentionally generic (pass "
+                "allow_unmatched_rules=True)" % ", ".join(map(repr, dead)))
+    return out
 
 
 def shard_params(params, mesh, rules):
@@ -60,11 +77,17 @@ def zero1_spec(spec, shape, mesh, axis="dp"):
     into reduce-scatter + sharded update + all-gather (same bytes on the
     wire as a plain all-reduce, 1/dp the optimizer memory). Returns
     ``spec`` unchanged when nothing is divisible (falls back to the
-    param's own layout, e.g. tiny biases)."""
+    param's own layout, e.g. tiny biases).
+
+    Axes absent from ``mesh`` or sized 1 are dropped rather than
+    composed into the spec — a pure-tp/pp mesh (no ``dp`` axis at all,
+    or dp=1) degrades to "no ZeRO sharding", never to a spec naming an
+    axis the mesh does not have."""
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    axes = tuple(a for a in axes if mesh.shape.get(a, 1) > 1)
     n = 1
     for a in axes:
-        n *= mesh.shape.get(a, 1)
+        n *= mesh.shape[a]
     if n <= 1 or not shape:
         return spec
     if len(spec) > len(shape):
@@ -77,6 +100,34 @@ def zero1_spec(spec, shape, mesh, axis="dp"):
             entries[d] = axes if len(axes) > 1 else axes[0]
             return P(*entries)
     return spec
+
+
+def spec_transplant_reason(spec, shape, mesh):
+    """Why ``spec`` cannot be realized for a leaf of ``shape`` on
+    ``mesh`` — None when it can. This is the live-resize computability
+    predicate: a saved PartitionSpec transplants onto a target mesh iff
+    every axis it names exists there and every sharded dimension is
+    divisible by the product of its target axis sizes (then each target
+    device's span is computable and the span-overlap ladder applies).
+    """
+    shape = tuple(shape)
+    if len(spec) > len(shape):
+        return ("spec %s names %d dims but leaf has rank %d"
+                % (spec, len(spec), len(shape)))
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = (entry,) if isinstance(entry, str) else tuple(entry)
+        n = 1
+        for a in axes:
+            if a not in mesh.shape:
+                return ("axis %r of spec %s absent from target mesh "
+                        "axes %s" % (a, spec, tuple(mesh.axis_names)))
+            n *= mesh.shape[a]
+        if n > 1 and shape[d] % n != 0:
+            return ("dim %d of shape %s not divisible by target %s=%d "
+                    "for spec %s" % (d, shape, "*".join(axes), n, spec))
+    return None
 
 
 def opt_state_shardings(tx, params, param_shardings, default,

@@ -167,6 +167,52 @@ def _parse_spans(s):
                  for a, b in [part.split(":")])
 
 
+# -- sharding record: the saved PartitionSpec tree + mesh axes ------------
+#
+# A checkpoint's entry spans say WHERE each saved block lives; the
+# sharding record says WHY — the mesh axis names/sizes and the per-leaf
+# PartitionSpec that produced those spans. Restore never needs it
+# (PlacedTarget intersects spans against whatever target sharding the
+# caller asks for), but the resize planner does: with the record, a
+# target mesh's reshard cost and live-eligibility are computable from
+# metadata alone, before any data is read. It rides the existing
+# meta.json ("sharding" key), so legacy checkpoints simply lack it.
+
+
+def sharding_record(shardings):
+    """JSON-able record of a sharding pytree: the mesh axis names and
+    sizes plus per-leaf PartitionSpec entries keyed by path. Leaves
+    without a NamedSharding (single-device, callables) record None and
+    read back as replicated."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(shardings)
+    mesh = None
+    specs = {}
+    for path, sh in flat:
+        key = _path_key(path)
+        spec = getattr(sh, "spec", None)
+        m = getattr(sh, "mesh", None)
+        if spec is None or m is None:
+            specs[key] = None
+            continue
+        if mesh is None:
+            mesh = {"axes": [str(a) for a in m.axis_names],
+                    "shape": {str(a): int(m.shape[a])
+                              for a in m.axis_names}}
+        specs[key] = [list(e) if isinstance(e, (tuple, list)) else e
+                      for e in spec]
+    return {"mesh": mesh, "specs": specs}
+
+
+def spec_from_record(entry):
+    """PartitionSpec from one ``sharding_record`` specs entry (None or
+    missing -> fully replicated)."""
+    from jax.sharding import PartitionSpec
+    if not entry:
+        return PartitionSpec()
+    return PartitionSpec(*[tuple(e) if isinstance(e, list) else e
+                           for e in entry])
+
+
 # -- stream-format plumbing (the async snapshot/persist engine) -----------
 
 _CHUNK = 4 << 20  # fixed-size streaming chunk for entry files
@@ -471,6 +517,14 @@ class CheckpointManager(object):
                 return json.load(f).get("meta")
         except (IOError, OSError, ValueError):
             return None
+
+    def saved_sharding(self, version):
+        """The :func:`sharding_record` saved with ``version`` (meta key
+        ``"sharding"``), or None for legacy/recordless checkpoints —
+        which restore as "everything replicated" for planning purposes,
+        matching what they actually were."""
+        m = self.meta(version)
+        return m.get("sharding") if isinstance(m, dict) else None
 
     def clean_uncommitted(self):
         """Delete version dirs without a MANIFEST — garbage from crashed

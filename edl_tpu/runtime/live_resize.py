@@ -40,12 +40,18 @@ per-span FS fallback — the same ladder as a stop-resume restore, minus
 the process restart.
 
 Scope: the engine reshapes within ONE process (the JAX runtime cannot
-re-run ``jax.distributed.initialize``), so live resize applies to
-single-process trainers on a pure-dp mesh with replicated state — the
-same predicate as the AOT resize prewarm, and exactly the shape of the
-headline "resize 8→4→8" arc. Multi-process worlds keep stop-resume;
-the capability key simply never appears, and the generator's
-eligibility check falls through. See docs/elastic_resize.md.
+re-run ``jax.distributed.initialize``). Within that process the
+predicate is SPAN COMPUTABILITY, not replication: any state sharding
+whose PartitionSpecs transplant onto the target mesh (every named axis
+present, every sharded dim divisible) is in scope — a tp-degree
+change, a pp-stage re-split, or an expert re-balance is per-leaf span
+intersection like any other restore, and the intent may carry a
+``mesh`` factorization (the generator's roofline choice, see
+parallel/costmodel.py) for the trainer to rebuild. Multi-process
+worlds and hybrid (dcn) topologies keep stop-resume; the capability
+key simply never appears, and the generator's eligibility check falls
+through. See docs/elastic_resize.md for the saved-mesh × target-mesh
+support matrix.
 """
 
 import json
@@ -67,10 +73,13 @@ DEFAULT_DEADLINE_S = 30.0
 
 
 def make_intent(intent_id, survivors, devices=None, leader=None,
-                cluster_json=None, deadline_s=DEFAULT_DEADLINE_S):
+                cluster_json=None, mesh=None,
+                deadline_s=DEFAULT_DEADLINE_S):
     """The intent document. ``survivors`` are the pods/trainers that
     must ack; ``devices`` the per-survivor device target (None = keep);
-    ``cluster_json`` the new cluster map the commit installs."""
+    ``cluster_json`` the new cluster map the commit installs; ``mesh``
+    an optional {axis: size} factorization for the survivors to
+    rebuild (None = keep model axes, rescale dp)."""
     return {
         "id": str(intent_id),
         "phase": PREPARE,
@@ -78,6 +87,7 @@ def make_intent(intent_id, survivors, devices=None, leader=None,
         "devices": devices,
         "leader": leader,
         "cluster": cluster_json,
+        "mesh": mesh,
         "deadline_ts": time.time() + float(deadline_s),
         "ts": time.time(),
     }

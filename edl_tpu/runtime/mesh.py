@@ -120,6 +120,34 @@ def make_hybrid_mesh(dcn_dp=None, dp=None, tp=1, sp=1, pp=1, ep=1,
                             SEQ_AXIS, MODEL_AXIS))
 
 
+def parse_mesh_arg(s):
+    """Parse a CLI mesh factorization: ``"dp,tp"`` or ``"dp=2,tp=4"`` ->
+    {axis: size|None} suitable for ``make_mesh(**factors)``.
+
+    A bare model axis (tp/sp/pp/ep) defaults to 2; a bare ``dp`` maps to
+    None (make_mesh fills it with the remaining devices). Unknown axis
+    names raise — the CLI should fail loudly, not build a mesh the
+    trainer can't rebuild on resize."""
+    known = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS)
+    out = {}
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            axis, _, val = part.partition("=")
+            axis = axis.strip()
+            size = int(val)
+        else:
+            axis = part
+            size = None if axis == DATA_AXIS else 2
+        if axis not in known:
+            raise ValueError("unknown mesh axis %r (want one of %s)"
+                             % (axis, ", ".join(known)))
+        out[axis] = size
+    return out
+
+
 def data_sharding(mesh):
     """Batch-dim sharding over the data axes present in the mesh: dp, plus
     dcn for hybrid (multi-slice) meshes."""

@@ -633,20 +633,19 @@ class ElasticTrainer(object):
             data_sh = self._batch_sharding
             repl = self._repl
         else:
-            axes = self.mesh.axis_names
-            # the PROCESS device list, not the current mesh's: a trainer
-            # running on a shrunken sub-mesh can then prewarm the grow
-            # direction too (the 4→8 leg of the live-resize arc)
-            devices = jax.devices()
-            shape_n = tuple(world_n if a == DATA_AXIS else 1
-                            for a in axes)
-            from jax.sharding import Mesh
-            mesh_n = Mesh(np.asarray(devices[:world_n]).reshape(shape_n),
-                          axes)
+            # _target_mesh uses the PROCESS device list, not the
+            # current mesh's: a trainer running on a shrunken sub-mesh
+            # can then prewarm the grow direction too (the 4→8 leg of
+            # the live-resize arc). Model axes keep their sizes; dp
+            # absorbs the world change — the same mesh live_resize
+            # will build.
+            mesh_n = self._target_mesh(world_n)
             repl = NamedSharding(mesh_n, P())
             data_sh = NamedSharding(mesh_n, self._batch_sharding.spec)
-            state_sh = jax.tree_util.tree_map(lambda _: repl,
-                                              self._state_shardings)
+            state_sh, why = self._transplant_shardings(mesh_n)
+            if state_sh is None:
+                raise ValueError("world %d: uncomputable target "
+                                 "spans: %s" % (world_n, why))
         lowered = jax.jit(
             self._raw_step(),
             in_shardings=(state_sh, data_sh, repl),
@@ -663,17 +662,19 @@ class ElasticTrainer(object):
         return lowered, h.hexdigest()[:24]
 
     def _prewarm_in_scope(self):
+        """Same family as _live_scope_check: prewarm covers any mesh
+        the in-place reshape can rebuild (model axes welcome — the AOT
+        step is lowered with the transplanted state shardings); only
+        multi-process worlds and unreproducible topologies are out."""
         if self._example_batch_sds is None:
             return "needs the batch structure (call after a train_step)"
         if jax.process_count() > 1:
             return "multi-process world"
-        sizes = dict(self.mesh.shape)
-        if any(sizes[a] != 1 for a in self.mesh.axis_names
-               if a != DATA_AXIS):
-            return "model-parallel mesh %s" % (dict(sizes),)
-        flat = jax.tree_util.tree_leaves(self._state_shardings)
-        if not all(getattr(s, "spec", None) == P() for s in flat):
-            return "non-replicated state sharding"
+        bad = [a for a in self.mesh.axis_names
+               if a not in ("dp", "tp", "sp", "pp", "ep")]
+        if bad:
+            return ("mesh axes %s (hybrid/custom topology) cannot be "
+                    "rebuilt in place" % (bad,))
         return None
 
     def prewarm_resize_compiles(self, world_sizes, block=True):
@@ -681,11 +682,14 @@ class ElasticTrainer(object):
         the executables under EDL_TPU_COMPILE_CACHE/aot_steps, so the
         next resize restart LOADS its step instead of compiling it
         (picked up automatically at the restarted trainer's first
-        train_step). Scope: single-process trainers on a pure-dp mesh
-        with replicated state — the stop-resume workhorse. Sizes out
-        of range or not dividing the batch are skipped with a log
-        line. ``block=False`` runs on a background thread. Returns the
-        target sizes (the compiled subset when blocking)."""
+        train_step). Scope: single-process trainers on any
+        make_mesh-shaped mesh — model axes keep their sizes and dp
+        absorbs the world change, with state shardings transplanted
+        (see _live_scope_check). Sizes out of range, not divisible by
+        the model-parallel factor, or not dividing the batch are
+        skipped with a log line. ``block=False`` runs on a background
+        thread. Returns the target sizes (the compiled subset when
+        blocking)."""
         import pickle
 
         why = self._prewarm_in_scope()
@@ -711,6 +715,12 @@ class ElasticTrainer(object):
                 break
         batch_dim = jax.tree_util.tree_leaves(
             self._example_batch_sds)[0].shape[axis_index]
+        # rows split over dp only; the model-parallel factor is fixed
+        # across the resize, so world n implies dp = n / model_n
+        model_n = 1
+        for a in self.mesh.axis_names:
+            if a != DATA_AXIS:
+                model_n *= int(self.mesh.shape[a])
         targets = []
         for n in sorted(set(int(w) for w in world_sizes)):
             if n == current:
@@ -719,10 +729,15 @@ class ElasticTrainer(object):
                 logger.info("prewarm: world %d outside this process's "
                             "1..%d devices — skipped", n, len(devices))
                 continue
-            if batch_dim % n:
-                logger.info("prewarm: world %d does not divide the "
-                            "sharded batch dim %d — skipped", n,
-                            batch_dim)
+            if n % model_n:
+                logger.info("prewarm: world %d not divisible by the "
+                            "model-parallel factor %d — skipped", n,
+                            model_n)
+                continue
+            if batch_dim % (n // model_n):
+                logger.info("prewarm: world %d (dp=%d) does not divide "
+                            "the sharded batch dim %d — skipped", n,
+                            n // model_n, batch_dim)
                 continue
             targets.append(n)
 
@@ -974,29 +989,94 @@ class ElasticTrainer(object):
         for a, v in saved.items():
             setattr(self, a, v)
 
-    def _live_scope_check(self, n_devices):
-        """Reason string when an in-place reshape to ``n_devices`` is
-        impossible, else None. The same family as _prewarm_in_scope —
-        live resize and the AOT prewarm cover exactly the same shape
-        (the stop-resume workhorse: single process, pure dp,
-        replicated state)."""
+    def _target_mesh(self, n_devices, mesh_shape=None):
+        """The live-resize target mesh over the first ``n_devices``
+        process devices: ``mesh_shape`` ({axis: size} factors; dp may
+        be omitted and fills the remainder) or, by default, the current
+        mesh's model-parallel axes with dp rescaled. Raises ValueError
+        when the factorization cannot be built (non-divisible,
+        unknown axes, hybrid dcn topology)."""
+        known = ("dp", "tp", "sp", "pp", "ep")
+        if mesh_shape:
+            bad = [a for a in mesh_shape if a not in known]
+            if bad:
+                raise ValueError("target mesh axes %s not buildable "
+                                 "in place" % (bad,))
+            kw = {a: int(s) for a, s in mesh_shape.items()
+                  if a != DATA_AXIS}
+            dp = mesh_shape.get(DATA_AXIS)
+            if dp is not None:
+                kw["dp"] = int(dp)
+        else:
+            bad = [a for a in self.mesh.axis_names if a not in known]
+            if bad:
+                raise ValueError(
+                    "mesh axes %s (hybrid/custom topology) cannot be "
+                    "rebuilt in place" % (bad,))
+            kw = {a: int(self.mesh.shape[a])
+                  for a in self.mesh.axis_names if a != DATA_AXIS}
+        return make_mesh(devices=jax.devices()[:n_devices], **kw)
+
+    def _transplant_shardings(self, new_mesh, shardings=None):
+        """(shardings-on-new_mesh, reason): every state leaf's
+        PartitionSpec re-rooted onto ``new_mesh``, or (None, why) when
+        some leaf's target spans are not computable there — the reason
+        names the leaf, the spec, and the failing axis/dim, and is what
+        the fallback event journals."""
+        from edl_tpu.parallel.sharding import spec_transplant_reason
+        src = self._state_shardings if shardings is None else shardings
+        reasons = []
+
+        def move(path, sh, leaf):
+            spec = getattr(sh, "spec", None)
+            if spec is None:
+                spec = P()
+            why = spec_transplant_reason(spec, getattr(leaf, "shape",
+                                                       ()), new_mesh)
+            if why is not None:
+                reasons.append("%s: %s"
+                               % (checkpoint_mod._path_key(path), why))
+            return NamedSharding(new_mesh, spec)
+
+        out = jax.tree_util.tree_map_with_path(move, src,
+                                               self.train_state)
+        if reasons:
+            return None, "; ".join(reasons[:3])
+        return out, None
+
+    def _live_scope_check(self, n_devices, mesh_shape=None):
+        """Reason string when an in-place reshape to ``n_devices``
+        (optionally a specific ``mesh_shape`` factorization) is
+        impossible, else None. The same family as _prewarm_in_scope.
+        The predicate is span computability, not replication: any state
+        sharding whose PartitionSpecs transplant onto the target mesh
+        (axes present, dims divisible) is in scope — a tp-degree
+        change, a pp re-split, an expert re-balance all qualify; what
+        does not (multi-process worlds, hybrid topologies, indivisible
+        dims) degrades to stop-resume with the reason journaled."""
         if jax.process_count() > 1:
             return ("multi-process world (jax.distributed cannot "
                     "re-initialize in place)")
-        sizes = dict(self.mesh.shape)
-        if any(sizes[a] != 1 for a in self.mesh.axis_names
-               if a != DATA_AXIS):
-            return "model-parallel mesh %s" % (sizes,)
-        flat = jax.tree_util.tree_leaves(self._state_shardings)
-        if not all(getattr(s, "spec", None) == P() for s in flat):
-            return "non-replicated state sharding"
         n_all = len(jax.devices())
         if n_devices < 1 or n_devices > n_all:
             return ("target world %d outside this process's 1..%d "
                     "devices" % (n_devices, n_all))
-        if self.total_batch_size % n_devices:
-            return ("total batch %d not divisible by target world %d"
-                    % (self.total_batch_size, n_devices))
+        try:
+            target = self._target_mesh(n_devices, mesh_shape)
+        except ValueError as e:
+            return str(e)
+        n_rows = 1
+        spec0 = data_sharding(target).spec
+        spec0 = spec0[0] if spec0 else None
+        for ax in ((spec0,) if isinstance(spec0, str)
+                   else tuple(spec0 or ())):
+            n_rows *= target.shape[ax]
+        if self.total_batch_size % n_rows:
+            return ("total batch %d not divisible by target dp=%d"
+                    % (self.total_batch_size, n_rows))
+        _, why = self._transplant_shardings(target)
+        if why is not None:
+            return "uncomputable target spans: %s" % why
         return None
 
     def _reshard_tree(self, tree, shardings):
@@ -1023,11 +1103,14 @@ class ElasticTrainer(object):
             self_endpoint=(self._state_server.endpoint
                            if self._state_server is not None else None))
 
-    def live_resize(self, n_devices):
+    def live_resize(self, n_devices, mesh_shape=None):
         """Reshape the mesh to ``n_devices`` IN PLACE: drain the save
-        engine to a clean boundary, rebuild the dp mesh, reshard
-        params + optimizer state onto it, rebuild the step (loading the
-        prewarmed AOT executable when one exists), and resume — the
+        engine to a clean boundary, rebuild the mesh (``mesh_shape``
+        picks a (dp, tp, pp, ep) factorization — e.g. the cluster
+        generator's roofline choice — default: keep the current model
+        axes and rescale dp), transplant every state PartitionSpec onto
+        it, reshard params + optimizer state, rebuild the step (loading
+        the prewarmed AOT executable when one exists), and resume — the
         process never exits, so the kill/barrier/restore stages of the
         stop-resume budget are eliminated. Stamps a fresh
         ``_resize_timing`` record (mode "live", with the new
@@ -1050,13 +1133,22 @@ class ElasticTrainer(object):
                                    rank=self.env.global_rank,
                                    from_devices=old_n,
                                    to_devices=n_devices)
-        why = self._live_scope_check(n_devices)
+        why = self._live_scope_check(n_devices, mesh_shape)
         if why is not None:
+            # scope=True marks "rejected before any state moved" (the
+            # doctor's reshard_fallback finding), vs a mid-flight
+            # rollback below
             obs_events.emit("resize.live.fallback", cause=start_id,
                             rank=self.env.global_rank, reason=why,
+                            scope=True,
                             from_devices=old_n, to_devices=n_devices)
             raise LiveResizeError("live resize out of scope: %s" % why)
-        if n_devices == old_n:
+        same_shape = True
+        if mesh_shape:
+            same_shape = all(
+                int(self.mesh.shape.get(a, 1)) == int(s)
+                for a, s in mesh_shape.items())
+        if n_devices == old_n and same_shape:
             obs_events.emit("resize.live.done", cause=start_id,
                             rank=self.env.global_rank, noop=True,
                             from_devices=old_n, to_devices=n_devices)
@@ -1079,14 +1171,17 @@ class ElasticTrainer(object):
             self.wait_for_save()
             drain_s = time.perf_counter() - t0
             t1 = time.perf_counter()
-            new_mesh = make_mesh(devices=jax.devices()[:n_devices])
+            new_mesh = self._target_mesh(n_devices, mesh_shape)
             if faults.PLANE is not None:
                 faults.PLANE.fire("resize.live.reshard",
                                   from_devices=str(old_n),
                                   to_devices=str(n_devices))
+            new_shardings, why_t = self._transplant_shardings(
+                new_mesh, saved["_state_shardings"])
+            if new_shardings is None:
+                raise LiveResizeError(
+                    "uncomputable target spans: %s" % why_t)
             self._bind_mesh(new_mesh)
-            new_shardings = jax.tree_util.tree_map(
-                lambda _: self._repl, saved["_state_shardings"])
             self.train_state, reshard_stats = self._reshard_tree(
                 self.train_state, new_shardings)
             self._state_shardings = new_shardings
@@ -1129,6 +1224,9 @@ class ElasticTrainer(object):
             "drain_s": round(drain_s, 6),
             "reshard_s": round(reshard_s, 6),
             "from_devices": old_n, "to_devices": n_devices,
+            "from_mesh": {str(a): int(s) for a, s in
+                          zip(saved["mesh"].axis_names,
+                              saved["mesh"].devices.shape)},
             "prewarm": prewarm,
             "restore_source": reshard_stats["source"],
             "restore_bytes": (reshard_stats["local_bytes"]
@@ -1194,13 +1292,15 @@ class ElasticTrainer(object):
         target = rec.get("devices")
         if isinstance(target, dict):
             target = target.get(self._live_who)
+        mesh_shape = rec.get("mesh")  # generator's factorization, opt.
         ok, reason, info = False, None, None
         try:
             if target is None:
                 raise LiveResizeError(
                     "intent %s carries no device target for %s"
                     % (intent_id, self._live_who))
-            stats = self.live_resize(int(target))
+            stats = self.live_resize(int(target),
+                                     mesh_shape=mesh_shape)
             ok = True
             info = {"world": stats.get("to_devices"),
                     "reshard_s": stats.get("reshard_s"),
@@ -1653,7 +1753,13 @@ class ElasticTrainer(object):
         # must not see the live State's nested dicts mutating under it
         import json
         state_snapshot = json.loads(self.state.to_json())
-        meta = {"state": state_snapshot}
+        # the sharding record (PartitionSpec tree + mesh axes) rides
+        # meta.json through every save path — restore never needs it
+        # (span intersection works blind) but the resize planner reads
+        # it to cost a target mesh before touching any data
+        meta = {"state": state_snapshot,
+                "sharding": checkpoint_mod.sharding_record(
+                    self._state_shardings)}
 
         self.wait_for_save()
         # peer restore plane: capture SEPARATE host copies of this
@@ -1806,6 +1912,10 @@ class ElasticTrainer(object):
         # MetricsPublisher, so this doc is how measure_resize (and the
         # pause-agreement test) reads the worker's time attribution
         doc = dict(self._resize_timing)
+        # the CURRENT mesh factorization, so the driver can tell a
+        # dp-only record from a dp x tp one without parsing shardings
+        doc["mesh"] = {str(a): int(self.mesh.shape[a])
+                       for a in self.mesh.axis_names}
         doc["ledger"] = {s: round(v, 6) for s, v
                         in obs_ledger.LEDGER.totals().items()}
         try:

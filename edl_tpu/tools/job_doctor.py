@@ -53,7 +53,7 @@ from edl_tpu.tools.job_stats import format_autopilot
 _DETECTOR_RANK = {"flight_recorder": 0, "stale_publisher": 1,
                   "straggler": 2, "slo_burn": 3, "breaker_flap": 4,
                   "queue_saturation": 5, "live_resize_fallback": 6,
-                  "prewarm_miss": 7}
+                  "reshard_fallback": 7, "prewarm_miss": 8}
 
 
 def collect(coord):
@@ -153,30 +153,49 @@ def _live_resize_findings(obs, timeline):
       in-place resize rolled back and the job paid a full stop-resume;
       the chain links the fallback to its ``resize.live.start`` via the
       event's cause id and names the reason.
+    - reshard_fallback: a fallback whose event carries ``scope=True`` —
+      the trainer's ``_live_scope_check`` rejected the target BEFORE any
+      state moved (uncomputable target spans, hybrid mesh, batch not
+      divisible...); the summary names the exact rejection reason so the
+      operator can fix the factorization rather than the rollback path.
     - prewarm_miss: prewarm-scope first steps paid a full compile and
       none ever loaded an AOT artifact — the compile cache is cold or
       unconfigured, so every resize (live or not) eats compile_s."""
     findings = []
     falls = [e for e in timeline
              if e.get("kind") == "resize.live.fallback"]
-    if falls:
-        last = falls[-1]
+
+    def _fall_finding(last, detector, summary):
         attrs = last.get("attrs") or {}
         cause = last.get("cause")
         evidence = [e for e in timeline
                     if e is last
                     or (cause is not None and e.get("id") == cause
                         and e.get("pod") == last.get("pod"))]
-        findings.append({
+        return {
             "pod": last.get("pod"),
-            "detector": "live_resize_fallback",
+            "detector": detector,
             "severity": "warn",
-            "summary": ("live resize fell back to stop-resume: %s"
-                        % (attrs.get("reason") or "unknown reason")),
+            "summary": summary % (attrs.get("reason")
+                                  or "unknown reason"),
             "events": evidence,
             "event_ids": [i for i in (cause, last.get("id"))
                           if i is not None],
-        })
+        }
+
+    # scope=True = rejected up front by _live_scope_check; everything
+    # else is a mid-flight rollback — distinct findings, distinct fixes
+    scoped = [e for e in falls if (e.get("attrs") or {}).get("scope")]
+    rolled = [e for e in falls if not (e.get("attrs") or {}).get("scope")]
+    if scoped:
+        findings.append(_fall_finding(
+            scoped[-1], "reshard_fallback",
+            "cross-mesh reshard out of scope, resize degraded to "
+            "stop-resume: %s"))
+    if rolled:
+        findings.append(_fall_finding(
+            rolled[-1], "live_resize_fallback",
+            "live resize fell back to stop-resume: %s"))
     hits = _counter_total(obs, "edl_resize_prewarm_hits_total")
     misses = _counter_total(obs, "edl_resize_prewarm_misses_total")
     if misses and not hits:

@@ -552,6 +552,8 @@ def _spawn_worker(store_endpoint, job_id, log_dir, args, n_devices,
         cmd += ["--prewarm_worlds", prewarm_worlds]
     if ckpt:
         cmd += ["--ckpt", ckpt]
+    if getattr(args, "mesh", ""):
+        cmd += ["--mesh", args.mesh]
     proc = subprocess.Popen(cmd, env=env, stdout=log,
                             stderr=subprocess.STDOUT,
                             preexec_fn=os.setsid)
@@ -582,10 +584,11 @@ def _wait_worker_step(coord, pred, timeout, proc=None):
                        % timeout)
 
 
-def _drive_live_resize(coord, who, n_devices, timeout):
+def _drive_live_resize(coord, who, n_devices, timeout, mesh=None):
     """Publish a prepare intent for ``who`` → wait for the ack → commit;
     returns (t_intent, timing_rec). The caller must hold the leader key
-    as 'bench_driver'."""
+    as 'bench_driver'. ``mesh`` ({axis: size}) rides the intent so the
+    worker rebuilds that factorization instead of pure dp."""
     import uuid
 
     from edl_tpu.runtime import live_resize as live_mod
@@ -593,7 +596,7 @@ def _drive_live_resize(coord, who, n_devices, timeout):
     t_intent = time.time()
     intent = live_mod.make_intent(uuid.uuid4().hex, [who],
                                   devices=int(n_devices),
-                                  leader="bench_driver",
+                                  leader="bench_driver", mesh=mesh,
                                   deadline_s=timeout)
     if not live_mod.publish_prepare(coord, "bench_driver", intent):
         raise RuntimeError("bench driver does not hold the leader key")
@@ -635,8 +638,16 @@ def run_live_arc(args):
                           worker)
         coord.set_server_permanent(C.SERVICE_LEADER, C.LEADER_SERVER,
                                    "bench_driver")
+        # a sharded arc (--mesh dp,tp) pins the model axes on the
+        # intent; dp is left to the trainer to fill from the world size
+        intent_mesh = None
+        if getattr(args, "mesh", ""):
+            from edl_tpu.runtime.mesh import parse_mesh_arg
+            intent_mesh = {a: s for a, s in
+                           parse_mesh_arg(args.mesh).items()
+                           if a != "dp" and s} or None
         t_intent, rec = _drive_live_resize(coord, "bench_worker", n_lo,
-                                           wait_s)
+                                           wait_s, mesh=intent_mesh)
         pause = rec["t_first_step"] - rec["t_resume_start"]
         breakdown = {
             "detect_s": max(0.0, rec["t_resume_start"] - t_intent),
@@ -652,7 +663,7 @@ def run_live_arc(args):
                    "version": rec.get("version")}
         # grow back to the full world: same process, second intent
         _, rec_up = _drive_live_resize(coord, "bench_worker", n_hi,
-                                       wait_s)
+                                       wait_s, mesh=intent_mesh)
         alive = worker.poll() is None
         out = _peer_result(
             tag, args, "live", pause, breakdown, restore,
@@ -660,10 +671,12 @@ def run_live_arc(args):
             prewarm=rec.get("prewarm"),
             drain_s=round(rec.get("drain_s", 0.0), 3),
             ledger=rec.get("ledger"),
+            mesh=rec.get("mesh"), from_mesh=rec.get("from_mesh"),
             process_survived=alive,
             grow={"to_devices": n_hi,
                   "pause_s": round(rec_up["t_first_step"]
                                    - rec_up["t_resume_start"], 3),
+                  "mesh": rec_up.get("mesh"),
                   "prewarm": rec_up.get("prewarm")})
         if not alive:
             out["warning"] = ("worker process exited during the live "
@@ -738,7 +751,7 @@ def run_stop_resume_arc(args):
             breakdown, restore, from_devices=n_hi, to_devices=n_lo,
             pause_in_process_s=round(
                 rec["t_first_step"] - rec["t_resume_start"], 3),
-            ledger=rec.get("ledger"))
+            mesh=rec.get("mesh"), ledger=rec.get("ledger"))
     finally:
         if worker is not None:
             _kill_group(worker)
@@ -767,6 +780,11 @@ def main(argv=None):
     p.add_argument("--from_devices", type=int, default=2,
                    help="resize arcs shrink from this world to half "
                         "of it (8 for the queued TPU run)")
+    p.add_argument("--mesh", default="",
+                   help='worker mesh factorization for the live/'
+                        'stop_resume arcs, e.g. "dp,tp" — the model '
+                        "axes ride the resize intent so the shrunken "
+                        "world keeps them (sharded-state arcs)")
     p.add_argument("--micro", action="store_true",
                    help="peer_restore arcs only: hermetic in-process "
                         "restore-path timing instead of the full pod "

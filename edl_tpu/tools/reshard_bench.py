@@ -1,0 +1,207 @@
+"""Cross-mesh reshard benchmark: live device_put reshard vs stop-resume
+restore, over the mesh-transition arcs the elastic trainer takes.
+
+Each arc moves ONE sharded state tree from a source mesh factorization to
+a target factorization two ways:
+
+  live         the trainer's single-process fast path — one
+               ``jax.device_put`` onto the transplanted shardings, where
+               every target block that already lives on the right device
+               moves zero bytes over the wire
+  stop_resume  ``CheckpointManager.restore_placed`` from a committed
+               stream checkpoint — the wholesale path a fallback takes
+
+The result is gated byte-identical: the live tree, the restored tree and
+the original host tree must match bit-for-bit or the arc fails (rc 1).
+Byte volumes come from the analytic model
+(:func:`edl_tpu.parallel.costmodel.tree_reshard_bytes`): ``bytes_moved``
+is the over-the-wire volume after same-device overlap credit and
+``bytes_needed`` the wholesale-restore volume it is saved against.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m edl_tpu.tools.reshard_bench
+
+Emits one JSON line per arc (schema "reshard_bench/v1"):
+    arc             dp_to_dp_tp | tp_change | pp_resplit
+    from_mesh/to_mesh   {axis: size} factorizations (non-trivial axes)
+    state_bytes     total tree bytes
+    bytes_moved     analytic wire bytes for the live reshard
+    bytes_needed    analytic wholesale-restore bytes
+    live_pause_s / stop_resume_s   measured wall times
+    byte_identical  live == stop_resume == original, bit-exact
+    saved_record    checkpoint carried the sharding record (meta)
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+# the bench runs jax in-process; when nothing imported jax yet, pin the
+# virtual-CPU world BEFORE the first import (a test harness that already
+# initialized jax keeps its own device world)
+if "jax" not in sys.modules:
+    from edl_tpu.utils.cpu_mesh import force_cpu_env
+    force_cpu_env(os.environ, 8)
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from edl_tpu.parallel import costmodel
+from edl_tpu.runtime.checkpoint import CheckpointManager, sharding_record
+from edl_tpu.runtime.mesh import make_mesh
+
+# every arc runs on this many devices at both ends — the factorization
+# changes, the world does not (a pure reshard, no membership change)
+WORLD = 4
+
+# leaves: name -> (shape_fn(dim, layers), PartitionSpec). The specs are
+# the LOGICAL layout (Megatron-style kernels + zero1 moments); a reshard
+# keeps the spec and changes the mesh under it, exactly like
+# trainer._transplant_shardings.
+_FLAT = {
+    "w": (lambda d, L: (d, d), P()),              # replicated params
+    "m": (lambda d, L: (d, d), P("dp")),          # zero1 moment row-shard
+    "k": (lambda d, L: (d, d), P(None, "tp")),    # tp-sharded kernel
+}
+_STACKED = {
+    "w": (lambda d, L: (d, d), P()),
+    "blocks": (lambda d, L: (L, d, d), P("pp")),  # per-stage params
+    "blocks_m": (lambda d, L: (L, d, d), P("pp", "dp")),
+}
+
+ARCS = (
+    # pure-dp world grows a tp axis: the moment re-rows, the kernel and
+    # replicated params slice locally (zero wire)
+    {"arc": "dp_to_dp_tp", "src": {"dp": 4}, "dst": {"dp": 2, "tp": 2},
+     "leaves": _FLAT},
+    # tp degree change: kernels re-column, the moment de-shards
+    {"arc": "tp_change", "src": {"dp": 2, "tp": 2},
+     "dst": {"dp": 1, "tp": 4}, "leaves": _FLAT},
+    # pipeline re-split: aligned stage halves keep their blocks local
+    {"arc": "pp_resplit", "src": {"pp": 2, "dp": 2},
+     "dst": {"pp": 4, "dp": 1}, "leaves": _STACKED},
+)
+
+
+def _build_tree(leaves, dim, layers, seed=0):
+    rng = np.random.RandomState(seed)
+    return {name: rng.rand(*shape_fn(dim, layers)).astype(np.float32)
+            for name, (shape_fn, _) in sorted(leaves.items())}
+
+
+def _shardings(leaves, mesh):
+    return {name: NamedSharding(mesh, spec)
+            for name, (_, spec) in sorted(leaves.items())}
+
+
+def _tree_bytes(tree):
+    return int(sum(np.asarray(x).nbytes
+                   for x in jax.tree_util.tree_leaves(tree)))
+
+
+def _host_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    if len(fa) != len(fb):
+        return False
+    for va, vb in zip(fa, fb):
+        va = np.asarray(jax.device_get(va))
+        vb = np.asarray(jax.device_get(vb))
+        if va.dtype != vb.dtype or va.shape != vb.shape \
+                or va.tobytes() != vb.tobytes():
+            return False
+    return True
+
+
+def run_arc(arc, dim=16, layers=8):
+    devices = jax.devices()[:WORLD]
+    src_mesh = make_mesh(devices=devices, **arc["src"])
+    dst_mesh = make_mesh(devices=devices, **arc["dst"])
+    leaves = arc["leaves"]
+    tree = _build_tree(leaves, dim, layers)
+    src_sh = _shardings(leaves, src_mesh)
+    dst_sh = _shardings(leaves, dst_mesh)
+    placed = jax.device_put(tree, src_sh)
+    jax.block_until_ready(placed)
+
+    tmp = tempfile.mkdtemp(prefix="reshard_bench_")
+    try:
+        # committed stream checkpoint carrying the sharding record — the
+        # same artifact a live reshard's fallback would restore from
+        ckpt = CheckpointManager(tmp, keep=1)
+        ckpt.save_async(1, placed,
+                        meta={"sharding": sharding_record(src_sh)}).result()
+        saved_record = ckpt.saved_sharding(1) is not None
+
+        t0 = time.perf_counter()
+        live = jax.device_put(placed, dst_sh)
+        jax.block_until_ready(live)
+        live_pause_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        _, restored, _ = ckpt.restore_placed(1, tree, dst_sh)
+        jax.block_until_ready(restored)
+        stop_resume_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    cm_leaves = [(shape_fn(dim, layers), 4, tuple(spec), tuple(spec))
+                 for _, (shape_fn, spec) in sorted(leaves.items())]
+    moved, needed = costmodel.tree_reshard_bytes(
+        cm_leaves, costmodel.mesh_axes(arc["src"]),
+        costmodel.mesh_axes(arc["dst"]))
+
+    identical = _host_equal(live, restored) and _host_equal(live, tree)
+    return {
+        "schema": "reshard_bench/v1",
+        "arc": arc["arc"],
+        "from_mesh": dict(arc["src"]),
+        "to_mesh": dict(arc["dst"]),
+        "world": WORLD,
+        "state_bytes": _tree_bytes(tree),
+        "bytes_moved": moved,
+        "bytes_needed": needed,
+        "live_pause_s": round(live_pause_s, 6),
+        "stop_resume_s": round(stop_resume_s, 6),
+        "byte_identical": identical,
+        "saved_record": saved_record,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        "live cross-mesh reshard vs stop-resume restore")
+    p.add_argument("--arcs", default=",".join(a["arc"] for a in ARCS))
+    p.add_argument("--dim", type=int, default=16,
+                   help="square leaf dimension (divisible by every "
+                        "axis degree the arcs use)")
+    p.add_argument("--layers", type=int, default=8,
+                   help="stacked-leaf leading dim for the pp arc")
+    args = p.parse_args(argv)
+    by_name = {a["arc"]: a for a in ARCS}
+    rc = 0
+    for name in args.arcs.split(","):
+        name = name.strip()
+        if not name:
+            continue
+        try:
+            arc = by_name[name]
+            out = run_arc(arc, dim=args.dim, layers=args.layers)
+            if not out["byte_identical"] or not out["saved_record"] \
+                    or out["bytes_moved"] > out["bytes_needed"]:
+                rc = 1
+        except Exception as e:  # noqa: BLE001
+            out = {"schema": "reshard_bench/v1", "arc": name,
+                   "error": repr(e)}
+            rc = 1
+        print(json.dumps(out), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

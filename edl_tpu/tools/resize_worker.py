@@ -33,6 +33,9 @@ def main(argv=None):
     p.add_argument("--who", default="bench_worker")
     p.add_argument("--n_devices", type=int, required=True,
                    help="initial mesh size (first n of jax.devices())")
+    p.add_argument("--mesh", default="",
+                   help='mesh factorization over the devices, e.g. '
+                        '"dp,tp" or "dp=2,tp=2" (default: pure dp)')
     p.add_argument("--total_batch", type=int, default=64)
     p.add_argument("--steps", type=int, default=1000000)
     p.add_argument("--save_every", type=int, default=5)
@@ -47,17 +50,26 @@ def main(argv=None):
     import jax
     import optax
 
+    from jax.sharding import PartitionSpec as P
+
     from edl_tpu.controller import constants
     from edl_tpu.coordination.client import CoordClient
     from edl_tpu.models import linear
-    from edl_tpu.runtime.mesh import make_mesh
+    from edl_tpu.runtime.mesh import make_mesh, parse_mesh_arg
     from edl_tpu.runtime.trainer import ElasticTrainer
 
     coord = CoordClient(args.store_endpoints.split(","), root=args.job_id)
-    mesh = make_mesh(devices=jax.devices()[:args.n_devices])
+    factors = parse_mesh_arg(args.mesh) if args.mesh else {}
+    mesh = make_mesh(devices=jax.devices()[:args.n_devices], **factors)
+    # model-parallel meshes shard w over tp; the housing feature dim 13
+    # is prime, so sharded runs pad the fixture up to a divisible 16
+    tp = mesh.shape.get("tp", 1)
+    feature_dim = 16 if tp > 1 else 13
+    param_shardings = [(r"^w$", P("tp"))] if tp > 1 else None
     trainer = ElasticTrainer(
-        linear.loss_fn, linear.init_params(), optax.sgd(0.05),
+        linear.loss_fn, linear.init_params(feature_dim), optax.sgd(0.05),
         total_batch_size=args.total_batch, mesh=mesh, coord=coord,
+        param_shardings=param_shardings,
         checkpoint_dir=args.ckpt or None,
         async_save=bool(args.ckpt))
     resumed = trainer.resume() if args.ckpt else False
@@ -65,7 +77,8 @@ def main(argv=None):
     print("worker up: pid=%d world=%d resumed=%s" %
           (os.getpid(), args.n_devices, resumed), flush=True)
 
-    batch = linear.synthetic_batch(args.total_batch, seed=0)
+    batch = linear.synthetic_batch(args.total_batch,
+                                   feature_dim=feature_dim, seed=0)
     prewarmed = False
     for step in range(args.steps):
         trainer.train_step(trainer.local_batch_slice(batch))

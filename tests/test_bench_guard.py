@@ -674,3 +674,74 @@ def test_serve_bench_micro_schema():
     assert out["clean"]["stranded"] == 0
 
     json.dumps(out)  # the whole report is JSON-serializable
+
+
+def test_reshard_bench_cpu_schema(capsys):
+    """Tier-1 pin of the cross-mesh reshard bench contract (schema
+    reshard_bench/v1): every arc must be byte-identical to stop-resume
+    and carry a sharding record, and the headline dp->dp x tp arc must
+    move strictly fewer bytes than a wholesale restore of the state —
+    but not zero (the dp-sharded moment really re-rows). No live-vs-
+    stop_resume timing gate — CI boxes are too noisy; the acceptance
+    run compares the pause columns offline."""
+    import json
+
+    from edl_tpu.tools import reshard_bench
+
+    rc = reshard_bench.main([])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == len(reshard_bench.ARCS)
+    by_arc = {}
+    for line in lines:
+        out = json.loads(line)
+        assert "error" not in out, out
+        assert out["schema"] == "reshard_bench/v1"
+        assert out["byte_identical"] is True
+        assert out["saved_record"] is True
+        assert out["live_pause_s"] > 0
+        assert out["stop_resume_s"] > 0
+        assert 0 <= out["bytes_moved"] <= out["bytes_needed"]
+        assert out["state_bytes"] > 0
+        by_arc[out["arc"]] = out
+    assert set(by_arc) == {"dp_to_dp_tp", "tp_change", "pp_resplit"}
+    # the headline acceptance gate
+    arc = by_arc["dp_to_dp_tp"]
+    assert arc["from_mesh"] == {"dp": 4}
+    assert arc["to_mesh"] == {"dp": 2, "tp": 2}
+    assert 0 < arc["bytes_moved"] < arc["state_bytes"]
+    # every arc keeps some state in place — the overlap fast path is
+    # doing work (moved strictly under the wholesale volume)
+    for out in by_arc.values():
+        assert out["bytes_moved"] < out["bytes_needed"]
+
+
+def test_measure_resize_live_sharded_arc_mesh_records(capsys):
+    """The sharded live arc: a dp x tp worker (--mesh dp,tp) is resized
+    4->2->4 through the 2PC with the tp axis pinned on the intent; the
+    worker must keep tp=2 across both transitions, survive in place,
+    and publish its mesh shape in every resize_timing record (the
+    from_mesh/mesh pair in the emitted bench record)."""
+    import json
+
+    from edl_tpu.tools import measure_resize
+
+    rc = measure_resize.main(["--arcs", "live", "--platform", "cpu",
+                              "--from_devices", "4", "--mesh", "dp,tp",
+                              "--timeout", "120"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "error" not in out and "warning" not in out, out
+    assert out["schema"] == "resize_bench/v1"
+    assert out["mode"] == "live"
+    assert out["process_survived"] is True
+    # the worker started on dp=2 x tp=2 and shrank to dp=1 x tp=2:
+    # tp rides the intent, dp absorbs the world change
+    assert out["from_mesh"]["dp"] == 2 and out["from_mesh"]["tp"] == 2
+    assert out["mesh"]["dp"] == 1 and out["mesh"]["tp"] == 2
+    # ...and grew back to the full factorization in the same process
+    assert out["grow"]["mesh"]["dp"] == 2
+    assert out["grow"]["mesh"]["tp"] == 2
+    json.dumps(out)  # round-trips

@@ -564,3 +564,127 @@ def test_job_doctor_live_resize_findings():
     assert "doctor-local" in report["summary"]
     json.dumps(report)
     job_doctor.render(report)  # the human surface renders the chains
+
+
+# -- cross-mesh (model-parallel) transitions -------------------------------
+
+
+def _tp_trainer(n_devices, mesh_shape=None, ckpt=None, feature_dim=16):
+    """A trainer whose w is tp-sharded by rule (replicated while tp=1),
+    so the SAME param table rides every factorization of the world."""
+    from jax.sharding import PartitionSpec as P
+    kw = dict(mesh_shape or {})
+    return ElasticTrainer(
+        linear.loss_fn, linear.init_params(feature_dim), optax.sgd(0.05),
+        total_batch_size=TOTAL_BATCH,
+        mesh=make_mesh(devices=jax.devices()[:n_devices], **kw),
+        param_shardings=[(r"^w$", P("tp"))],
+        checkpoint_dir=ckpt)
+
+
+def _tp_batches(n=6, feature_dim=16):
+    return [linear.synthetic_batch(TOTAL_BATCH, feature_dim=feature_dim,
+                                   seed=i) for i in range(n)]
+
+
+def test_live_resize_dp_to_dp_tp_byte_identical(tmp_path):
+    """The tentpole arc at trainer level: a pure-dp world live-reshards
+    onto a dp x tp factorization of the SAME device count (the intent's
+    mesh_shape), byte-identical to a stop-resume over the same mesh
+    sequence, and the record carries both factorizations."""
+    batches = _tp_batches()
+    live = _tp_trainer(4)
+    _steps(live, batches[:2])
+    rec = live.live_resize(4, mesh_shape={"dp": 2, "tp": 2})
+    assert rec["mode"] == "live"
+    assert rec["from_mesh"]["dp"] == 4 and rec["from_mesh"]["tp"] == 1
+    assert _world(live) == 4
+    assert live.mesh.shape["dp"] == 2 and live.mesh.shape["tp"] == 2
+    # w really is tp-sharded on the new mesh
+    assert live.train_state["params"]["w"].sharding.spec[0] == "tp"
+    _steps(live, batches[2:4])
+
+    ckpt = str(tmp_path / "ckpt")
+    a = _tp_trainer(4, ckpt=ckpt)
+    _steps(a, batches[:2])
+    a.save()
+    b = _tp_trainer(4, mesh_shape={"dp": 2, "tp": 2}, ckpt=ckpt)
+    assert b.resume()
+    _steps(b, batches[2:4])
+    assert _state_bytes(live) == _state_bytes(b)
+
+    # and back down to pure dp in the same process
+    rec_back = live.live_resize(4, mesh_shape={"dp": 4})
+    assert rec_back["mode"] == "live"
+    assert live.mesh.shape["tp"] == 1
+    _steps(live, batches[4:5])
+
+
+def test_live_resize_tp_change_with_world_shrink():
+    """World 4 -> 2 while keeping tp=2: dp absorbs the change (the
+    default when no mesh_shape rides the intent), single process."""
+    batches = _tp_batches()
+    tr = _tp_trainer(4, mesh_shape={"dp": 2, "tp": 2})
+    _steps(tr, batches[:2])
+    tr.live_resize(2)  # no mesh_shape: model axes carry over
+    assert _world(tr) == 2
+    assert tr.mesh.shape["tp"] == 2 and tr.mesh.shape["dp"] == 1
+    _steps(tr, batches[2:3])
+    tr.live_resize(4, mesh_shape={"dp": 2, "tp": 2})
+    assert tr.mesh.shape["dp"] == 2
+    _steps(tr, batches[3:4])
+
+
+def test_live_resize_uncomputable_spans_fallback_names_reason():
+    """A target factorization whose spans are NOT computable (w dim 14
+    divides tp=2 but not tp=4) must be rejected up front: state
+    untouched, LiveResizeError raised, and the fallback event carrying
+    scope=True + the exact per-leaf reason — the contract the doctor's
+    reshard_fallback detector reads."""
+    batches = _tp_batches(feature_dim=14)
+    tr = _tp_trainer(4, mesh_shape={"dp": 2, "tp": 2}, feature_dim=14)
+    _steps(tr, batches[:1])
+    before = _state_bytes(tr)
+    mark = obs_events.emit("test.reshard_scope.mark")
+    with pytest.raises(LiveResizeError, match="uncomputable target"):
+        tr.live_resize(4, mesh_shape={"dp": 1, "tp": 4})
+    assert _world(tr) == 4
+    assert tr.mesh.shape["tp"] == 2          # untouched factorization
+    assert _state_bytes(tr) == before
+    falls = [e for e in obs_events.EVENTS.snapshot(since_id=mark)
+             if e["kind"] == "resize.live.fallback"]
+    assert falls and falls[-1]["attrs"]["scope"] is True
+    reason = falls[-1]["attrs"]["reason"]
+    assert "uncomputable target spans" in reason
+    assert "not divisible" in reason
+    _steps(tr, batches[1:2])  # still training on the old mesh
+
+
+def test_job_doctor_reshard_fallback_finding():
+    """scope=True fallbacks get their own detector, ranked apart from
+    mid-flight rollbacks, with the _live_scope_check reason verbatim in
+    the summary."""
+    from edl_tpu.tools import job_doctor
+    reason = ("uncomputable target spans: params/w: dim 0 of shape "
+              "(14,) not divisible by target tp=4 for spec "
+              "PartitionSpec('tp',)")
+    events = [
+        {"id": 1, "ts": 100.0, "kind": "resize.live.start", "cause": None,
+         "attrs": {"from_devices": 4, "to_devices": 4}},
+        {"id": 2, "ts": 101.0, "kind": "resize.live.fallback", "cause": 1,
+         "attrs": {"reason": reason, "scope": True,
+                   "from_devices": 4, "to_devices": 4}},
+    ]
+    obs_doc = {"schema": "obs_pub/v1", "events": events, "metrics": {}}
+    report = job_doctor.diagnose({"job_id": "j", "job_status": None,
+                                  "health": None,
+                                  "obs": {"pod-00": obs_doc}})
+    assert [f["detector"] for f in report["findings"]] == [
+        "reshard_fallback"]
+    f = report["findings"][0]
+    assert f["pod"] == "pod-00"
+    assert "uncomputable target spans" in f["summary"]
+    assert "not divisible" in f["summary"]   # the EXACT reason, verbatim
+    assert any("resize.live.start" in step for step in f["chain"])
+    json.dumps(report)
+    job_doctor.render(report)

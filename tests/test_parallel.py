@@ -131,3 +131,121 @@ def test_shard_params_places_on_mesh():
     # tp=2 → each device holds half the columns
     assert sharded["w"].addressable_shards[0].data.shape == (8, 3)
     assert sharded["b"].sharding.spec == P()
+
+
+def test_match_partition_rules_scalars_never_partitioned():
+    """A rule that matches a scalar or size-1 leaf must not shard it —
+    and the match still counts, so an all-scalar table is not "dead"."""
+    params = {"step": np.zeros(()), "gain": np.ones((1,)),
+              "w": np.zeros((4, 4))}
+    specs = match_partition_rules([(r".*", P("tp", None))], params)
+    assert specs["step"] == P()
+    assert specs["gain"] == P()
+    assert specs["w"] == P("tp", None)
+    # matched only by scalars: still matched, no dead-rule error
+    assert match_partition_rules([(r"step", P("dp"))],
+                                 {"step": np.zeros(())})["step"] == P()
+
+
+def test_match_partition_rules_first_match_wins():
+    params = {"attn": {"kernel": np.zeros((4, 4))},
+              "mlp": {"kernel": np.zeros((4, 4))}}
+    rules = [
+        (r"attn/kernel", P(None, "tp")),
+        (r"kernel", P("tp", None)),       # generic fallback, ordered last
+    ]
+    specs = match_partition_rules(rules, params)
+    assert specs["attn"]["kernel"] == P(None, "tp")  # NOT the fallback
+    assert specs["mlp"]["kernel"] == P("tp", None)
+
+
+def test_match_partition_rules_dead_rule_raises():
+    """A rule matching no path is a renamed module silently falling
+    back to replicated — it must raise, with the regex named, unless
+    explicitly allowed."""
+    params = {"mlp": {"kernel": np.zeros((4, 4))}}
+    rules = [(r"mlp/kernel", P(None, "tp")),
+             (r"attn/qkv/kernel", P("tp", None))]
+    with pytest.raises(ValueError, match=r"attn/qkv/kernel"):
+        match_partition_rules(rules, params)
+    specs = match_partition_rules(rules, params,
+                                  allow_unmatched_rules=True)
+    assert specs["mlp"]["kernel"] == P(None, "tp")
+
+
+def test_zero1_spec_mesh_without_dp_axis():
+    """A mesh that has NO dp axis at all (hand-built pure-tp Mesh):
+    zero1 must degrade to the param layout, never emit a spec naming an
+    axis the mesh lacks."""
+    from jax.sharding import Mesh
+
+    from edl_tpu.parallel.sharding import zero1_spec
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    assert "dp" not in mesh.shape
+    assert zero1_spec(P(), (8, 8), mesh) == P()
+    assert zero1_spec(P(None, "tp"), (8, 8), mesh) == P(None, "tp")
+    # tuple axis with every member absent: unchanged too
+    assert zero1_spec(P(), (8, 8), mesh, axis=("dcn", "dp")) == P()
+
+
+def test_zero1_spec_size1_dp_axis():
+    """make_mesh always carries all five axes; dp=1 must behave exactly
+    like an absent dp axis (no P("dp") over a trivial axis)."""
+    from edl_tpu.parallel.sharding import zero1_spec
+
+    mesh = mesh_mod.make_mesh(dp=1, tp=2, devices=jax.devices()[:2])
+    assert zero1_spec(P(), (8, 8), mesh) == P()
+    assert zero1_spec(P(None, "tp"), (8, 8), mesh) == P(None, "tp")
+    # partial tuple: dcn absent, dp present and >1 -> only dp composed
+    mesh4 = mesh_mod.make_mesh(dp=4, devices=jax.devices()[:4])
+    assert zero1_spec(P(), (8, 8), mesh4, axis=("dcn", "dp")) \
+        == P("dp", None)
+
+
+def test_opt_state_shardings_zero1_degenerate_meshes():
+    """opt_state_shardings with zero1 enabled on a dp-less/dp=1 mesh:
+    every derived spec must be realizable on that mesh (no dp entries),
+    and moment leaves keep the param's tp layout."""
+    import optax
+
+    from edl_tpu.parallel.sharding import opt_state_shardings
+    from edl_tpu.runtime.mesh import replicated
+
+    params = {"w": np.ones((8, 8), np.float32)}
+    for kw in ({"dp": 1, "tp": 2}, {"dp": 2, "tp": 1}):
+        mesh = mesh_mod.make_mesh(devices=jax.devices()[:2], **kw)
+        _, shardings = shard_params(
+            params, mesh,
+            [(r"^w$", P(None, "tp"))] if kw["tp"] > 1 else [])
+        opt_sh = opt_state_shardings(
+            optax.sgd(0.1, momentum=0.9), params, shardings,
+            replicated(mesh), zero1_mesh=mesh)
+        for sh in jax.tree_util.tree_leaves(
+                opt_sh, is_leaf=lambda x: isinstance(x, NamedSharding)):
+            for entry in sh.spec:
+                axes = ((entry,) if isinstance(entry, str)
+                        else tuple(entry or ()))
+                for a in axes:
+                    assert mesh.shape.get(a, 1) > 1, (kw, sh.spec)
+
+
+def test_spec_transplant_reason():
+    """The live-resize computability predicate: None iff every spec
+    axis exists on the target and every sharded dim divides."""
+    from edl_tpu.parallel.sharding import spec_transplant_reason
+
+    dp_tp = mesh_mod.make_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+    assert spec_transplant_reason(P(None, "tp"), (8, 8), dp_tp) is None
+    assert spec_transplant_reason(P(), (8, 8), dp_tp) is None
+    # indivisible dim
+    why = spec_transplant_reason(P("tp"), (7,), dp_tp)
+    assert why and "not divisible" in why
+    # axis absent from the target mesh entirely
+    from jax.sharding import Mesh
+    tp_only = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+    why = spec_transplant_reason(P("dp"), (8,), tp_only)
+    assert why and "absent" in why
+    # rank mismatch
+    why = spec_transplant_reason(P("dp", None), (8,), dp_tp)
+    assert why and "rank" in why
