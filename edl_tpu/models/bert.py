@@ -19,10 +19,13 @@ from jax.sharding import PartitionSpec as P
 
 
 class BertSelfAttention(nn.Module):
+    """``use_flash``: None = auto-dispatch by kernel legality (note a
+    non-None attention mask always forces dense), True/False force a
+    path. The pre-auto default was ``False``."""
     num_heads: int
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
-    use_flash: bool = False
+    use_flash: Optional[bool] = None
     mesh: Any = None
     # in-shard ring: the module is ALREADY inside a shard_map (e.g. a
     # pipeline stage) and the named axis carries the sequence sharding —
@@ -100,7 +103,8 @@ class BertLayer(nn.Module):
     mlp_dim: int
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
-    use_flash: bool = False
+    # None = auto flash dispatch (was False before the auto default)
+    use_flash: Optional[bool] = None
     mesh: Any = None
     ring_axis: Optional[str] = None  # in-shard ring (see BertSelfAttention)
     # mixture-of-experts FFN: replaces the dense MLP with num_experts
@@ -131,6 +135,11 @@ class BertLayer(nn.Module):
 
 class Bert(nn.Module):
     """BERT encoder; bert-base = defaults (12 layers, 768 hidden, 12 heads).
+
+    ``use_flash``: None = auto-dispatch (flash on TPU for kernel-legal
+    shapes and no attention mask; dense otherwise), True = force flash,
+    False = force dense. Default was ``False`` until the roofline-gap
+    PR; explicit True/False callers are unaffected.
     """
     vocab_size: int = 30522
     num_layers: int = 12
@@ -141,7 +150,7 @@ class Bert(nn.Module):
     num_classes: Optional[int] = 2
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
-    use_flash: bool = False
+    use_flash: Optional[bool] = None
     mesh: Any = None
     # activation recompute: save only layer-boundary activations and
     # recompute layer internals (attention scores, MLP hidden) in the

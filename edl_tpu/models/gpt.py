@@ -30,12 +30,17 @@ class CausalSelfAttention(nn.Module):
     sized [b, max_len, h, hd] at ``decode_index`` — the ONE source of
     truth for the decode position (the same value drives the position
     embedding in Gpt), so a retried step overwrites its own slot instead
-    of silently drifting — and attention runs against the prefix."""
+    of silently drifting — and attention runs against the prefix.
+
+    ``use_flash=None`` (default) auto-dispatches dense→flash by kernel
+    legality (see ops/attention.flash_dispatch_reason); True/False still
+    force a path. The pre-auto default was ``False`` — pass it
+    explicitly to pin the dense path."""
     num_heads: int
     max_len: int
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
-    use_flash: bool = False
+    use_flash: Optional[bool] = None
     mesh: Any = None
     ring_axis: Optional[str] = None
 
@@ -113,13 +118,16 @@ class CausalSelfAttention(nn.Module):
 
 
 class GptBlock(nn.Module):
-    """Pre-LN decoder block: x + attn(ln(x)); x + mlp(ln(x))."""
+    """Pre-LN decoder block: x + attn(ln(x)); x + mlp(ln(x)).
+
+    ``use_flash``: None = auto (flash where legal on TPU), True/False
+    force; was ``False`` before the auto default."""
     num_heads: int
     mlp_dim: int
     max_len: int
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
-    use_flash: bool = False
+    use_flash: Optional[bool] = None
     mesh: Any = None
     ring_axis: Optional[str] = None
 
@@ -145,7 +153,13 @@ class GptBlock(nn.Module):
 
 
 class Gpt(nn.Module):
-    """Decoder-only causal LM; logits via the tied word embedding."""
+    """Decoder-only causal LM; logits via the tied word embedding.
+
+    ``use_flash``: None = auto-dispatch (Pallas flash on TPU when the
+    shape is kernel-legal, dense otherwise — numerics-gated vs dense in
+    tier-1), True = force flash, False = force dense. The default was
+    ``False`` until the roofline-gap PR; explicit callers are
+    unaffected."""
     vocab_size: int = 32000
     num_layers: int = 12
     d_model: int = 768
@@ -154,7 +168,7 @@ class Gpt(nn.Module):
     max_len: int = 1024
     dtype: Any = jnp.bfloat16
     use_ring: bool = False
-    use_flash: bool = False
+    use_flash: Optional[bool] = None
     mesh: Any = None
     ring_axis: Optional[str] = None
     remat: bool = False

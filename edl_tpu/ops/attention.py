@@ -2,18 +2,64 @@
 in-shard ring / ring over the sp mesh axis / Pallas flash kernel / dense
 — one copy of the -1e30 mask convention, sm_scale, and the CPU interpret
 fallback. Lives in ops/ (neutral layer) so model modules don't import
-each other for infrastructure."""
+each other for infrastructure.
+
+``use_flash=None`` (the default) auto-dispatches: on TPU, shapes the
+Pallas kernel handles exactly take the flash path; everything else stays
+dense. Explicit ``True``/``False`` still force a path, so callers that
+pinned a choice before the auto default keep their behavior.
+"""
+
+import os
 
 import jax
 import jax.numpy as jnp
 
+# Pallas kernel defaults (ops/flash_attention.py): blocks are 128x128
+# with block_q clamped to seq. Lane tiling wants head_dim % 8 == 0.
+_FLASH_BLOCK = 128
+_FLASH_HEAD_MULT = 8
+
+
+def flash_dispatch_reason(seq_len, head_dim, *, mask=None, platform=None):
+    """Why auto-dispatch would (not) pick flash for this shape.
+
+    Returns ``None`` when the flash path is legal and profitable, else a
+    human-readable reason string (the dense path is taken). Pure shape
+    math — safe to call from tests and benches without tracing.
+    """
+    if mask is not None:
+        return "attention_mask set (flash kernel has no mask support)"
+    platform = platform or jax.default_backend()
+    if os.environ.get("EDL_TPU_FLASH_AUTO", "") == "0":
+        return "disabled via EDL_TPU_FLASH_AUTO=0"
+    if platform not in ("tpu", "axon"):
+        return "platform %r (interpret-mode flash is slower than dense)" \
+            % platform
+    if head_dim % _FLASH_HEAD_MULT != 0:
+        return "head_dim %d not a multiple of %d" % (head_dim,
+                                                     _FLASH_HEAD_MULT)
+    if seq_len > _FLASH_BLOCK and seq_len % _FLASH_BLOCK != 0:
+        # ragged q blocks are not masked by the kernel; ragged kv is.
+        # Stay conservative: only whole-block (or single-block) seqs.
+        return "seq_len %d not a multiple of block %d" % (seq_len,
+                                                          _FLASH_BLOCK)
+    return None
+
 
 def attention_context(q, k, v, *, causal, mask, dtype, ring_axis=None,
-                      use_ring=False, use_flash=False, mesh=None):
+                      use_ring=False, use_flash=None, mesh=None):
     """The shared attention-impl dispatch for BERT and GPT: in-shard ring
     (already inside a shard_map over ``ring_axis``) / ring over the sp
     mesh axis / Pallas flash kernel / dense — one copy of the -1e30 mask
-    convention, sm_scale, and the CPU interpret fallback."""
+    convention, sm_scale, and the CPU interpret fallback.
+
+    ``use_flash``: ``True`` forces the Pallas flash kernel, ``False``
+    forces dense, ``None`` (default) auto-dispatches by
+    :func:`flash_dispatch_reason` (flash on TPU for kernel-legal shapes,
+    dense otherwise). The old default was ``False``; auto is numerics-
+    gated against dense in tier-1 (tests/test_attention_dispatch.py).
+    """
     head_dim = q.shape[-1]
     scale = head_dim ** -0.5
     if ring_axis:
@@ -23,6 +69,9 @@ def attention_context(q, k, v, *, causal, mask, dtype, ring_axis=None,
     if use_ring:
         from edl_tpu.parallel.ring_attention import ring_attention
         return ring_attention(q, k, v, mesh, causal=causal)
+    if use_flash is None:
+        use_flash = flash_dispatch_reason(q.shape[1], head_dim,
+                                          mask=mask) is None
     if use_flash:
         if mask is not None:
             raise ValueError(

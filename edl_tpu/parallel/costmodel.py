@@ -31,6 +31,9 @@ enumeration runtime.mesh.make_mesh uses over jax.devices()[:N], which
 is what makes the per-device overlap math agree with the real reshard.
 """
 
+import json
+import os
+
 import numpy as np
 
 # same chip as perf_accounting.py / roofline_resnet.py (single source
@@ -48,6 +51,80 @@ CHIP_V5E = {
     "hbm_gbps": V5E_HBM_GBPS,
     "ici_gbps": V5E_ICI_GBPS,
 }
+
+# -- measured calibration (tools/roofline_gap.py) --------------------------
+#
+# The roofline_gap bench fits ACHIEVED constants (sustained tflops, HBM
+# and collective GB/s as the trainer actually sees them) and writes a
+# "roofline_calib/v1" record; pointing CALIB_ENV at it makes every
+# default-chip scorer plan against measured silicon instead of
+# datasheet numbers. Fail-open per FIELD: a missing/corrupt file, wrong
+# schema, or a fitted value outside sanity bounds keeps the builtin for
+# that field — calibration can tune the planner, never brick it.
+
+CALIB_ENV = "EDL_TPU_ROOFLINE_CALIB"
+CALIB_SCHEMA = "roofline_calib/v1"
+# a fitted constant this far off the builtin is a measurement artifact
+# (e.g. an interpret-mode CPU run), not a chip
+_CALIB_MIN_RATIO = 0.005
+_CALIB_MAX_RATIO = 20.0
+_calib_cache = {}
+
+
+def load_calibration(path=None):
+    """Parse a roofline_calib/v1 record from ``path`` (default: the
+    ``CALIB_ENV`` env var). Returns the record dict, or None when unset,
+    unreadable, or not the expected schema — never raises. Cached by
+    (path, mtime) so the scorer's inner loop doesn't re-read the file."""
+    path = path or os.environ.get(CALIB_ENV)
+    if not path:
+        return None
+    try:
+        key = (path, os.path.getmtime(path))
+    except OSError:
+        return None
+    if key in _calib_cache:
+        return _calib_cache[key]
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("schema") != CALIB_SCHEMA \
+                or not isinstance(doc.get("chip"), dict):
+            doc = None
+    except Exception:  # noqa: BLE001 — fail-open is the contract
+        doc = None
+    _calib_cache.clear()
+    _calib_cache[key] = doc
+    return doc
+
+
+def calibrated_chip(path=None):
+    """CHIP_V5E with any sane fitted constants from the calibration
+    record layered on top. With no record (or a bad one) this IS a copy
+    of CHIP_V5E, so default-chip callers see identical scores until a
+    calibration is installed."""
+    chip = dict(CHIP_V5E)
+    doc = load_calibration(path)
+    if not doc:
+        return chip
+    fitted = doc["chip"]
+    changed = False
+    for field in ("bf16_tflops", "hbm_gbps", "ici_gbps"):
+        try:
+            val = float(fitted[field])
+        except (KeyError, TypeError, ValueError):
+            continue
+        builtin = CHIP_V5E[field]
+        # NaN fails both comparisons and is dropped with the rest
+        if not (builtin * _CALIB_MIN_RATIO <= val
+                <= builtin * _CALIB_MAX_RATIO):
+            continue
+        chip[field] = val
+        changed = True
+    if changed:
+        chip["name"] = str(fitted.get("name",
+                                      CHIP_V5E["name"] + "+calib"))
+    return chip
 
 # microbatches per pipeline round-trip when estimating the 1F1B bubble
 PIPELINE_MICROBATCHES = 8
@@ -139,8 +216,12 @@ def legality_reason(factors, profile, total_batch):
 def step_time_s(factors, profile, total_batch, chip=None):
     """Roofline step-time estimate: max(compute, HBM) floor with the
     pipeline bubble applied, plus the per-axis collective terms.
-    Returns a breakdown dict; ``total_s`` is the score input."""
-    chip = chip or CHIP_V5E
+    Returns a breakdown dict; ``total_s`` is the score input.
+
+    ``chip=None`` uses :func:`calibrated_chip` — the builtin CHIP_V5E
+    constants unless a roofline_gap calibration record is installed via
+    the ``EDL_TPU_ROOFLINE_CALIB`` env var."""
+    chip = chip or calibrated_chip()
     dp, tp = factors["dp"], factors["tp"]
     pp, ep = factors["pp"], factors["ep"]
     world = dp * tp * pp * ep

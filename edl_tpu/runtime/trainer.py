@@ -162,7 +162,7 @@ def make_multi_step(loss_fn, tx, steps_per_call, has_aux=False,
 
 
 def make_accum_step(loss_fn, tx, accum_steps, has_aux=False,
-                    remat_policy=None):
+                    remat_policy=None, overlap_axis=None, mesh=None):
     """Gradient accumulation: ONE optimizer update from ``accum_steps``
     microbatches, scanned in one dispatch.
 
@@ -182,10 +182,46 @@ def make_accum_step(loss_fn, tx, accum_steps, has_aux=False,
     kept global batch constant by resharding rows only
     (train_with_fleet.py:360-361); accumulation extends that policy past
     the per-device memory ceiling. The rng is folded per microbatch so
-    dropout streams differ across microbatches."""
+    dropout streams differ across microbatches.
+
+    Collective–compute overlap (``overlap_axis``/``mesh``): with a data
+    axis named, the step runs under shard_map over that axis and the
+    gradient all-reduce for microbatch *i* is DELAYED into the scan
+    carry — issued at the top of iteration *i+1*, where it has no data
+    dependence on that iteration's fwd/bwd, so XLA schedules the pmean
+    (one collective per leaf — naturally bucketed) behind the compute.
+    The last microbatch's reduce runs after the scan. When the axis has
+    size 1 (or ``mesh`` is None) there are no collectives to hide, so
+    the EAGER step is returned unchanged and the no-op is logged —
+    clean degradation (bitwise-identical updates by construction, and
+    no 2x gradient carry), not an error. Incompatible with
+    ``has_aux`` (per-shard extra state has no defined reduction), and
+    the loss's rng stream is shared across shards (fine for rng-free or
+    row-independent losses; dropout masks would repeat per shard)."""
     if accum_steps < 1:
         raise ValueError("accum_steps must be >= 1")
     _maybe_remat = _remat_wrapper(remat_policy)
+
+    if overlap_axis is not None:
+        if has_aux:
+            raise ValueError(
+                "overlap_axis is incompatible with has_aux: extra "
+                "state is per-shard under shard_map and has no defined "
+                "reduction")
+        axes = ((overlap_axis,) if isinstance(overlap_axis, str)
+                else tuple(overlap_axis))
+        axis_size = 1
+        if mesh is not None:
+            axis_size = int(np.prod([mesh.shape[a] for a in axes
+                                     if a in mesh.shape]))
+        if mesh is not None and axis_size > 1:
+            return _make_overlap_accum_step(loss_fn, tx, accum_steps,
+                                            _maybe_remat, axes, mesh)
+        logger.info(
+            "make_accum_step: dp overlap over %s is a no-op (axis size "
+            "%d) — no collectives to hide, returning the eager "
+            "accumulation step unchanged", axes, axis_size)
+        # fall through to the eager step below
 
     def step(train_state, batches, rng):
         params = train_state["params"]
@@ -224,6 +260,66 @@ def make_accum_step(loss_fn, tx, accum_steps, has_aux=False,
         }, loss_sum / accum_steps
 
     return step
+
+
+def _make_overlap_accum_step(loss_fn, tx, accum_steps, _maybe_remat,
+                             axes, mesh):
+    """The delayed-reduction accumulation schedule (see make_accum_step's
+    overlap paragraph). Only built when the overlap axes have size > 1 —
+    the degenerate case returns the eager step from make_accum_step —
+    and split out so the eager path stays byte-for-byte what it was."""
+
+    def _fold(reduced, pending):
+        pending = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, axes), pending)
+        return jax.tree_util.tree_map(jnp.add, reduced, pending)
+
+    def step(train_state, batches, rng):
+        params = train_state["params"]
+
+        def body(carry, xs):
+            reduced, pending, loss_acc = carry
+            i, batch = xs
+            # fold the PREVIOUS microbatch's unreduced grads into the
+            # running sum before this microbatch's fwd/bwd: the pmean
+            # has no data dependence on the compute below, so XLA
+            # overlaps the wire time with it
+            reduced = _fold(reduced, pending)
+            rng_i = jax.random.fold_in(rng, i)
+
+            @_maybe_remat
+            def compute(p):
+                return loss_fn(p, batch, rng_i)
+            loss, grads = jax.value_and_grad(compute)(params)
+            return (reduced, grads, loss_acc + loss), None
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (reduced, pending, loss_sum), _ = lax.scan(
+            body,
+            (zeros, jax.tree_util.tree_map(jnp.zeros_like, params),
+             jnp.zeros((), jnp.float32)),
+            (jnp.arange(accum_steps), batches), length=accum_steps)
+        grad_sum = _fold(reduced, pending)  # the last microbatch's reduce
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps,
+                                       grad_sum)
+        loss = lax.pmean(loss_sum / accum_steps, axes)
+        updates, opt_state = tx.update(grads, train_state["opt_state"],
+                                       params)
+        return {
+            "params": optax.apply_updates(params, updates),
+            "opt_state": opt_state,
+            "step": train_state["step"] + 1,
+            "extra": train_state["extra"],
+        }, loss
+
+    from jax.sharding import PartitionSpec
+    from edl_tpu.parallel.shard_map_compat import shard_map
+    state_spec = PartitionSpec()
+    batch_spec = PartitionSpec(None, axes)
+    return shard_map(step, mesh=mesh,
+                     in_specs=(state_spec, batch_spec, state_spec),
+                     out_specs=(state_spec, state_spec),
+                     check_rep=False)
 
 
 def auto_grad_accum(per_device_batch, max_per_device_batch):
@@ -321,6 +417,16 @@ class ElasticTrainer(object):
         (e.g. stages over "pp") for the layout, and build the step with
         the SAME ``tx`` object passed here (it initializes the
         opt_state the step updates).
+      dp_overlap: with grad_accum > 1, run the delayed-reduction
+        accumulation schedule (make_accum_step's overlap path): the
+        gradient all-reduce for microbatch i overlaps microbatch i+1's
+        fwd/bwd. Plain-DP only (replicated params/opt state — no zero1
+        or param_shardings, whose leaf-wise shard_map specs this path
+        does not build) and no has_aux. On a 1-device data axis there
+        are no collectives to hide, so the eager accumulation step runs
+        unchanged (logged no-op). At
+        grad_accum == 1 there is no cross-microbatch edge to hide the
+        reduce behind, so the knob is ignored (logged).
     """
 
     def __init__(self, loss_fn, params, tx, total_batch_size,
@@ -328,15 +434,25 @@ class ElasticTrainer(object):
                  keep_checkpoints=3, extra_state=None, has_aux=False,
                  async_save=False, remat_policy=None,
                  param_shardings=None, grad_accum=1, zero1=False,
-                 max_per_device_batch=None, step_fn=None):
+                 max_per_device_batch=None, step_fn=None,
+                 dp_overlap=False):
         if step_fn is not None and (has_aux or grad_accum != 1
                                     or remat_policy is not None
-                                    or max_per_device_batch is not None):
+                                    or max_per_device_batch is not None
+                                    or dp_overlap):
             raise ValueError(
                 "step_fn owns the whole step: has_aux/grad_accum/"
-                "remat_policy/max_per_device_batch do not apply")
-        if step_fn is None and loss_fn is None:
-            raise ValueError("need loss_fn (canonical step) or step_fn")
+                "remat_policy/max_per_device_batch/dp_overlap do not "
+                "apply")
+        if dp_overlap and has_aux:
+            raise ValueError("dp_overlap is incompatible with has_aux "
+                             "(see make_accum_step)")
+        if dp_overlap and (zero1 or param_shardings is not None):
+            raise ValueError(
+                "dp_overlap requires replicated params/opt state "
+                "(plain DP): zero1/param_shardings shard the state, and "
+                "the overlap shard_map only builds replicated specs")
+        self._dp_overlap = dp_overlap
         self._step_fn = step_fn
         self.env = env or TrainerEnv()
         maybe_init_distributed(self.env)
@@ -588,9 +704,22 @@ class ElasticTrainer(object):
         if self._step_fn is not None:
             return self._step_fn
         if self._grad_accum > 1:
+            overlap_axis = None
+            if self._dp_overlap:
+                # the row axes of the microbatch-major layout — "dp",
+                # or ("dcn", "dp") on hybrid meshes
+                overlap_axis = (self._batch_sharding.spec[1]
+                                or DATA_AXIS)
             return make_accum_step(self._loss_fn, self._tx,
                                    self._grad_accum, self._has_aux,
-                                   remat_policy=self._remat_policy)
+                                   remat_policy=self._remat_policy,
+                                   overlap_axis=overlap_axis,
+                                   mesh=self.mesh if overlap_axis
+                                   else None)
+        if self._dp_overlap:
+            logger.info("dp_overlap ignored: grad_accum == 1 leaves no "
+                        "next microbatch to overlap the gradient "
+                        "all-reduce with")
         return make_train_step(self._loss_fn, self._tx, self._has_aux,
                                remat_policy=self._remat_policy)
 

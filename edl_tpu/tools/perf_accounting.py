@@ -53,6 +53,87 @@ def scrub_env_for_cli():
 V5E_HBM_GBPS = 819.0
 V5E_BF16_TFLOPS = 197.0
 
+# reference baselines for the BENCH_BEST_TPU.json vs_baseline column
+# (value / baseline, the resnet record's convention): gpt's is the r5b
+# measured 59,157.8 tok/s/chip — the "flat 59k" every later measurement
+# is judged against
+BASELINES = {"gpt": 59157.8}
+
+
+def _default_best_path():
+    return os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                        "BENCH_BEST_TPU.json")
+
+
+def fold_roofline_gap(gap_doc, best_path, force=False):
+    """Fold a ``roofline_gap/v1`` gpt tok/s arc into the BENCH_BEST
+    pointer file: take the max of the existing and measured value, stamp
+    the source, and ALWAYS recompute vs_baseline from the known gpt
+    baseline — the headline record can no longer sit at a silent 0.0.
+
+    Refuses non-TPU arcs unless ``force`` (a CPU micro run must never
+    masquerade as a TPU best). Returns (changed, message)."""
+    if not isinstance(gap_doc, dict) \
+            or gap_doc.get("schema") != "roofline_gap/v1":
+        return False, "not a roofline_gap/v1 doc"
+    arc = gap_doc.get("gpt_arc")
+    if not arc:
+        return False, "no gpt arc in the gap doc"
+    platform = arc.get("platform")
+    if platform not in ("tpu", "axon") and not force:
+        return False, ("gpt arc measured on %r — refusing to fold a "
+                       "non-TPU number into %s (force overrides)"
+                       % (platform, os.path.basename(best_path)))
+    with open(best_path) as f:
+        best = json.load(f)
+    rec = best.setdefault("gpt", {
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": 0.0, "unit": "tok/s/chip",
+        "measured": "", "source": ""})
+    changed = []
+    value = float(arc.get("value") or 0.0)
+    if value > float(rec.get("value") or 0.0):
+        rec["value"] = value
+        rec["measured"] = arc.get("measured", rec.get("measured", ""))
+        rec["source"] = "roofline_gap/v1 %s (%s)" % (
+            arc.get("config", "?"), platform)
+        changed.append("value -> %.1f" % value)
+    baseline = float(rec.get("baseline") or BASELINES["gpt"])
+    want_vs = round(float(rec["value"]) / baseline, 3) if baseline else 0.0
+    if rec.get("vs_baseline") != want_vs or rec.get("baseline") != baseline:
+        rec["vs_baseline"] = want_vs
+        rec["baseline"] = baseline
+        changed.append("vs_baseline -> %.3f" % want_vs)
+    if changed:
+        with open(best_path, "w") as f:
+            json.dump(best, f, indent=1)
+            f.write("\n")
+        return True, "gpt record updated: %s" % "; ".join(changed)
+    return False, "gpt record already current (value %.1f)" % rec["value"]
+
+
+def recompute_vs_baseline(best_path):
+    """Backfill vs_baseline for records stuck at 0.0/absent whose model
+    has a known baseline. Returns the list of models fixed."""
+    with open(best_path) as f:
+        best = json.load(f)
+    fixed = []
+    for model, rec in best.items():
+        if model not in BASELINES:
+            continue
+        baseline = float(rec.get("baseline") or BASELINES[model])
+        want = round(float(rec.get("value") or 0.0) / baseline, 3)
+        if rec.get("vs_baseline") in (0.0, None) \
+                or rec.get("baseline") != baseline:
+            rec["vs_baseline"] = want
+            rec["baseline"] = baseline
+            fixed.append(model)
+    if fixed:
+        with open(best_path, "w") as f:
+            json.dump(best, f, indent=1)
+            f.write("\n")
+    return fixed
+
 
 def spec_like(tree, sharding=None):
     return jax.tree_util.tree_map(
@@ -586,7 +667,30 @@ def main(argv=None):
     p.add_argument("--platform", choices=("tpu", "cpu"), default="tpu")
     p.add_argument("--accounts", default=",".join(ACCOUNTS))
     p.add_argument("--out", default=None, help="write JSON list here")
+    p.add_argument("--fold_roofline_gap", default=None, metavar="PATH",
+                   help="fold the gpt arc of a roofline_gap/v1 output "
+                        "file into the BENCH_BEST pointer and exit")
+    p.add_argument("--best", default=None,
+                   help="BENCH_BEST_TPU.json path (default: repo root)")
+    p.add_argument("--force_fold", action="store_true",
+                   help="fold even a non-TPU arc (testing only)")
+    p.add_argument("--recompute_vs_baseline", action="store_true",
+                   help="backfill vs_baseline for 0.0 records and exit")
     args = p.parse_args(argv)
+    if args.fold_roofline_gap or args.recompute_vs_baseline:
+        # pure-JSON maintenance of the pointer file: no jax, no scrub
+        best_path = args.best or _default_best_path()
+        if args.fold_roofline_gap:
+            with open(args.fold_roofline_gap) as f:
+                lines = [ln for ln in f.read().splitlines() if ln.strip()]
+            gap_doc = json.loads(lines[-1]) if lines else {}
+            changed, msg = fold_roofline_gap(gap_doc, best_path,
+                                             force=args.force_fold)
+            print(msg)
+        if args.recompute_vs_baseline:
+            fixed = recompute_vs_baseline(best_path)
+            print("vs_baseline backfilled: %s" % (fixed or "nothing"))
+        return 0
     scrub_env_for_cli()
     names = [n for n in args.accounts.split(",") if n]
     unknown = sorted(set(names) - set(ACCOUNTS))

@@ -1,0 +1,139 @@
+"""Dense / flash / auto attention dispatch parity (the flash-by-default
+satellite): ``use_flash=None`` auto-dispatches by kernel legality, and
+the three paths must agree numerically on the SAME small gpt config —
+allclose logits, matching grads — with the legality boundaries pinned
+so an illegal shape can never silently take the kernel path."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.ops.attention import attention_context, flash_dispatch_reason
+
+
+# -- legality boundaries (pure shape math, no tracing) ---------------------
+
+
+def test_auto_dispatch_legality_on_tpu_shapes():
+    """The shapes the Pallas kernel handles exactly take flash; ragged
+    q blocks and off-lane head dims stay dense."""
+    ok = lambda s, d: flash_dispatch_reason(s, d, platform="tpu")
+    assert ok(128, 64) is None
+    assert ok(1024, 64) is None  # whole 128-blocks
+    assert ok(64, 16) is None    # single (clamped) q block
+    assert ok(96, 8) is None     # <= one block, ragged kv is masked
+    # odd seq: ragged q blocks are NOT masked by the kernel
+    assert "seq_len" in ok(129, 64)
+    assert "seq_len" in ok(250, 64)
+    # head_dim off the 8-lane tiling
+    assert "head_dim" in ok(128, 15)
+    assert "head_dim" in ok(1024, 12)
+
+
+def test_auto_dispatch_never_picks_flash_off_tpu_or_with_mask():
+    assert "platform" in flash_dispatch_reason(128, 64, platform="cpu")
+    assert "mask" in flash_dispatch_reason(
+        128, 64, mask=np.ones((2, 128), bool), platform="tpu")
+
+
+def test_auto_dispatch_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("EDL_TPU_FLASH_AUTO", "0")
+    assert "EDL_TPU_FLASH_AUTO" in flash_dispatch_reason(
+        128, 64, platform="tpu")
+    monkeypatch.delenv("EDL_TPU_FLASH_AUTO")
+    assert flash_dispatch_reason(128, 64, platform="tpu") is None
+
+
+# -- numerics parity on the shared dispatch --------------------------------
+
+
+def _qkv(b=2, s=64, h=4, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d).astype(np.float32)) * 0.4
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_attention_context_flash_matches_dense(causal):
+    q, k, v = _qkv()
+    kw = dict(causal=causal, mask=None, dtype=jnp.float32)
+    dense = attention_context(q, k, v, use_flash=False, **kw)
+    flash = attention_context(q, k, v, use_flash=True, **kw)
+    np.testing.assert_allclose(np.asarray(flash), np.asarray(dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_attention_context_auto_is_dense_on_cpu():
+    """On CPU the auto path must resolve to dense (interpret-mode flash
+    is slower), bit-for-bit — the tier-1 default behavior is unchanged
+    by the new None default."""
+    q, k, v = _qkv(seed=1)
+    kw = dict(causal=True, mask=None, dtype=jnp.float32)
+    dense = attention_context(q, k, v, use_flash=False, **kw)
+    auto = attention_context(q, k, v, use_flash=None, **kw)
+    assert np.asarray(auto).tobytes() == np.asarray(dense).tobytes()
+
+
+# -- the small-gpt parity gate (logits + grads) ----------------------------
+
+
+def _gpt_logits_and_grads(use_flash, seed=0):
+    from edl_tpu.models import gpt
+
+    kw = dict(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
+              vocab_size=128, max_len=64, dtype=jnp.float32,
+              use_flash=use_flash)
+    model = gpt.Gpt(**kw)
+    ids = jnp.asarray(np.random.RandomState(seed).randint(0, 128, (2, 64)),
+                      jnp.int32)
+    ref = gpt.Gpt(**dict(kw, use_flash=False))
+    params = ref.init(jax.random.PRNGKey(0), ids)["params"]
+    logits = model.apply({"params": params}, ids)
+
+    def loss(p):
+        out = model.apply({"params": p}, ids)
+        return (out.astype(jnp.float32) ** 2).mean()
+
+    grads = jax.grad(loss)(params)
+    return logits, grads
+
+
+def test_gpt_dense_flash_auto_parity():
+    """The acceptance gate: dense vs forced-flash (interpret mode on
+    CPU) vs auto on one small gpt config — allclose logits AND matching
+    grads through the whole stack; auto == dense exactly on CPU."""
+    logits_d, grads_d = _gpt_logits_and_grads(False)
+    logits_f, grads_f = _gpt_logits_and_grads(True)
+    logits_a, grads_a = _gpt_logits_and_grads(None)
+
+    np.testing.assert_allclose(np.asarray(logits_f), np.asarray(logits_d),
+                               rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(grads_f),
+                    jax.tree_util.tree_leaves(grads_d)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+    # auto resolves to dense on CPU: identical computation
+    assert np.asarray(logits_a).tobytes() == np.asarray(logits_d).tobytes()
+    for a, b in zip(jax.tree_util.tree_leaves(grads_a),
+                    jax.tree_util.tree_leaves(grads_d)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_bert_auto_default_matches_explicit_dense():
+    """Threading None through bert must not change the encoder's output
+    vs an explicit use_flash=False (the pre-PR default)."""
+    from edl_tpu.models import bert
+
+    kw = dict(num_layers=2, d_model=32, num_heads=2, mlp_dim=64,
+              vocab_size=100, max_len=64, dtype=jnp.float32)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 100, (2, 32)),
+                      jnp.int32)
+    m_auto = bert.Bert(**kw)  # default use_flash=None
+    m_dense = bert.Bert(use_flash=False, **kw)
+    params = m_dense.init(jax.random.PRNGKey(0), ids)["params"]
+    out_a = m_auto.apply({"params": params}, ids)
+    out_d = m_dense.apply({"params": params}, ids)
+    assert np.asarray(out_a).tobytes() == np.asarray(out_d).tobytes()

@@ -745,3 +745,188 @@ def test_measure_resize_live_sharded_arc_mesh_records(capsys):
     assert out["grow"]["mesh"]["dp"] == 2
     assert out["grow"]["mesh"]["tp"] == 2
     json.dumps(out)  # round-trips
+
+
+# -- roofline_gap/v1 (measured-vs-predicted roofline bench) ---------------
+
+
+def test_roofline_gap_micro_cpu_schema(capsys):
+    """Tier-1 pin of the roofline-gap bench contract (schema
+    roofline_gap/v1): >= 2 (model, mesh) configs, a measured/predicted
+    ratio for EVERY cost-model term (with honest exercised flags), a
+    roofline_calib/v1 calibration record, and a gpt tok/s arc for the
+    perf_accounting fold. No absolute-ratio gate — CPU interpret ratios
+    are astronomically off the v5e prediction by design; the pin is
+    presence + finiteness + positivity."""
+    import json
+
+    import numpy as np
+
+    from edl_tpu.tools import roofline_gap
+
+    rc = roofline_gap.main(["--micro", "--iters", "1"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 1
+    doc = json.loads(lines[-1])
+    assert doc["schema"] == "roofline_gap/v1"
+    assert doc["mode"] == "micro"
+    assert set(doc["chip_builtin"]) >= {"bf16_tflops", "hbm_gbps",
+                                        "ici_gbps"}
+    configs = doc["configs"]
+    assert len(configs) >= 2
+    assert {c["model"] for c in configs} == {"gpt", "bert"}
+    terms = set(roofline_gap.RATIO_TERMS)
+    for cfg in configs:
+        assert "error" not in cfg, cfg
+        assert cfg["world"] >= 2
+        assert set(cfg["ratios"]) == terms
+        assert set(cfg["exercised"]) == terms
+        for term, r in cfg["ratios"].items():
+            assert np.isfinite(r) and r > 0, (cfg["name"], term, r)
+        # unexercised terms report the neutral ratio, not a fake fit
+        for term, on in cfg["exercised"].items():
+            if not on and term not in ("compute", "hbm"):
+                assert cfg["ratios"][term] == 1.0, (cfg["name"], term)
+        assert cfg["measured"]["total_s"] > 0
+        assert cfg["predicted"]["total_s"] > 0
+        assert cfg["tokens_per_sec_per_chip"] > 0
+    # the dp term was actually measured on these meshes
+    assert any(c["exercised"]["dp"] for c in configs)
+    # the accum-over-dp config swept the overlap schedule
+    overlaps = [c["overlap"] for c in configs if c["overlap"]]
+    assert overlaps and all(o["off_s"] > 0 and o["on_s"] > 0
+                            for o in overlaps)
+    calib = doc["calibration"]
+    assert calib["schema"] == "roofline_calib/v1"
+    assert isinstance(calib["chip"], dict)
+    for field, val in calib["chip"].items():
+        if field == "name":
+            continue
+        assert np.isfinite(val) and val > 0, (field, val)
+    arc = doc["gpt_arc"]
+    assert arc and arc["value"] > 0
+    assert arc["unit"] == "tok/s/chip"
+    assert arc["platform"] == "cpu"
+    json.dumps(doc)  # round-trips
+
+
+def test_roofline_calib_round_trip_and_fail_open(tmp_path, monkeypatch):
+    """costmodel loads a roofline_calib/v1 record via the env var and
+    plans against the fitted constants; a missing, corrupt, or
+    out-of-sanity-bounds record keeps the builtin CHIP_V5E values
+    PER FIELD (fail-open proven, the acceptance bullet)."""
+    import json
+
+    from edl_tpu.parallel import costmodel
+
+    prof = costmodel.transformer_profile(n_layers=8, d_model=1024,
+                                         n_heads=16, seq_len=512)
+    factors = {"dp": 4, "tp": 1, "pp": 1, "ep": 1}
+
+    # no calibration installed: defaults ARE the builtins
+    monkeypatch.delenv(costmodel.CALIB_ENV, raising=False)
+    assert costmodel.calibrated_chip() == costmodel.CHIP_V5E
+
+    # round trip: fitted constants flow into default-chip scoring
+    good = tmp_path / "calib_good.json"
+    good.write_text(json.dumps({
+        "schema": costmodel.CALIB_SCHEMA,
+        "chip": {"name": "v5e+fit", "bf16_tflops": 150.0,
+                 "hbm_gbps": 700.0, "ici_gbps": 90.0}}))
+    monkeypatch.setenv(costmodel.CALIB_ENV, str(good))
+    chip = costmodel.calibrated_chip()
+    assert chip["bf16_tflops"] == 150.0
+    assert chip["hbm_gbps"] == 700.0
+    assert chip["ici_gbps"] == 90.0
+    t_cal = costmodel.step_time_s(factors, prof, total_batch=64)
+    t_builtin = costmodel.step_time_s(factors, prof, total_batch=64,
+                                      chip=costmodel.CHIP_V5E)
+    # slower fitted ICI -> a larger dp term under the default chip
+    assert t_cal["dp_s"] > t_builtin["dp_s"]
+
+    # corrupt file: whole record dropped, builtins stay
+    bad = tmp_path / "calib_corrupt.json"
+    bad.write_text("{not json")
+    monkeypatch.setenv(costmodel.CALIB_ENV, str(bad))
+    assert costmodel.calibrated_chip() == costmodel.CHIP_V5E
+
+    # wrong schema: dropped
+    wrong = tmp_path / "calib_wrong_schema.json"
+    wrong.write_text(json.dumps({"schema": "nope/v9",
+                                 "chip": {"bf16_tflops": 150.0}}))
+    monkeypatch.setenv(costmodel.CALIB_ENV, str(wrong))
+    assert costmodel.calibrated_chip() == costmodel.CHIP_V5E
+
+    # out-of-bounds field dropped PER FIELD, sane sibling kept (a CPU
+    # micro fit must not brick the planner's compute constant)
+    partial = tmp_path / "calib_partial.json"
+    partial.write_text(json.dumps({
+        "schema": costmodel.CALIB_SCHEMA,
+        "chip": {"bf16_tflops": 0.001, "hbm_gbps": 700.0,
+                 "ici_gbps": float("nan")}}))
+    monkeypatch.setenv(costmodel.CALIB_ENV, str(partial))
+    chip = costmodel.calibrated_chip()
+    assert chip["bf16_tflops"] == costmodel.V5E_BF16_TFLOPS
+    assert chip["hbm_gbps"] == 700.0
+    assert chip["ici_gbps"] == costmodel.V5E_ICI_GBPS
+
+    # missing path: builtins
+    monkeypatch.setenv(costmodel.CALIB_ENV, str(tmp_path / "gone.json"))
+    assert costmodel.calibrated_chip() == costmodel.CHIP_V5E
+
+
+def test_fold_roofline_gap_updates_best(tmp_path):
+    """perf_accounting folds a roofline_gap/v1 gpt arc into the
+    BENCH_BEST pointer: vs_baseline computed against the 59,157.8
+    baseline, source stamped, non-TPU arcs refused — the headline can
+    never silently sit at 0.0 again."""
+    import json
+
+    from edl_tpu.tools import perf_accounting as pa
+
+    best = tmp_path / "best.json"
+    best.write_text(json.dumps({"gpt": {
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": 59157.8, "unit": "tok/s/chip", "vs_baseline": 0.0,
+        "measured": "2026-07-31", "source": "BENCH_SWEEP_r5b.txt"}}))
+
+    def gap(platform, value):
+        return {"schema": "roofline_gap/v1",
+                "gpt_arc": {"metric": "gpt_train_tokens_per_sec_per_chip",
+                            "value": value, "unit": "tok/s/chip",
+                            "platform": platform, "config": "gpt2s_dp_all",
+                            "measured": "2026-08-08"}}
+
+    # a CPU arc is refused outright (the pointer stays TPU-measured)
+    changed, msg = pa.fold_roofline_gap(gap("cpu", 999999.0), str(best))
+    assert not changed and "refusing" in msg
+    assert json.loads(best.read_text())["gpt"]["value"] == 59157.8
+
+    # a slower TPU arc does not regress the best value, but the stale
+    # 0.0 vs_baseline is backfilled
+    changed, msg = pa.fold_roofline_gap(gap("tpu", 50000.0), str(best))
+    assert changed
+    rec = json.loads(best.read_text())["gpt"]
+    assert rec["value"] == 59157.8
+    assert rec["vs_baseline"] == 1.0
+    assert rec["baseline"] == pa.BASELINES["gpt"]
+
+    # a faster TPU arc takes the record and stamps its source
+    changed, msg = pa.fold_roofline_gap(gap("tpu", 70989.4), str(best))
+    assert changed
+    rec = json.loads(best.read_text())["gpt"]
+    assert rec["value"] == 70989.4
+    assert rec["source"].startswith("roofline_gap/v1 gpt2s_dp_all")
+    assert rec["vs_baseline"] == round(70989.4 / 59157.8, 3)
+
+    # idempotent: same arc again changes nothing
+    changed, _ = pa.fold_roofline_gap(gap("tpu", 70989.4), str(best))
+    assert not changed
+
+    # malformed docs are rejected, not half-applied
+    changed, msg = pa.fold_roofline_gap({"schema": "other/v1"}, str(best))
+    assert not changed
+    changed, msg = pa.fold_roofline_gap({"schema": "roofline_gap/v1",
+                                         "gpt_arc": None}, str(best))
+    assert not changed

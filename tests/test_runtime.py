@@ -882,6 +882,140 @@ def test_elastic_trainer_grad_accum_equivalent(tmp_path):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+def test_overlap_accum_bitwise_identity_unsharded():
+    """Overlap with no mesh: no collectives to hide, so make_accum_step
+    returns the eager step unchanged — the update is BITWISE identical
+    for a fixed seed across accum_steps 1/2/4, by construction."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import make_accum_step, make_train_state
+
+    params = linear.init_params(feature_dim=4)
+    tx = optax.sgd(0.1)
+    rs = np.random.RandomState(5)
+    full = {
+        "x": rs.randn(16, 4).astype(np.float32),
+        "y": rs.randn(16).astype(np.float32),
+    }
+    rng = jax.random.PRNGKey(11)
+    for K in (1, 2, 4):
+        micro = {k: v.reshape((K, 16 // K) + v.shape[1:])
+                 for k, v in full.items()}
+        off = jax.jit(make_accum_step(linear.loss_fn, tx, accum_steps=K))
+        on = jax.jit(make_accum_step(linear.loss_fn, tx, accum_steps=K,
+                                     overlap_axis="dp", mesh=None))
+        got_off, loss_off = off(make_train_state(params, tx), micro, rng)
+        got_on, loss_on = on(make_train_state(params, tx), micro, rng)
+        assert np.asarray(loss_on).tobytes() == np.asarray(loss_off).tobytes()
+        for a, b in zip(jax.tree_util.tree_leaves(got_on["params"]),
+                        jax.tree_util.tree_leaves(got_off["params"])):
+            assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), K
+
+
+def test_overlap_accum_degrades_on_single_device_mesh():
+    """A real 1-device mesh must take the logged no-op path (no
+    collectives, no shard_map — the eager step is returned) and match
+    the plain accum step bitwise."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import make_accum_step, make_train_state
+
+    mesh1 = mesh_mod.make_mesh(dp=1, devices=jax.devices()[:1])
+    params = linear.init_params(feature_dim=4)
+    tx = optax.sgd(0.1)
+    rs = np.random.RandomState(6)
+    micro = {
+        "x": rs.randn(2, 8, 4).astype(np.float32),
+        "y": rs.randn(2, 8).astype(np.float32),
+    }
+    rng = jax.random.PRNGKey(0)
+    off = jax.jit(make_accum_step(linear.loss_fn, tx, accum_steps=2))
+    on = jax.jit(make_accum_step(linear.loss_fn, tx, accum_steps=2,
+                                 overlap_axis=mesh_mod.DATA_AXIS,
+                                 mesh=mesh1))
+    got_off, _ = off(make_train_state(params, tx), micro, rng)
+    got_on, _ = on(make_train_state(params, tx), micro, rng)
+    for a, b in zip(jax.tree_util.tree_leaves(got_on["params"]),
+                    jax.tree_util.tree_leaves(got_off["params"])):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+def test_overlap_accum_sharded_matches_eager():
+    """Overlap over the real 8-way dp axis (shard_map + delayed pmean)
+    must agree with the eager accum step on the same global batch: the
+    per-shard sum-then-pmean reassociates the row reduction, so allclose
+    rather than bitwise."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import make_accum_step, make_train_state
+
+    mesh = mesh_mod.make_mesh(dp=8)
+    params = linear.init_params(feature_dim=4)
+    tx = optax.sgd(0.1)
+    rs = np.random.RandomState(9)
+    K = 2
+    micro = {
+        "x": rs.randn(K, 16, 4).astype(np.float32),
+        "y": rs.randn(K, 16).astype(np.float32),
+    }
+    rng = jax.random.PRNGKey(4)
+    off = jax.jit(make_accum_step(linear.loss_fn, tx, accum_steps=K))
+    on = jax.jit(make_accum_step(linear.loss_fn, tx, accum_steps=K,
+                                 overlap_axis=mesh_mod.DATA_AXIS,
+                                 mesh=mesh))
+    got_off, loss_off = off(make_train_state(params, tx), micro, rng)
+    got_on, loss_on = on(make_train_state(params, tx), micro, rng)
+    assert int(got_on["step"]) == 1
+    np.testing.assert_allclose(float(loss_on), float(loss_off), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(got_on["params"]),
+                    jax.tree_util.tree_leaves(got_off["params"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_overlap_accum_rejects_has_aux():
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import make_accum_step
+
+    with pytest.raises(ValueError, match="has_aux"):
+        make_accum_step(linear.loss_fn, optax.sgd(0.1), accum_steps=2,
+                        has_aux=True, overlap_axis="dp")
+
+
+def test_elastic_trainer_dp_overlap_matches_plain(tmp_path):
+    """ElasticTrainer(dp_overlap=True, grad_accum=2) trains to the same
+    params as the plain accum trainer on the same data, and the invalid
+    combinations raise up front."""
+    from edl_tpu.models import linear
+    from edl_tpu.runtime.trainer import ElasticTrainer
+
+    rs = np.random.RandomState(3)
+    batch = {
+        "x": rs.randn(16, 4).astype(np.float32),
+        "y": rs.randn(16).astype(np.float32),
+    }
+    params = []
+    for overlap in (False, True):
+        tr = ElasticTrainer(linear.loss_fn, linear.init_params(4),
+                            optax.sgd(0.05), total_batch_size=16,
+                            checkpoint_dir="", grad_accum=2,
+                            dp_overlap=overlap)
+        for i in range(3):
+            tr.train_step(batch, rng=jax.random.PRNGKey(i))
+        params.append(jax.tree_util.tree_leaves(
+            jax.device_get(tr.train_state["params"])))
+    for a, b in zip(*params):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    with pytest.raises(ValueError, match="has_aux"):
+        ElasticTrainer(linear.loss_fn, linear.init_params(4),
+                       optax.sgd(0.05), total_batch_size=16,
+                       checkpoint_dir="", grad_accum=2, dp_overlap=True,
+                       has_aux=True, extra_state={"n": jnp.zeros(())})
+    with pytest.raises(ValueError, match="replicated"):
+        ElasticTrainer(linear.loss_fn, linear.init_params(4),
+                       optax.sgd(0.05), total_batch_size=16,
+                       checkpoint_dir="", grad_accum=2, dp_overlap=True,
+                       zero1=True)
+
+
 def test_zero1_spec_composition():
     """zero1_spec shards the first free divisible dim over dp, on top of
     the param's tp layout; falls back to the param spec when nothing
