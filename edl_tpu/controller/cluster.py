@@ -25,6 +25,13 @@ class Cluster(Serializable):
         # rides the live-resize intent so survivors rebuild THIS mesh,
         # and the cluster map so stop-resume restarts do too
         self.mesh = None
+        # redundancy partner rings ({pod_id: [partner pod ids]}, or
+        # None): recorded for observability/audit — the rule itself
+        # (redundancy.partner_ring over the sorted member set) is a
+        # pure function every pod recomputes from this map, so the
+        # assignment survives any resize with no negotiation, the
+        # same determinism trick as the relay tree
+        self.redundancy = None
 
     def new_stage(self):
         self.stage = unique_name.uid()

@@ -115,6 +115,7 @@ class Generator(object):
             return
         new.assign_ranks()
         self._plan_mesh(new, current)
+        self._plan_redundancy(new)
         self._commit(new, current=current)
 
     @staticmethod
@@ -146,6 +147,32 @@ class Generator(object):
         except Exception:
             logger.exception("mesh planner failed; committing flat dp")
             new.mesh = None
+
+    @staticmethod
+    def _plan_redundancy(new):
+        """Attach the redundancy partner rings for the new membership
+        to the cluster map. The ring rule (redundancy.partner_ring:
+        the next k+m members in the sorted cyclic order of the pod-id
+        set) is a pure function of the membership — every pod derives
+        the identical assignment from the committed map, so rings
+        survive any resize with no negotiation, exactly like the relay
+        tree's parent rule. The map copy exists for observability and
+        drift tests, not as a source of truth. Fail-open: a planning
+        error never blocks a commit."""
+        try:
+            from edl_tpu.runtime import redundancy
+            if not redundancy.enabled():
+                new.redundancy = None
+                return
+            k, m = redundancy.coding_params()
+            ids = new.pod_ids()
+            new.redundancy = {
+                pid: redundancy.partner_ring(ids, pid, k + m)
+                for pid in ids}
+        except Exception:
+            logger.exception("redundancy ring planning failed; "
+                             "committing without rings")
+            new.redundancy = None
 
     def _initial_cluster(self, resources):
         if len(resources) < self._min:

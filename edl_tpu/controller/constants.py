@@ -34,6 +34,11 @@ SERVICE_AUTOPILOT = "autopilot"
 # inlined there to keep coordination below controller; drift-guarded
 # by tests/test_relay.py)
 SERVICE_RELAY = "relay"
+# diskless fault tolerance: each StateServer accepting erasure-coded
+# partner checkpoint shards advertises here under a TTL lease; the
+# pusher's partner ring and the rebuilder's holder set are both
+# resolved from this registry (edl_tpu/runtime/redundancy.py)
+SERVICE_REDUNDANCY = "redundancy"
 
 LEADER_SERVER = "0"          # the single leader key
 CLUSTER_SERVER = "cluster"   # the single cluster-map key

@@ -85,6 +85,21 @@ relay.forward            relay side, before a child's wait_events
                          poll (delay, never loss), ``error`` forces
                          the child through the since_rev-lossless
                          reattach path
+redundancy.encode        push path, before the committed snapshot is
+                         erasure-coded (ctx: owner, version) — an
+                         armed ``error`` means this version gets no
+                         parity cover; the restore ladder must stay
+                         lossless via peers/FS
+redundancy.push          before each shard is sent to a ring partner
+                         (ctx: endpoint, owner, shard) — per-shard
+                         failures shrink the rebuild margin, never
+                         the commit
+redundancy.rebuild       rebuild side, before a dead owner's shards
+                         are fetched and decoded (ctx: owner,
+                         version) — an armed ``error`` is THE
+                         fallback drill: the restore must degrade to
+                         the FS rung byte-identically and emit a
+                         redundancy.fallback event (reason: fault)
 ======================== ===============================================
 
 Fault kinds:

@@ -760,7 +760,12 @@ class CheckpointManager(object):
         commits the MANIFEST last. Returns a SaveHandle; max_inflight
         is 1 — this call first drains any previous async save.
         ``on_commit`` (optional) runs on the driver thread right after
-        the manifest commit."""
+        the manifest commit — the hand-off point where the trainer
+        publishes the committed snapshot to its StateServer and pushes
+        erasure-coded shards to its redundancy partner ring
+        (runtime/redundancy.py). Callbacks are best-effort observers
+        of an already-durable commit: an on_commit failure is logged,
+        never surfaced as a save failure."""
         self.drain()
         t0 = time.perf_counter()
         # the snapshot is the async save's only training-thread cost
@@ -791,7 +796,15 @@ class CheckpointManager(object):
                                 mode="async", nbytes=total)
                 self._gc()
                 if on_commit is not None:
-                    on_commit()
+                    # the manifest is already durable: a failing
+                    # commit observer (state publish, redundancy
+                    # shard push) must not mark the save failed
+                    try:
+                        on_commit()
+                    except Exception:
+                        logger.exception(
+                            "on_commit callback for v%d failed",
+                            version)
                 handle._finish(vdir,
                                persist_s=time.perf_counter() - p0)
             except BaseException as e:  # noqa: BLE001 — surfaces via result()
@@ -1101,7 +1114,14 @@ class CheckpointManager(object):
                                              barrier, timeout,
                                              write_rank_files, commit)
                 if on_commit is not None:
-                    on_commit()
+                    # same contract as save_async: commit observers
+                    # are best-effort once the protocol completed
+                    try:
+                        on_commit()
+                    except Exception:
+                        logger.exception(
+                            "on_commit callback for v%d failed",
+                            version)
                 handle._finish(out, persist_s=time.perf_counter() - p0)
             except BaseException as e:  # noqa: BLE001 — surfaces via result()
                 handle._finish(None, exc=e,

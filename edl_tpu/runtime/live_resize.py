@@ -293,12 +293,15 @@ def _leaf_spec(x):
 
 def reshard_placed(tree, shardings, coord=None, ckpt=None, version=None,
                    self_endpoint=None, timeout=20.0):
-    """Reshard a live pytree onto new shardings IN PLACE of a restore:
-    paste locally-held spans straight from the device arrays (no wire,
-    no disk), fill the rest by peer range-reads at the committed
-    ``version``, then the per-span FS fallback. Returns
+    """Reshard a live pytree onto new shardings IN PLACE of a restore,
+    walking the recovery ladder: paste locally-held spans straight
+    from the device arrays (no wire, no disk), fill the rest by peer
+    range-reads at the committed ``version``, decode spans no live
+    peer serves from the redundancy tier's parity shards
+    (runtime/redundancy.py — zero FS reads even when pods died), then
+    the per-span FS fallback as the cold layer. Returns
     (new_tree, stats) where stats = {"source", "local_bytes",
-    "peer_bytes", "fs_keys", "peers"}.
+    "peer_bytes", "parity_bytes", "fs_keys", "peers"}.
 
     Raises MissingKeysError when spans remain uncovered — the caller
     rolls back to the old mesh and the stop-resume ladder takes over.
@@ -336,7 +339,8 @@ def reshard_placed(tree, shardings, coord=None, ckpt=None, version=None,
                 local_bytes += arr.nbytes
 
     stats = {"source": "local", "local_bytes": int(local_bytes),
-             "peer_bytes": 0, "fs_keys": [], "peers": 0}
+             "peer_bytes": 0, "parity_bytes": 0, "fs_keys": [],
+             "peers": 0}
     missing = pt.missing()
     if missing and coord is not None and version is not None:
         from edl_tpu.runtime.state_server import PeerRestorer
@@ -349,8 +353,24 @@ def reshard_placed(tree, shardings, coord=None, ckpt=None, version=None,
             stats["peers"] = peer_stats["peers"]
         except errors.PeerRestoreError as e:
             logger.info("live reshard: no peer path (%s); trying the "
-                        "FS fallback", e)
+                        "parity rung", e)
         missing = pt.missing()
+    if missing and coord is not None and version is not None:
+        # parity rung: spans only dead pods held decode from the
+        # erasure-coded shards survivors hold — still zero FS reads
+        from edl_tpu.runtime import redundancy
+        if redundancy.enabled():
+            try:
+                par = redundancy.fill_from_parity(
+                    coord, version, pt, self_endpoint=self_endpoint,
+                    timeout=timeout)
+                if par["owners"]:
+                    stats["source"] += "+parity"
+                    stats["parity_bytes"] = par["parity_bytes"]
+            except errors.EdlError as e:
+                logger.info("live reshard: parity rung unavailable "
+                            "(%s); trying the FS fallback", e)
+            missing = pt.missing()
     if missing and ckpt is not None and version is not None:
         for key in missing:
             pt.reset_key(key)

@@ -1,0 +1,760 @@
+"""Diskless fault tolerance: erasure-coded peer checkpoint shards.
+
+The restore ladder used to bottom out on the shared filesystem: a pod
+LOSS (as opposed to a survivor resharding) meant the dead pod's unique
+spans existed nowhere but the FS, so every failure paid a
+storage-bandwidth restore and at fleet scale the FS is both the
+recovery bottleneck and the blast radius. This module adds the
+redundancy tier that makes any f-pod loss recoverable entirely from
+survivors (the Gemini/SOSP'23 argument, extended with erasure coding
+so the host-RAM overhead is m/k of a replica):
+
+- on each async-save COMMIT, every pod packs its committed snapshot
+  spans (the same host copies the StateServer serves) into one blob,
+  k-of-n erasure-codes it (GF(256) Cauchy parity; m == 1 degenerates
+  to XOR, k == 1 to plain replication) and pushes one shard to each of
+  n = k+m partner pods over ``state.shard_put``;
+- partners hold shards in host RAM, versioned with the snapshot and
+  served back via the ``state.shard`` range-read RPC (alongside
+  ``state.read``), advertised through a SERVICE_REDUNDANCY lease;
+- when a pod dies, any survivor rebuilds the dead pod's snapshot from
+  any k of its n shards with ZERO FS reads — and pastes the decoded
+  spans straight into a :class:`~edl_tpu.runtime.checkpoint.
+  PlacedTarget`, so the rebuild lands directly in a NEW mesh
+  factorization (the same span-overlap machinery the resize path
+  uses; :func:`rebuild_plan` composes the decode with
+  ``parallel.costmodel.device_spans``/``tree_reshard_bytes`` to price
+  it analytically).
+
+Partner ring rule (:func:`partner_ring`): a pod's partners are the
+next n members after it in the SORTED cyclic order of the membership
+set — a pure function of the set, like the relay tree's parent rule,
+so every pod computes identical rings from the same cluster map and
+the assignment survives any resize with zero negotiation.
+
+Version fencing: a partner holds exactly ONE version per owner — the
+newest pushed — and ``state.shard`` raises StaleStateError on any
+mismatch; the rebuilder skips holders whose manifest shows a stale
+version, so a stale shard is never decoded into a newer restore.
+
+Ladder position (docs/elastic_resize.md "recovery ladder"): local
+device spans → peer snapshot reads → THIS parity rung → the FS, now a
+cold layer. The rung is strictly best-effort: every skip or failure
+falls through losslessly and is recorded via the
+``edl_redundancy_fs_fallbacks_total{reason}`` counter and a
+``redundancy.fallback`` obs event (reason: stale_version,
+insufficient_partners, fault, error) that job_doctor surfaces as a
+``rebuild_fallback`` finding.
+
+Kill switch: ``EDL_TPU_REDUNDANCY=0`` disables push, serve and rebuild
+(the pre-PR ladder). ``EDL_TPU_REDUNDANCY_K``/``_M`` size the code
+(default k=2, m=1).
+
+Chaos fault points: ``redundancy.encode`` (pre-encode on the push
+path; ctx: owner, version), ``redundancy.push`` (per shard send; ctx:
+endpoint, owner, shard), ``redundancy.rebuild`` (per dead-owner
+decode; ctx: owner, version) — see edl_tpu/robustness/faults.py.
+"""
+
+import json
+import os
+import struct
+import time
+
+import numpy as np
+
+from edl_tpu.controller import constants
+from edl_tpu.obs import events as obs_events
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.parallel import costmodel
+from edl_tpu.robustness import faults
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+_CHUNK = 4 << 20  # per range-read sub-fetch; matches the peer restorer
+
+DEFAULT_K = 2  # data shards: partners hold 1/k of the blob each
+DEFAULT_M = 1  # parity shards: tolerated partner losses per owner
+
+_PUSH_MS = obs_metrics.histogram(
+    "edl_redundancy_push_ms",
+    "encode + partner-ring shard push wall time per commit")
+_REBUILD_MS = obs_metrics.histogram(
+    "edl_redundancy_rebuild_ms",
+    "parity-rung rebuild wall time per restore attempt")
+_FALLBACKS = obs_metrics.counter(
+    "edl_redundancy_fs_fallbacks_total",
+    "parity rung skipped or failed; restore fell through toward FS",
+    labels=("reason",))
+SHARDS_HELD = obs_metrics.gauge(
+    "edl_redundancy_shards_held",
+    "partner checkpoint shards currently held in host RAM")
+
+
+def enabled():
+    """The EDL_TPU_REDUNDANCY kill switch (default on)."""
+    return os.environ.get("EDL_TPU_REDUNDANCY", "1") != "0"
+
+
+def coding_params():
+    """(k, m) from EDL_TPU_REDUNDANCY_K/_M, defaulting to (2, 1)."""
+    k = max(1, int(os.environ.get("EDL_TPU_REDUNDANCY_K", DEFAULT_K)))
+    m = max(0, int(os.environ.get("EDL_TPU_REDUNDANCY_M", DEFAULT_M)))
+    if k + m > 256:
+        raise ValueError("GF(256) code supports k+m <= 256, got %d"
+                         % (k + m))
+    return k, m
+
+
+def _fallback(reason, **attrs):
+    """Record why the parity rung was skipped/failed (counter + obs
+    event); job_doctor quotes the reason in its rebuild_fallback
+    finding."""
+    _FALLBACKS.labels(reason).inc()
+    obs_events.emit("redundancy.fallback", reason=reason, **attrs)
+
+
+# -- GF(256) codec ----------------------------------------------------------
+#
+# Systematic k-of-n code over GF(2^8) with the AES/Rijndael-adjacent
+# generator polynomial x^8+x^4+x^3+x^2+1 (0x11d, the classic
+# Reed-Solomon choice). Generator matrix [I_k ; C] with C an m x k
+# Cauchy block (C[i][j] = 1/(x_i ^ y_j), x_i = k+i, y_j = j): every
+# k x k minor of a Cauchy-extended identity is invertible, so ANY k of
+# the n = k+m shards decode. Vector math is numpy table lookups — no
+# third-party codec dependency.
+
+_GF_EXP = np.zeros(512, np.uint8)
+_GF_LOG = np.zeros(256, np.int64)
+_acc = 1
+for _i in range(255):
+    _GF_EXP[_i] = _acc
+    _GF_LOG[_acc] = _i
+    _acc <<= 1
+    if _acc & 0x100:
+        _acc ^= 0x11D
+_GF_EXP[255:510] = _GF_EXP[:255]
+del _acc, _i
+
+_MUL_TABLES = {}  # coeff -> 256-entry product row (lazily built)
+
+
+def _gf_mul(a, b):
+    if a == 0 or b == 0:
+        return 0
+    return int(_GF_EXP[_GF_LOG[a] + _GF_LOG[b]])
+
+
+def _gf_inv(a):
+    if a == 0:
+        raise ZeroDivisionError("GF(256) inverse of 0")
+    return int(_GF_EXP[255 - _GF_LOG[a]])
+
+
+def _mul_vec(c, v):
+    """c * v elementwise over GF(256) for a uint8 vector ``v``."""
+    if c == 0:
+        return np.zeros_like(v)
+    if c == 1:
+        return v
+    table = _MUL_TABLES.get(c)
+    if table is None:
+        table = np.zeros(256, np.uint8)
+        table[1:] = _GF_EXP[int(_GF_LOG[c]) + _GF_LOG[1:256]]
+        _MUL_TABLES[c] = table
+    return table[v]
+
+
+def _gf_dot(coeffs, vecs, chunk):
+    """XOR-accumulate ``sum_i coeffs[i] * vecs[i]`` over GF(256).
+
+    Zero terms are skipped and the accumulator is SEEDED from the
+    first live term instead of a zeros+XOR pass — with the normalized
+    parity rows (coefficient 1 everywhere in row 0) the m=1 path is a
+    single copy plus plain ``^`` passes, no GF table gathers."""
+    acc = None
+    for c, v in zip(coeffs, vecs):
+        if c == 0:
+            continue
+        t = _mul_vec(c, v)
+        if acc is None:
+            acc = t.copy() if t is v else t  # c==1 returns v itself
+        else:
+            acc ^= t
+    if acc is None:
+        return np.zeros(chunk, np.uint8)
+    return acc
+
+
+def _parity_rows(k, m):
+    """The m x k Cauchy block below I_k in the generator matrix,
+    column-scaled so row 0 is all ones. Diagonal column scaling
+    preserves every mixed minor of [I_k ; C] (identity rows expand to
+    a scaled Cauchy minor, still nonzero), so the code stays MDS —
+    and the m=1 default becomes PLAIN XOR: encode and the
+    single-loss decode run at numpy ^ speed instead of GF table
+    gathers."""
+    rows = [[_gf_inv((k + i) ^ j) for j in range(k)]
+            for i in range(m)]
+    if not rows:
+        return rows
+    scale = [_gf_inv(c) for c in rows[0]]
+    return [[_gf_mul(c, s) for c, s in zip(row, scale)]
+            for row in rows]
+
+
+def _gf_matinv(a):
+    """Invert a small k x k matrix over GF(256) (Gauss-Jordan)."""
+    k = len(a)
+    aug = [list(row) + [1 if r == c else 0 for c in range(k)]
+           for r, row in enumerate(a)]
+    for col in range(k):
+        piv = next((r for r in range(col, k) if aug[r][col]), None)
+        if piv is None:
+            raise errors.RedundancyError("singular decode matrix")
+        aug[col], aug[piv] = aug[piv], aug[col]
+        inv = _gf_inv(aug[col][col])
+        aug[col] = [_gf_mul(inv, v) for v in aug[col]]
+        for r in range(k):
+            if r == col or not aug[r][col]:
+                continue
+            f = aug[r][col]
+            aug[r] = [v ^ _gf_mul(f, w)
+                      for v, w in zip(aug[r], aug[col])]
+    return [row[k:] for row in aug]
+
+
+def encode(blob, k, m):
+    """blob (bytes or uint8 array) -> n = k+m uint8 shards of equal
+    ``chunk_len = ceil(len(blob)/k)``. Shards 0..k-1 are the data
+    chunks verbatim (systematic: an all-data decode is a concat),
+    k..n-1 the Cauchy parity."""
+    if isinstance(blob, (bytes, bytearray, memoryview)):
+        blob = np.frombuffer(blob, np.uint8)
+    blob = np.ascontiguousarray(blob).view(np.uint8).reshape(-1)
+    k, m = int(k), int(m)
+    if k < 1 or m < 0 or k + m > 256:
+        raise ValueError("bad code parameters k=%d m=%d" % (k, m))
+    chunk = max(1, -(-blob.size // k))
+    padded = np.zeros(k * chunk, np.uint8)
+    padded[:blob.size] = blob
+    data = [padded[i * chunk:(i + 1) * chunk] for i in range(k)]
+    shards = list(data)
+    for row in _parity_rows(k, m):
+        acc = _gf_dot(row, data, chunk)
+        shards.append(acc)
+    return shards
+
+
+def decode(shards, k, m, blob_len):
+    """Rebuild the blob from any k of the n shards.
+
+    ``shards``: {shard_index: uint8 array}. Raises RedundancyError
+    when fewer than k shards are present (reason
+    ``insufficient_partners``)."""
+    k, m = int(k), int(m)
+    have = {int(i): np.ascontiguousarray(v).view(np.uint8).reshape(-1)
+            for i, v in shards.items()}
+    if len(have) < k:
+        e = errors.RedundancyError(
+            "decode needs %d shards, have %d" % (k, len(have)))
+        e.reason = "insufficient_partners"
+        raise e
+    # prefer data shards: every present one is a free (identity) row
+    use = sorted(i for i in have if i < k)
+    use += sorted(i for i in have if i >= k)
+    use = use[:k]
+    chunk = have[use[0]].size
+    if any(have[i].size != chunk for i in use):
+        raise errors.RedundancyError("shard length mismatch")
+    if use == list(range(k)):  # all data shards survived
+        out = np.concatenate([have[i] for i in use]) if use else \
+            np.empty(0, np.uint8)
+        return out[:int(blob_len)]
+    rows = _parity_rows(k, m)
+    mat = [([1 if c == i else 0 for c in range(k)] if i < k
+            else rows[i - k]) for i in use]
+    inv = _gf_matinv(mat)
+    chunks = []
+    for j in range(k):
+        terms = [(c, i) for c, i in zip(inv[j], use) if c]
+        if len(terms) == 1 and terms[0][0] == 1:
+            # identity row (surviving data shard): concatenate below
+            # is the only copy this chunk ever pays
+            chunks.append(have[terms[0][1]])
+            continue
+        chunks.append(_gf_dot([c for c, _ in terms],
+                              [have[i] for _, i in terms], chunk))
+    return np.concatenate(chunks)[:int(blob_len)]
+
+
+# -- snapshot blob ----------------------------------------------------------
+
+def pack_snapshot(entries, dtypes, meta=None):
+    """Pack a StateServer snapshot ({skey: host ndarray}, dtype tags,
+    meta) into one contiguous uint8 blob: an 8-byte little-endian
+    header length, a JSON header (schema redundancy_blob/v1 with
+    per-entry dtype/shape/offset), then the raw entry bytes."""
+    recs, bufs, off = [], [], 0
+    for skey in sorted(entries):
+        # asarray(order="C"), NOT ascontiguousarray: the latter
+        # promotes 0-d scalars to shape (1,) and the header must
+        # record the true shape
+        arr = np.asarray(entries[skey], order="C")
+        flat = (np.frombuffer(memoryview(arr).cast("B"), np.uint8)
+                if arr.nbytes else np.empty(0, np.uint8))
+        recs.append({"skey": skey, "dtype": arr.dtype.str,
+                     "shape": list(arr.shape),
+                     "nbytes": int(arr.nbytes), "offset": off})
+        bufs.append(flat)
+        off += int(arr.nbytes)
+    head = json.dumps({"schema": "redundancy_blob/v1",
+                       "dtypes": dict(dtypes), "meta": meta,
+                       "entries": recs}).encode("utf-8")
+    blob = np.empty(8 + len(head) + off, np.uint8)
+    blob[:8] = np.frombuffer(struct.pack("<Q", len(head)), np.uint8)
+    blob[8:8 + len(head)] = np.frombuffer(head, np.uint8)
+    pos = 8 + len(head)
+    for flat in bufs:
+        blob[pos:pos + flat.size] = flat
+        pos += flat.size
+    return blob
+
+
+def unpack_snapshot(blob):
+    """Inverse of :func:`pack_snapshot` -> (entries, dtypes, meta)."""
+    blob = np.ascontiguousarray(blob).view(np.uint8).reshape(-1)
+    hlen = struct.unpack("<Q", blob[:8].tobytes())[0]
+    head = json.loads(blob[8:8 + hlen].tobytes().decode("utf-8"))
+    if head.get("schema") != "redundancy_blob/v1":
+        raise errors.RedundancyError(
+            "bad blob schema: %r" % (head.get("schema"),))
+    base = 8 + hlen
+    entries = {}
+    for rec in head["entries"]:
+        lo = base + int(rec["offset"])
+        raw = blob[lo:lo + int(rec["nbytes"])]
+        entries[rec["skey"]] = raw.view(
+            np.dtype(rec["dtype"])).reshape(tuple(rec["shape"]))
+    return entries, head.get("dtypes") or {}, head.get("meta")
+
+
+# -- partner ring -----------------------------------------------------------
+
+def partner_ring(members, self_id, n):
+    """The next ``n`` members after ``self_id`` in the sorted cyclic
+    order of the member-id set, self excluded. A pure function of the
+    set — every pod computes identical rings from the same cluster
+    map (the relay-tree trick), so partner assignment survives any
+    resize with no negotiation and no tie-breaks."""
+    ids = sorted({str(x) for x in members} | {str(self_id)})
+    me = ids.index(str(self_id))
+    others = [ids[(me + 1 + i) % len(ids)] for i in range(len(ids) - 1)]
+    return others[:max(0, int(n))]
+
+
+def _discover(coord, self_endpoint=None):
+    """Sorted [(member_key, endpoint)] from SERVICE_REDUNDANCY leases
+    (self excluded by endpoint)."""
+    recs = coord.get_service(constants.SERVICE_REDUNDANCY)
+    out = []
+    for key, value in recs:
+        try:
+            rec = json.loads(value)
+        except ValueError:
+            continue
+        endpoint = rec.get("endpoint")
+        if not endpoint or endpoint == self_endpoint:
+            continue
+        out.append((str(key), endpoint))
+    return sorted(out)
+
+
+# -- push (the commit-path hand-off) ----------------------------------------
+
+def push_shards(coord, owner, version, entries, dtypes, meta=None,
+                self_endpoint=None, k=None, m=None, timeout=20.0):
+    """Encode this pod's freshly committed snapshot and push one shard
+    to each partner on its ring. Called from the async-save commit
+    hand-off (the same driver-thread hook that publishes to the
+    StateServer), so it never blocks a training step.
+
+    Strictly best-effort: per-partner failures are logged and
+    counted, never raised — a missing push only narrows the rebuild
+    margin for THIS version. When fewer than k+m partners are alive
+    the code shrinks (n = live partners, m' = min(m, n-1)); a single
+    partner degenerates to one full replica shard.
+
+    Returns {"partners", "pushed", "k", "m", "nbytes", "version"}."""
+    t0 = time.perf_counter()
+    if k is None or m is None:
+        dk, dm = coding_params()
+        k = dk if k is None else int(k)
+        m = dm if m is None else int(m)
+    try:
+        live = dict(_discover(coord, self_endpoint))
+    except errors.EdlError as e:
+        logger.warning("redundancy: partner discovery failed (%r); "
+                       "no shards pushed for v%s", e, version)
+        return {"partners": 0, "pushed": 0, "k": 0, "m": 0,
+                "nbytes": 0, "version": int(version)}
+    ring = [(key, live[key]) for key in
+            partner_ring(list(live) + [str(owner)], str(owner), k + m)
+            if key in live]
+    if not ring:
+        logger.info("redundancy: no live partners; v%s not redundant",
+                    version)
+        return {"partners": 0, "pushed": 0, "k": 0, "m": 0,
+                "nbytes": 0, "version": int(version)}
+    n = min(k + m, len(ring))
+    m_eff = min(m, n - 1)
+    k_eff = n - m_eff
+    if faults.PLANE is not None:
+        faults.PLANE.fire("redundancy.encode", owner=str(owner),
+                          version=str(version))
+    blob = pack_snapshot(entries, dtypes, meta)
+    shards = encode(blob, k_eff, m_eff)
+    header = {"k": k_eff, "m": m_eff, "blob_len": int(blob.size),
+              "chunk_len": int(shards[0].size)}
+    inflight = []
+    for idx, (pkey, endpoint) in enumerate(ring[:n]):
+        client = None
+        try:
+            if faults.PLANE is not None:
+                faults.PLANE.fire("redundancy.push", endpoint=endpoint,
+                                  owner=str(owner), shard=str(idx))
+            client = RpcClient(endpoint, timeout=timeout)
+            fut = client.call_async("state.shard_put", str(owner),
+                                    int(version), idx, header,
+                                    shards[idx], timeout=timeout)
+            inflight.append((endpoint, client, fut))
+        except Exception as e:  # noqa: BLE001 — any partner may be gone
+            logger.warning("redundancy: shard %d push to %s failed at "
+                           "dial (%r)", idx, endpoint, e)
+            if client is not None:
+                client.close()
+    pushed = 0
+    for endpoint, client, fut in inflight:
+        try:
+            fut.result()
+            pushed += 1
+        except Exception as e:  # noqa: BLE001
+            logger.warning("redundancy: shard push to %s failed (%r)",
+                           endpoint, e)
+        finally:
+            client.close()
+    _PUSH_MS.observe((time.perf_counter() - t0) * 1e3)
+    obs_events.emit("redundancy.pushed", owner=str(owner),
+                    version=int(version), pushed=pushed,
+                    partners=len(ring), k=k_eff, m=m_eff)
+    return {"partners": len(ring), "pushed": pushed, "k": k_eff,
+            "m": m_eff, "nbytes": int(blob.size),
+            "version": int(version)}
+
+
+# -- rebuild (the diskless rung) --------------------------------------------
+
+def _holders(coord, self_endpoint=None, timeout=20.0):
+    """[(key, endpoint, client, shard_manifest)] for live redundancy
+    peers; open clients are the caller's to close."""
+    members = _discover(coord, self_endpoint)
+    inflight = []
+    for key, endpoint in members:
+        client = None
+        try:
+            client = RpcClient(endpoint, timeout=timeout)
+            fut = client.call_async("state.shard_manifest",
+                                    timeout=timeout)
+            inflight.append((key, endpoint, client, fut))
+        except Exception as e:  # noqa: BLE001
+            logger.warning("redundancy: holder %s unreachable (%r)",
+                           endpoint, e)
+            if client is not None:
+                client.close()
+    holders = []
+    for key, endpoint, client, fut in inflight:
+        try:
+            manifest = fut.result()
+        except Exception as e:  # noqa: BLE001
+            logger.warning("redundancy: shard manifest from %s failed "
+                           "(%r)", endpoint, e)
+            client.close()
+            continue
+        holders.append((key, endpoint, client, manifest))
+    return holders
+
+
+def _issue_shard(client, owner, version, idx, nbytes, chunk, timeout):
+    """Issue the pipelined chunked range-reads for one shard; returns
+    the future list (join with :func:`_join_shard`). Issuing for every
+    needed shard BEFORE joining any overlaps the transfers across
+    holders — each holder is a distinct server, so the wall clock is
+    the slowest single shard, not the sum."""
+    if nbytes <= 0:
+        return []
+    return [client.call_async("state.shard", str(owner), int(version),
+                              int(idx), off, min(chunk, nbytes - off),
+                              timeout=timeout)
+            for off in range(0, nbytes, chunk)]
+
+
+def _join_shard(futs, owner, idx, nbytes):
+    if nbytes <= 0:
+        return np.empty(0, np.uint8)
+    parts = [np.asarray(f.result()) for f in futs]
+    data = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    data = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+    if data.size != nbytes:
+        raise IOError("shard %s/%d: got %d bytes, want %d"
+                      % (owner, idx, data.size, nbytes))
+    return data
+
+
+def _fetch_shard(client, owner, version, idx, nbytes, chunk, timeout):
+    """Blocking single-shard fetch (the sequential fallback path)."""
+    futs = _issue_shard(client, owner, version, idx, nbytes, chunk,
+                        timeout)
+    return _join_shard(futs, owner, idx, nbytes)
+
+
+def rebuild_owner(holders, owner, version, timeout=20.0, chunk=_CHUNK):
+    """Decode one dead owner's snapshot at ``version`` from any k of
+    its live shards -> (entries, dtypes, meta). Stale-versioned
+    holders are skipped (never decoded); a holder dying mid-fetch is
+    survived by falling to the remaining shard indices. Raises
+    RedundancyError (with a ``reason`` attribute) when the surviving
+    shard set is insufficient."""
+    owner = str(owner)
+    by_idx = {}  # shard index -> [(client, endpoint), ...]
+    header = None
+    stale = 0
+    for _key, endpoint, client, manifest in holders:
+        rec = (manifest.get("shards") or {}).get(owner)
+        if not rec:
+            continue
+        if int(rec.get("version", -1)) != int(version):
+            stale += 1
+            continue
+        header = rec
+        for idx in rec.get("held") or []:
+            by_idx.setdefault(int(idx), []).append((client, endpoint))
+    if header is None or len(by_idx) < int(header["k"]):
+        reason = "stale_version" if stale else "insufficient_partners"
+        e = errors.RedundancyError(
+            "owner %s@v%s: %d shard index(es) live (%d stale "
+            "holder(s)), need k=%s" % (owner, version, len(by_idx),
+                                       stale,
+                                       header["k"] if header else "?"))
+        e.reason = reason
+        raise e
+    k = int(header["k"])
+    # data shards first (identity rows decode for free), then parity;
+    # keep fetching past k failures until the indices run out
+    order = sorted(by_idx, key=lambda i: (i >= k, i))
+    nbytes = int(header["chunk_len"])
+    got = {}
+    # fast path: issue k+1 shards concurrently (one holder each) and
+    # join in preference order, stopping at k — the +1 hedge means a
+    # single holder dying mid-rebuild (the common failure while a dead
+    # pod is being rebuilt) costs no serial refetch, at one shard of
+    # extra transfer that overlaps the needed ones anyway
+    inflight = []
+    for idx in order[:k + 1]:
+        client, endpoint = by_idx[idx][0]
+        try:
+            inflight.append((idx, endpoint, _issue_shard(
+                client, owner, version, idx, nbytes, chunk, timeout)))
+        except Exception as e:  # noqa: BLE001 — holder already gone
+            logger.warning("redundancy: shard %s/%d issue to %s failed "
+                           "(%r)", owner, idx, endpoint, e)
+    for idx, endpoint, futs in inflight:
+        if len(got) >= k:
+            break
+        try:
+            got[idx] = _join_shard(futs, owner, idx, nbytes)
+        except Exception as e:  # noqa: BLE001 — holder died mid-read
+            logger.warning("redundancy: shard %s/%d from %s failed "
+                           "(%r)", owner, idx, endpoint, e)
+    # slow path: anything still short is retried sequentially over
+    # every remaining (index, holder) alternative
+    for idx in order:
+        if len(got) >= k:
+            break
+        if idx in got:
+            continue
+        for client, endpoint in by_idx[idx]:
+            try:
+                got[idx] = _fetch_shard(client, owner, version, idx,
+                                        nbytes, chunk, timeout)
+                break
+            except Exception as e:  # noqa: BLE001 — holder died mid-read
+                logger.warning("redundancy: shard %s/%d from %s failed "
+                               "(%r)", owner, idx, endpoint, e)
+    if len(got) < k:
+        e = errors.RedundancyError(
+            "owner %s@v%s: fetched %d of k=%d shards"
+            % (owner, version, len(got), k))
+        e.reason = "insufficient_partners"
+        raise e
+    blob = decode(got, k, int(header["m"]), int(header["blob_len"]))
+    return unpack_snapshot(blob)
+
+
+def fill_from_parity(coord, version, pt, self_endpoint=None,
+                     timeout=20.0):
+    """Fill a PlacedTarget's still-missing spans by decoding dead
+    owners' parity shards held by survivors — ZERO FS reads. The
+    caller has already pasted everything it holds locally and (when
+    live) everything peers serve; what remains is exactly the dead
+    pods' unique spans.
+
+    Returns {"parity_bytes", "owners", "holders", "meta", "reason"}
+    (reason set when some rebuild was skipped). Raises
+    RedundancyError only when no holder is reachable at all. Never
+    raises on per-owner failure: the FS rung below stays the lossless
+    backstop."""
+    from edl_tpu.runtime.checkpoint import _parse_spans, _untag_array
+    t0 = time.perf_counter()
+    holders = _holders(coord, self_endpoint, timeout)
+    if not holders:
+        _fallback("insufficient_partners", version=int(version))
+        raise errors.RedundancyError(
+            "no redundancy holders alive for v%s" % (version,))
+    try:
+        owners = sorted({o for _k, _e, _c, man in holders
+                         for o in (man.get("shards") or {})})
+        parity_bytes = 0
+        rebuilt = []
+        meta = None
+        reason = None
+        for owner in owners:
+            missing = pt.missing()
+            if not missing:
+                break
+            try:
+                if faults.PLANE is not None:
+                    faults.PLANE.fire("redundancy.rebuild",
+                                      owner=str(owner),
+                                      version=str(version))
+            except Exception:  # noqa: BLE001 — injected chaos
+                reason = "fault"
+                _fallback("fault", owner=str(owner),
+                          version=int(version))
+                continue
+            try:
+                entries, dtypes, meta_o = rebuild_owner(
+                    holders, owner, version, timeout)
+            except errors.RedundancyError as e:
+                reason = getattr(e, "reason", "error")
+                _fallback(reason, owner=str(owner),
+                          version=int(version))
+                logger.info("redundancy: rebuild of %s skipped (%r)",
+                            owner, e)
+                continue
+            except Exception as e:  # noqa: BLE001
+                reason = "error"
+                _fallback("error", owner=str(owner),
+                          version=int(version))
+                logger.warning("redundancy: rebuild of %s failed (%r)",
+                               owner, e)
+                continue
+            pasted = 0
+            for skey, arr in entries.items():
+                key, _, spans_s = skey.rpartition("@")
+                if key not in missing:
+                    continue
+                entry_spans = _parse_spans(spans_s)
+                pt.check_bounds(key, entry_spans)
+                if not pt.overlaps_local(key, entry_spans):
+                    continue
+                pt.paste(key, entry_spans,
+                         _untag_array(np.ascontiguousarray(arr),
+                                      dtypes.get(key)))
+                pasted += arr.nbytes
+            if pasted:
+                rebuilt.append(str(owner))
+                parity_bytes += pasted
+            if meta is None:
+                meta = meta_o
+        _REBUILD_MS.observe((time.perf_counter() - t0) * 1e3)
+        if rebuilt:
+            obs_events.emit("redundancy.rebuilt", version=int(version),
+                            owners=",".join(rebuilt),
+                            nbytes=int(parity_bytes))
+        return {"parity_bytes": int(parity_bytes), "owners": rebuilt,
+                "holders": len(holders), "meta": meta,
+                "reason": reason}
+    finally:
+        for _key, _endpoint, client, _manifest in holders:
+            client.close()
+
+
+def restore_placed(coord, version, target, shardings,
+                   self_endpoint=None, timeout=20.0):
+    """Wholesale placed restore decoded purely from partner shards —
+    the rung the trainer tries when NO live peer serves the version
+    (every data-holding pod of the old world is gone) before paying
+    the cold FS restore. Returns (version, tree, meta, stats); raises
+    RedundancyError when spans remain missing (the caller falls to
+    FS)."""
+    from edl_tpu.runtime.checkpoint import PlacedTarget
+    pt = PlacedTarget(target, shardings)
+    stats = fill_from_parity(coord, version, pt,
+                             self_endpoint=self_endpoint,
+                             timeout=timeout)
+    missing = pt.missing()
+    if missing:
+        raise errors.RedundancyError(
+            "parity rebuild left %d key(s) missing: %s"
+            % (len(missing), sorted(missing)[:5]))
+    meta = stats.pop("meta", None)
+    out = {"source": "parity", "parity_bytes": stats["parity_bytes"],
+           "owners": stats["owners"], "holders": stats["holders"]}
+    return int(version), pt.assemble(), meta, out
+
+
+# -- analytic plan (costmodel composition) ----------------------------------
+
+def rebuild_plan(leaves, src_axes, dst_axes, lost_devices):
+    """Price a rebuild-into-a-new-factorization after losing
+    ``lost_devices`` (source-mesh device indices): compose the parity
+    decode with the costmodel's span addressing.
+
+    leaves: [(shape, itemsize, src_spec, dst_spec)] — the same record
+    ``costmodel.tree_reshard_bytes`` takes. For every distinct block
+    of the destination placement, the bytes are classed by where they
+    can come from: a surviving source device that holds them
+    (``survivor_bytes``, plain ``state.read`` peer traffic) or ONLY
+    lost devices (``parity_bytes``, must come out of the decode).
+    ``reshard_bytes`` is ``tree_reshard_bytes``' wire total for the
+    same move, so callers can report the parity fraction of the
+    resize."""
+    lost = {int(d) for d in lost_devices}
+    parity = survivor = 0
+    for shape, itemsize, src_spec, dst_spec in leaves:
+        src = costmodel.device_spans(shape, src_spec, src_axes)
+        dst = costmodel.device_spans(shape, dst_spec, dst_axes)
+        src_boxes = {}  # distinct source block -> holder device set
+        for dev, spans in src.items():
+            src_boxes.setdefault(tuple(spans), set()).add(dev)
+        seen = set()
+        for _dev, spans in dst.items():
+            box = tuple(spans)
+            if box in seen:  # dst replicas fan out after one fetch
+                continue
+            seen.add(box)
+            for sbox, devs in src_boxes.items():
+                vol = costmodel._overlap_volume(box, sbox) * itemsize
+                if not vol:
+                    continue
+                if devs - lost:
+                    survivor += vol
+                else:
+                    parity += vol
+    moved, needed = costmodel.tree_reshard_bytes(leaves, src_axes,
+                                                 dst_axes)
+    return {"parity_bytes": int(parity),
+            "survivor_bytes": int(survivor),
+            "reshard_bytes": int(moved),
+            "needed_bytes": int(needed)}

@@ -21,8 +21,16 @@ checkpoints cut recovery from storage-bandwidth to NIC-bandwidth):
   restore uses.
 
 Fallback ladder (docs/elastic_resize.md): peers → alternate peers for
-the same span → per-span FS range reads (fill_placed_from_fs) →
-wholesale ``restore_placed`` (the caller's job, on PeerRestoreError).
+the same span → parity decode of dead pods' shards
+(runtime/redundancy.py, zero FS reads) → per-span FS range reads
+(fill_placed_from_fs) → wholesale ``restore_placed`` (the caller's
+job, on PeerRestoreError).
+
+The server doubles as the redundancy tier's shard depot: partners
+push erasure-coded snapshot shards via ``state.shard_put`` (host RAM,
+one version per owner) and rebuilders range-read them back via
+``state.shard``/``state.shard_manifest`` — advertised separately
+under SERVICE_REDUNDANCY (``advertise_redundancy``).
 
 Version/ownership rules: a server serves exactly ONE version — the
 newest committed — and ``state.read`` raises StaleStateError when a
@@ -124,9 +132,23 @@ class StateServer(object):
         self._table = {}   # skey -> {dtype, shape, nbytes}
         self._dtypes = {}
         self._register = None
+        self._redundancy_register = None
+        # partner shards held for the redundancy tier
+        # (runtime/redundancy.py): owner -> {"version", "k", "m",
+        # "blob_len", "chunk_len", "held": {index: flat uint8}}. One
+        # version per owner — a newer put evicts, an older one fences.
+        self._shards = {}
+        # test/bench hook (owner, index) -> None, called before a
+        # state.shard read replies — peer_holdout --kill uses it to
+        # drill the decode-with-missing-partner path
+        self.shard_read_hook = None
         self._server = RpcServer(host=host, port=port)
         self._server.register("state.manifest", self._rpc_manifest)
         self._server.register("state.read", self._rpc_read)
+        self._server.register("state.shard_put", self._rpc_shard_put)
+        self._server.register("state.shard", self._rpc_shard)
+        self._server.register("state.shard_manifest",
+                              self._rpc_shard_manifest)
         self._server.start()
 
     @property
@@ -152,6 +174,25 @@ class StateServer(object):
         except errors.EdlError as e:
             logger.warning("state server: advertise failed (%r); peers "
                            "will not find this process", e)
+
+    def advertise_redundancy(self, coord, key=None, ttl=None):
+        """Second TTL-leased registration, under SERVICE_REDUNDANCY:
+        this process accepts partner checkpoint shards
+        (``state.shard_put``) and serves them back (``state.shard``).
+        ``key`` defaults to the rank; the redundancy ring is computed
+        over these keys. Best-effort, like :meth:`advertise`."""
+        from edl_tpu.controller.register import Register
+        value = json.dumps({"endpoint": self.endpoint,
+                            "rank": self._rank})
+        try:
+            self._redundancy_register = Register(
+                coord, constants.SERVICE_REDUNDANCY,
+                str(self._rank) if key is None else str(key),
+                value, ttl=ttl or constants.ETCD_TTL)
+        except errors.EdlError as e:
+            logger.warning("state server: redundancy advertise failed "
+                           "(%r); this process holds no partner "
+                           "shards", e)
 
     def publish(self, version, entries, dtypes, meta=None):
         """Atomically swap the served snapshot to ``version``. Entries
@@ -185,12 +226,14 @@ class StateServer(object):
             self._meta = None
 
     def stop(self):
-        if self._register is not None:
-            try:
-                self._register.stop()
-            except errors.EdlError:
-                pass
-            self._register = None
+        for attr in ("_register", "_redundancy_register"):
+            reg = getattr(self, attr)
+            if reg is not None:
+                try:
+                    reg.stop()
+                except errors.EdlError:
+                    pass
+                setattr(self, attr, None)
         self._server.stop()
 
     # -- served methods ----------------------------------------------------
@@ -212,6 +255,73 @@ class StateServer(object):
             raise errors.NotFoundError("peer rank %d has no entry %s"
                                        % (self._rank, skey))
         return flat[int(offset):int(offset) + int(length)]
+
+    # -- redundancy tier (erasure-coded partner shards) ---------------------
+
+    def _rpc_shard_put(self, owner, version, index, header, payload):
+        """Accept one erasure-coded shard of ``owner``'s snapshot at
+        ``version`` into host RAM. One version per owner: a newer put
+        drops the old shard set, an older one raises StaleStateError
+        (the version fence — a stale shard is never stored past a
+        newer one, so it can never be decoded into a newer restore)."""
+        owner = str(owner)
+        version = int(version)
+        flat = np.ascontiguousarray(
+            np.asarray(payload)).view(np.uint8).reshape(-1)
+        with self._lock:
+            rec = self._shards.get(owner)
+            if rec is not None and version < rec["version"]:
+                raise errors.StaleStateError(
+                    "shard_put %s: held v%d is newer than v%d"
+                    % (owner, rec["version"], version))
+            if rec is None or version > rec["version"]:
+                rec = {"version": version, "k": int(header["k"]),
+                       "m": int(header["m"]),
+                       "blob_len": int(header["blob_len"]),
+                       "chunk_len": int(header["chunk_len"]),
+                       "held": {}}
+                self._shards[owner] = rec
+            rec["held"][int(index)] = flat
+            total = sum(len(r["held"]) for r in self._shards.values())
+        from edl_tpu.runtime import redundancy
+        redundancy.SHARDS_HELD.set(total)
+        return {"version": version, "held": len(rec["held"])}
+
+    def _rpc_shard(self, owner, version, index, offset, length):
+        """Range-read of a held partner shard (the rebuild path's
+        ``state.read`` analogue). StaleStateError on any version
+        mismatch, NotFoundError for a shard this peer does not hold."""
+        hook = self.shard_read_hook
+        if hook is not None:
+            hook(str(owner), int(index))
+        with self._lock:
+            rec = self._shards.get(str(owner))
+            if rec is None:
+                raise errors.NotFoundError(
+                    "peer rank %d holds no shards for owner %s"
+                    % (self._rank, owner))
+            if rec["version"] != int(version):
+                raise errors.StaleStateError(
+                    "shards for %s are v%d, not v%s"
+                    % (owner, rec["version"], version))
+            flat = rec["held"].get(int(index))
+        if flat is None:
+            raise errors.NotFoundError(
+                "peer rank %d holds no shard %s/%s"
+                % (self._rank, owner, index))
+        return flat[int(offset):int(offset) + int(length)]
+
+    def _rpc_shard_manifest(self):
+        """What this peer holds, per owner — the rebuilder intersects
+        these across holders to find k live shards per dead owner."""
+        with self._lock:
+            return {"rank": self._rank,
+                    "shards": {owner: {"version": rec["version"],
+                                       "k": rec["k"], "m": rec["m"],
+                                       "blob_len": rec["blob_len"],
+                                       "chunk_len": rec["chunk_len"],
+                                       "held": sorted(rec["held"])}
+                               for owner, rec in self._shards.items()}}
 
 
 class PeerRestorer(object):
@@ -454,6 +564,32 @@ class PeerRestorer(object):
         pt = PlacedTarget(target, shardings)
         peer_bytes, failed, meta = self._fill_from(peers, version, pt)
         need_fs = failed | pt.missing()
+        parity_bytes = 0
+        parity_owners = []
+        if need_fs and self._coord is not None:
+            # the diskless rung: spans no live peer serves (a dead
+            # pod's unique shards) may still decode from the parity
+            # shards survivors hold — zero FS reads. Strictly
+            # best-effort; the FS fill below stays the backstop.
+            from edl_tpu.runtime import redundancy
+            if redundancy.enabled():
+                before = pt.missing()
+                try:
+                    par = redundancy.fill_from_parity(
+                        self._coord, version, pt,
+                        self_endpoint=self._self_endpoint,
+                        timeout=self._timeout)
+                    parity_bytes = par["parity_bytes"]
+                    parity_owners = par["owners"]
+                    if meta is None:
+                        meta = par.get("meta")
+                except errors.EdlError as e:
+                    logger.info("peer restore v%s: parity rung "
+                                "unavailable (%r)", version, e)
+                # keys the parity decode completed need no FS refill;
+                # everything else keeps the original reset-and-refill
+                # accounting
+                need_fs -= before - pt.missing()
         if need_fs:
             # a key partially pasted from peers restarts from zero so
             # the FS fill's coverage accounting stays exact
@@ -476,7 +612,13 @@ class PeerRestorer(object):
         missing = pt.missing()
         if missing:
             raise MissingKeysError(missing)
-        stats = {"source": "peer+fs" if need_fs else "peer",
-                 "peer_bytes": int(peer_bytes),
+        source = "peer"
+        if parity_owners:
+            source += "+parity"
+        if need_fs:
+            source += "+fs"
+        stats = {"source": source, "peer_bytes": int(peer_bytes),
+                 "parity_bytes": int(parity_bytes),
+                 "parity_owners": parity_owners,
                  "fs_keys": sorted(need_fs), "peers": len(peers)}
         return version, pt.assemble(), meta, stats

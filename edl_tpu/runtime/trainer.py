@@ -586,6 +586,15 @@ class ElasticTrainer(object):
                     rank=self.env.global_rank,
                     host=os.environ.get("EDL_TPU_POD_IP", "0.0.0.0"))
                 self._state_server.advertise(self.coord)
+                # diskless redundancy tier (runtime/redundancy.py):
+                # accept partners' erasure-coded snapshot shards and
+                # push our own on every commit, so a pod loss rebuilds
+                # from survivors with zero FS reads. Kill switch:
+                # EDL_TPU_REDUNDANCY=0.
+                from edl_tpu.runtime import redundancy as redundancy_mod
+                if redundancy_mod.enabled():
+                    self._state_server.advertise_redundancy(
+                        self.coord, key=str(self.env.global_rank))
             except Exception:
                 logger.exception("state server failed to start; peer "
                                  "restore disabled for this process")
@@ -1899,13 +1908,31 @@ class ElasticTrainer(object):
         # is always also manifest-valid on the FS.
         publish = None
         if self._state_server is not None:
+            from edl_tpu.runtime import redundancy as redundancy_mod
             from edl_tpu.runtime import state_server as state_server_mod
             entries, dtags = state_server_mod.snapshot_entries(
                 dict(self.train_state))
             srv = self._state_server
+            coord = self.coord
+            owner = str(self.env.global_rank)
 
             def publish():
                 srv.publish(version, entries, dtags, meta=meta)
+                # commit-path hand-off to the redundancy tier: encode
+                # the same committed host copies and push the shards
+                # to this pod's partner ring. Runs on the persist
+                # driver thread (never the training step) and is
+                # strictly best-effort — the version is already
+                # durable on the FS and served by the StateServer.
+                if coord is not None and redundancy_mod.enabled():
+                    try:
+                        redundancy_mod.push_shards(
+                            coord, owner, version, entries, dtags,
+                            meta=meta, self_endpoint=srv.endpoint)
+                    except Exception:
+                        logger.exception(
+                            "redundancy shard push for v%d failed; "
+                            "this version has no parity cover", version)
 
         if not self._state_fully_addressable():
             # per-host sharded write; every rank participates
@@ -1994,14 +2021,18 @@ class ElasticTrainer(object):
             state_mod.save_to_store(self.coord, snap)
 
     def _restore_placed_any(self, version, target, shardings):
-        """restore_placed with the peer fast path: fetch from live peer
-        StateServers first (NIC bandwidth, host memory), fall back
-        WHOLESALE to the shared FS when no usable peer path exists.
-        MissingKeysError propagates either way — the caller's core-only
-        retry must see it. Returns (version, tree, meta)."""
+        """restore_placed walking the recovery ladder: live peer
+        StateServers first (NIC bandwidth, host memory; the restorer
+        itself decodes dead pods' parity shards for spans no peer
+        serves), then a wholesale parity rebuild when NO peer serves
+        the version at all, and only then the shared FS — the cold
+        layer. MissingKeysError propagates either way — the caller's
+        core-only retry must see it. Returns (version, tree, meta)."""
         if self._state_server is not None:
+            from edl_tpu.runtime import redundancy as redundancy_mod
             from edl_tpu.runtime.state_server import PeerRestorer
-            from edl_tpu.utils.errors import PeerRestoreError
+            from edl_tpu.utils.errors import (PeerRestoreError,
+                                              RedundancyError)
             restorer = PeerRestorer(
                 self.coord, self._ckpt,
                 self_endpoint=self._state_server.endpoint)
@@ -2020,10 +2051,35 @@ class ElasticTrainer(object):
                 raise
             except PeerRestoreError as e:
                 logger.info("peer restore unavailable for v%d (%s); "
-                            "restoring from the shared FS", version, e)
+                            "trying the parity rung", version, e)
             except Exception:
                 logger.exception("peer restore for v%d failed; "
-                                 "restoring from the shared FS", version)
+                                 "trying the parity rung", version)
+            if redundancy_mod.enabled() and self.coord is not None:
+                try:
+                    v, tree, meta, stats = redundancy_mod.restore_placed(
+                        self.coord, version, target, shardings,
+                        self_endpoint=self._state_server.endpoint)
+                    self._resize_timing["restore_source"] = "parity"
+                    self._resize_timing["restore_bytes"] = \
+                        stats["parity_bytes"]
+                    self._resize_timing["restore_peers"] = \
+                        stats["holders"]
+                    logger.info("parity restore v%d: %.1f MB decoded "
+                                "from %d holder(s) (owners: %s)", v,
+                                stats["parity_bytes"] / 1e6,
+                                stats["holders"], stats["owners"])
+                    return v, tree, meta or {}
+                except MissingKeysError:
+                    raise
+                except RedundancyError as e:
+                    logger.info("parity rung unavailable for v%d (%s);"
+                                " restoring from the shared FS",
+                                version, e)
+                except Exception:
+                    logger.exception("parity restore for v%d failed; "
+                                     "restoring from the shared FS",
+                                     version)
         out = self._ckpt.restore_placed(version, target, shardings)
         self._resize_timing["restore_source"] = "fs"
         return out

@@ -53,7 +53,8 @@ from edl_tpu.tools.job_stats import format_autopilot
 _DETECTOR_RANK = {"flight_recorder": 0, "stale_publisher": 1,
                   "straggler": 2, "slo_burn": 3, "breaker_flap": 4,
                   "queue_saturation": 5, "live_resize_fallback": 6,
-                  "reshard_fallback": 7, "prewarm_miss": 8}
+                  "reshard_fallback": 7, "rebuild_fallback": 8,
+                  "prewarm_miss": 9}
 
 
 def collect(coord):
@@ -158,6 +159,10 @@ def _live_resize_findings(obs, timeline):
       state moved (uncomputable target spans, hybrid mesh, batch not
       divisible...); the summary names the exact rejection reason so the
       operator can fix the factorization rather than the rollback path.
+    - rebuild_fallback: a ``redundancy.fallback`` event — the diskless
+      parity rung was skipped and recovery paid FS reads; the summary
+      quotes the recorded reason (stale_version / insufficient_partners
+      / fault / error).
     - prewarm_miss: prewarm-scope first steps paid a full compile and
       none ever loaded an AOT artifact — the compile cache is cold or
       unconfigured, so every resize (live or not) eats compile_s."""
@@ -196,6 +201,35 @@ def _live_resize_findings(obs, timeline):
         findings.append(_fall_finding(
             rolled[-1], "live_resize_fallback",
             "live resize fell back to stop-resume: %s"))
+    # rebuild_fallback: the diskless-recovery parity rung was skipped
+    # and the restore paid FS reads instead (runtime/redundancy.py).
+    # Lossless by design — the FS rung is the backstop — but sub-second
+    # recovery was NOT delivered, so the operator should know WHY: the
+    # event's reason is quoted verbatim (stale_version = partners hold
+    # an older snapshot than the one being restored, e.g. the push
+    # after the last commit was lost; insufficient_partners = fewer
+    # than k shards live; fault = a seeded chaos drill; error =
+    # unexpected decode/transport failure).
+    red_falls = [e for e in timeline
+                 if e.get("kind") == "redundancy.fallback"]
+    if red_falls:
+        last = red_falls[-1]
+        attrs = last.get("attrs") or {}
+        total = _counter_total(obs, "edl_redundancy_fs_fallbacks_total")
+        findings.append({
+            "pod": last.get("pod"),
+            "detector": "rebuild_fallback",
+            "severity": "warn",
+            "summary": ("parity rung skipped, recovery fell back to "
+                        "the FS rung: %s"
+                        % (attrs.get("reason") or "unknown reason")),
+            "metric": "edl_redundancy_fs_fallbacks_total",
+            "value": total,
+            "threshold": 0,
+            "events": [last],
+            "event_ids": [last.get("id")]
+            if last.get("id") is not None else [],
+        })
     hits = _counter_total(obs, "edl_resize_prewarm_hits_total")
     misses = _counter_total(obs, "edl_resize_prewarm_misses_total")
     if misses and not hits:

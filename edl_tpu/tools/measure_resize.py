@@ -511,6 +511,244 @@ def run_peer_arc_micro(peer, args):
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _spawn_redundancy_holdout(store_endpoint, job_id, rank, ready_file,
+                              log_dir, kill=0):
+    """A surviving-partner stand-in (tools/peer_holdout.py
+    --redundancy): accepts erasure-coded shards and serves them back.
+    ``kill=N`` SIGKILLs it when the Nth state.shard read arrives — the
+    decode-with-missing-partner drill."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    os.makedirs(log_dir, exist_ok=True)
+    log = open(os.path.join(log_dir, "holdout_r%d.log" % rank), "ab")
+    cmd = [sys.executable, "-u", "-m", "edl_tpu.tools.peer_holdout",
+           "--store_endpoints", store_endpoint, "--job_id", job_id,
+           "--redundancy", "--rank", str(rank),
+           "--ready_file", ready_file]
+    if kill:
+        cmd += ["--kill", str(kill)]
+    proc = subprocess.Popen(cmd, env=env, stdout=log,
+                            stderr=subprocess.STDOUT,
+                            preexec_fn=os.setsid)
+    log.close()
+    return proc
+
+
+class _CountingFS(object):
+    """FS wrapper that counts read operations — the kill arc's proof
+    that the parity rebuild issued ZERO FS reads."""
+
+    def __init__(self, fs):
+        self._fs = fs
+        self.reads = 0
+
+    def open(self, path, mode):
+        if "r" in mode:
+            self.reads += 1
+        return self._fs.open(path, mode)
+
+    def read_range(self, path, offset, length):
+        self.reads += 1
+        return self._fs.read_range(path, offset, length)
+
+    def listdir(self, path):
+        self.reads += 1
+        return self._fs.listdir(path)
+
+    def exists(self, path):
+        self.reads += 1
+        return self._fs.exists(path)
+
+    def __getattr__(self, name):
+        return getattr(self._fs, name)
+
+
+def run_kill_pod_arc_micro(args):
+    """Kill-one-pod micro arc (diskless fault tolerance,
+    runtime/redundancy.py). An in-process "victim pod" saves a stream
+    checkpoint behind fake GCS and pushes k=2,m=1 erasure-coded shards
+    of its committed snapshot to three surviving-partner stand-ins,
+    one of which is armed to SIGKILL itself on the first rebuild touch
+    (the decode-with-missing-partner path). The victim then "dies" and
+    recovery walks the real ladder — peer rung (no peers: everything
+    is dead), then parity — and the arc proves:
+
+    - the parity restore is byte-identical to the FS restore,
+    - with ``fs_reads == 0`` (a counting FS wrapper sees the window),
+    - surviving the mid-rebuild partner kill,
+    - and a chaos-faulted rebuild (``redundancy.rebuild:error``)
+      degrades to the FS rung byte-identically (``fallback_drill``).
+
+    Hermetic and in-process; this is the tier-1 smoke arc for the
+    redundancy tier. Always micro — there is no pod-fleet variant."""
+    import numpy as np
+
+    from edl_tpu.coordination.client import CoordClient
+    from edl_tpu.robustness import faults
+    from edl_tpu.runtime import redundancy
+    from edl_tpu.runtime.checkpoint import CheckpointManager
+    from edl_tpu.runtime.fs import GCSFS
+    from edl_tpu.runtime.state_server import (PeerRestorer,
+                                              snapshot_entries)
+    from edl_tpu.tools.fake_gcs import FakeGCSServer
+    from edl_tpu.utils import errors
+
+    import jax
+
+    tag = "kill_pod"
+    tmp = tempfile.mkdtemp(prefix="measure_%s_micro_" % tag)
+    gcs = FakeGCSServer().start()
+    ckpt_dir = "gs://resize-bench/ckpt"
+    fs = _CountingFS(GCSFS(endpoint=gcs.endpoint))
+    cm = CheckpointManager(ckpt_dir, fs=fs)
+    store = _spawn_store()
+    job_id = "rzm_%s_%d" % (tag, os.getpid())
+    coord = CoordClient([store.endpoint], root=job_id)
+    holdouts = []
+    plane = None
+    try:
+        rng = np.random.RandomState(0)
+        n = max(1, int(args.micro_mb))
+        tree = {"layer%d" % i: rng.standard_normal(
+            (256, 1024)).astype(np.float32) for i in range(n)}
+        cm.save_async(1, tree, meta={"bench": tag}).result(60.0)
+        dev = jax.devices()[0]
+        sharding = jax.sharding.SingleDeviceSharding(dev)
+        shardings = {k: sharding for k in tree}
+
+        # three surviving partners; rank 9102 dies on its first
+        # state.shard read, so the decode must finish from the other
+        # two (9102 holds data shard 1 — the rebuild is forced through
+        # the parity shard and a real GF(256) matrix inversion)
+        kill_rank = 9102
+        for rank in (9101, 9102, 9103):
+            ready = os.path.join(tmp, "holdout_%d.ready" % rank)
+            proc = _spawn_redundancy_holdout(
+                store.endpoint, job_id, rank, ready, tmp,
+                kill=1 if rank == kill_rank else 0)
+            holdouts.append((rank, proc))
+            _wait_file(ready, args.timeout, proc,
+                       what="redundancy holdout r%d" % rank)
+
+        # the victim's commit-path hand-off (trainer save() does this
+        # on the persist driver thread)
+        entries, dtags = snapshot_entries(tree)
+        push = redundancy.push_shards(coord, "victim", 1, entries,
+                                      dtags, meta={"bench": tag},
+                                      k=2, m=1)
+        if push["pushed"] != 3:
+            raise RuntimeError("expected 3 shards pushed, got %r"
+                               % (push,))
+
+        # FS baseline: the cold-layer restore the parity rung
+        # replaces. Best-of-3, same as the parity window below — the
+        # bench guard gates parity < FS, so both sides get the same
+        # noise shield.
+        fs_times = []
+        for _ in range(3):
+            fs.reads = 0
+            t0 = time.perf_counter()
+            _, fs_tree, _ = cm.restore_placed(1, tree, shardings)
+            fs_times.append(time.perf_counter() - t0)
+        fs_baseline = {"restore_s": round(min(fs_times), 3),
+                       "fs_reads": int(fs.reads)}
+
+        # the kill: the victim is gone (this process just drops its
+        # state); recovery walks the ladder — peers first (none live),
+        # then the parity rung. fs.reads counts BOTH passes: the
+        # first one eats the mid-rebuild partner SIGKILL (its time is
+        # kept as cold_restore_s), the rest are clean repeats.
+        fs.reads = 0
+        parity_times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            try:
+                PeerRestorer(coord, cm).restore_placed(
+                    1, tree, shardings)
+                raise RuntimeError("peer rung unexpectedly served a "
+                                   "world with no survivors")
+            except errors.PeerRestoreError:
+                pass  # expected: every state-holding pod is dead
+            _, parity_tree, _, stats = redundancy.restore_placed(
+                coord, 1, tree, shardings)
+            parity_times.append(time.perf_counter() - t0)
+        restore_s = min(parity_times)
+        parity_fs_reads = int(fs.reads)
+
+        killed = next(p for r, p in holdouts if r == kill_rank)
+        try:  # SIGKILLed itself mid-rebuild, by design
+            killed.wait(timeout=30)
+            killed_partner = True
+        except subprocess.TimeoutExpired:
+            killed_partner = False
+
+        def _identical(a, b):
+            fa = jax.tree_util.tree_leaves(a)
+            fb = jax.tree_util.tree_leaves(b)
+            return len(fa) == len(fb) and all(
+                np.asarray(x).tobytes() == np.asarray(y).tobytes()
+                for x, y in zip(fa, fb))
+
+        byte_identical = _identical(parity_tree, fs_tree)
+
+        # chaos drill: a faulted rebuild must degrade to the FS rung
+        # losslessly (and be visible: fault fired, fallback recorded)
+        plane = faults.FaultPlane(seed=0).install()
+        fault = plane.inject("redundancy.rebuild", "error")
+        fs.reads = 0
+        drill_source = "parity"
+        try:
+            redundancy.restore_placed(coord, 1, tree, shardings)
+        except errors.RedundancyError:
+            drill_source = "fs"
+        _, drill_tree, _ = cm.restore_placed(1, tree, shardings)
+        fallback_drill = {
+            "fault_fired": bool(fault.fired),
+            "source": drill_source,
+            "fs_reads": int(fs.reads),
+            "byte_identical": _identical(drill_tree, fs_tree)}
+
+        # compile + first step on the parity-restored state (same
+        # stand-in step as the peer micro arcs)
+        step = jax.jit(lambda t: sum(x.sum()
+                                     for x in jax.tree_util
+                                     .tree_leaves(t)))
+        c0 = time.perf_counter()
+        jax.block_until_ready(step(parity_tree))
+        compile_s = time.perf_counter() - c0
+        c1 = time.perf_counter()
+        jax.block_until_ready(step(parity_tree))
+        first_step_s = time.perf_counter() - c1
+
+        breakdown = {"detect_s": 0.0, "kill_s": 0.0, "barrier_s": 0.0,
+                     "restore_s": restore_s, "compile_s": compile_s,
+                     "first_step_s": first_step_s}
+        restore = {"source": stats["source"],
+                   "bytes": stats["parity_bytes"],
+                   "peers": stats["holders"], "version": 1,
+                   "fs_reads": parity_fs_reads,
+                   "owners": stats["owners"],
+                   "killed_partner": bool(killed_partner),
+                   "cold_restore_s": round(parity_times[0], 3),
+                   "byte_identical": bool(byte_identical)}
+        return _peer_result(
+            tag, args, "micro",
+            restore_s + compile_s + first_step_s, breakdown, restore,
+            micro_mb=n, state_bytes=n * 256 * 1024 * 4,
+            shards={"k": 2, "m": 1, "pushed": push["pushed"]},
+            fs_baseline=fs_baseline, fallback_drill=fallback_drill)
+    finally:
+        if plane is not None:
+            plane.uninstall()
+        for _rank, proc in holdouts:
+            _kill_group(proc)
+        cm.close()
+        store.stop()
+        gcs.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 # -- live vs stop-resume arcs (zero-downtime in-place resize) --------------
 #
 # live: one resize_worker process on --from_devices devices; the driver
@@ -805,6 +1043,8 @@ def main(argv=None):
                 if tag in ("peer_restore_on", "peer_restore_off"):
                     out = (run_peer_arc_micro if args.micro
                            else run_peer_arc)(tag.endswith("_on"), args)
+                elif tag == "kill_pod":
+                    out = run_kill_pod_arc_micro(args)
                 elif tag == "live":
                     out = run_live_arc(args)
                 elif tag == "stop_resume":

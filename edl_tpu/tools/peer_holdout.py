@@ -19,10 +19,26 @@ behind the pipelined RPC plane.
 
 ``--ready_file`` is written ("<version>\\n") after the first publish —
 drivers poll it instead of scraping logs.
+
+Redundancy stand-in (``--redundancy``, diskless fault tolerance,
+edl_tpu/runtime/redundancy.py): skip the checkpoint entirely and play
+a surviving PARTNER pod instead — advertise under SERVICE_REDUNDANCY,
+accept erasure-coded shards (``state.shard_put``) and serve them back
+(``state.shard``). ``--ckpt`` becomes optional; the ready file is
+written ("0\\n") once the lease is up.
+
+``--kill N`` (redundancy mode) SIGKILLs this process the instant the
+N-th ``state.shard`` read REQUEST arrives — before the reply is sent —
+so a driver can drill the decode-with-missing-partner path (the
+rebuilder must finish from the remaining k-of-n shards) without a pod
+fleet. N=1 dies on the very first rebuild touch.
 """
 
 import argparse
+import os
+import signal
 import sys
+import threading
 import time
 
 from edl_tpu.utils.logger import logger
@@ -46,17 +62,54 @@ def _load_entries(cm, version):
     return entries, meta_blob.get("dtypes") or {}, meta_blob
 
 
+def _arm_kill(srv, after):
+    """Install the --kill hook: SIGKILL self when the ``after``-th
+    state.shard read request arrives, BEFORE it is answered. SIGKILL
+    (not exit) so no reply, no TCP FIN courtesy — the rebuilder sees
+    exactly a partner dying mid-rebuild."""
+    lock = threading.Lock()
+    count = [0]
+
+    def hook(owner, index):
+        with lock:
+            count[0] += 1
+            n = count[0]
+        if n >= after:
+            logger.info("holdout: --kill tripped on shard read #%d "
+                        "(%s/%d); SIGKILL", n, owner, index)
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    srv.shard_read_hook = hook
+
+
 def serve(args):
     from edl_tpu.coordination.client import CoordClient
-    from edl_tpu.runtime.checkpoint import CheckpointManager
     from edl_tpu.runtime.state_server import StateServer
 
     coord = CoordClient(args.store_endpoints.split(","),
                         root=args.job_id)
-    cm = CheckpointManager(args.ckpt)
     srv = StateServer(rank=args.rank, host=args.host)
     served = None
     try:
+        if args.redundancy:
+            if args.kill > 0:
+                _arm_kill(srv, args.kill)
+            srv.advertise_redundancy(coord, key=str(args.rank))
+            logger.info("holdout: redundancy partner up at %s "
+                        "(rank %d%s)", srv.endpoint, args.rank,
+                        ", kill after %d shard read(s)" % args.kill
+                        if args.kill > 0 else "")
+            if args.ready_file:
+                with open(args.ready_file, "w") as f:
+                    f.write("0\n")
+            while True:  # shard traffic is server-driven; just stay up
+                time.sleep(args.poll)
+            return
+        if not args.ckpt:
+            raise SystemExit("holdout: --ckpt is required unless "
+                             "--redundancy")
+        from edl_tpu.runtime.checkpoint import CheckpointManager
+        cm = CheckpointManager(args.ckpt)
         srv.advertise(coord)
         while True:
             versions = cm.versions()
@@ -83,9 +136,10 @@ def main(argv=None):
         "serve a committed checkpoint as a peer StateServer")
     p.add_argument("--store_endpoints", required=True)
     p.add_argument("--job_id", required=True)
-    p.add_argument("--ckpt", required=True,
+    p.add_argument("--ckpt", default="",
                    help="checkpoint directory (local or gs://; GCS "
-                        "emulator via STORAGE_EMULATOR_HOST)")
+                        "emulator via STORAGE_EMULATOR_HOST); required "
+                        "unless --redundancy")
     p.add_argument("--rank", type=int, default=9001,
                    help="advertised rank; keep it out of the trainer "
                         "rank range")
@@ -93,6 +147,14 @@ def main(argv=None):
     p.add_argument("--ready_file", default="")
     p.add_argument("--poll", type=float, default=0.25,
                    help="newest-committed-version re-sync period")
+    p.add_argument("--redundancy", action="store_true",
+                   help="play a redundancy partner (accept and serve "
+                        "erasure-coded shards) instead of a "
+                        "checkpoint-backed peer")
+    p.add_argument("--kill", type=int, default=0,
+                   help="redundancy mode: SIGKILL self when the Nth "
+                        "state.shard read request arrives (0 = never) "
+                        "— the decode-with-missing-partner drill")
     serve(p.parse_args(argv))
 
 

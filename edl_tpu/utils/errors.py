@@ -162,6 +162,14 @@ class PeerRestoreError(EdlError):
     the caller restores wholesale from the shared FS."""
 
 
+class RedundancyError(EdlError):
+    """The erasure-coded parity rung could not rebuild the requested
+    state (no live holders, insufficient/stale shards, decode
+    failure). Carries a ``reason`` attribute when known (stale_version,
+    insufficient_partners); the caller falls through to the FS rung —
+    the parity tier is strictly best-effort."""
+
+
 class LiveResizeError(EdlError):
     """The in-place live resize could not complete (out of scope,
     drain/reshard failure, rolled back). The trainer is left on its

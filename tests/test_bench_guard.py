@@ -186,6 +186,62 @@ def test_measure_resize_micro_peer_arc_cpu_schema(capsys):
     json.dumps(out)  # round-trips
 
 
+def test_measure_resize_kill_pod_arc_cpu_schema(capsys):
+    """Tier-1 pin of the kill-one-pod arc (diskless fault tolerance,
+    resize_bench/v1): the dead pod's state is rebuilt purely from
+    partner-held erasure shards — ``fs_reads == 0`` across the whole
+    parity window, byte-identical to the FS restore, surviving a
+    partner SIGKILLed mid-rebuild — and the chaos-faulted rebuild
+    drill degrades to the FS rung losslessly.
+
+    This arc DOES carry a timing gate, unlike its siblings: parity
+    restore must beat the FS baseline. It is safe here because both
+    windows are measured best-of-3 back-to-back in the same process
+    against a loopback fake GCS (the most FS-favorable baseline
+    possible — real object stores only widen the gap), and the parity
+    side wins every observed run by >=1.5x at this size."""
+    import json
+
+    from edl_tpu.tools import measure_resize
+
+    rc = measure_resize.main(["--arcs", "kill_pod", "--micro",
+                              "--micro_mb", "16", "--platform", "cpu"])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert "error" not in out
+    assert out["schema"] == "resize_bench/v1"
+    assert out["arc"] == "kill_pod" and out["mode"] == "micro"
+    assert set(out["breakdown"]) == set(measure_resize.BREAKDOWN_STAGES)
+    assert out["shards"] == {"k": 2, "m": 1, "pushed": 3}
+
+    # the diskless guarantee: zero FS reads, byte-identical, decoded
+    # through a partner dying mid-rebuild
+    restore = out["restore"]
+    assert restore["source"] == "parity"
+    assert restore["fs_reads"] == 0
+    assert restore["byte_identical"] is True
+    assert restore["killed_partner"] is True
+    assert restore["owners"] == ["victim"]
+    assert restore["bytes"] > 0
+    assert restore["cold_restore_s"] > 0
+
+    # sub-second and faster than the FS rung it replaces
+    assert out["fs_baseline"]["fs_reads"] > 0
+    assert 0 < out["breakdown"]["restore_s"] < 1.0
+    assert out["breakdown"]["restore_s"] \
+        < out["fs_baseline"]["restore_s"]
+
+    # the chaos drill: faulted rebuild -> FS rung, losslessly
+    drill = out["fallback_drill"]
+    assert drill["fault_fired"] is True
+    assert drill["source"] == "fs"
+    assert drill["fs_reads"] > 0
+    assert drill["byte_identical"] is True
+    json.dumps(out)  # round-trips
+
+
 def test_measure_resize_live_arc_cpu_schema(capsys):
     """Tier-1 smoke of the live in-place resize arc: one worker process
     is resized 8→4→8 through the store 2PC without ever exiting, and

@@ -1,0 +1,439 @@
+"""Diskless fault tolerance (runtime/redundancy.py): the GF(256)
+erasure codec, the deterministic partner ring, version fencing on the
+shard depot, and the parity rung of the restore ladder — including the
+headline guarantee: a dead pod's state decoded purely from partner
+shards into a NEW mesh factorization is byte-identical to the FS
+restore, and a chaos-faulted rebuild degrades to the FS rung
+losslessly."""
+
+import itertools
+import json
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.controller import constants
+from edl_tpu.coordination.client import CoordClient
+from edl_tpu.coordination.server import StoreServer
+from edl_tpu.parallel import costmodel  # noqa: F401 — rebuild_plan dep
+from edl_tpu.robustness import faults
+from edl_tpu.runtime import redundancy
+from edl_tpu.runtime.checkpoint import CheckpointManager, PlacedTarget
+from edl_tpu.runtime.state_server import (PeerRestorer, StateServer,
+                                          snapshot_entries)
+from edl_tpu.rpc.client import RpcClient
+from edl_tpu.utils import errors
+
+
+@pytest.fixture()
+def coord():
+    srv = StoreServer(host="127.0.0.1", port=0).start()
+    try:
+        yield CoordClient([srv.endpoint], root="t_red")
+    finally:
+        srv.stop()
+
+
+def _tree(seed):
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    rng = np.random.RandomState(seed)
+    w = rng.randn(8, 4).astype(np.float32)
+    mu = rng.randn(16, 2).astype(np.float32)
+    bf = rng.randn(8, 2).astype(np.float32)
+    tree = {
+        "params": {"w": jax.device_put(w, NamedSharding(mesh, P()))},
+        "opt": {"mu": jax.device_put(mu, NamedSharding(mesh, P("dp")))},
+        "bf16": jax.device_put(jnp.asarray(bf, jnp.bfloat16),
+                               NamedSharding(mesh, P("dp"))),
+        "step": np.int32(seed),
+    }
+    host = {"params": {"w": w}, "opt": {"mu": mu}, "bf16": bf,
+            "step": np.int32(seed)}
+    return tree, host
+
+
+def _target_and_shardings(tree, n=4):
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    shardings = {"params": {"w": NamedSharding(mesh, P())},
+                 "opt": {"mu": NamedSharding(mesh, P("dp"))},
+                 "bf16": NamedSharding(mesh, P("dp")),
+                 "step": NamedSharding(mesh, P())}
+    target = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                       getattr(x, "dtype",
+                                               np.asarray(x).dtype)),
+        tree)
+    return target, shardings
+
+
+def _assert_bit_identical(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        xa, ya = np.asarray(x), np.asarray(y)
+        assert xa.dtype == ya.dtype
+        assert xa.tobytes() == ya.tobytes()
+
+
+def _holder(coord, key, shard_read_hook=None):
+    srv = StateServer(rank=int(key), host="127.0.0.1")
+    if shard_read_hook is not None:
+        srv.shard_read_hook = shard_read_hook
+    srv.advertise_redundancy(coord, key=str(key))
+    return srv
+
+
+# -- codec ------------------------------------------------------------------
+
+def test_codec_round_trip_every_loss_subset():
+    """k-of-n MDS: EVERY k-subset of the n shards decodes the blob,
+    for blob sizes that do and do not divide by k."""
+    rng = np.random.default_rng(0)
+    for k in range(1, 5):
+        for m in range(0, 3):
+            for size in (k * 257, k * 257 + 3, 1):
+                blob = rng.integers(0, 256, size=size, dtype=np.uint8)
+                shards = redundancy.encode(blob, k, m)
+                assert len(shards) == k + m
+                assert len({s.size for s in shards}) == 1
+                for keep in itertools.combinations(range(k + m), k):
+                    out = redundancy.decode(
+                        {i: shards[i] for i in keep}, k, m, blob.size)
+                    assert np.array_equal(out, blob), (k, m, size, keep)
+
+
+def test_codec_insufficient_shards_reason():
+    blob = np.arange(100, dtype=np.uint8)
+    shards = redundancy.encode(blob, 3, 1)
+    with pytest.raises(errors.RedundancyError) as ei:
+        redundancy.decode({0: shards[0], 2: shards[2]}, 3, 1, blob.size)
+    assert ei.value.reason == "insufficient_partners"
+
+
+def test_pack_unpack_snapshot_round_trip():
+    entries = {
+        "opt/mu@2:4;0:2": np.arange(4, dtype=np.float32).reshape(2, 2),
+        "bf16@0:1;0:2": np.array([[7, 9]], np.uint16),  # tagged wire
+        "step@": np.int32(5),
+    }
+    dtypes = {"bf16": "bfloat16"}
+    blob = redundancy.pack_snapshot(entries, dtypes,
+                                    meta={"state": {"epoch": 3}})
+    out, dt, meta = redundancy.unpack_snapshot(blob)
+    assert dt == dtypes and meta == {"state": {"epoch": 3}}
+    assert set(out) == set(entries)
+    for skey in entries:
+        want = np.asarray(entries[skey])
+        assert out[skey].dtype == want.dtype
+        assert out[skey].shape == want.shape
+        assert out[skey].tobytes() == want.tobytes()
+
+
+# -- partner ring -----------------------------------------------------------
+
+def test_partner_ring_pure_function_of_member_set():
+    members = ["p3", "p1", "p7", "p0", "p5"]
+    want = redundancy.partner_ring(members, "p3", 3)
+    assert want == ["p5", "p7", "p0"]  # cyclic successors of p3
+    rng = random.Random(0)
+    for _ in range(10):
+        shuffled = list(members)
+        rng.shuffle(shuffled)
+        assert redundancy.partner_ring(shuffled, "p3", 3) == want
+    # self never partners itself; n caps at the other members
+    assert "p3" not in redundancy.partner_ring(members, "p3", 99)
+    assert len(redundancy.partner_ring(members, "p3", 99)) == 4
+    assert redundancy.partner_ring(["p0"], "p0", 3) == []
+    # a resize recomputes consistently: every pod derives every OTHER
+    # pod's ring from the same set with no negotiation
+    grown = members + ["p2", "p9"]
+    rings = {p: redundancy.partner_ring(grown, p, 2) for p in grown}
+    for p, ring in rings.items():
+        assert p not in ring and len(ring) == 2
+        shuffled = list(grown)
+        rng.shuffle(shuffled)
+        assert redundancy.partner_ring(shuffled, p, 2) == ring
+
+
+# -- shard depot version fencing --------------------------------------------
+
+def test_shard_put_version_fencing(coord):
+    srv = _holder(coord, 9301)
+    client = None
+    try:
+        client = RpcClient(srv.endpoint)
+        header = {"k": 2, "m": 1, "blob_len": 8, "chunk_len": 4}
+        payload = np.arange(4, dtype=np.uint8)
+        client.call("state.shard_put", "owner", 7, 0, header, payload)
+        # an OLDER version is fenced at the server, never stored
+        with pytest.raises(errors.StaleStateError):
+            client.call("state.shard_put", "owner", 6, 1, header,
+                        payload)
+        # reads are version-fenced too: a stale reader never decodes
+        with pytest.raises(errors.StaleStateError):
+            client.call("state.shard", "owner", 6, 0, 0, 4)
+        got = np.asarray(client.call("state.shard", "owner", 7, 0,
+                                     0, 4))
+        assert got.tobytes() == payload.tobytes()
+        with pytest.raises(errors.NotFoundError):
+            client.call("state.shard", "owner", 7, 1, 0, 4)
+        # a NEWER version evicts the old record wholesale
+        client.call("state.shard_put", "owner", 8, 2, header, payload)
+        man = client.call("state.shard_manifest")
+        assert man["shards"]["owner"]["version"] == 8
+        assert man["shards"]["owner"]["held"] == [2]
+        with pytest.raises(errors.StaleStateError):
+            client.call("state.shard", "owner", 7, 0, 0, 4)
+    finally:
+        if client is not None:
+            client.close()
+        srv.stop()
+
+
+# -- push + rebuild ---------------------------------------------------------
+
+def test_push_and_rebuild_into_new_mesh_byte_identical(coord, tmp_path):
+    """THE diskless guarantee: state saved on the 8-device mesh,
+    erasure-coded to partners, is decoded and placed onto a DIFFERENT
+    4-device factorization byte-for-byte equal to the FS restore —
+    with the checkpoint directory never touched."""
+    tree, host = _tree(11)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(7, tree, meta={"state": {"epoch": 2}}).result(60.0)
+    holders = [_holder(coord, k) for k in (9301, 9302, 9303)]
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        push = redundancy.push_shards(coord, "0", 7, entries, dtypes,
+                                      meta={"state": {"epoch": 2}},
+                                      k=2, m=1)
+        assert push["pushed"] == 3 and push["k"] == 2 and push["m"] == 1
+
+        target, shardings = _target_and_shardings(tree, n=4)
+        v, par_tree, meta, stats = redundancy.restore_placed(
+            coord, 7, target, shardings)
+        assert v == 7 and meta == {"state": {"epoch": 2}}
+        assert stats["source"] == "parity"
+        assert stats["owners"] == ["0"] and stats["parity_bytes"] > 0
+
+        _, fs_tree, _ = cm.restore_placed(7, target, shardings)
+        _assert_bit_identical(par_tree, fs_tree)
+        np.testing.assert_array_equal(
+            np.asarray(par_tree["opt"]["mu"]), host["opt"]["mu"])
+    finally:
+        for h in holders:
+            h.stop()
+        cm.close()
+
+
+def test_rebuild_survives_dead_partner(coord, tmp_path):
+    """One of three partners dead (lease not yet expired): the decode
+    finishes from the remaining k shards, forced through a parity
+    shard."""
+    tree, _ = _tree(13)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(4, tree).result(60.0)
+    holders = [_holder(coord, k) for k in (9301, 9302, 9303)]
+    dead_reg = None
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        push = redundancy.push_shards(coord, "0", 4, entries, dtypes,
+                                      k=2, m=1)
+        assert push["pushed"] == 3
+        # kill the middle holder but keep its lease advertised
+        dead = holders[1]
+        dead_reg, dead._redundancy_register = \
+            dead._redundancy_register, None
+        dead.stop()
+        target, shardings = _target_and_shardings(tree, n=4)
+        _, par_tree, _, stats = redundancy.restore_placed(
+            coord, 4, target, shardings)
+        assert stats["owners"] == ["0"]
+        _, fs_tree, _ = cm.restore_placed(4, target, shardings)
+        _assert_bit_identical(par_tree, fs_tree)
+    finally:
+        if dead_reg is not None:
+            dead_reg.stop()
+        for h in holders:
+            h.stop()
+        cm.close()
+
+
+def test_stale_holders_skipped_then_fenced(coord):
+    """A holder stuck at an older version is skipped (its shard is
+    never decoded); when EVERY holder is stale the rebuild reports
+    stale_version and fills nothing — the FS rung's job."""
+    tree, _ = _tree(17)
+    entries, dtypes = snapshot_entries(tree)
+    holders = [_holder(coord, k) for k in (9301, 9302, 9303)]
+    try:
+        assert redundancy.push_shards(coord, "0", 6, entries, dtypes,
+                                      k=2, m=1)["pushed"] == 3
+        # v7 lands on only two partners (the third stays at v6): the
+        # rebuild must use exactly the fresh pair
+        blob = redundancy.pack_snapshot(entries, dtypes, meta=None)
+        shards = redundancy.encode(blob, 2, 1)
+        header = {"k": 2, "m": 1, "blob_len": int(blob.size),
+                  "chunk_len": int(shards[0].size)}
+        for idx, srv in ((0, holders[0]), (1, holders[1])):
+            c = RpcClient(srv.endpoint)
+            try:
+                c.call("state.shard_put", "0", 7, idx, header,
+                       shards[idx])
+            finally:
+                c.close()
+        target, shardings = _target_and_shardings(tree, n=4)
+        _, par_tree, _, _ = redundancy.restore_placed(
+            coord, 7, target, shardings)
+        _assert_bit_identical(par_tree, jax.device_put(tree, shardings))
+        # v8 exists nowhere: every holder is stale -> reason recorded,
+        # nothing pasted, restore_placed refuses
+        pt = PlacedTarget(target, shardings)
+        stats = redundancy.fill_from_parity(coord, 8, pt)
+        assert stats["reason"] == "stale_version"
+        assert stats["owners"] == [] and stats["parity_bytes"] == 0
+        assert pt.missing()
+        with pytest.raises(errors.RedundancyError):
+            redundancy.restore_placed(coord, 8, target, shardings)
+    finally:
+        for h in holders:
+            h.stop()
+
+
+def test_insufficient_survivors_reports_reason(coord):
+    """k=2 shards spread over three partners; two die -> one live
+    shard < k, the rebuild refuses with insufficient_partners (and
+    nothing is half-pasted)."""
+    tree, _ = _tree(19)
+    entries, dtypes = snapshot_entries(tree)
+    holders = [_holder(coord, k) for k in (9301, 9302, 9303)]
+    dead_regs = []
+    try:
+        assert redundancy.push_shards(coord, "0", 3, entries, dtypes,
+                                      k=2, m=1)["pushed"] == 3
+        for dead in holders[:2]:
+            dead_regs.append(dead._redundancy_register)
+            dead._redundancy_register = None
+            dead.stop()
+        target, shardings = _target_and_shardings(tree, n=4)
+        pt = PlacedTarget(target, shardings)
+        stats = redundancy.fill_from_parity(coord, 3, pt, timeout=3.0)
+        assert stats["reason"] == "insufficient_partners"
+        assert stats["parity_bytes"] == 0 and pt.missing()
+    finally:
+        for reg in dead_regs:
+            reg.stop()
+        for h in holders:
+            h.stop()
+
+
+# -- the restore ladder -----------------------------------------------------
+
+def test_ladder_peer_plus_parity_then_faulted_fs_fallback(coord,
+                                                          tmp_path):
+    """PeerRestorer's ladder with the parity rung in place: a live
+    peer covers part of the snapshot, the parity decode covers the
+    dead pod's remainder with ZERO FS keys — and when a chaos fault
+    arms ``redundancy.rebuild`` the SAME restore degrades to the FS
+    rung byte-identically (the catalog drill)."""
+    tree, _ = _tree(23)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(5, tree, meta={"state": {"epoch": 4}}).result(60.0)
+    entries, dtypes = snapshot_entries(tree)
+    peer = StateServer(rank=1, host="127.0.0.1")
+    holders = [_holder(coord, k) for k in (9301, 9302, 9303)]
+    plane = None
+    try:
+        # the survivor serves only part of the state...
+        partial = {k: v for k, v in entries.items()
+                   if k.startswith(("opt/mu@", "step@"))}
+        peer.publish(5, partial, dtypes, meta={"state": {"epoch": 4}})
+        peer.advertise(coord)
+        # ...the dead pod's parity cover holds all of it
+        assert redundancy.push_shards(coord, "0", 5, entries, dtypes,
+                                      meta={"state": {"epoch": 4}},
+                                      k=2, m=1)["pushed"] == 3
+
+        target, shardings = _target_and_shardings(tree, n=4)
+        _, fs_tree, _ = cm.restore_placed(5, target, shardings)
+
+        v, got, meta, stats = PeerRestorer(coord, cm).restore_placed(
+            5, target, shardings)
+        assert v == 5 and meta == {"state": {"epoch": 4}}
+        assert stats["source"] == "peer+parity"
+        assert stats["fs_keys"] == []
+        assert stats["parity_bytes"] > 0
+        _assert_bit_identical(got, fs_tree)
+
+        # chaos drill: fault the rebuild -> the parity rung is skipped
+        # (reason=fault) and the FS rung restores losslessly
+        plane = faults.FaultPlane(seed=0).install()
+        fault = plane.inject("redundancy.rebuild", "error")
+        _, got2, _, stats2 = PeerRestorer(coord, cm).restore_placed(
+            5, target, shardings)
+        assert fault.fired >= 1
+        assert stats2["source"] == "peer+fs"
+        assert set(stats2["fs_keys"]) == {"params/w", "bf16"}
+        _assert_bit_identical(got2, fs_tree)
+    finally:
+        if plane is not None:
+            plane.uninstall()
+        peer.stop()
+        for h in holders:
+            h.stop()
+        cm.close()
+
+
+def test_kill_switch_disables_parity_rung(coord, tmp_path,
+                                          monkeypatch):
+    """EDL_TPU_REDUNDANCY=0 turns the whole tier off: pushes are not
+    attempted and the ladder never dials holders."""
+    monkeypatch.setenv("EDL_TPU_REDUNDANCY", "0")
+    assert not redundancy.enabled()
+    tree, _ = _tree(29)
+    cm = CheckpointManager(str(tmp_path))
+    cm.save_async(2, tree).result(60.0)
+    peer = StateServer(rank=1, host="127.0.0.1")
+    try:
+        entries, dtypes = snapshot_entries(tree)
+        partial = {k: v for k, v in entries.items()
+                   if k.startswith(("opt/mu@", "step@"))}
+        peer.publish(2, partial, dtypes)
+        peer.advertise(coord)
+        target, shardings = _target_and_shardings(tree, n=4)
+        _, got, _, stats = PeerRestorer(coord, cm).restore_placed(
+            2, target, shardings)
+        assert stats["source"] == "peer+fs"  # parity rung never tried
+        _, fs_tree, _ = cm.restore_placed(2, target, shardings)
+        _assert_bit_identical(got, fs_tree)
+    finally:
+        peer.stop()
+        cm.close()
+
+
+# -- analytic plan ----------------------------------------------------------
+
+def test_rebuild_plan_classifies_parity_vs_survivor_bytes():
+    """(8,4) f32 dp-sharded one row block per source device; dst is
+    the 4-way factorization. Losing source device 0 makes exactly its
+    unique row parity traffic; everything else is peer-readable."""
+    leaves = [((8, 4), 4, ("dp",), ("dp",))]
+    plan = redundancy.rebuild_plan(leaves, {"dp": 8}, {"dp": 4},
+                                   lost_devices=[0])
+    assert plan["parity_bytes"] == 1 * 4 * 4  # the lost row
+    assert plan["survivor_bytes"] == 7 * 4 * 4
+    assert plan["needed_bytes"] > 0
+    # no losses -> nothing owes the decode anything
+    clean = redundancy.rebuild_plan(leaves, {"dp": 8}, {"dp": 4}, [])
+    assert clean["parity_bytes"] == 0
+    assert clean["survivor_bytes"] == 8 * 4 * 4
+    # replicated leaf: any survivor serves it, even losing 7 of 8
+    repl = redundancy.rebuild_plan([((8, 4), 4, (), ())],
+                                   {"dp": 8}, {"dp": 4},
+                                   lost_devices=list(range(7)))
+    assert repl["parity_bytes"] == 0
+    assert repl["survivor_bytes"] == 8 * 4 * 4

@@ -22,7 +22,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGES = ("rpc", "coordination", "distill", "liveft", "controller",
-            "data", "serve", "parallel")
+            "data", "serve", "parallel", "runtime")
 
 # (relpath, enclosing function) -> why the raw sleep-in-loop is OK
 ALLOWLIST = {
@@ -42,6 +42,18 @@ ALLOWLIST = {
         "SIGTERM->SIGKILL shutdown grace period, not a retry",
     ("edl_tpu/distill/registry.py", "main"):
         "CLI keep-alive loop (sleeps forever by design)",
+    ("edl_tpu/runtime/checkpoint.py", "_fs_wait"):
+        "FS-visibility wait with a hard deadline and exponential "
+        "0.02->0.5s backoff; eventual-consistency settle, not a retry",
+    ("edl_tpu/runtime/checkpoint.py", "_sharded_protocol"):
+        "commit/supersession wait under the sharded-save protocol: "
+        "nonce-fenced poll with a hard outer deadline",
+    ("edl_tpu/runtime/live_resize.py", "wait_for_acks"):
+        "2PC ack-collection poll with a hard outer deadline; the poll "
+        "cadence IS the protocol tick, not error recovery",
+    ("edl_tpu/runtime/trainer.py", "_emergency_save"):
+        "drain wait for the in-flight async save during teardown; "
+        "bounded by the save future's own deadline",
 }
 
 
