@@ -30,14 +30,21 @@ CLIENT_TTL = 10.0
 
 
 class _Client(object):
-    __slots__ = ("id", "require", "servers", "version", "last_seen")
+    __slots__ = ("id", "require", "servers", "version", "last_seen",
+                 "phase")
 
-    def __init__(self, cid, require, now):
+    def __init__(self, cid, require, now, phase=None):
         self.id = cid
         self.require = max(1, require)
         self.servers = set()
         self.version = 0
         self.last_seen = now
+        # serving-phase affinity (None | "prefill" | "decode"): which
+        # advertised capacity this client consumes — a one-shot/prefill
+        # client scales with batch capacity, a decode client with KV
+        # slots (capacity_prefill / capacity_decode in the teacher's
+        # registration info, teacher_server.decode_capacities)
+        self.phase = phase
 
 
 class Service(object):
@@ -118,15 +125,23 @@ class Service(object):
                 self._servers.setdefault(ep, set())
             self._rebalance()
 
-    def register_client(self, client_id, require_num):
+    def register_client(self, client_id, require_num, phase=None):
+        """``phase`` (None | "prefill" | "decode") picks which
+        advertised capacity the client weighs against — phase
+        disaggregation over one teacher fleet."""
+        if phase not in (None, "prefill", "decode"):
+            phase = None
         with self._lock:
             self._evict_stale_locked()
             if client_id not in self._clients:
                 self._clients[client_id] = _Client(
-                    client_id, require_num, self._clock())
+                    client_id, require_num, self._clock(), phase=phase)
                 self._rebalance()
             c = self._clients[client_id]
             c.last_seen = self._clock()
+            if c.phase != phase:
+                c.phase = phase
+                self._rebalance()
             return {"version": c.version, "servers": sorted(c.servers)}
 
     def unregister_client(self, client_id):
@@ -158,24 +173,54 @@ class Service(object):
         self._reassigned += 1
         _REASSIGNMENTS.inc()
 
-    def _weight(self, ep):
+    def _weight(self, ep, phase=None):
         """Relative capacity weight from the registration info: a
         draining teacher weighs 0 (its clients move off immediately —
         the load-aware half of the drain protocol), a ``capacity``
-        field scales the connection cap, anything else is 1.0."""
+        field scales the connection cap, anything else is 1.0.
+
+        With ``phase`` set, ``capacity_prefill`` / ``capacity_decode``
+        take precedence over the generic ``capacity`` — a teacher
+        without a decode engine advertises no ``capacity_decode`` and
+        keeps its generic weight, while one that DOES advertises both,
+        so prefill-heavy and decode-heavy clients see the capacity that
+        actually limits them. Phase capacities are ABSOLUTE sizes
+        (batch rows / KV slots); they are normalized against the fleet
+        mean in :meth:`_server_cap`, so a slot-rich teacher takes
+        proportionally more decode clients."""
         info = self._info.get(ep) or {}
         if info.get("draining"):
             return 0.0
+        key = "capacity_%s" % phase if phase else None
+        if key and key in info:
+            try:
+                return max(0.0, float(info[key]))
+            except (TypeError, ValueError):
+                return 1.0
         try:
             w = float(info.get("capacity", 1.0))
         except (TypeError, ValueError):
             w = 1.0
         return max(0.0, w)
 
-    def _server_cap(self, ep, per_server):
-        w = self._weight(ep)
+    def _phase_norm(self, phase):
+        """Fleet-mean phase capacity, the denominator that turns the
+        absolute per-phase sizes into relative weights (generic
+        ``capacity`` is already relative, mean 1.0 by convention)."""
+        if not phase:
+            return 1.0
+        vals = [self._weight(ep, phase) for ep in self._servers]
+        vals = [v for v in vals if v > 0.0]
+        if not vals:
+            return 1.0
+        return sum(vals) / len(vals)
+
+    def _server_cap(self, ep, per_server, phase=None):
+        w = self._weight(ep, phase)
         if w <= 0.0:
             return 0
+        if phase:
+            w = w / self._phase_norm(phase)
         if w == 1.0:
             return per_server
         return max(1, int(round(per_server * w)))
@@ -230,14 +275,17 @@ class Service(object):
                 self._count_move()
 
         # 2. link: starved clients to least-loaded servers with
-        #    weighted headroom
+        #    weighted headroom — against the client's PHASE capacity,
+        #    so decode clients skip slot-less teachers and pile onto
+        #    slot-rich ones while prefill clients spread by batch size
         for c in self._clients.values():
             allowance = min(per_client, c.require)
             while len(c.servers) < allowance:
                 candidates = [
                     ep for ep, linked in self._servers.items()
                     if ep not in c.servers
-                    and len(linked) < self._server_cap(ep, per_server)]
+                    and len(linked) < self._server_cap(ep, per_server,
+                                                       c.phase)]
                 if not candidates:
                     break
                 ep = min(candidates, key=lambda e: len(self._servers[e]))
@@ -249,7 +297,7 @@ class Service(object):
         for c in self._clients.values():
             if not c.servers and self._servers:
                 live = [ep for ep in self._servers
-                        if self._weight(ep) > 0.0]
+                        if self._weight(ep, c.phase) > 0.0]
                 ep = min(live or self._servers,
                          key=lambda e: len(self._servers[e]))
                 c.servers.add(ep)

@@ -26,10 +26,14 @@ def _gen_client_id():
 
 class DiscoveryClient(object):
     def __init__(self, endpoint, service_name, require_num=1,
-                 heartbeat_interval=2.0):
+                 heartbeat_interval=2.0, phase=None):
         self._endpoint = endpoint
         self._service = service_name
         self._require = require_num
+        # serving-phase affinity (None | "prefill" | "decode"): which
+        # advertised teacher capacity this client weighs against in the
+        # balance table (distill/balance.py phase disaggregation)
+        self._phase = phase
         self._interval = heartbeat_interval
         self.client_id = _gen_client_id()
         self._rpc = None
@@ -63,7 +67,8 @@ class DiscoveryClient(object):
         for _ in range(8):
             self._connect(endpoint)
             resp = self._rpc.call("register_client", self.client_id,
-                                  self._service, self._require)
+                                  self._service, self._require,
+                                  self._phase)
             code = resp.get("code")
             if code == ds.CODE_REDIRECT:
                 endpoint = resp["endpoint"]

@@ -119,13 +119,14 @@ class DiscoveryServer(object):
 
     # -- RPC surface ----------------------------------------------------------
 
-    def register_client(self, client_id, service_name, require_num):
+    def register_client(self, client_id, service_name, require_num,
+                        phase=None):
         owner = self._owner(service_name)
         if owner is not None and owner != self.endpoint:
             return {"code": CODE_REDIRECT, "endpoint": owner}
         self._ensure_service(service_name)
         out = self._table.service(service_name).register_client(
-            client_id, require_num)
+            client_id, require_num, phase=phase)
         code = CODE_OK if out["servers"] else CODE_NO_READY
         return {"code": code, "version": out["version"],
                 "servers": out["servers"]}

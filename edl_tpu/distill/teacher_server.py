@@ -110,8 +110,13 @@ class TeacherServer(object):
 
     def __init__(self, predict_fn, feed_specs, fetch_specs, max_batch=128,
                  host="0.0.0.0", port=0, adaptive_batch=True,
-                 batch_timeout_ms=0.0, admission=None):
+                 batch_timeout_ms=0.0, admission=None,
+                 decode_engine=None):
         self._fn = predict_fn
+        # optional autoregressive plane (serve/decode_engine.py): adds
+        # the lm_generate / lm_submit / lm_poll RPCs, folds engine
+        # stats into stats(), and joins the drain protocol
+        self._decode = decode_engine
         # admission control (serve/admission.py): None/True builds the
         # default controller (bounded queue only — no rate limit, no
         # projection shed until configured, so plain fleets behave as
@@ -144,6 +149,10 @@ class TeacherServer(object):
         self._rpc.register("stats", self.stats)
         self._rpc.register("set_knobs", self.apply_knobs)
         self._rpc.register("drain", self.drain)
+        if self._decode is not None:
+            self._rpc.register("lm_generate", self._lm_generate_rpc)
+            self._rpc.register("lm_submit", self._lm_submit_rpc)
+            self._rpc.register("lm_poll", self._lm_poll_rpc)
 
     def get_feed_fetch(self):
         features = list(_RPC_FEATURES)
@@ -151,9 +160,50 @@ class TeacherServer(object):
             features.append("adaptive_batch")
         if self._admission is not None:
             features.append("serve.admission")
-        return {"feed": self._feed_specs, "fetch": self._fetch_specs,
-                "max_batch": self._max_batch, "features": features,
-                "batch_timeout_ms": self._batch_timeout * 1000.0}
+        out = {"feed": self._feed_specs, "fetch": self._fetch_specs,
+               "max_batch": self._max_batch, "features": features,
+               "batch_timeout_ms": self._batch_timeout * 1000.0}
+        if self._decode is not None:
+            features.append("decode.engine")
+            out.update(self.decode_capacities())
+        return out
+
+    def decode_capacities(self):
+        """Phase-disaggregated capacity weights for the balance table
+        (distill/balance.py): ``capacity_prefill`` — how many one-shot
+        forwards this server absorbs per scheduling quantum (the batch
+        plane, same meaning as ``capacity``) — and ``capacity_decode`` —
+        resident-sequence capacity, bounded by KV slots. Pass through
+        ``TeacherRegister(info=...)`` so prefill-heavy and decode-heavy
+        clients hash against the capacity that actually limits them."""
+        if self._decode is None:
+            return {}
+        return {"capacity_prefill": float(self._max_batch),
+                "capacity_decode": float(self._decode.slots)}
+
+    # -- the autoregressive plane (serve/decode_engine.py) -----------------
+
+    def _lm_generate_rpc(self, prompt, max_new_tokens, deadline_ms=None):
+        """Blocking generate: admit (or typed OverloadedError), decode
+        to completion, return the report (tokens include the prompt).
+        Ships on the pipelined plane — call_async keeps many sequences
+        in flight per connection while each handler thread parks on its
+        sequence future."""
+        report = self._decode.generate(prompt, max_new_tokens,
+                                       deadline_ms=deadline_ms,
+                                       timeout=600.0)
+        return report
+
+    def _lm_submit_rpc(self, prompt, max_new_tokens, deadline_ms=None):
+        h = self._decode.submit(prompt, max_new_tokens,
+                                deadline_ms=deadline_ms)
+        return {"seq": h.seq_id}
+
+    def _lm_poll_rpc(self, seq, start=0):
+        """Token streaming: tokens generated since ``start`` + done flag
+        (raises the sequence's typed error once failed)."""
+        tokens, done = self._decode.handle(seq).tokens_from(start)
+        return {"tokens": tokens, "done": done}
 
     def apply_knobs(self, knobs):
         """Runtime tuning surface (``set_knobs`` RPC — the same contract
@@ -196,6 +246,8 @@ class TeacherServer(object):
         }
         if self._admission is not None:
             out.update(self._admission.stats())
+        if self._decode is not None:
+            out.update(self._decode.stats())
         return obs_metrics.mirror_stats("edl_teacher", out)
 
     def drain(self, deadline_s=30.0):
@@ -212,6 +264,11 @@ class TeacherServer(object):
                               pending=self._queue.qsize())
         if self._admission is not None:
             self._admission.set_draining(True)
+        if self._decode is not None:
+            # flip the decode front door too, then let BOTH planes
+            # finish their in-flight work: resident sequences decode to
+            # completion, waiting ones still get slots — zero stranded
+            self._decode.admission.set_draining(True)
         deadline = Deadline(deadline_s if deadline_s else 30.0)
         served_before = self._rows
         while not self._drained():
@@ -230,6 +287,10 @@ class TeacherServer(object):
     def _drained(self):
         if self._adaptive and self._queue.qsize() > 0:
             return False
+        if self._decode is not None:
+            st = self._decode.stats()
+            if st["decode_waiting"] or st["decode_active"]:
+                return False
         return self._admission is None or self._admission.idle()
 
     def _validate(self, feed):
@@ -455,6 +516,8 @@ class TeacherServer(object):
                 target=self._device_loop, daemon=True,
                 name="teacher-device")
             self._device_thread.start()
+        if self._decode is not None and not self._decode.running:
+            self._decode.start()
         self._rpc.start()
         logger.info("teacher serving on %s (max_batch=%d, adaptive=%s)",
                     self._rpc.endpoint, self._max_batch, self._adaptive)
@@ -474,6 +537,8 @@ class TeacherServer(object):
             self._stop_ev.set()
             self._device_thread.join(timeout=5)
             self._device_thread = None
+        if self._decode is not None:
+            self._decode.stop()
 
 
 def nop_teacher(fetch_specs, max_batch=128, host="0.0.0.0", port=0,
@@ -536,18 +601,25 @@ def resnet_teacher(depth=50, num_classes=1000, image_size=224,
 
 def gpt_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
                 vocab_size=256, seq_len=32, max_batch=64, host="0.0.0.0",
-                port=0, params=None, **kwargs):
+                port=0, params=None, quantize=None, **kwargs):
     """A causal-LM teacher: per-position next-token logits + probs —
     sequence-level knowledge distillation (the LM counterpart of the
     reference's ERNIE→BOW soft-label serving). Fixed ``seq_len`` so XLA
     compiles one program; clients pad shorter sequences.
 
     ``params`` (a trained Gpt param tree) makes it a real teacher; the
-    default random init serves as a shape-true stand-in for tests."""
+    default random init serves as a shape-true stand-in for tests.
+
+    ``quantize``: None | "int8" | "bf16" — serve from absmax
+    per-channel int8 (or bf16) kernels (ops/quant.py); the dequant runs
+    inside the jitted forward so the int8 arrays are what sit in HBM.
+    Logits parity vs f32 is gated in tier-1
+    (tests/test_decode_engine.py)."""
     import jax
     import jax.numpy as jnp
 
     from edl_tpu.models import gpt
+    from edl_tpu.ops import quant
 
     model = gpt.Gpt(num_layers=num_layers, d_model=d_model,
                     num_heads=num_heads, mlp_dim=mlp_dim,
@@ -556,15 +628,18 @@ def gpt_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
     if params is None:
         dummy = jnp.zeros((1, seq_len), jnp.int32)
         params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+    if quantize is not None:
+        params = quant.quantize_tree(params, quantize)
 
     @jax.jit
-    def infer(ids):
-        logits = model.apply({"params": params}, ids)
+    def infer(qparams, ids):
+        p = quant.dequantize_tree(qparams)
+        logits = model.apply({"params": p}, ids)
         return logits, jax.nn.softmax(logits)
 
     def predict(feed):
         ids = np.asarray(feed["input_ids"], np.int32)
-        logits, probs = infer(ids)
+        logits, probs = infer(params, ids)
         return {"logits": np.asarray(logits), "probs": np.asarray(probs)}
 
     return TeacherServer(
@@ -573,6 +648,60 @@ def gpt_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
         fetch_specs={"logits": ([seq_len, vocab_size], "<f4"),
                      "probs": ([seq_len, vocab_size], "<f4")},
         max_batch=max_batch, host=host, port=port, **kwargs)
+
+
+def lm_teacher(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
+               vocab_size=256, max_len=128, slots=8, max_batch=16,
+               host="0.0.0.0", port=0, params=None, quantize=None,
+               decode_admission=None, **kwargs):
+    """An autoregressive LM teacher: the one-shot per-position logits
+    plane of :func:`gpt_teacher` PLUS the continuous-batching decode
+    engine (serve/decode_engine.py) behind ``lm_generate`` /
+    ``lm_submit`` / ``lm_poll``. Prefill-heavy clients use ``predict``;
+    decode-heavy ones hold KV slots — the two capacities are advertised
+    separately (``decode_capacities``) so the balance table can
+    disaggregate the phases. ``quantize`` (None|"int8"|"bf16") applies
+    to BOTH planes from one shared quantized param tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import gpt
+    from edl_tpu.ops import quant
+    from edl_tpu.serve.decode_engine import DecodeEngine
+
+    # decode path runs f32: greedy sampling is gated token-identical
+    # against models.gpt.generate, which bf16 activations would break
+    model = gpt.Gpt(num_layers=num_layers, d_model=d_model,
+                    num_heads=num_heads, mlp_dim=mlp_dim,
+                    vocab_size=vocab_size, max_len=max_len,
+                    dtype=jnp.float32)
+    if params is None:
+        dummy = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), dummy)["params"]
+    if quantize is not None:
+        params = quant.quantize_tree(params, quantize)
+    engine = DecodeEngine(model, params, slots=slots,
+                          admission=decode_admission)
+
+    @jax.jit
+    def infer(qparams, ids):
+        p = quant.dequantize_tree(qparams)
+        logits = model.apply({"params": p}, ids)
+        return logits, jax.nn.softmax(logits)
+
+    def predict(feed):
+        ids = np.asarray(feed["input_ids"], np.int32)
+        logits, probs = infer(params, ids)
+        return {"logits": np.asarray(logits), "probs": np.asarray(probs)}
+
+    seq_len = max_len
+    return TeacherServer(
+        predict,
+        feed_specs={"input_ids": ([seq_len], "<i4")},
+        fetch_specs={"logits": ([seq_len, vocab_size], "<f4"),
+                     "probs": ([seq_len, vocab_size], "<f4")},
+        max_batch=max_batch, host=host, port=port,
+        decode_engine=engine, **kwargs)
 
 
 def main():

@@ -31,6 +31,9 @@ class CausalSelfAttention(nn.Module):
     truth for the decode position (the same value drives the position
     embedding in Gpt), so a retried step overwrites its own slot instead
     of silently drifting — and attention runs against the prefix.
+    ``decode_index`` may be a scalar (all rows at the same position, the
+    ``generate`` path) or a [b] vector (each row at its OWN position —
+    the slot-batched continuous-decode path in serve.decode_engine).
 
     ``use_flash=None`` (default) auto-dispatches dense→flash by kernel
     legality (see ops/attention.flash_dispatch_reason); True/False still
@@ -93,15 +96,34 @@ class CausalSelfAttention(nn.Module):
                 "cache", "v", jnp.zeros,
                 (b, self.max_len, self.num_heads, head_dim), self.dtype)
             idx = jnp.asarray(decode_index, jnp.int32)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(self.dtype), (0, idx, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(self.dtype), (0, idx, 0, 0))
+            if idx.ndim == 0:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(self.dtype), (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(self.dtype), (0, idx, 0, 0))
+                mask = (jnp.arange(self.max_len)[None, None, None, :]
+                        <= idx)
+            else:
+                # vector decode_index: one position PER ROW, the slot
+                # layout of the continuous-batching engine — every slot
+                # advances through its own sequence independently inside
+                # ONE fixed-shape step (scatter write + per-row prefix
+                # mask; no recompile as slot membership churns)
+                if idx.shape != (b,):
+                    raise ValueError(
+                        "vector decode_index must be [batch]=%d, got %s"
+                        % (b, idx.shape))
+                rows = jnp.arange(b)
+                ck.value = ck.value.at[rows, idx].set(
+                    k[:, 0].astype(self.dtype))
+                cv.value = cv.value.at[rows, idx].set(
+                    v[:, 0].astype(self.dtype))
+                mask = (jnp.arange(self.max_len)[None, None, None, :]
+                        <= idx[:, None, None, None])
             scale = head_dim ** -0.5
             scores = jnp.einsum(
                 "bqhd,bkhd->bhqk", (q * scale).astype(jnp.float32),
                 ck.value.astype(jnp.float32))
-            mask = jnp.arange(self.max_len)[None, None, None, :] <= idx
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1)
             ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
@@ -188,7 +210,13 @@ class Gpt(nn.Module):
         if decode:
             if decode_index is None:
                 raise ValueError("decode mode needs decode_index")
-            pos_ids = jnp.full((1, s), decode_index, jnp.int32)
+            idx = jnp.asarray(decode_index, jnp.int32)
+            if idx.ndim == 0:
+                pos_ids = jnp.full((1, s), idx, jnp.int32)
+            else:
+                # per-row positions (slot-batched decode): row i sits at
+                # its own sequence offset
+                pos_ids = idx[:, None]
         else:
             pos_ids = jnp.arange(s)[None, :]
             if self.ring_axis:

@@ -21,15 +21,28 @@ _FLASH_BLOCK = 128
 _FLASH_HEAD_MULT = 8
 
 
-def flash_dispatch_reason(seq_len, head_dim, *, mask=None, platform=None):
+def flash_dispatch_reason(seq_len, head_dim, *, mask=None, platform=None,
+                          seq_kv=None):
     """Why auto-dispatch would (not) pick flash for this shape.
 
     Returns ``None`` when the flash path is legal and profitable, else a
     human-readable reason string (the dense path is taken). Pure shape
     math — safe to call from tests and benches without tracing.
+
+    ``seq_kv`` (default: ``seq_len``) is the K/V sequence length.
+    Decode-shaped queries — seq_q=1 (or any seq_q != seq_kv) against a
+    cached K/V — are NEVER flash-legal here: the Pallas kernel derives
+    its causal block mask from the query position, so with q shorter
+    than kv it would mask against the wrong diagonal and read an
+    under-tiled q block. The decode path in models/gpt.py owns its own
+    masked dense attention against the cache; auto-dispatch must not
+    steal it mid-decode.
     """
     if mask is not None:
         return "attention_mask set (flash kernel has no mask support)"
+    if seq_kv is not None and seq_kv != seq_len:
+        return ("decode-shaped query (seq_q %d != seq_kv %d): flash "
+                "causal masking assumes square q/kv" % (seq_len, seq_kv))
     platform = platform or jax.default_backend()
     if os.environ.get("EDL_TPU_FLASH_AUTO", "") == "0":
         return "disabled via EDL_TPU_FLASH_AUTO=0"
@@ -71,12 +84,19 @@ def attention_context(q, k, v, *, causal, mask, dtype, ring_axis=None,
         return ring_attention(q, k, v, mesh, causal=causal)
     if use_flash is None:
         use_flash = flash_dispatch_reason(q.shape[1], head_dim,
-                                          mask=mask) is None
+                                          mask=mask,
+                                          seq_kv=k.shape[1]) is None
     if use_flash:
         if mask is not None:
             raise ValueError(
                 "use_flash does not support attention_mask yet; drop "
                 "the mask (fixed-length batches) or use the dense path")
+        if q.shape[1] != k.shape[1]:
+            raise ValueError(
+                "use_flash=True with decode-shaped q (seq_q %d != "
+                "seq_kv %d): the flash kernel's causal mask assumes "
+                "square q/kv; use the cached dense decode path"
+                % (q.shape[1], k.shape[1]))
         from edl_tpu.ops.flash_attention import mha
         return mha(q, k, v, causal=causal,
                    interpret=jax.default_backend() != "tpu")

@@ -74,6 +74,14 @@ serve.drain              when a teacher starts draining (ctx: endpoint,
                          pending) — arm ``delay`` to hold the drain
                          window open or ``error`` to drill a teacher
                          dying mid-decommission
+serve.decode.step        before each fused decode step of the
+                         continuous-batching engine (ctx: active,
+                         step) — an armed ``error`` fails ONLY the
+                         sequences active in that step (typed
+                         DecodeStepError, slots freed) and the device
+                         loop keeps serving; ``delay`` inflates the
+                         inter-token latency so the per-phase ``itl``
+                         shed trips
 relay.attach             child side, when a relay attachment adopts a
                          candidate endpoint (ctx: endpoint, pod) — an
                          armed ``error`` skips the candidate, driving

@@ -14,14 +14,21 @@ The robustness layer between raw traffic and the distill data plane
 - :mod:`~edl_tpu.serve.drain` — the drain-safe decommission protocol:
   stop advertising → let the discovery TTL lapse → finish in-flight
   work → exit, with zero stranded requests.
+- :mod:`~edl_tpu.serve.decode_engine` + :mod:`~edl_tpu.serve.kv_cache`
+  — the autoregressive plane: slot-based KV cache with continuous
+  batching at decode-step granularity, fronted by per-phase admission
+  (:class:`~edl_tpu.serve.admission.DecodeAdmission`: TTFT projection
+  for prefill, ITL + slot occupancy for decode).
 
-Fault points ``serve.admit`` / ``serve.drain`` put both halves under
-seeded chaos (docs/fault_tolerance.md).
+Fault points ``serve.admit`` / ``serve.drain`` / ``serve.decode.step``
+put all three halves under seeded chaos (docs/fault_tolerance.md).
 """
 
-from edl_tpu.serve.admission import AdmissionController
+from edl_tpu.serve.admission import AdmissionController, DecodeAdmission
+from edl_tpu.serve.decode_engine import DecodeEngine
 from edl_tpu.serve.drain import decommission
+from edl_tpu.serve.kv_cache import SlotKvCache
 from edl_tpu.serve.scaler import ServeScaler, load_actions
 
-__all__ = ["AdmissionController", "ServeScaler", "decommission",
-           "load_actions"]
+__all__ = ["AdmissionController", "DecodeAdmission", "DecodeEngine",
+           "ServeScaler", "SlotKvCache", "decommission", "load_actions"]

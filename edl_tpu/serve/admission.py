@@ -34,6 +34,14 @@ RPCs never pass through here — admission guards ``predict`` only, and
 the RPC substrate serves plain (non-pipelined) calls inline on the
 connection read thread, so observability survives overload by
 construction (docs/distill_dataplane.md §"The serving plane").
+
+:class:`DecodeAdmission` is the PER-PHASE variant for the
+autoregressive decode engine: a sequence's cost splits into a prefill
+phase (one batched forward, governs time-to-first-token) and a decode
+phase (one slot for its whole lifetime, governs everyone's inter-token
+latency), so the front door projects against BOTH — TTFT for the
+prefill queue, ITL for the slot plane — plus slot-occupancy shedding
+(docs/distill_dataplane.md §"Autoregressive decode").
 """
 
 import threading
@@ -54,6 +62,16 @@ _PENDING = obs_metrics.gauge(
 
 SHED_REASONS = ("draining", "queue_full", "rate_limit", "slo",
                 "deadline")
+
+# decode-phase taxonomy: prefill-phase reasons (queue_full, ttft) speak
+# about the waiting queue; decode-phase reasons (slots, itl, deadline)
+# speak about the slot plane
+DECODE_SHED_REASONS = ("draining", "queue_full", "slots", "ttft", "itl",
+                       "deadline")
+
+_DECODE_SHED = obs_metrics.counter(
+    "edl_decode_shed_total", "sequences shed by per-phase decode "
+    "admission", labels=("reason",))
 
 
 class AdmissionController(object):
@@ -198,6 +216,144 @@ class AdmissionController(object):
                 "projected_wait_ms": wait,
                 "row_ms": self._row_ms,
                 "slo_ms": self._slo_ms,
+                "draining": self._draining,
+                "admitted": self._admitted,
+                "shed": dict(self._shed),
+                "shed_total": sum(self._shed.values()),
+            }
+
+
+class DecodeAdmission(object):
+    """Per-phase front door for :class:`~edl_tpu.serve.decode_engine.
+    DecodeEngine`. Thread-safe; one instance per engine.
+
+    The engine feeds it two service-time estimates (EWMAs it measures on
+    the device loop): ``prefill_ms`` — wall time of one prefill forward
+    — and ``itl_ms`` — wall time of one fused decode step, which IS the
+    inter-token latency every occupied slot experiences. Admission then
+    checks, in order:
+
+    - ``draining``    — decommissioning; new sequences go elsewhere.
+    - ``queue_full``  — the waiting (pre-prefill) queue is at
+                        ``max_waiting``.
+    - ``slots``       — zero free slots AND the waiting queue already
+                        holds ``slot_slack`` sequences (default: one
+                        full slot refill) — occupancy shedding: more
+                        queueing cannot be served before slots turn
+                        over.
+    - ``ttft``        — TTFT projection: (waiting+1) x prefill EWMA
+                        exceeds ``ttft_slo_ms``. Prefill-phase analog
+                        of the queue-wait ``slo`` shed.
+    - ``itl``         — the measured ITL EWMA exceeds ``itl_slo_ms``
+                        while slots are occupied: every admitted
+                        sequence inflates EVERY resident sequence's
+                        ITL, so the decode plane protects residents by
+                        shedding arrivals.
+
+    Same liveness rules as :class:`AdmissionController`: a cold engine
+    (no estimate yet) admits freely, and an idle one (no waiting work /
+    no occupied slots) never projection-sheds — the EWMAs only update
+    while work flows, so shedding at idle would freeze a poisoned
+    estimate forever. ``deadline`` accounts decode-phase evictions
+    (sequence exceeded its budget mid-generation; the device loop calls
+    :meth:`shed_evicted`)."""
+
+    def __init__(self, max_waiting=64, ttft_slo_ms=None, itl_slo_ms=None,
+                 slot_slack=None, ewma_alpha=0.2, clock=time.monotonic):
+        self._max_waiting = int(max_waiting)
+        self._ttft_slo_ms = (None if ttft_slo_ms is None
+                             else float(ttft_slo_ms))
+        self._itl_slo_ms = (None if itl_slo_ms is None
+                            else float(itl_slo_ms))
+        self._slot_slack = slot_slack  # None -> slots, resolved per call
+        self._alpha = float(ewma_alpha)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._prefill_ms = None  # EWMA, one prefill forward
+        self._itl_ms = None      # EWMA, one fused decode step
+        self._draining = False
+        self._admitted = 0
+        self._shed = {r: 0 for r in DECODE_SHED_REASONS}
+
+    def set_draining(self, flag=True):
+        with self._lock:
+            self._draining = bool(flag)
+
+    @property
+    def draining(self):
+        with self._lock:
+            return self._draining
+
+    # -- estimates (fed by the engine's device loop) -----------------------
+
+    def observe_prefill_ms(self, ms):
+        with self._lock:
+            self._prefill_ms = ms if self._prefill_ms is None else (
+                self._alpha * ms + (1.0 - self._alpha) * self._prefill_ms)
+
+    def observe_itl_ms(self, ms):
+        with self._lock:
+            self._itl_ms = ms if self._itl_ms is None else (
+                self._alpha * ms + (1.0 - self._alpha) * self._itl_ms)
+
+    # -- the decision ------------------------------------------------------
+
+    def admit(self, free_slots, waiting, occupied, slots):
+        """Admit one sequence or raise :class:`OverloadedError`.
+        ``free_slots``/``occupied``/``slots`` describe the slot plane,
+        ``waiting`` the pre-prefill queue, at the instant of arrival."""
+        with self._lock:
+            if self._draining:
+                raise self._shed_locked("draining", retry_after_s=0.1)
+            if waiting >= self._max_waiting:
+                raise self._shed_locked(
+                    "queue_full", retry_after_s=self._turnover_s_locked())
+            slack = (int(slots) if self._slot_slack is None
+                     else int(self._slot_slack))
+            if free_slots <= 0 and waiting >= slack:
+                raise self._shed_locked(
+                    "slots", retry_after_s=self._turnover_s_locked())
+            if (self._ttft_slo_ms is not None and waiting > 0
+                    and self._prefill_ms is not None):
+                ttft = (waiting + 1) * self._prefill_ms
+                if ttft > self._ttft_slo_ms:
+                    raise self._shed_locked(
+                        "ttft",
+                        retry_after_s=(ttft - self._ttft_slo_ms) / 1000.0)
+            if (self._itl_slo_ms is not None and occupied > 0
+                    and self._itl_ms is not None
+                    and self._itl_ms > self._itl_slo_ms):
+                raise self._shed_locked(
+                    "itl", retry_after_s=self._turnover_s_locked())
+            self._admitted += 1
+
+    def _turnover_s_locked(self):
+        # a slot frees after roughly one sequence tail: O(itl) per token;
+        # without an estimate fall back to a fixed polite backoff
+        if self._itl_ms is not None:
+            return max(0.05, self._itl_ms / 100.0)
+        return 0.2
+
+    def _shed_locked(self, reason, retry_after_s=None):
+        self._shed[reason] += 1
+        _DECODE_SHED.labels(reason).inc()
+        return errors.OverloadedError.shed(reason,
+                                           retry_after_s=retry_after_s)
+
+    def shed_evicted(self):
+        """Account a decode-phase deadline eviction (the device loop
+        already freed the slot)."""
+        with self._lock:
+            return self._shed_locked("deadline")
+
+    def stats(self):
+        with self._lock:
+            return {
+                "max_waiting": self._max_waiting,
+                "prefill_ms": self._prefill_ms,
+                "itl_ms": self._itl_ms,
+                "ttft_slo_ms": self._ttft_slo_ms,
+                "itl_slo_ms": self._itl_slo_ms,
                 "draining": self._draining,
                 "admitted": self._admitted,
                 "shed": dict(self._shed),

@@ -1,0 +1,535 @@
+"""Continuous-batching autoregressive decode engine for the serving plane.
+
+Decode-step-level scheduling (Orca, Yu et al. OSDI'22) on top of the
+slot KV cache (:mod:`edl_tpu.serve.kv_cache`): instead of batching at
+request granularity — where every sequence in a batch waits for the
+longest one — the device loop makes an admission decision EVERY DECODE
+STEP. Each iteration it
+
+1. admits newly arrived sequences into free slots (one prefill forward
+   per arrival fills the slot's cache rows ``[0:prompt_len)`` via the
+   path ``models/gpt.py`` exposes, and yields the first token),
+2. runs ONE fused decode step over all occupied slots — a fixed-shape
+   jit over ``[slots]`` tokens and ``[slots]`` per-row positions
+   (vector ``decode_index``), so slot membership churn never
+   recompiles; free rows ride along masked-out on the host side,
+3. retires finished sequences (slot back to the free list, future
+   resolved) and evicts ones past their deadline,
+
+and streams tokens back over the pipelined RPC plane (``lm_submit`` /
+``lm_poll`` on :class:`~edl_tpu.distill.teacher_server.TeacherServer`,
+or blocking ``lm_generate``).
+
+Generation is greedy (argmax) — deliberately: tier-1 gates the engine
+on TOKEN-IDENTICAL output vs the unbatched ``models.gpt.generate`` for
+the same prompts, which pins down the whole slot machinery (prefill
+padding, scatter, per-row masks, cache reuse without zeroing).
+
+Faults: the ``serve.decode.step`` point fires before every fused step;
+a faulted step fails ONLY the sequences active in it (typed
+:class:`~edl_tpu.utils.errors.DecodeStepError`, slots freed) and the
+loop keeps serving — chaos-drilled in tests/test_decode_engine.py.
+
+Quantization: pass ``params`` straight from
+:func:`edl_tpu.ops.quant.quantize_tree` — the jitted prefill/step call
+:func:`~edl_tpu.ops.quant.dequantize_tree` under trace, so int8/bf16
+weights are what cross the HBM boundary (identity on f32 trees).
+"""
+
+import collections
+import itertools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.ops.quant import dequantize_tree
+from edl_tpu.robustness import faults
+from edl_tpu.serve.admission import DecodeAdmission
+from edl_tpu.serve.kv_cache import SlotKvCache
+from edl_tpu.utils import errors
+
+_MS_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+
+_SLOTS_OCCUPIED = obs_metrics.gauge(
+    "edl_decode_slots_occupied", "KV-cache slots holding a live sequence")
+_SLOTS_TOTAL = obs_metrics.gauge(
+    "edl_decode_slots_total", "preallocated KV-cache slots")
+_PREFILL_QUEUE = obs_metrics.gauge(
+    "edl_decode_prefill_queue", "admitted sequences waiting for a slot "
+    "+ prefill")
+_TTFT = obs_metrics.histogram(
+    "edl_decode_ttft_ms", "submit -> first token (prefill phase)",
+    buckets=_MS_BUCKETS)
+_ITL = obs_metrics.histogram(
+    "edl_decode_itl_ms", "inter-token latency (one fused decode step)",
+    buckets=_MS_BUCKETS)
+_TOKENS = obs_metrics.counter(
+    "edl_decode_tokens_total", "tokens generated across all sequences")
+_EVICTED = obs_metrics.counter(
+    "edl_decode_evicted_sequences_total", "sequences evicted before "
+    "completion (deadline or faulted step)")
+_STEPS = obs_metrics.counter(
+    "edl_decode_steps_total", "fused decode steps executed")
+
+
+class _Seq(object):
+    __slots__ = ("id", "prompt", "max_new", "deadline_ms", "submitted_at",
+                 "slot", "pos", "tok", "tokens", "ttft_ms", "itl_ms",
+                 "done", "error", "event")
+
+    def __init__(self, seq_id, prompt, max_new, deadline_ms, submitted_at):
+        self.id = seq_id
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline_ms = deadline_ms
+        self.submitted_at = submitted_at
+        self.slot = None
+        self.pos = None      # position the NEXT fed token occupies
+        self.tok = None      # the next token to feed
+        self.tokens = []     # generated tokens (streamed via poll)
+        self.ttft_ms = None
+        self.itl_ms = []
+        self.done = False
+        self.error = None
+        self.event = threading.Event()
+
+
+class SeqHandle(object):
+    """Client-side handle: stream via :meth:`tokens_from`, or block on
+    :meth:`result`."""
+
+    def __init__(self, engine, seq):
+        self._engine = engine
+        self._seq = seq
+
+    @property
+    def seq_id(self):
+        return self._seq.id
+
+    def tokens_from(self, start):
+        """(new_tokens, done) — tokens generated since index ``start``.
+        Raises the sequence's typed error once it has failed."""
+        return self._engine._poll(self._seq, start)
+
+    def result(self, timeout=None):
+        """Block until the sequence finishes; returns a report dict
+        (tokens, ttft_ms, itl p50/p99) or raises its typed error."""
+        if not self._seq.event.wait(timeout):
+            raise errors.TimeoutError_(
+                "sequence %d still decoding after %ss"
+                % (self._seq.id, timeout))
+        return self._engine._report(self._seq)
+
+
+class DecodeEngine(object):
+    """One device loop + slot cache + per-phase admission, serving a
+    single causal-LM ``model`` with KV-cache decode (``models/gpt.py``).
+
+    ``params`` may be plain f32 or the output of
+    :func:`~edl_tpu.ops.quant.quantize_tree`. ``slots`` bounds resident
+    sequences; ``admission`` is a :class:`DecodeAdmission` (``None`` =
+    defaults, ``False`` = admit everything except when draining)."""
+
+    def __init__(self, model, params, slots=8, admission=None,
+                 clock=time.monotonic):
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.max_len = int(model.max_len)
+        self._clock = clock
+        if admission is None:
+            admission = DecodeAdmission(clock=clock)
+        self.admission = admission or DecodeAdmission(
+            max_waiting=1 << 30, clock=clock)
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._waiting = collections.deque()
+        self._seqs = {}      # id -> _Seq (live + recently finished)
+        self._by_slot = {}   # slot -> _Seq (active only)
+        self._ids = itertools.count(1)
+        self._stop = False
+        self._thread = None
+        self._sequences_done = 0
+        self._evicted = 0
+        self._tokens_total = 0
+        self._steps_total = 0
+        self._step_traces = 0     # fixed-shape discipline: must stay 1
+        self._prefill_traces = 0  # bounded by len(prefill buckets)
+
+        self.kv = SlotKvCache(
+            lambda n: _init_cache(model, params, n), self.slots)
+        _SLOTS_TOTAL.set(self.slots)
+        self._jit_prefill = jax.jit(self._prefill_impl)
+        self._jit_step = jax.jit(self._step_impl)
+
+    # -- jitted device functions -------------------------------------------
+
+    def _prefill_impl(self, qparams, cache, ids, prompt_len, slot):
+        """Fills slot ``slot`` of ``cache`` from a padded prompt
+        ``ids [1, P]`` and returns (cache', last-prompt-position logits).
+        The prefill cache row is FULL-length (prompt K/V then zeros), so
+        the scatter erases any previous tenant of the slot; junk K/V at
+        padded positions ``[prompt_len, P)`` is overwritten by the decode
+        step at each position before it is ever attended."""
+        self._prefill_traces += 1  # python side effect: counts traces
+        params = dequantize_tree(qparams)
+        row = _init_cache(self.model, None, 1)
+        logits, muts = self.model.apply(
+            {"params": params, "cache": row}, ids, prefill=True,
+            mutable=["cache"])
+        starts = (slot, 0, 0, 0)
+        cache = jax.tree_util.tree_map(
+            lambda full, r: jax.lax.dynamic_update_slice(full, r, starts),
+            cache, muts["cache"])
+        return cache, logits[0, prompt_len - 1]
+
+    def _step_impl(self, qparams, cache, toks, pos):
+        """ONE fused decode step over every slot: fixed shapes
+        ``toks [slots]`` / ``pos [slots]`` whatever subset is live (free
+        rows carry tok=0 at pos=0 — their junk write lands in a row the
+        next prefill fully overwrites). Returns (cache', logits
+        [slots, vocab])."""
+        self._step_traces += 1  # python side effect: counts traces
+        params = dequantize_tree(qparams)
+        logits, muts = self.model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            decode=True, decode_index=pos, mutable=["cache"])
+        return muts["cache"], logits[:, 0]
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens, deadline_ms=None):
+        """Admit one sequence (or raise ``OverloadedError``); returns a
+        :class:`SeqHandle`. ``prompt_ids`` is a 1-D int sequence."""
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise errors.FeedSpecError("empty prompt")
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise errors.FeedSpecError("max_new_tokens must be >= 1")
+        total = len(prompt) + max_new
+        if total > self.max_len:
+            raise errors.FeedSpecError(
+                "prompt+new %d exceeds max_len %d" % (total, self.max_len))
+        now = self._clock()
+        with self._work:
+            self.admission.admit(
+                free_slots=self.kv.free_slots, waiting=len(self._waiting),
+                occupied=self.kv.occupied, slots=self.slots)
+            seq = _Seq(next(self._ids), prompt, max_new, deadline_ms, now)
+            self._seqs[seq.id] = seq
+            self._waiting.append(seq)
+            _PREFILL_QUEUE.set(len(self._waiting))
+            self._work.notify()
+        return SeqHandle(self, seq)
+
+    def generate(self, prompt_ids, max_new_tokens, deadline_ms=None,
+                 timeout=None):
+        """Blocking submit: the full report dict when the sequence
+        finishes (tokens include the prompt, matching
+        ``models.gpt.generate``)."""
+        return self.submit(prompt_ids, max_new_tokens,
+                           deadline_ms=deadline_ms).result(timeout)
+
+    def handle(self, seq_id):
+        with self._lock:
+            seq = self._seqs.get(int(seq_id))
+        if seq is None:
+            raise errors.NotFoundError("unknown sequence %s" % seq_id)
+        return SeqHandle(self, seq)
+
+    def _poll(self, seq, start):
+        with self._lock:
+            if seq.error is not None:
+                raise seq.error
+            return list(seq.tokens[int(start):]), seq.done
+
+    def _report(self, seq):
+        with self._lock:
+            if seq.error is not None:
+                raise seq.error
+            itl = sorted(seq.itl_ms)
+            return {
+                "tokens": seq.prompt + list(seq.tokens),
+                "generated": list(seq.tokens),
+                "ttft_ms": seq.ttft_ms,
+                "itl_ms": list(seq.itl_ms),
+                "itl_p50_ms": _pct(itl, 0.50),
+                "itl_p99_ms": _pct(itl, 0.99),
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def running(self):
+        return self._thread is not None
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._thread = threading.Thread(target=self._loop,
+                                        name="decode-engine", daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self, deadline_s=30.0):
+        """Stop admitting, finish every in-flight sequence (waiting AND
+        active), then return True; False if ``deadline_s`` elapsed with
+        work still live. Zero stranded: nothing is dropped — waiting
+        sequences still get slots as they free up. (The wait rides the
+        engine condition var — every retire/evict notifies — not a
+        poll.)"""
+        self.admission.set_draining(True)
+        deadline = self._clock() + deadline_s
+        with self._work:
+            self._work.notify_all()
+            while self._waiting or self._by_slot:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    return False
+                self._work.wait(timeout=min(0.05, remaining))
+            return True
+
+    def stop(self):
+        """Stop the device loop. Any sequence still live is resolved
+        with a typed ``StopError`` so no client blocks forever — call
+        :meth:`drain` first for a zero-stranded shutdown."""
+        with self._work:
+            self._stop = True
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        with self._lock:
+            leftovers = list(self._waiting) + list(self._by_slot.values())
+            self._waiting.clear()
+            for seq in leftovers:
+                if seq.slot is not None:
+                    del self._by_slot[seq.slot]
+                    self.kv.free(seq.slot)
+                    seq.slot = None
+                self._resolve_locked(seq, error=errors.StopError(
+                    "engine stopped with sequence %d live" % seq.id))
+
+    # -- the device loop ---------------------------------------------------
+
+    def _loop(self):
+        while True:
+            with self._work:
+                if self._stop:
+                    return
+                if not self._by_slot and not self._waiting:
+                    self._work.wait(timeout=0.05)
+                    if self._stop:
+                        return
+            self._admit_arrivals()
+            if self._by_slot:
+                self._run_step()
+
+    def _admit_arrivals(self):
+        while True:
+            with self._lock:
+                if not self._waiting:
+                    return
+                seq = self._waiting[0]
+                if (seq.deadline_ms is not None
+                        and (self._clock() - seq.submitted_at) * 1000.0
+                        > seq.deadline_ms):
+                    # dead on arrival: budget burned in the queue
+                    self._waiting.popleft()
+                    _PREFILL_QUEUE.set(len(self._waiting))
+                    self._resolve_locked(
+                        seq, error=self.admission.shed_evicted())
+                    self._evicted += 1
+                    _EVICTED.inc()
+                    continue
+                slot = self.kv.alloc()
+                if slot is None:
+                    return
+                self._waiting.popleft()
+                _PREFILL_QUEUE.set(len(self._waiting))
+            self._prefill(seq, slot)
+
+    def _prefill(self, seq, slot):
+        plen = len(seq.prompt)
+        bucket = _prefill_bucket(plen, self.max_len)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :plen] = seq.prompt
+        t0 = time.monotonic()
+        try:
+            cache, last = self._jit_prefill(
+                self.params, self.kv.cache, jnp.asarray(ids),
+                jnp.asarray(plen, jnp.int32), jnp.asarray(slot, jnp.int32))
+            first = int(np.argmax(np.asarray(last)))
+        except Exception as exc:  # noqa: BLE001 — fail one seq, not the loop
+            self.kv.free(slot)
+            with self._lock:
+                self._resolve_locked(seq, error=errors.DecodeStepError(
+                    "prefill failed: %s" % exc))
+                self._evicted += 1
+            _EVICTED.inc()
+            return
+        self.kv.cache = cache
+        # TTFT = submit -> first token; one interval feeds the histogram,
+        # the admission EWMA and the per-seq report (allowlisted pair
+        # site in tools/check_no_ad_hoc_instrumentation.py)
+        prefill_ms = (time.monotonic() - t0) * 1000.0
+        self.admission.observe_prefill_ms(prefill_ms)
+        with self._lock:
+            seq.slot = slot
+            seq.pos = plen
+            seq.tok = first
+            seq.tokens.append(first)
+            seq.ttft_ms = (self._clock() - seq.submitted_at) * 1000.0
+            self._tokens_total += 1
+            self._by_slot[slot] = seq
+            ttft = seq.ttft_ms
+            finished = len(seq.tokens) >= seq.max_new
+            if finished:
+                self._retire_locked(seq)
+        _TTFT.observe(ttft)
+        _TOKENS.inc()
+        _SLOTS_OCCUPIED.set(self.kv.occupied)
+
+    def _run_step(self):
+        toks = np.zeros(self.slots, np.int32)
+        pos = np.zeros(self.slots, np.int32)
+        with self._lock:
+            active = dict(self._by_slot)
+            for slot, seq in active.items():
+                toks[slot] = seq.tok
+                pos[slot] = seq.pos
+        t0 = time.monotonic()
+        try:
+            if faults.PLANE is not None:
+                faults.PLANE.fire("serve.decode.step",
+                                  active=len(active),
+                                  step=self._steps_total)
+            cache, logits = self._jit_step(
+                self.params, self.kv.cache, jnp.asarray(toks),
+                jnp.asarray(pos))
+            logits = np.asarray(logits)
+        except Exception as exc:  # noqa: BLE001 — fail the step's seqs,
+            self._fail_step(active, exc)  # never the loop
+            return
+        self.kv.cache = cache
+        step_ms = (time.monotonic() - t0) * 1000.0
+        self.admission.observe_itl_ms(step_ms)
+        _ITL.observe(step_ms)
+        _STEPS.inc()
+        now = self._clock()
+        done_or_evicted = False
+        with self._lock:
+            self._steps_total += 1
+            for slot, seq in active.items():
+                nxt = int(np.argmax(logits[slot]))
+                seq.tokens.append(nxt)
+                seq.itl_ms.append(step_ms)
+                seq.pos += 1
+                seq.tok = nxt
+                self._tokens_total += 1
+                _TOKENS.inc()
+                if len(seq.tokens) >= seq.max_new:
+                    self._retire_locked(seq)
+                    done_or_evicted = True
+                elif (seq.deadline_ms is not None
+                        and (now - seq.submitted_at) * 1000.0
+                        > seq.deadline_ms):
+                    self._evict_locked(seq)
+                    done_or_evicted = True
+        if done_or_evicted:
+            _SLOTS_OCCUPIED.set(self.kv.occupied)
+
+    def _fail_step(self, active, exc):
+        """A faulted fused step fails ONLY the sequences in it: typed
+        error, slots freed, loop keeps running (never wedged)."""
+        with self._lock:
+            for seq in active.values():
+                self._evict_locked(seq, error=errors.DecodeStepError(
+                    "decode step faulted for seq %d: %s" % (seq.id, exc)))
+        _SLOTS_OCCUPIED.set(self.kv.occupied)
+
+    def _retire_locked(self, seq):
+        if seq.slot is not None:
+            del self._by_slot[seq.slot]
+            self.kv.free(seq.slot)
+            seq.slot = None
+        self._sequences_done += 1
+        self._resolve_locked(seq)
+
+    def _evict_locked(self, seq, error=None):
+        if seq.slot is not None:
+            del self._by_slot[seq.slot]
+            self.kv.free(seq.slot)
+            seq.slot = None
+        self._evicted += 1
+        _EVICTED.inc()
+        if error is None:
+            error = self.admission.shed_evicted()
+        self._resolve_locked(seq, error=error)
+
+    def _resolve_locked(self, seq, error=None):
+        seq.error = error
+        seq.done = True
+        seq.event.set()
+        self._work.notify_all()  # wake drain()
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            waiting = len(self._waiting)
+            active = len(self._by_slot)
+            steps = self._steps_total
+        occ = self.kv.occupied
+        return {
+            "decode_slots_total": self.slots,
+            "decode_slots_occupied": occ,
+            "decode_slot_frac": occ / float(self.slots),
+            "decode_waiting": waiting,
+            "decode_active": active,
+            "decode_steps_total": steps,
+            "decode_step_traces": self._step_traces,
+            "decode_prefill_traces": self._prefill_traces,
+            "decode_tokens_total": self._tokens_total,
+            "decode_sequences_total": self._sequences_done,
+            "decode_evicted_total": self._evicted,
+            "decode_ttft_p50_ms": _TTFT.percentile(0.50),
+            "decode_ttft_p99_ms": _TTFT.percentile(0.99),
+            "decode_itl_p50_ms": _ITL.percentile(0.50),
+            "decode_itl_p99_ms": _ITL.percentile(0.99),
+            "decode_kv_bytes": self.kv.bytes(),
+            "decode_admission": self.admission.stats(),
+        }
+
+
+def _init_cache(model, params, batch_size):
+    """Zeroed cache pytree for ``batch_size`` rows (trace-safe: shapes
+    from eval_shape, no params materialized — mirrors
+    ``models.gpt.init_cache`` without importing the params)."""
+    dummy = jnp.zeros((batch_size, 1), jnp.int32)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), dummy, decode=True,
+                           decode_index=jnp.zeros((), jnp.int32)))
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes["cache"])
+
+
+def _prefill_bucket(prompt_len, max_len):
+    """Pad prompts to power-of-two buckets: prefill compile count is
+    O(log max_len), not O(distinct prompt lengths)."""
+    b = 1
+    while b < prompt_len:
+        b <<= 1
+    return min(b, max_len)
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]

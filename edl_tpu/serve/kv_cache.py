@@ -1,0 +1,80 @@
+"""Preallocated slot-based KV cache for continuous-batching decode.
+
+The paged-attention insight (vLLM, Kwon et al. SOSP'23) applied at slot
+granularity: the engine owns ONE device-resident cache pytree shaped
+``[slots, max_len, heads, head_dim]`` per layer (the Flax "cache"
+collection of ``models/gpt.py`` initialized at ``batch=slots``), and a
+host-side free-slot allocator maps live sequences onto rows. Admitting
+a sequence scatters its prefill cache into a free row; retiring one
+just returns the row to the free list — no device work, because decode
+correctness never reads a position that hasn't been written by the
+CURRENT tenant:
+
+- prefill overwrites the ENTIRE row ``[0:max_len]`` (the prefill cache
+  from ``model.apply`` is full-length: prompt K/V in ``[0:prompt_len)``,
+  zeros beyond), erasing any previous tenant, and
+- the decode step at position ``i`` writes K/V at ``i`` BEFORE attending
+  ``<= i``, so the zeros beyond the prompt are always replaced before
+  they are ever attended.
+
+Slot rows are therefore reused without zeroing, and the fused decode
+step runs at a FIXED shape ``[slots, ...]`` whatever subset of rows is
+live — membership churn costs a mask update, never a recompile.
+"""
+
+import threading
+
+import jax
+
+
+class SlotKvCache(object):
+    """``slots`` preallocated cache rows + a free-slot allocator.
+
+    The device arrays live in ``self.cache`` (a Flax "cache" pytree with
+    leading dim ``slots``); the allocator is host-side and thread-safe.
+    The device loop is the only writer of ``self.cache``; ``alloc`` /
+    ``free`` only move slot ids between the free list and the live set.
+    """
+
+    def __init__(self, init_cache_fn, slots):
+        if slots < 1:
+            raise ValueError("need at least one slot, got %d" % slots)
+        self.slots = int(slots)
+        self.cache = init_cache_fn(self.slots)
+        self._lock = threading.Lock()
+        self._free = list(range(self.slots - 1, -1, -1))  # pop -> slot 0 first
+        self._live = set()
+
+    def alloc(self):
+        """A free slot id, or ``None`` when fully occupied."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._live.add(slot)
+            return slot
+
+    def free(self, slot):
+        with self._lock:
+            if slot not in self._live:
+                raise ValueError("slot %d is not live" % slot)
+            self._live.discard(slot)
+            self._free.append(slot)
+
+    @property
+    def occupied(self):
+        with self._lock:
+            return len(self._live)
+
+    @property
+    def free_slots(self):
+        with self._lock:
+            return len(self._free)
+
+    def live(self):
+        with self._lock:
+            return sorted(self._live)
+
+    def bytes(self):
+        return sum(leaf.size * leaf.dtype.itemsize
+                   for leaf in jax.tree_util.tree_leaves(self.cache))

@@ -12,10 +12,15 @@ admission controller enriches every teacher's stats with queue depth,
 projected wait, and shed counters — serve/admission.py):
 
 - **occupancy** — mean compiled-batch fill across live teachers;
+- **slot occupancy** — worst KV-slot fill across decode engines
+  (``decode_slot_frac`` from serve/decode_engine.py): a fleet can be
+  decode-bound with near-empty predict batches, so slot pressure is a
+  first-class overload signal;
 - **queue pressure** — worst projected queue wait vs the predict SLO
   (fallback: queue fill fraction when no service estimate exists yet);
 - **sheds** — any admission shed since the last tick is overload by
-  definition (the front door is already refusing work);
+  definition (the front door is already refusing work), decode-phase
+  sheds included;
 - **burn** — the ``predict_p99`` multi-window burn-rate severity from
   :class:`edl_tpu.obs.slo.BurnRateEvaluator`, fed cumulative
   (total, bad) predict-latency counts by the host.
@@ -182,6 +187,7 @@ class ServeScaler(object):
         live = {ep: s for ep, s in stats_by_endpoint.items()
                 if isinstance(s, dict) and not s.get("draining")}
         occs, wait_fracs, shed_total = [], [], 0
+        slot_fracs = []
         for s in live.values():
             occs.append(float(s.get("occupancy") or 0.0))
             slo_ms = s.get("slo_ms")
@@ -191,10 +197,19 @@ class ServeScaler(object):
             elif s.get("queue_frac") is not None:
                 wait_fracs.append(float(s["queue_frac"]))
             shed_total += int(s.get("shed_total") or 0)
+            # the decode plane (serve/decode_engine.py): KV-slot
+            # occupancy is the decode-phase analog of batch fill, and
+            # its sheds are part of the same overload signal
+            if s.get("decode_slot_frac") is not None:
+                slot_fracs.append(float(s["decode_slot_frac"]))
+            adm = s.get("decode_admission")
+            if isinstance(adm, dict):
+                shed_total += int(adm.get("shed_total") or 0)
         return {
             "teachers": len(live),
             "occupancy": (sum(occs) / len(occs)) if occs else 0.0,
             "wait_frac": max(wait_fracs) if wait_fracs else 0.0,
+            "slot_frac": max(slot_fracs) if slot_fracs else 0.0,
             "shed_total": shed_total,
         }
 
@@ -213,10 +228,12 @@ class ServeScaler(object):
                        else max(0, sig["shed_total"] - prev_shed))
 
         overloaded = (sig["occupancy"] >= self._occ_high
+                      or sig["slot_frac"] >= self._occ_high
                       or sig["wait_frac"] >= self._wait_frac_high
                       or sheds_delta > 0
                       or severity is not None)
         idle = (sig["occupancy"] <= self._occ_low
+                and sig["slot_frac"] <= self._occ_low
                 and sig["wait_frac"] < 0.5 * self._wait_frac_high
                 and sheds_delta == 0
                 and severity is None)
@@ -232,10 +249,10 @@ class ServeScaler(object):
             self._out_streak = 0
             self._in_streak = 0
 
-        why = ("occupancy %.2f, wait %.2fx slo, %d sheds this tick, "
-               "burn %s, %d teachers"
-               % (sig["occupancy"], sig["wait_frac"], sheds_delta,
-                  severity or "ok", n))
+        why = ("occupancy %.2f, slots %.2f, wait %.2fx slo, %d sheds "
+               "this tick, burn %s, %d teachers"
+               % (sig["occupancy"], sig["slot_frac"], sig["wait_frac"],
+                  sheds_delta, severity or "ok", n))
         cause = {"signals": sig, "sheds_delta": sheds_delta,
                  "burn_severity": severity}
 

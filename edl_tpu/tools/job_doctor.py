@@ -54,7 +54,7 @@ _DETECTOR_RANK = {"flight_recorder": 0, "stale_publisher": 1,
                   "straggler": 2, "slo_burn": 3, "breaker_flap": 4,
                   "queue_saturation": 5, "live_resize_fallback": 6,
                   "reshard_fallback": 7, "rebuild_fallback": 8,
-                  "prewarm_miss": 9}
+                  "prewarm_miss": 9, "decode_slot_starvation": 10}
 
 
 def collect(coord):
@@ -144,6 +144,56 @@ def _counter_total(obs, name):
             seen = True
             total += float(s.get("value") or 0.0)
     return total if seen else None
+
+
+def _pod_gauge(doc, name):
+    """Latest value of a gauge in one pod's obs doc (summed over label
+    series); None when the pod does not publish it."""
+    metric = (((doc.get("metrics") or {}).get("metrics") or {})
+              .get(name))
+    if not metric:
+        return None
+    total, seen = 0.0, False
+    for s in metric.get("series") or ():
+        seen = True
+        total += float(s.get("value") or 0.0)
+    return total if seen else None
+
+
+def _decode_findings(obs):
+    """Doctor-local detector for the serving plane's decode engine:
+
+    - decode_slot_starvation: a pod whose KV slot occupancy is pinned
+      at the maximum while the prefill queue keeps growing — every
+      arriving prompt waits for a retirement, so TTFT degrades without
+      any pod being unhealthy. The fix is capacity, not repair: scale
+      the teacher fleet out (ServeScaler folds the same
+      ``decode_slot_frac`` signal into its journaled decisions) or
+      lower ``max_new_tokens``/raise slots."""
+    findings = []
+    for pod in sorted(obs):
+        doc = obs[pod]
+        total = _pod_gauge(doc, "edl_decode_slots_total")
+        occupied = _pod_gauge(doc, "edl_decode_slots_occupied")
+        queue = _pod_gauge(doc, "edl_decode_prefill_queue")
+        if not total or occupied is None or queue is None:
+            continue
+        if occupied >= total and queue > 0:
+            findings.append({
+                "pod": pod,
+                "detector": "decode_slot_starvation",
+                "severity": "warn",
+                "summary": ("decode slots starved: %d/%d KV slots "
+                            "occupied with %d prompt(s) queued for "
+                            "prefill — arrivals wait on retirements; "
+                            "scale out or shed (serve/decode_engine)"
+                            % (int(occupied), int(total), int(queue))),
+                "metric": "edl_decode_prefill_queue",
+                "value": queue,
+                "threshold": 0,
+                "event_ids": [],
+            })
+    return findings
 
 
 def _live_resize_findings(obs, timeline):
@@ -303,7 +353,8 @@ def diagnose(collected, now=None):
         # the doctor-local detectors read obs docs directly, so they
         # still fire on monitor-less jobs (bench runs, early startup)
         report["findings"] = _render_findings(
-            _live_resize_findings(obs, timeline), timeline, ())
+            _live_resize_findings(obs, timeline)
+            + _decode_findings(obs), timeline, ())
         if report["findings"]:
             head = report["findings"][0]
             report["summary"] += ("; %d doctor-local finding(s), "
@@ -320,7 +371,8 @@ def diagnose(collected, now=None):
     report["pods"] = health.get("pods") or {}
     out_findings = _render_findings(
         list(health.get("findings") or ())
-        + _live_resize_findings(obs, timeline),
+        + _live_resize_findings(obs, timeline)
+        + _decode_findings(obs),
         timeline, health.get("events") or ())
     report["findings"] = out_findings
     report["slos"] = health.get("slos") or []

@@ -35,11 +35,23 @@ What the record (schema ``serve_bench/v1``) proves:
 device queue is saturated — so ``stats_rpc_ms`` doubles as the proof
 that observability RPCs keep strict priority over predict work.
 
+The ``--arc decode`` variant benches the autoregressive decode engine
+(serve/decode_engine.py) instead and emits ``decode_bench/v1``:
+tokens/s/chip with continuous batching ON (slot engine) vs the serial
+per-sequence baseline (the SAME engine pinned to one slot, so the only
+lever is decode-step batching), token-identical parity vs the unbatched
+``models.gpt.generate``, TTFT p99 vs ITL p99, the per-phase shed
+taxonomy (every ``DECODE_SHED_REASONS`` entry forced deterministically),
+the int8-teacher logits gap, and a forced scale-out under load — the
+``ServeScaler`` reacting to pinned ``decode_slot_frac`` — with zero
+stranded sequences across the drain.
+
 Usage:
     JAX_PLATFORMS=cpu python -m edl_tpu.tools.serve_bench
     python -m edl_tpu.tools.serve_bench --mode full
+    JAX_PLATFORMS=cpu python -m edl_tpu.tools.serve_bench --arc decode
 
-Emits one JSON object (schema "serve_bench/v1").
+Emits one JSON object (schema "serve_bench/v1" or "decode_bench/v1").
 """
 
 import argparse
@@ -55,7 +67,8 @@ from edl_tpu.distill.teacher_server import TeacherServer
 from edl_tpu.robustness import faults
 from edl_tpu.rpc.client import RpcClient
 from edl_tpu.serve import drain as serve_drain
-from edl_tpu.serve.admission import AdmissionController
+from edl_tpu.serve.admission import AdmissionController, \
+    DECODE_SHED_REASONS
 from edl_tpu.serve.scaler import ServeScaler, load_actions
 from edl_tpu.utils import errors
 
@@ -510,11 +523,431 @@ def _run(knobs, mode, seed):
     return report
 
 
+# -- the decode arc (schema decode_bench/v1) --------------------------------
+
+#: decode-arc knobs; micro is the tier-1 gate (tiny model, short
+#: sequences — wall time is dominated by per-step dispatch, which is
+#: exactly the overhead continuous batching amortizes)
+DECODE_MODES = {
+    "micro": dict(num_layers=2, d_model=32, num_heads=2, mlp_dim=64,
+                  vocab_size=64, max_len=64, slots=4, n_prompts=12,
+                  prompt_lens=(4, 7), max_news=(6, 12), max_new=8,
+                  long_new=24),
+    "full": dict(num_layers=4, d_model=64, num_heads=4, mlp_dim=128,
+                 vocab_size=256, max_len=128, slots=8, n_prompts=32,
+                 prompt_lens=(4, 9, 17), max_news=(8, 16, 24),
+                 max_new=16, long_new=64),
+}
+
+
+def _decode_prompts(knobs, seed):
+    """(prompts, per-prompt max_new): lengths and budgets CYCLE over
+    two (three in full) fixed shapes — staggered retirements churn the
+    slot membership while the (prompt_len, max_new) shape set (and so
+    the reference-decode compile count) stays tiny."""
+    rng = np.random.RandomState(seed)
+    lens = knobs["prompt_lens"]
+    news = knobs["max_news"]
+    prompts = [rng.randint(1, knobs["vocab_size"],
+                           size=lens[i % len(lens)]).tolist()
+               for i in range(knobs["n_prompts"])]
+    max_news = [news[i % len(news)] for i in range(knobs["n_prompts"])]
+    return prompts, max_news
+
+
+def _open_admission():
+    """Admission that never sheds: the throughput arcs isolate the
+    batching lever, so queueing must be free."""
+    from edl_tpu.serve.admission import DecodeAdmission
+    return DecodeAdmission(max_waiting=1 << 30, slot_slack=1 << 30)
+
+
+def _new_engine(model, params, slots, admission=None):
+    from edl_tpu.serve.decode_engine import DecodeEngine
+    return DecodeEngine(model, params, slots=slots,
+                        admission=admission).start()
+
+
+def _warm_engine(engine, prompts, vocab):
+    """Compile every prefill bucket the timed prompts will hit, plus
+    the fused step, so the timed window measures steps, not XLA."""
+    from edl_tpu.serve.decode_engine import _prefill_bucket
+    buckets = sorted({_prefill_bucket(len(p), engine.max_len)
+                      for p in prompts})
+    for b in buckets:
+        engine.generate([1 % vocab] * b, 2, timeout=120.0)
+
+
+def _shed_reason(fn):
+    """Run ``fn`` expecting an OverloadedError; returns its reason."""
+    try:
+        fn()
+    except errors.OverloadedError as e:
+        return str(e).split("overloaded: ", 1)[-1].split(" (")[0]
+    return None
+
+
+def _wait_stat(engine, key, at_least, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while engine.stats()[key] < at_least:
+        if time.monotonic() > deadline:
+            raise errors.TimeoutError_(
+                "engine stat %s never reached %s" % (key, at_least))
+        time.sleep(0.002)
+
+
+def _wait_until(predicate, what, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        if time.monotonic() > deadline:
+            raise errors.TimeoutError_("bench never saw %s" % what)
+        time.sleep(0.002)
+
+
+def _decode_shed_arcs(engine, knobs):
+    """Force every DECODE_SHED_REASONS entry deterministically; returns
+    ({reason: count}, stranded) — every ADMITTED sequence still
+    resolves, sheds are typed refusals at the front door.
+
+    Runs on the (already compiled) serial engine, swapping its
+    ``admission`` object between sub-arcs — the policies under test
+    live entirely in :class:`DecodeAdmission`, so one warm device loop
+    serves every arc without paying another jit."""
+    from edl_tpu.serve.admission import DecodeAdmission
+    reasons = {}
+
+    def saw(reason):
+        if reason is not None:
+            reasons[reason] = reasons.get(reason, 0) + 1
+
+    handles = []
+    long_new = knobs["long_new"]
+    prompt = [1, 2, 3]
+
+    def idle():
+        # one snapshot: a sequence mid-prefill is in neither the waiting
+        # queue nor the active map but still holds its slot, so occupied
+        # is the only counter that cannot read 0 while work is in flight
+        s = engine.stats()
+        return (s["decode_waiting"] == 0 and s["decode_active"] == 0
+                and s["decode_slots_occupied"] == 0)
+
+    def settle():
+        """Wait for the previous sub-arc's work to finish so each arc
+        starts from an empty queue + free slot."""
+        _wait_until(idle, "engine idle between shed arcs")
+
+    def resident():
+        # the busy sequence itself holds the slot AND has left the
+        # waiting queue — probes submitted now observe exactly one
+        # resident decode and an empty queue
+        s = engine.stats()
+        return s["decode_slots_occupied"] >= 1 and s["decode_waiting"] == 0
+
+    def busy_submit():
+        h = engine.submit(prompt, long_new)
+        handles.append(h)
+        _wait_until(resident, "busy sequence resident")
+        return h
+
+    # a serve.decode.step DELAY fault (the catalog's ITL-inflation
+    # drill) holds each busy sequence resident long enough that every
+    # probe submit observes the engine state it targets — no races
+    plane = faults.FaultPlane(seed=1)
+    plane.inject("serve.decode.step", "delay", seconds=0.02)
+    plane.install()
+    try:
+        # queue_full + draining: tiny waiting bound, slot shed disabled
+        engine.admission = DecodeAdmission(max_waiting=2,
+                                           slot_slack=1 << 30)
+        busy_submit()
+        handles.append(engine.submit(prompt, 2))  # waiting -> 1
+        handles.append(engine.submit(prompt, 2))  # waiting -> 2
+        saw(_shed_reason(lambda: engine.submit(prompt, 2)))  # queue_full
+        engine.admission.set_draining(True)
+        saw(_shed_reason(lambda: engine.submit(prompt, 2)))  # draining
+        engine.admission.set_draining(False)
+        settle()
+
+        # slots + deadline: default admission (slot_slack = slots = 1)
+        engine.admission = DecodeAdmission()
+        busy_submit()
+        dead = engine.submit(prompt, 2, deadline_ms=0.0)  # dead on arrival
+        saw(_shed_reason(lambda: dead.result(timeout=60.0)))  # deadline
+        handles.append(engine.submit(prompt, 2))  # waiting -> 1
+        saw(_shed_reason(lambda: engine.submit(prompt, 2)))  # slots
+        settle()
+
+        # ttft: projection trips as soon as one sequence waits behind a
+        # measured prefill
+        engine.admission = DecodeAdmission(ttft_slo_ms=1e-4,
+                                           slot_slack=1 << 30)
+        busy_submit()
+        _wait_until(lambda: (engine.stats()["decode_admission"]
+                             ["prefill_ms"] is not None),
+                    "a prefill estimate")
+        handles.append(engine.submit(prompt, 2))  # waiting -> 1
+        saw(_shed_reason(lambda: engine.submit(prompt, 2)))  # ttft
+        settle()
+
+        # itl: the measured (fault-inflated) step EWMA exceeds the
+        # absurd SLO while a resident sequence decodes — exactly the
+        # catalog's serve.decode.step delay drill
+        engine.admission = DecodeAdmission(itl_slo_ms=1e-5,
+                                           slot_slack=1 << 30)
+        busy_submit()
+        _wait_until(lambda: (engine.stats()["decode_admission"]
+                             ["itl_ms"] is not None),
+                    "an ITL estimate")
+        saw(_shed_reason(lambda: engine.submit(prompt, 2)))  # itl
+    finally:
+        plane.uninstall()
+
+    stranded = 0
+    for h in handles:
+        try:
+            h.result(timeout=60.0)
+        except errors.TimeoutError_:
+            stranded += 1
+    return reasons, stranded
+
+
+def _decode_scale_out(seed_engine, model, params, knobs, interval=0.05):
+    """Pin the seed engine's ``decode_slot_frac`` at 1.0 under long
+    sequences; the ServeScaler must react with a journaled scale_out,
+    and EVERY submitted sequence — including the waiting queue on the
+    saturated engine — must resolve (zero stranded across the drain)."""
+    coord = _MemCoord()
+    seed_engine.admission = _open_admission()
+    engines = [seed_engine]
+
+    def new():
+        engines.append(_new_engine(model, params, 2,
+                                   admission=_open_admission()))
+        return "decode-%d" % len(engines)
+
+    scaler = ServeScaler(
+        coord, "decode-bench", mode="on", interval=interval,
+        scale_out_fn=new, scale_in_fn=None, min_teachers=1,
+        max_teachers=2, occupancy_high=0.8, occupancy_low=0.0,
+        out_streak=2, in_streak=1 << 20,
+        cooldowns={"scale_out": 2 * interval, "scale_in": 1e9})
+    prompts, _ = _decode_prompts(knobs, seed=11)
+    handles, actions = [], []
+    n_pin = seed_engine.slots + 4
+    try:
+        # slots+4 long sequences into the seed engine: frac pins at
+        # 1.0 with a visible waiting queue.  A step-delay fault holds
+        # them resident across the scaler's streak window — a warm
+        # engine would otherwise drain the backlog between two ticks.
+        plane = faults.FaultPlane(seed=2)
+        plane.inject("serve.decode.step", "delay", seconds=0.02)
+        plane.install()
+        try:
+            for p in prompts[:n_pin]:
+                handles.append(engines[0].submit(p, knobs["long_new"]))
+            deadline = time.monotonic() + 30.0
+            while len(engines) == 1 and time.monotonic() < deadline:
+                snap = {"decode-%d" % (i + 1): e.stats()
+                        for i, e in enumerate(engines)}
+                actions.extend(scaler.tick(snap, now=time.time()))
+                time.sleep(interval)
+        finally:
+            plane.uninstall()
+        # post-scale-out arrivals route to the new capacity
+        if len(engines) > 1:
+            for p in prompts[n_pin:n_pin + 4]:
+                handles.append(engines[-1].submit(p, knobs["max_new"]))
+        stranded = 0
+        for h in handles:
+            try:
+                h.result(timeout=120.0)
+            except errors.TimeoutError_:
+                stranded += 1
+        drained = [e.drain(deadline_s=30.0) for e in engines]
+    finally:
+        for e in engines:
+            e.stop()
+    kinds = [a["kind"] for a in actions]
+    return {
+        "engines": len(engines),
+        "scale_out": kinds.count("scale_out"),
+        "journaled": len(load_actions(coord)),
+        "submitted": len(handles),
+        "stranded": stranded,
+        "drained_ok": all(drained),
+        "zero_stranded": stranded == 0 and all(drained),
+    }
+
+
+def run_decode(mode="micro", seed=7):
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import gpt as gpt_mod
+    from edl_tpu.ops.quant import dequantize_tree, quantize_tree, \
+        quantized_bytes
+
+    knobs = DECODE_MODES[mode]
+    # f32 end to end: the parity gate is TOKEN-IDENTICAL vs generate,
+    # which bf16 accumulation would break
+    model = gpt_mod.Gpt(
+        vocab_size=knobs["vocab_size"], num_layers=knobs["num_layers"],
+        d_model=knobs["d_model"], num_heads=knobs["num_heads"],
+        mlp_dim=knobs["mlp_dim"], max_len=knobs["max_len"],
+        dtype=jnp.float32)
+    dummy = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(seed), dummy)["params"]
+    prompts, max_news = _decode_prompts(knobs, seed)
+    t_start = time.monotonic()
+
+    # the reference decode: gpt.generate re-traces per call, so run
+    # ONE batched call per (prompt_len, max_new) shape group — rows of
+    # a causal batch decode independently, tokens match per-call runs
+    groups = {}
+    for i, (p, n) in enumerate(zip(prompts, max_news)):
+        groups.setdefault((len(p), n), []).append(i)
+    refs = [None] * len(prompts)
+    for (_, n), idxs in groups.items():
+        toks = np.asarray(gpt_mod.generate(
+            model, params,
+            jnp.asarray([prompts[i] for i in idxs], jnp.int32), n))
+        for i, row in zip(idxs, toks):
+            refs[i] = row.tolist()
+
+    # serial baseline: same engine machinery, ONE slot, one sequence
+    # at a time — isolates decode-step batching as the only lever
+    serial = _new_engine(model, params, 1, admission=_open_admission())
+    _warm_engine(serial, prompts, knobs["vocab_size"])
+    t0 = time.monotonic()
+    serial_toks = [serial.generate(p, n, timeout=120.0)["tokens"]
+                   for p, n in zip(prompts, max_news)]
+    serial_s = time.monotonic() - t0
+
+    # continuous batching: all prompts in flight, fused steps
+    cb = _new_engine(model, params, knobs["slots"],
+                     admission=_open_admission())
+    _warm_engine(cb, prompts, knobs["vocab_size"])
+    t0 = time.monotonic()
+    hs = [cb.submit(p, n) for p, n in zip(prompts, max_news)]
+    cb_reports = [h.result(timeout=120.0) for h in hs]
+    cb_s = time.monotonic() - t0
+    cb_toks = [r["tokens"] for r in cb_reports]
+    cb_stats = cb.stats()
+    # exact per-sequence latencies (the module histograms are global
+    # and bucketed — they include warmup compiles)
+    ttfts = [r["ttft_ms"] for r in cb_reports]
+    itls = [ms for r in cb_reports for ms in r["itl_ms"]]
+
+    gen_tokens = sum(max_news)
+    serial_tps = gen_tokens / serial_s if serial_s else None
+    cb_tps = gen_tokens / cb_s if cb_s else None
+
+    # int8 teacher: logits gap vs f32 (the parity-gate quantity) and
+    # bytes crossing HBM; the engine also RUNS on the quantized tree
+    qparams = quantize_tree(params, mode="int8")
+    q_bytes, f_bytes = quantized_bytes(qparams)
+    ids = jnp.asarray(np.vstack([np.asarray(p[:3] + [0] * 5)[None]
+                                 for p in prompts[:4]]), jnp.int32)
+    logits_f32 = np.asarray(model.apply({"params": params}, ids))
+    logits_q = np.asarray(model.apply(
+        {"params": dequantize_tree(qparams)}, ids))
+    rel_err = (np.linalg.norm(logits_q - logits_f32)
+               / max(1e-9, np.linalg.norm(logits_f32)))
+    qeng = _new_engine(model, qparams, knobs["slots"],
+                       admission=_open_admission())
+    q_toks = [qeng.submit(p, n).result(timeout=120.0)["tokens"]
+              for p, n in zip(prompts[:4], max_news[:4])]
+    qeng.drain(deadline_s=30.0)
+    qeng.stop()
+
+    # the shed arcs reuse the warm serial engine (admission swaps, no
+    # new compiles); the scale-out arc reuses the warm CB engine as
+    # its saturated seed
+    shed_by_reason, shed_stranded = _decode_shed_arcs(serial, knobs)
+    serial.drain(deadline_s=30.0)
+    serial.stop()
+    scale = _decode_scale_out(cb, model, params, knobs)
+
+    report = {
+        "schema": "decode_bench/v1",
+        "mode": mode,
+        "seed": seed,
+        "model": {k: knobs[k] for k in ("num_layers", "d_model",
+                                        "num_heads", "vocab_size",
+                                        "max_len")},
+        "prompts": len(prompts),
+        "max_new": sorted(set(max_news)),
+        "slots": knobs["slots"],
+        "devices": jax.device_count(),
+        "parity": {
+            # byte-/token-identical vs the unbatched reference decode
+            "serial_vs_generate_ok": serial_toks == refs,
+            "cb_vs_generate_ok": cb_toks == refs,
+            # informational: int8 CAN flip an argmax; the gate is the
+            # logits gap, not token identity
+            "int8_tokens_match": q_toks == refs[:4],
+        },
+        "throughput": {
+            "serial_tokens_per_s": round(serial_tps, 2),
+            "cb_tokens_per_s": round(cb_tps, 2),
+            "cb_tokens_per_s_per_chip": round(
+                cb_tps / jax.device_count(), 2),
+            "speedup": round(cb_tps / serial_tps, 3),
+            "serial_wall_s": round(serial_s, 3),
+            "cb_wall_s": round(cb_s, 3),
+        },
+        "latency_ms": {
+            "ttft_p50": _pct(ttfts, 50),
+            "ttft_p99": _pct(ttfts, 99),
+            "itl_p50": _pct(itls, 50),
+            "itl_p99": _pct(itls, 99),
+        },
+        "compile": {
+            # the fixed-shape contract: ONE fused-step trace however
+            # membership churned; prefill traces bounded by buckets
+            "step_traces": cb_stats["decode_step_traces"],
+            "prefill_traces": cb_stats["decode_prefill_traces"],
+        },
+        "kv_bytes": cb_stats["decode_kv_bytes"],
+        "quant": {
+            "int8_logits_rel_err": round(float(rel_err), 5),
+            "int8_bytes_ratio": round(q_bytes / float(f_bytes), 4),
+        },
+        "shed": {
+            "by_reason": shed_by_reason,
+            "reasons_covered": sorted(shed_by_reason),
+            "stranded": shed_stranded,
+        },
+        "scale_out": scale,
+        "wall_s": round(time.monotonic() - t_start, 3),
+    }
+    return report
+
+
+def _decode_healthy(out):
+    return (out["parity"]["serial_vs_generate_ok"]
+            and out["parity"]["cb_vs_generate_ok"]
+            and out["throughput"]["speedup"] >= 1.5
+            and out["compile"]["step_traces"] == 1
+            and out["shed"]["reasons_covered"]
+            == sorted(DECODE_SHED_REASONS)
+            and out["shed"]["stranded"] == 0
+            and out["scale_out"]["zero_stranded"]
+            and out["scale_out"]["scale_out"] >= 1)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="micro", choices=sorted(MODES))
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--arc", default="serve", choices=("serve", "decode"))
     args = ap.parse_args(argv)
+    if args.arc == "decode":
+        out = run_decode(mode=args.mode, seed=args.seed)
+        json.dump(out, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 0 if _decode_healthy(out) else 1
     out = run(mode=args.mode, seed=args.seed)
     json.dump(out, sys.stdout, indent=2)
     sys.stdout.write("\n")

@@ -123,6 +123,15 @@ class OverloadedError(EdlError):
             return None
 
 
+class DecodeStepError(EdlError):
+    """A fused decode step failed for this sequence (device fault mid-
+    generation). The sequence's slot has been freed and its partial
+    output discarded; the engine itself keeps running — only the
+    sequences that were active in the faulted step see this error.
+    Retryable by resubmitting the prompt (generation restarts from the
+    prefill; there is no partial-state resume)."""
+
+
 class DataEndError(EdlError):
     """All data has been consumed for this epoch."""
 

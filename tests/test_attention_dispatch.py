@@ -137,3 +137,25 @@ def test_bert_auto_default_matches_explicit_dense():
     out_a = m_auto.apply({"params": params}, ids)
     out_d = m_dense.apply({"params": params}, ids)
     assert np.asarray(out_a).tobytes() == np.asarray(out_d).tobytes()
+
+
+def test_auto_dispatch_decode_shaped_queries_stay_dense():
+    """KV-cache decode queries (seq_q=1 vs a longer cached kv) must
+    never take the flash kernel — its causal mask assumes square q/kv —
+    and square shapes with seq_kv passed explicitly stay legal."""
+    assert "decode-shaped" in flash_dispatch_reason(1, 64, platform="tpu",
+                                                    seq_kv=64)
+    assert "decode-shaped" in flash_dispatch_reason(4, 64, platform="tpu",
+                                                    seq_kv=128)
+    assert flash_dispatch_reason(128, 64, platform="tpu",
+                                 seq_kv=128) is None
+
+
+def test_use_flash_true_rejects_decode_shaped_q():
+    """Forcing the kernel onto a decode-shaped query is a loud
+    ValueError, never a silently mis-masked context."""
+    q = jnp.zeros((1, 1, 2, 16), jnp.float32)
+    k = v = jnp.zeros((1, 8, 2, 16), jnp.float32)
+    with pytest.raises(ValueError, match="decode-shaped"):
+        attention_context(q, k, v, causal=True, mask=None,
+                          dtype=jnp.float32, use_flash=True)

@@ -986,3 +986,55 @@ def test_fold_roofline_gap_updates_best(tmp_path):
     changed, msg = pa.fold_roofline_gap({"schema": "roofline_gap/v1",
                                          "gpt_arc": None}, str(best))
     assert not changed
+
+
+def test_decode_bench_micro_schema():
+    """Tier-1 pin of the decode bench contract (schema decode_bench/v1):
+    micro mode must prove the serving-decode guarantees end to end —
+    continuous batching is token-identical to ``gpt.generate`` (serial,
+    batched, and int8 engines) while beating the serial engine >= 1.5x
+    under ONE fused step trace; every decode shed reason fires typed
+    with zero admitted sequences stranded; slot saturation drives a
+    journaled scale-out whose drain also strands nothing; and the int8
+    teacher passes the logits parity gate at half the weight bytes.
+    The parity and zero-stranded fields are MANDATORY: a report without
+    them is a schema break, not a passing run."""
+    import json
+
+    from edl_tpu.serve.admission import DECODE_SHED_REASONS
+    from edl_tpu.tools import serve_bench
+
+    out = serve_bench.run_decode(mode="micro", seed=7)
+    assert out["schema"] == "decode_bench/v1"
+
+    # token parity: continuous batching NEVER changes the decode
+    assert out["parity"]["serial_vs_generate_ok"] is True
+    assert out["parity"]["cb_vs_generate_ok"] is True
+    assert out["parity"]["int8_tokens_match"] is True
+
+    # batching pays on the same host, under fixed-shape discipline
+    assert out["throughput"]["speedup"] >= 1.5
+    assert out["throughput"]["cb_tokens_per_s"] > 0
+    assert out["compile"]["step_traces"] == 1
+    assert out["latency_ms"]["ttft_p50"] > 0
+    assert out["latency_ms"]["itl_p50"] > 0
+
+    # every decode-phase shed reason fired, typed; nothing admitted
+    # was stranded
+    assert out["shed"]["reasons_covered"] == sorted(DECODE_SHED_REASONS)
+    assert sum(out["shed"]["by_reason"].values()) >= \
+        len(DECODE_SHED_REASONS)
+    assert out["shed"]["stranded"] == 0
+
+    # pinned slots forced a journaled scale-out; the fleet drained with
+    # zero stranded sequences
+    assert out["scale_out"]["engines"] >= 2
+    assert out["scale_out"]["scale_out"] >= 1
+    assert out["scale_out"]["journaled"] >= 1
+    assert out["scale_out"]["zero_stranded"] is True
+
+    # the quantization gate: close logits, genuinely smaller teacher
+    assert out["quant"]["int8_logits_rel_err"] < 0.05
+    assert out["quant"]["int8_bytes_ratio"] < 0.6
+
+    json.dumps(out)  # the whole report is JSON-serializable

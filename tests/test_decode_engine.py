@@ -1,0 +1,325 @@
+"""Decode-engine tests (ISSUE 18): slot-based continuous batching must
+be token-identical to ``models.gpt.generate`` under ONE fused step
+trace, quantized teachers must pass the logits parity gate, a faulted
+fused step fails only the sequences in it (typed error, slot freed,
+loop alive), drain strands nothing, and the per-phase admission /
+balance / scaler surfaces shed and scale deterministically."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.distill.balance import Service
+from edl_tpu.models import gpt as gpt_mod
+from edl_tpu.ops.quant import dequantize_tree, quantize_tree, \
+    quantized_bytes
+from edl_tpu.robustness.faults import FaultPlane
+from edl_tpu.serve.admission import DECODE_SHED_REASONS, DecodeAdmission
+from edl_tpu.serve.decode_engine import DecodeEngine
+from edl_tpu.serve.kv_cache import SlotKvCache
+from edl_tpu.serve.scaler import ServeScaler, load_actions
+from edl_tpu.utils import errors
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = gpt_mod.gpt_tiny(num_layers=2, d_model=32, num_heads=2,
+                             mlp_dim=64, vocab_size=64, max_len=64,
+                             dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def engine(tiny):
+    model, params = tiny
+    eng = DecodeEngine(model, params, slots=4, admission=False)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+_REF_PROMPTS = ([1, 5, 9], [3, 3, 3], [9, 8, 7], [2, 4, 6],
+                [1, 2, 1], [2, 3, 1], [3, 4, 1], [4, 5, 1], [5, 6, 1],
+                [6, 7, 1], [2, 4, 6, 8], [7, 1, 7, 1])
+_REF_NEW = 6
+
+
+@pytest.fixture(scope="module")
+def refs(tiny):
+    """Unbatched-reference tokens for every prompt the engine tests
+    decode, computed in ONE ``gpt.generate`` call per prompt length —
+    generate re-traces per call, so batching keeps this file fast."""
+    model, params = tiny
+    out = {}
+    by_len = {}
+    for p in _REF_PROMPTS:
+        by_len.setdefault(len(p), []).append(p)
+    for prompts in by_len.values():
+        toks = np.asarray(gpt_mod.generate(
+            model, params, np.asarray(prompts, np.int32), _REF_NEW))
+        for p, row in zip(prompts, toks):
+            out[tuple(p)] = [int(t) for t in row]
+    return out
+
+
+# -- the allocator ---------------------------------------------------------
+
+
+def test_slot_kv_cache_alloc_free():
+    kv = SlotKvCache(lambda n: {"k": jnp.zeros((n, 8, 2, 4))}, slots=3)
+    assert kv.free_slots == 3 and kv.occupied == 0
+    got = [kv.alloc() for _ in range(3)]
+    assert sorted(got) == [0, 1, 2]
+    assert kv.alloc() is None  # full: a typed None, never an overwrite
+    assert kv.occupied == 3 and kv.free_slots == 0
+    kv.free(1)
+    assert kv.occupied == 2 and kv.alloc() == 1  # the freed row reused
+    with pytest.raises(ValueError):
+        kv.free(2)  # double free
+        kv.free(2)
+    assert kv.bytes() == 3 * 8 * 2 * 4 * 4
+
+
+# -- per-phase admission ---------------------------------------------------
+
+
+def test_decode_admission_sheds_every_reason_typed():
+    """Each DECODE_SHED_REASONS entry is reachable, typed, and counted;
+    the same state that sheds one phase admits when the pressure is on
+    the other phase."""
+    adm = DecodeAdmission(max_waiting=2, ttft_slo_ms=8.0, itl_slo_ms=2.0,
+                          slot_slack=1)
+    adm.admit(free_slots=1, waiting=0, occupied=0, slots=2)
+
+    with pytest.raises(errors.OverloadedError, match="queue_full"):
+        adm.admit(free_slots=1, waiting=2, occupied=0, slots=2)
+    with pytest.raises(errors.OverloadedError, match="slots"):
+        adm.admit(free_slots=0, waiting=1, occupied=2, slots=2)
+    # estimates gate the SLO projections: no estimate, no shed
+    adm.admit(free_slots=1, waiting=1, occupied=1, slots=2)
+    adm.observe_prefill_ms(5.0)
+    adm.observe_itl_ms(5.0)
+    # (waiting+1) * prefill = 10ms > 8ms TTFT SLO
+    with pytest.raises(errors.OverloadedError, match="ttft"):
+        adm.admit(free_slots=1, waiting=1, occupied=0, slots=2)
+    adm.admit(free_slots=1, waiting=0, occupied=0, slots=2)  # queue empty
+    # measured step 5ms > 2ms ITL SLO while decodes are resident
+    with pytest.raises(errors.OverloadedError, match="itl"):
+        adm.admit(free_slots=1, waiting=0, occupied=1, slots=2)
+    adm.set_draining(True)
+    with pytest.raises(errors.OverloadedError, match="draining"):
+        adm.admit(free_slots=2, waiting=0, occupied=0, slots=2)
+    adm.set_draining(False)
+    with pytest.raises(errors.OverloadedError, match="deadline"):
+        raise adm.shed_evicted()
+
+    s = adm.stats()
+    assert s["admitted"] == 3
+    assert sorted(s["shed"]) == sorted(DECODE_SHED_REASONS)
+    assert all(s["shed"][r] == 1 for r in DECODE_SHED_REASONS)
+    assert s["shed_total"] == len(DECODE_SHED_REASONS)
+
+
+# -- continuous batching parity --------------------------------------------
+
+
+def test_engine_token_identical_to_generate_one_step_trace(engine, refs):
+    """Sequences batched into one fused step decode the EXACT tokens of
+    ``gpt.generate`` — admission order, slot id, and batch mates never
+    leak into the logits — and the whole mixed workload retires under a
+    single step trace (fixed-shape discipline)."""
+    prompts = [[1, 5, 9], [2, 4, 6, 8], [3, 3, 3], [7, 1, 7, 1],
+               [9, 8, 7]]
+    handles = [engine.submit(p, _REF_NEW) for p in prompts]
+    reports = [h.result(timeout=60.0) for h in handles]
+    for p, rep in zip(prompts, reports):
+        assert rep["tokens"] == refs[tuple(p)]
+        assert len(rep["generated"]) == _REF_NEW
+        assert rep["ttft_ms"] >= 0.0
+    s = engine.stats()
+    assert s["decode_step_traces"] == 1
+    # prompts pad to power-of-two buckets: every length above hit ONE
+    assert s["decode_prefill_traces"] == 1
+    assert s["decode_sequences_total"] >= len(prompts)
+
+
+def test_drain_finishes_every_admitted_sequence(engine, refs):
+    handles = [engine.submit([i + 1, i + 2, 1], _REF_NEW)
+               for i in range(6)]
+    assert engine.drain(deadline_s=30.0) is True
+    for i, h in enumerate(handles):
+        rep = h.result(timeout=1.0)  # already resolved: zero stranded
+        assert rep["tokens"] == refs[(i + 1, i + 2, 1)]
+    s = engine.stats()
+    assert s["decode_waiting"] == 0 and s["decode_active"] == 0
+    assert s["decode_slots_occupied"] == 0
+    # draining front door sheds typed, then reopens for the next test
+    with pytest.raises(errors.OverloadedError, match="draining"):
+        engine.submit([1, 2], 2)
+    engine.admission.set_draining(False)
+
+
+def test_deadline_burned_in_queue_is_a_typed_eviction(engine):
+    dead = engine.submit([1, 2, 3], 2, deadline_ms=0.0)
+    with pytest.raises(errors.OverloadedError, match="deadline"):
+        dead.result(timeout=30.0)
+
+
+# -- the chaos drill (docs/fault_tolerance.md catalog row) -----------------
+
+
+def test_faulted_step_fails_only_active_sequences(tiny, refs):
+    """``serve.decode.step`` error fault: the sequences in the faulted
+    fused step fail with a typed DecodeStepError and their slots free;
+    a sequence still WAITING at fault time is untouched — it takes the
+    freed slot and decodes to the exact reference tokens (the loop is
+    never wedged)."""
+    model, params = tiny
+    eng = DecodeEngine(model, params, slots=1, admission=False)
+    eng.start()
+    plane = FaultPlane(seed=3)
+    # deterministic schedule: steps 1-3 decode, step 4 raises, once
+    plane.inject("serve.decode.step", "error_once", after=3)
+    plane.install()
+    try:
+        active = eng.submit([1, 2, 3], 20)   # takes the only slot
+        waiter = eng.submit([2, 4, 6], _REF_NEW)  # queued behind it
+        with pytest.raises(errors.DecodeStepError):
+            active.result(timeout=60.0)
+        rep = waiter.result(timeout=60.0)  # fault consumed: clean run
+        assert rep["tokens"] == refs[(2, 4, 6)]
+        s = eng.stats()
+        assert s["decode_evicted_total"] == 1
+        assert s["decode_slots_occupied"] == 0  # faulted slot freed
+        assert plane.log == [("serve.decode.step", "error_once")]
+    finally:
+        plane.uninstall()
+        eng.stop()
+
+
+# -- quantized teachers: the parity gate -----------------------------------
+
+
+@pytest.mark.parametrize("mode,max_rel", [("int8", 0.05), ("bf16", 0.05)])
+def test_quantized_logits_parity_gate(tiny, mode, max_rel):
+    """Weight-only quantization is only allowed behind the gate: logits
+    within rel-Frobenius tolerance of fp32 and >= 90% greedy top-1
+    agreement — and int8 really halves the teacher's weight bytes."""
+    model, params = tiny
+    qparams = quantize_tree(params, mode)
+    ids = jnp.asarray(np.arange(24, dtype=np.int32).reshape(2, 12) % 64)
+    ref = np.asarray(model.apply({"params": params}, ids))
+    got = np.asarray(model.apply(
+        {"params": dequantize_tree(qparams)}, ids))
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < max_rel, "rel fro err %.4f" % rel
+    agree = np.mean(got.argmax(-1) == ref.argmax(-1))
+    assert agree >= 0.9, "top-1 agreement %.3f" % agree
+    if mode == "int8":
+        q_bytes, f_bytes = quantized_bytes(qparams)
+        assert q_bytes < 0.6 * f_bytes
+
+
+# -- slot pressure as an elasticity signal ---------------------------------
+
+
+class _Coord(object):
+    def __init__(self):
+        self.kv = {}
+
+    def get_value(self, service, key):
+        return self.kv.get((service, key))
+
+    def set_server_permanent(self, service, key, value):
+        self.kv[(service, key)] = value
+
+
+def test_scaler_reads_decode_slot_frac_as_overload():
+    """A fleet that is idle on the predict plane but whose KV slots are
+    pinned at 1.0 scales OUT — decode_slot_frac and decode-admission
+    sheds are first-class overload signals."""
+    coord = _Coord()
+    calls = []
+    sc = ServeScaler(
+        coord, "pod-decode", mode="on", interval=1.0,
+        scale_out_fn=lambda: (calls.append("out"), "ep-new")[1],
+        scale_in_fn=lambda ep: True, occupancy_high=0.8,
+        out_streak=2, in_streak=1 << 20)
+    hot = {"occupancy": 0.0, "decode_slot_frac": 1.0,
+           "decode_admission": {"shed_total": 2}}
+    acts = []
+    for t in range(3):
+        acts += sc.tick({"t0": hot}, now=float(t))
+    assert [a["kind"] for a in acts] == ["scale_out"]
+    assert calls == ["out"]
+    assert [a["kind"] for a in load_actions(coord)] == ["scale_out"]
+    # same fleet with free slots: no action
+    sc2 = ServeScaler(
+        coord, "pod-decode-2", mode="on", interval=1.0,
+        scale_out_fn=lambda: "ep", scale_in_fn=lambda ep: True,
+        occupancy_high=0.8, out_streak=2, in_streak=1 << 20)
+    cold = {"occupancy": 0.0, "decode_slot_frac": 0.25,
+            "decode_admission": {"shed_total": 0}}
+    assert [a for t in range(4)
+            for a in sc2.tick({"t0": cold}, now=float(t))] == []
+
+
+def test_balance_phase_capacity_routes_decode_clients():
+    """Per-phase balance weights: a teacher advertising zero
+    ``capacity_decode`` takes NO decode-phase clients (its prefill
+    capacity is irrelevant to them), while prefill-phase clients still
+    spread over both."""
+    now = [0.0]
+    svc = Service("phases", clock=lambda: now[0])
+    svc.set_servers({"pre-only": {"capacity_prefill": 8.0,
+                                  "capacity_decode": 0.0},
+                     "hybrid": {"capacity_prefill": 8.0,
+                                "capacity_decode": 4.0}})
+    for i in range(4):
+        svc.register_client("d%d" % i, 1, phase="decode")
+    stats = svc.stats()
+    assert stats["servers"]["pre-only"] == 0
+    assert stats["servers"]["hybrid"] == 4
+
+    svc2 = Service("phases2", clock=lambda: now[0])
+    svc2.set_servers({"pre-only": {"capacity_prefill": 8.0,
+                                   "capacity_decode": 0.0},
+                      "hybrid": {"capacity_prefill": 8.0,
+                                 "capacity_decode": 4.0}})
+    for i in range(4):
+        svc2.register_client("p%d" % i, 1, phase="prefill")
+    assert sorted(svc2.stats()["servers"].values()) == [2, 2]
+
+
+# -- the doctor's starvation detector --------------------------------------
+
+
+def test_job_doctor_flags_decode_slot_starvation():
+    """Saturated KV slots WITH a prefill queue is a ranked doctor
+    finding (arrivals wait on retirements); saturated slots with an
+    empty queue is healthy steady-state and stays silent."""
+    from edl_tpu.tools import job_doctor
+
+    def gauge(v):
+        return {"series": [{"labels": {}, "value": v}]}
+
+    def doc(occupied, queue):
+        return {"metrics": {"metrics": {
+            "edl_decode_slots_total": gauge(4),
+            "edl_decode_slots_occupied": gauge(occupied),
+            "edl_decode_prefill_queue": gauge(queue)}}}
+
+    report = job_doctor.diagnose(
+        {"job_id": "j", "job_status": None, "health": None,
+         "obs": {"pod-0": doc(4, 3), "pod-1": doc(4, 0),
+                 "pod-2": doc(2, 0)}})
+    found = [f for f in report["findings"]
+             if f["detector"] == "decode_slot_starvation"]
+    assert len(found) == 1
+    assert found[0]["pod"] == "pod-0"
+    assert found[0]["metric"] == "edl_decode_prefill_queue"
+    assert "4/4" in found[0]["summary"]
+    job_doctor.render(report)  # human surface renders the finding

@@ -14,11 +14,13 @@ only the timed-then-printed combination in one function is flagged.
 ``edl_tpu/obs`` (the sanctioned sink) and ``edl_tpu/tools`` (benches
 print reports by design) are out of scope.
 
-A second, stricter rule applies to ``edl_tpu/runtime/`` only: a raw
-stopwatch PAIR (``t0 = time.monotonic()`` … ``<x> - t0``) whose delta
-goes anywhere but a sanctioned sink (``observe`` / ``inc`` / ``set`` /
-``time_ms``) is wall-clock attribution bypassing the time ledger — the
-seconds it measures are invisible to ``goodput/v1``. Route the
+A second, stricter rule applies to ``edl_tpu/runtime/`` and
+``edl_tpu/serve/`` only: a raw stopwatch PAIR
+(``t0 = time.monotonic()`` … ``<x> - t0``) whose delta goes anywhere
+but a sanctioned sink (``observe`` / ``inc`` / ``set`` / ``time_ms``)
+is wall-clock attribution bypassing the time ledger — the seconds it
+measures are invisible to ``goodput/v1`` (in serve, to the decode
+TTFT/ITL admission estimates). Route the
 interval through :class:`edl_tpu.obs.ledger.TimeLedger` (or a registry
 histogram) instead. Deadline math (``deadline = monotonic() + x`` /
 ``deadline - monotonic()``) passes automatically: the deadline variable
@@ -47,9 +49,11 @@ STOPWATCHES = {"monotonic", "perf_counter"}
 # this lint correctly no longer sees as a raw console write.
 ALLOWLIST = {}
 
-#: only this subtree is held to the stopwatch-pair rule — it is where
-#: the time ledger's exclusive-state invariant lives
-PAIR_SCAN_PREFIX = "edl_tpu/runtime/"
+#: only these subtrees are held to the stopwatch-pair rule — runtime is
+#: where the time ledger's exclusive-state invariant lives, and serve is
+#: the decode data plane whose TTFT/ITL intervals must reach the
+#: admission EWMAs and registry histograms, not ad-hoc prints
+PAIR_SCAN_PREFIX = ("edl_tpu/runtime/", "edl_tpu/serve/")
 
 #: calls whose argument position is a sanctioned destination for a
 #: stopwatch delta (registry handles and the span tracer)
@@ -81,6 +85,14 @@ STOPWATCH_ALLOWLIST = {
         "the async persist driver is a background thread whose "
         "concurrency is deliberately NOT ledgered; persist_s lands on "
         "the SaveHandle and _SAVE_MS",
+    ("edl_tpu/serve/decode_engine.py", "_prefill"):
+        "prefill_ms feeds admission.observe_prefill_ms (the TTFT "
+        "projection EWMA) and the _TTFT histogram; the serving device "
+        "loop is outside the training time ledger by design",
+    ("edl_tpu/serve/decode_engine.py", "_run_step"):
+        "step_ms feeds admission.observe_itl_ms (the ITL shed EWMA), "
+        "per-seq itl_ms reports and the _ITL histogram; the serving "
+        "device loop is outside the training time ledger by design",
 }
 
 
@@ -234,8 +246,8 @@ def main():
               "tools/check_no_ad_hoc_instrumentation.py with a "
               "justification.")
     if pair_violations:
-        print("raw stopwatch pair bypassing the time ledger "
-              "(edl_tpu/runtime only):")
+        print("raw stopwatch pair bypassing the time ledger (%s):"
+              % " + ".join(PAIR_SCAN_PREFIX))
         for rel, func, line in pair_violations:
             print("  %s:%d in %s()" % (rel, line, func))
         print("attribute the interval through edl_tpu.obs.ledger "
