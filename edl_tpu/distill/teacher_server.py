@@ -175,10 +175,25 @@ class TeacherServer(object):
         plane, same meaning as ``capacity``) — and ``capacity_decode`` —
         resident-sequence capacity, bounded by KV slots. Pass through
         ``TeacherRegister(info=...)`` so prefill-heavy and decode-heavy
-        clients hash against the capacity that actually limits them."""
+        clients hash against the capacity that actually limits them.
+
+        ``capacity_prefill`` is REUSE-ADJUSTED: a server whose prefix
+        cache absorbs fraction f of prompt tokens does only (1-f) of
+        the prefill work per nominal request, so it advertises
+        1/(1-f) x the raw capacity (capped at 10x — a pathological
+        reuse_frac must not zero out the denominator)."""
         if self._decode is None:
             return {}
-        return {"capacity_prefill": float(self._max_batch),
+        prefill = float(self._max_batch)
+        try:
+            pfx = self._decode.stats().get("decode_prefix") or {}
+            if pfx.get("enabled"):
+                reuse = min(0.9, max(0.0,
+                                     float(pfx.get("reuse_frac") or 0.0)))
+                prefill /= (1.0 - reuse)
+        except Exception:  # noqa: BLE001 — capacity ad stays best-effort
+            pass
+        return {"capacity_prefill": prefill,
                 "capacity_decode": float(self._decode.slots)}
 
     # -- the autoregressive plane (serve/decode_engine.py) -----------------

@@ -35,6 +35,15 @@ class CausalSelfAttention(nn.Module):
     ``generate`` path) or a [b] vector (each row at its OWN position —
     the slot-batched continuous-decode path in serve.decode_engine).
 
+    prefill=True with ``prefill_offset`` set: x is a CHUNK of the prompt
+    [b, C, d] whose first token sits at sequence position ``offset``;
+    K/V are written into the cache at ``[offset, offset+C)`` and each
+    chunk row attends the ALREADY-WRITTEN prefix ``[0, offset+i]`` —
+    the Sarathi-style chunked-prefill primitive (and the suffix-prefill
+    step of shared-prefix KV reuse, where ``[0, offset)`` was copied
+    from a cached row). The mask runs against the full cache like the
+    decode path, so junk beyond ``offset+C`` is never attended.
+
     ``use_flash=None`` (default) auto-dispatches dense→flash by kernel
     legality (see ops/attention.flash_dispatch_reason); True/False still
     force a path. The pre-auto default was ``False`` — pass it
@@ -49,7 +58,7 @@ class CausalSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode=False, decode_index=None,
-                 prefill=False):
+                 prefill=False, prefill_offset=None):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         dense = lambda feats, name: nn.DenseGeneral(
@@ -75,14 +84,40 @@ class CausalSelfAttention(nn.Module):
             cv = self.variable(
                 "cache", "v", jnp.zeros,
                 (b, self.max_len, self.num_heads, head_dim), self.dtype)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(self.dtype), (0, 0, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(self.dtype), (0, 0, 0, 0))
-            from edl_tpu.ops.attention import attention_context
-            ctx = attention_context(q, k, v, causal=True, mask=None,
-                                    dtype=self.dtype,
-                                    use_flash=self.use_flash)
+            if prefill_offset is not None:
+                # chunked / suffix prefill: write this chunk's K/V at the
+                # offset and attend the full cache under the shifted
+                # causal mask — chunk row i sees keys [0, off+i], i.e.
+                # the already-written prefix plus its own chunk prefix.
+                # Same dense-masked numeric class as the decode path
+                # (f32 scores, -1e30 mask), so junk beyond off+s — rows
+                # are reused without zeroing — is never attended.
+                off = jnp.asarray(prefill_offset, jnp.int32)
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(self.dtype), (0, off, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(self.dtype), (0, off, 0, 0))
+                key_pos = jnp.arange(self.max_len)[None, None, None, :]
+                q_pos = (off + jnp.arange(s))[None, None, :, None]
+                mask = key_pos <= q_pos
+                scale = head_dim ** -0.5
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", (q * scale).astype(jnp.float32),
+                    ck.value.astype(jnp.float32))
+                scores = jnp.where(mask, scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1)
+                ctx = jnp.einsum("bhqk,bkhd->bqhd", probs,
+                                 cv.value.astype(jnp.float32))
+                ctx = ctx.astype(self.dtype)
+            else:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(self.dtype), (0, 0, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(self.dtype), (0, 0, 0, 0))
+                from edl_tpu.ops.attention import attention_context
+                ctx = attention_context(q, k, v, causal=True, mask=None,
+                                        dtype=self.dtype,
+                                        use_flash=self.use_flash)
         elif decode:
             if x.shape[1] != 1:
                 raise ValueError("decode mode feeds one token at a time")
@@ -155,7 +190,7 @@ class GptBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, decode=False, decode_index=None,
-                 prefill=False):
+                 prefill=False, prefill_offset=None):
         h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
         x = x + CausalSelfAttention(
@@ -163,7 +198,8 @@ class GptBlock(nn.Module):
             self.use_flash, self.mesh, ring_axis=self.ring_axis,
             name="attention")(h, decode=decode,
                               decode_index=decode_index,
-                              prefill=prefill)
+                              prefill=prefill,
+                              prefill_offset=prefill_offset)
         h = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
         h = nn.Dense(self.mlp_dim, dtype=self.dtype,
@@ -197,7 +233,7 @@ class Gpt(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, decode=False, decode_index=None,
-                 prefill=False):
+                 prefill=False, prefill_offset=None):
         # Embed with dtype=f32 so the tied-head attend() computes fp32
         # logits (Embed.attend promotes to its OWN dtype — a bf16 embed
         # would silently demote the logits); the activation stream is
@@ -219,6 +255,9 @@ class Gpt(nn.Module):
                 pos_ids = idx[:, None]
         else:
             pos_ids = jnp.arange(s)[None, :]
+            if prefill and prefill_offset is not None:
+                # chunk rows sit at absolute positions off..off+s-1
+                pos_ids = pos_ids + jnp.asarray(prefill_offset, jnp.int32)
             if self.ring_axis:
                 pos_ids = pos_ids + jax.lax.axis_index(self.ring_axis) * s
         x = x + nn.Embed(self.max_len, self.d_model,
@@ -241,7 +280,7 @@ class Gpt(nn.Module):
                 x = block(x)  # training defaults; no traced bools
             else:
                 x = block(x, decode=decode, decode_index=decode_index,
-                          prefill=prefill)
+                          prefill=prefill, prefill_offset=prefill_offset)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         # weight-tied LM head (embed.attend = x @ embedding.T)

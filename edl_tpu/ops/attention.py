@@ -22,7 +22,7 @@ _FLASH_HEAD_MULT = 8
 
 
 def flash_dispatch_reason(seq_len, head_dim, *, mask=None, platform=None,
-                          seq_kv=None):
+                          seq_kv=None, offset=None):
     """Why auto-dispatch would (not) pick flash for this shape.
 
     Returns ``None`` when the flash path is legal and profitable, else a
@@ -37,9 +37,21 @@ def flash_dispatch_reason(seq_len, head_dim, *, mask=None, platform=None,
     under-tiled q block. The decode path in models/gpt.py owns its own
     masked dense attention against the cache; auto-dispatch must not
     steal it mid-decode.
+
+    ``offset`` (chunked/suffix prefill: the chunk's KV write offset)
+    marks a CHUNK-SHAPED query: row i's causal frontier sits at
+    ``offset + i``, not ``i``, and the legal key range spans the whole
+    cached row. The flash kernel anchors its diagonal at position 0, so
+    any non-None offset is dense-only for the same reason decode is —
+    the offset-prefill path in models/gpt.py owns its masked dense
+    attention against the cache.
     """
     if mask is not None:
         return "attention_mask set (flash kernel has no mask support)"
+    if offset is not None:
+        return ("chunk-shaped query (prefill_offset set): flash causal "
+                "masking anchors the diagonal at position 0, not at the "
+                "chunk offset")
     if seq_kv is not None and seq_kv != seq_len:
         return ("decode-shaped query (seq_q %d != seq_kv %d): flash "
                 "causal masking assumes square q/kv" % (seq_len, seq_kv))

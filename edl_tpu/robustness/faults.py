@@ -82,6 +82,13 @@ serve.decode.step        before each fused decode step of the
                          loop keeps serving; ``delay`` inflates the
                          inter-token latency so the per-phase ``itl``
                          shed trips
+serve.decode.prefix_lookup  before the prefix-cache trie lookup that
+                         starts a prefill (ctx: seq, prompt_len) — an
+                         armed ``error`` makes the lookup LOSSLESS-fail:
+                         the sequence cold-prefills its full prompt
+                         (counted as a miss, never a wrong token), so
+                         the drill proves reuse is an optimization, not
+                         a correctness dependency
 relay.attach             child side, when a relay attachment adopts a
                          candidate endpoint (ctx: endpoint, pod) — an
                          armed ``error`` skips the candidate, driving

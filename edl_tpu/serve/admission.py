@@ -228,10 +228,12 @@ class DecodeAdmission(object):
     DecodeEngine`. Thread-safe; one instance per engine.
 
     The engine feeds it two service-time estimates (EWMAs it measures on
-    the device loop): ``prefill_ms`` — wall time of one prefill forward
-    — and ``itl_ms`` — wall time of one fused decode step, which IS the
-    inter-token latency every occupied slot experiences. Admission then
-    checks, in order:
+    the device loop): ``prefill_ms_per_token`` — prefill wall time
+    NORMALIZED by the tokens it prefilled (per-seq EWMAs let one long
+    prompt poison the projection into shedding short prompts; see
+    :meth:`observe_prefill_ms`) — and ``itl_ms`` — wall time of one
+    fused decode step, which IS the inter-token latency every occupied
+    slot experiences. Admission then checks, in order:
 
     - ``draining``    — decommissioning; new sequences go elsewhere.
     - ``queue_full``  — the waiting (pre-prefill) queue is at
@@ -241,9 +243,16 @@ class DecodeAdmission(object):
                         full slot refill) — occupancy shedding: more
                         queueing cannot be served before slots turn
                         over.
-    - ``ttft``        — TTFT projection: (waiting+1) x prefill EWMA
-                        exceeds ``ttft_slo_ms``. Prefill-phase analog
-                        of the queue-wait ``slo`` shed.
+    - ``ttft``        — TTFT projection: the prefill WORK ahead of this
+                        sequence — queued prefill tokens (waiting
+                        suffixes + the remainder of any half-prefilled
+                        chunked sequence) plus its own
+                        suffix-after-prefix-reuse — times the per-token
+                        prefill EWMA exceeds ``ttft_slo_ms``. A prompt
+                        whose prefix is cached projects only its
+                        suffix, so reuse directly buys admission
+                        headroom. Callers without token accounting fall
+                        back to the coarse (waiting+1) x EWMA form.
     - ``itl``         — the measured ITL EWMA exceeds ``itl_slo_ms``
                         while slots are occupied: every admitted
                         sequence inflates EVERY resident sequence's
@@ -269,8 +278,8 @@ class DecodeAdmission(object):
         self._alpha = float(ewma_alpha)
         self._clock = clock
         self._lock = threading.Lock()
-        self._prefill_ms = None  # EWMA, one prefill forward
-        self._itl_ms = None      # EWMA, one fused decode step
+        self._prefill_ms_tok = None  # EWMA, prefill ms PER TOKEN
+        self._itl_ms = None          # EWMA, one fused decode step
         self._draining = False
         self._admitted = 0
         self._shed = {r: 0 for r in DECODE_SHED_REASONS}
@@ -286,10 +295,20 @@ class DecodeAdmission(object):
 
     # -- estimates (fed by the engine's device loop) -----------------------
 
-    def observe_prefill_ms(self, ms):
+    def observe_prefill_ms(self, ms, tokens=1):
+        """Fold one prefill interval into the PER-TOKEN EWMA. ``tokens``
+        is how many prompt tokens that interval prefilled (the padded
+        bucket's valid span; the chunk's valid span under chunking). A
+        per-sequence EWMA would let one long prompt inflate the estimate
+        ~bucket-fold and poison the TTFT projection into shedding SHORT
+        prompts for the next ~1/alpha arrivals; normalizing makes the
+        estimate prompt-length-invariant."""
+        per_tok = float(ms) / max(1, int(tokens))
         with self._lock:
-            self._prefill_ms = ms if self._prefill_ms is None else (
-                self._alpha * ms + (1.0 - self._alpha) * self._prefill_ms)
+            self._prefill_ms_tok = (
+                per_tok if self._prefill_ms_tok is None else
+                self._alpha * per_tok
+                + (1.0 - self._alpha) * self._prefill_ms_tok)
 
     def observe_itl_ms(self, ms):
         with self._lock:
@@ -298,10 +317,17 @@ class DecodeAdmission(object):
 
     # -- the decision ------------------------------------------------------
 
-    def admit(self, free_slots, waiting, occupied, slots):
+    def admit(self, free_slots, waiting, occupied, slots,
+              suffix_tokens=None, queued_prefill_tokens=None):
         """Admit one sequence or raise :class:`OverloadedError`.
         ``free_slots``/``occupied``/``slots`` describe the slot plane,
-        ``waiting`` the pre-prefill queue, at the instant of arrival."""
+        ``waiting`` the pre-prefill queue, at the instant of arrival.
+        ``suffix_tokens`` — tokens THIS prompt still needs prefilled
+        after prefix reuse — and ``queued_prefill_tokens`` — prefill
+        tokens already ahead of it (waiting suffixes + unprefilled
+        chunk remainders) — switch the TTFT projection to token
+        accounting; omitted, it falls back to the coarse per-sequence
+        form."""
         with self._lock:
             if self._draining:
                 raise self._shed_locked("draining", retry_after_s=0.1)
@@ -313,13 +339,25 @@ class DecodeAdmission(object):
             if free_slots <= 0 and waiting >= slack:
                 raise self._shed_locked(
                     "slots", retry_after_s=self._turnover_s_locked())
-            if (self._ttft_slo_ms is not None and waiting > 0
-                    and self._prefill_ms is not None):
-                ttft = (waiting + 1) * self._prefill_ms
-                if ttft > self._ttft_slo_ms:
-                    raise self._shed_locked(
-                        "ttft",
-                        retry_after_s=(ttft - self._ttft_slo_ms) / 1000.0)
+            if (self._ttft_slo_ms is not None
+                    and self._prefill_ms_tok is not None):
+                if suffix_tokens is not None:
+                    # token-accurate projection; liveness: only sheds
+                    # when prefill work is ALREADY queued ahead (an
+                    # idle engine admits whatever the estimate says)
+                    queued = int(queued_prefill_tokens or 0)
+                    ttft = ((queued + int(suffix_tokens))
+                            * self._prefill_ms_tok)
+                    if queued > 0 and ttft > self._ttft_slo_ms:
+                        raise self._shed_locked(
+                            "ttft", retry_after_s=(
+                                ttft - self._ttft_slo_ms) / 1000.0)
+                elif waiting > 0:
+                    ttft = (waiting + 1) * self._prefill_ms_tok
+                    if ttft > self._ttft_slo_ms:
+                        raise self._shed_locked(
+                            "ttft", retry_after_s=(
+                                ttft - self._ttft_slo_ms) / 1000.0)
             if (self._itl_slo_ms is not None and occupied > 0
                     and self._itl_ms is not None
                     and self._itl_ms > self._itl_slo_ms):
@@ -350,7 +388,7 @@ class DecodeAdmission(object):
         with self._lock:
             return {
                 "max_waiting": self._max_waiting,
-                "prefill_ms": self._prefill_ms,
+                "prefill_ms_per_token": self._prefill_ms_tok,
                 "itl_ms": self._itl_ms,
                 "ttft_slo_ms": self._ttft_slo_ms,
                 "itl_slo_ms": self._itl_slo_ms,

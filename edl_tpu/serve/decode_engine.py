@@ -25,10 +25,36 @@ on TOKEN-IDENTICAL output vs the unbatched ``models.gpt.generate`` for
 the same prompts, which pins down the whole slot machinery (prefill
 padding, scatter, per-row masks, cache reuse without zeroing).
 
+Two serving fast paths ride the same machinery:
+
+- **Shared-prefix KV reuse** (SGLang RadixAttention): retired rows are
+  RETAINED as cached prefixes in a host-side token trie
+  (:class:`~edl_tpu.serve.kv_cache.PrefixCache`); a prompt sharing a
+  stored prefix copies the donor row on-device and prefills only the
+  suffix. Causality makes the reuse exact — K/V at position i depends
+  only on tokens <= i — and the suffix path is token-parity-gated vs
+  cold prefill. ``EDL_TPU_PREFIX_CACHE=0`` (or ``prefix_cache=False``)
+  kills the path byte-identically.
+- **Chunked prefill** (Sarathi-Serve, OSDI'24): with
+  ``prefill_chunk=C`` (or ``EDL_TPU_PREFILL_CHUNK``), prefills split
+  into fixed-width chunks and AT MOST ONE chunk rides each fused decode
+  step in the SAME dispatch, so a long prompt costs every resident
+  sequence one slightly-heavier step per chunk instead of a full
+  prefill-sized ITL stall. Chunk calls write K/V at the chunk's offset
+  (``models/gpt.py prefill_offset``) and the final chunk yields the
+  first token.
+
+Idle rows (free, cached, or mid-chunked-prefill) ride fused steps with
+a junk write pointed at position ``max_len - 1`` — a position every
+future tenant overwrites before attending — so step traffic can never
+corrupt a cached prefix or a half-prefilled row.
+
 Faults: the ``serve.decode.step`` point fires before every fused step;
 a faulted step fails ONLY the sequences active in it (typed
 :class:`~edl_tpu.utils.errors.DecodeStepError`, slots freed) and the
 loop keeps serving — chaos-drilled in tests/test_decode_engine.py.
+``serve.decode.prefix_lookup`` fires before each trie lookup; a fault
+there falls back LOSSLESSLY to cold prefill (never a wrong token).
 
 Quantization: pass ``params`` straight from
 :func:`edl_tpu.ops.quant.quantize_tree` — the jitted prefill/step call
@@ -38,6 +64,7 @@ weights are what cross the HBM boundary (identity on f32 trees).
 
 import collections
 import itertools
+import os
 import threading
 import time
 
@@ -49,7 +76,7 @@ from edl_tpu.obs import metrics as obs_metrics
 from edl_tpu.ops.quant import dequantize_tree
 from edl_tpu.robustness import faults
 from edl_tpu.serve.admission import DecodeAdmission
-from edl_tpu.serve.kv_cache import SlotKvCache
+from edl_tpu.serve.kv_cache import PrefixCache, SlotKvCache
 from edl_tpu.utils import errors
 
 _MS_BUCKETS = (0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
@@ -79,7 +106,8 @@ _STEPS = obs_metrics.counter(
 class _Seq(object):
     __slots__ = ("id", "prompt", "max_new", "deadline_ms", "submitted_at",
                  "slot", "pos", "tok", "tokens", "ttft_ms", "itl_ms",
-                 "done", "error", "event")
+                 "done", "error", "event", "next_off", "reuse_tokens",
+                 "suffix_est", "last_emit")
 
     def __init__(self, seq_id, prompt, max_new, deadline_ms, submitted_at):
         self.id = seq_id
@@ -96,6 +124,10 @@ class _Seq(object):
         self.done = False
         self.error = None
         self.event = threading.Event()
+        self.next_off = None            # prefill frontier (chunked path)
+        self.reuse_tokens = 0           # prefix tokens reused from cache
+        self.suffix_est = len(prompt)   # projected prefill work at submit
+        self.last_emit = None           # clock stamp of the last token
 
 
 class SeqHandle(object):
@@ -132,10 +164,19 @@ class DecodeEngine(object):
     ``params`` may be plain f32 or the output of
     :func:`~edl_tpu.ops.quant.quantize_tree`. ``slots`` bounds resident
     sequences; ``admission`` is a :class:`DecodeAdmission` (``None`` =
-    defaults, ``False`` = admit everything except when draining)."""
+    defaults, ``False`` = admit everything except when draining).
+
+    ``prefix_cache``: ``None`` = on unless ``EDL_TPU_PREFIX_CACHE=0``,
+    ``False`` = off (cold prefill only, byte-identical to the pre-reuse
+    engine), ``True`` = on regardless of the env knob, or a
+    :class:`~edl_tpu.serve.kv_cache.PrefixCache` to share or pre-seed
+    one. ``prefill_chunk``: chunk width in tokens for
+    Sarathi-style chunked prefill (``None`` = ``EDL_TPU_PREFILL_CHUNK``,
+    0/unset = monolithic prefill)."""
 
     def __init__(self, model, params, slots=8, admission=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, prefix_cache=None,
+                 prefill_chunk=None):
         self.model = model
         self.params = params
         self.slots = int(slots)
@@ -145,9 +186,23 @@ class DecodeEngine(object):
             admission = DecodeAdmission(clock=clock)
         self.admission = admission or DecodeAdmission(
             max_waiting=1 << 30, clock=clock)
+        if prefix_cache is None:
+            env = os.environ.get("EDL_TPU_PREFIX_CACHE", "1").lower()
+            prefix_cache = (PrefixCache()
+                            if env not in ("0", "off", "false") else None)
+        elif prefix_cache is False:
+            prefix_cache = None
+        elif prefix_cache is True:  # force on, ignoring the env knob
+            prefix_cache = PrefixCache()
+        self.prefix = prefix_cache
+        if prefill_chunk is None:
+            prefill_chunk = int(
+                os.environ.get("EDL_TPU_PREFILL_CHUNK", "0") or 0)
+        self.prefill_chunk = min(max(0, int(prefill_chunk)), self.max_len)
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._waiting = collections.deque()
+        self._prefill_q = collections.deque()  # chunked: slot held, prefill
         self._seqs = {}      # id -> _Seq (live + recently finished)
         self._by_slot = {}   # slot -> _Seq (active only)
         self._ids = itertools.count(1)
@@ -157,14 +212,26 @@ class DecodeEngine(object):
         self._evicted = 0
         self._tokens_total = 0
         self._steps_total = 0
+        self._prefilled_tokens = 0  # tokens cold-prefilled (not reused)
         self._step_traces = 0     # fixed-shape discipline: must stay 1
         self._prefill_traces = 0  # bounded by len(prefill buckets)
+        self._chunk_traces = 0    # bounded: 1 width under chunking,
+        #                           power-of-two buckets for suffixes
 
         self.kv = SlotKvCache(
             lambda n: _init_cache(model, params, n), self.slots)
         _SLOTS_TOTAL.set(self.slots)
-        self._jit_prefill = jax.jit(self._prefill_impl)
-        self._jit_step = jax.jit(self._step_impl)
+        # the cache argument is DONATED: every impl threads the full
+        # slot cache in and out, and without aliasing each dispatch
+        # round-trips a copy of the whole KV arena — at serving sizes
+        # that copy costs more than the step itself. Call sites always
+        # reassign self.kv.cache from the return value, so the donated
+        # (invalidated) input is never touched again.
+        self._jit_prefill = jax.jit(self._prefill_impl, donate_argnums=1)
+        self._jit_step = jax.jit(self._step_impl, donate_argnums=1)
+        self._jit_reuse = jax.jit(self._reuse_impl, donate_argnums=0)
+        self._jit_chunk = jax.jit(self._chunk_impl, donate_argnums=1)
+        self._jit_fused = jax.jit(self._fused_impl, donate_argnums=1)
 
     # -- jitted device functions -------------------------------------------
 
@@ -200,6 +267,60 @@ class DecodeEngine(object):
             decode=True, decode_index=pos, mutable=["cache"])
         return muts["cache"], logits[:, 0]
 
+    def _reuse_impl(self, cache, src, dst):
+        """Copy slot row ``src`` (a cached prefix donor) onto ``dst``.
+        The WHOLE row is copied — positions beyond the reused depth hold
+        junk, but the suffix prefill / decode writes overwrite every
+        position before it is attended (the no-zeroing invariant)."""
+        def cp(full):
+            row = jax.lax.dynamic_slice_in_dim(full, src, 1, axis=0)
+            return jax.lax.dynamic_update_slice_in_dim(full, row, dst,
+                                                       axis=0)
+        return jax.tree_util.tree_map(cp, cache)
+
+    def _apply_chunk(self, params, cache, ids, offset, slot):
+        """Shared chunk body: extract slot ``slot``'s row, run one
+        offset-prefill chunk over it (K/V written at ``offset``, rows
+        attend the already-written prefix), scatter it back. Returns
+        (cache', chunk logits [1, W, vocab])."""
+        row = jax.tree_util.tree_map(
+            lambda full: jax.lax.dynamic_slice(
+                full, (slot, 0, 0, 0), (1,) + full.shape[1:]), cache)
+        logits, muts = self.model.apply(
+            {"params": params, "cache": row}, ids, prefill=True,
+            prefill_offset=offset, mutable=["cache"])
+        cache = jax.tree_util.tree_map(
+            lambda full, r: jax.lax.dynamic_update_slice(
+                full, r, (slot, 0, 0, 0)), cache, muts["cache"])
+        return cache, logits
+
+    def _chunk_impl(self, qparams, cache, ids, offset, last, slot):
+        """One solo prefill chunk (no live decode rows to fuse with):
+        suffix prefill after a prefix hit, or a chunked-prefill quantum
+        on an otherwise idle engine. ``last`` indexes the final valid
+        prompt position in the window (its logits yield the first
+        token when this is the final chunk)."""
+        self._chunk_traces += 1  # python side effect: counts traces
+        params = dequantize_tree(qparams)
+        cache, logits = self._apply_chunk(params, cache, ids, offset, slot)
+        return cache, logits[0, last]
+
+    def _fused_impl(self, qparams, cache, ids, offset, last, slot,
+                    toks, pos):
+        """Sarathi-style fused quantum: ONE dispatch prefills one chunk
+        into slot ``slot`` AND advances every live decode row. The cache
+        threads chunk-then-step, and the step only writes real K/V for
+        live rows (the chunking row rides the decode side as junk at
+        max_len-1), so the chunk's window survives the step intact."""
+        self._chunk_traces += 1  # python side effect: counts traces
+        params = dequantize_tree(qparams)
+        cache, clogits = self._apply_chunk(params, cache, ids, offset,
+                                           slot)
+        logits, muts = self.model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            decode=True, decode_index=pos, mutable=["cache"])
+        return muts["cache"], logits[:, 0], clogits[0, last]
+
     # -- client surface ----------------------------------------------------
 
     def submit(self, prompt_ids, max_new_tokens, deadline_ms=None):
@@ -217,10 +338,24 @@ class DecodeEngine(object):
                 "prompt+new %d exceeds max_len %d" % (total, self.max_len))
         now = self._clock()
         with self._work:
+            suffix_est = len(prompt)
+            if self.prefix is not None:
+                suffix_est -= self.prefix.peek_len(prompt)
+            queued_tok = sum(s.suffix_est for s in self._waiting)
+            for s in self._prefill_q:
+                queued_tok += max(0, len(s.prompt) - (s.next_off or 0))
+            free = self.kv.free_slots
+            if self.prefix is not None:
+                # cached prefix rows are reclaimable on demand (LRU
+                # evict), so they count as capacity, not occupancy
+                free += self.kv.cached_rows
             self.admission.admit(
-                free_slots=self.kv.free_slots, waiting=len(self._waiting),
-                occupied=self.kv.occupied, slots=self.slots)
+                free_slots=free, waiting=len(self._waiting),
+                occupied=self.kv.occupied, slots=self.slots,
+                suffix_tokens=suffix_est,
+                queued_prefill_tokens=queued_tok)
             seq = _Seq(next(self._ids), prompt, max_new, deadline_ms, now)
+            seq.suffix_est = suffix_est
             self._seqs[seq.id] = seq
             self._waiting.append(seq)
             _PREFILL_QUEUE.set(len(self._waiting))
@@ -287,7 +422,7 @@ class DecodeEngine(object):
         deadline = self._clock() + deadline_s
         with self._work:
             self._work.notify_all()
-            while self._waiting or self._by_slot:
+            while self._waiting or self._by_slot or self._prefill_q:
                 remaining = deadline - self._clock()
                 if remaining <= 0:
                     return False
@@ -305,11 +440,15 @@ class DecodeEngine(object):
             self._thread.join(timeout=30.0)
             self._thread = None
         with self._lock:
-            leftovers = list(self._waiting) + list(self._by_slot.values())
+            leftovers = (list(self._waiting) + list(self._prefill_q)
+                         + list(self._by_slot.values()))
             self._waiting.clear()
+            self._prefill_q.clear()
             for seq in leftovers:
                 if seq.slot is not None:
-                    del self._by_slot[seq.slot]
+                    self._by_slot.pop(seq.slot, None)
+                    if self.prefix is not None:
+                        self.prefix.forget(seq.slot)
                     self.kv.free(seq.slot)
                     seq.slot = None
                 self._resolve_locked(seq, error=errors.StopError(
@@ -322,13 +461,36 @@ class DecodeEngine(object):
             with self._work:
                 if self._stop:
                     return
-                if not self._by_slot and not self._waiting:
+                if (not self._by_slot and not self._waiting
+                        and not self._prefill_q):
                     self._work.wait(timeout=0.05)
                     if self._stop:
                         return
             self._admit_arrivals()
-            if self._by_slot:
-                self._run_step()
+            self._service()
+
+    def _service(self):
+        """One scheduling quantum: at most ONE prefill chunk, fused
+        with the decode step when rows are live (the Sarathi budget —
+        residents pay one bounded chunk per step, never a monolithic
+        prefill stall)."""
+        with self._lock:
+            chunk_seq = self._prefill_q[0] if self._prefill_q else None
+        if chunk_seq is not None:
+            now = self._clock()
+            if (chunk_seq.deadline_ms is not None
+                    and (now - chunk_seq.submitted_at) * 1000.0
+                    > chunk_seq.deadline_ms):
+                # budget burned mid-prefill: drop before device work
+                with self._lock:
+                    if self._prefill_q and self._prefill_q[0] is chunk_seq:
+                        self._prefill_q.popleft()
+                    self._evict_locked(chunk_seq)
+                _SLOTS_OCCUPIED.set(self.kv.occupied)
+                return
+            self._run_chunk(chunk_seq)
+        elif self._by_slot:
+            self._run_step()
 
     def _admit_arrivals(self):
         while True:
@@ -348,10 +510,55 @@ class DecodeEngine(object):
                     _EVICTED.inc()
                     continue
                 slot = self.kv.alloc()
+                if slot is None and self.prefix is not None:
+                    # allocator dry but idle cached rows exist: evict
+                    # the LRU stored prefix and reclaim its row — reuse
+                    # never reduces decode capacity
+                    victim = self.prefix.evict_lru(self.kv.cached())
+                    if victim is not None:
+                        self.kv.release(victim)
+                        slot = self.kv.alloc()
                 if slot is None:
                     return
                 self._waiting.popleft()
                 _PREFILL_QUEUE.set(len(self._waiting))
+            self._start_prefill(seq, slot)
+
+    def _start_prefill(self, seq, slot):
+        """Route one admitted sequence onto its prefill path: prefix
+        lookup + row copy first (chaos point ``serve.decode.
+        prefix_lookup``; any fault falls back losslessly to cold
+        prefill), then either a monolithic/suffix prefill now, or —
+        under chunking — park the sequence on the chunk queue and let
+        its prefill ride the fused steps."""
+        src, reused = None, 0
+        if self.prefix is not None:
+            try:
+                if faults.PLANE is not None:
+                    faults.PLANE.fire("serve.decode.prefix_lookup",
+                                      seq=seq.id,
+                                      prompt_len=len(seq.prompt))
+                src, reused = self.prefix.lookup(seq.prompt)
+            except Exception:  # noqa: BLE001 — lossless cold fallback
+                self.prefix.note_miss()
+                src, reused = None, 0
+        if src is not None and reused > 0:
+            try:
+                self.kv.cache = self._jit_reuse(
+                    self.kv.cache, jnp.asarray(src, jnp.int32),
+                    jnp.asarray(slot, jnp.int32))
+            except Exception:  # noqa: BLE001 — lossless cold fallback
+                reused = 0
+        seq.reuse_tokens = reused
+        seq.next_off = reused
+        if self.prefill_chunk:
+            with self._work:
+                seq.slot = slot
+                self._prefill_q.append(seq)
+                self._work.notify_all()
+        elif reused > 0:
+            self._prefill_suffix(seq, slot)
+        else:
             self._prefill(seq, slot)
 
     def _prefill(self, seq, slot):
@@ -366,7 +573,7 @@ class DecodeEngine(object):
                 jnp.asarray(plen, jnp.int32), jnp.asarray(slot, jnp.int32))
             first = int(np.argmax(np.asarray(last)))
         except Exception as exc:  # noqa: BLE001 — fail one seq, not the loop
-            self.kv.free(slot)
+            self._drop_slot(slot)
             with self._lock:
                 self._resolve_locked(seq, error=errors.DecodeStepError(
                     "prefill failed: %s" % exc))
@@ -378,26 +585,158 @@ class DecodeEngine(object):
         # the admission EWMA and the per-seq report (allowlisted pair
         # site in tools/check_no_ad_hoc_instrumentation.py)
         prefill_ms = (time.monotonic() - t0) * 1000.0
-        self.admission.observe_prefill_ms(prefill_ms)
+        self.admission.observe_prefill_ms(prefill_ms, tokens=plen)
+        with self._lock:
+            self._prefilled_tokens += plen
+        self._finish_prefill(seq, slot, first)
+
+    def _prefill_suffix(self, seq, slot):
+        """Prefill ONLY the suffix after a prefix hit: one offset-chunk
+        call over a power-of-two window ending at the prompt's tail.
+        The window may slide back over the reused span (when the padded
+        width overruns ``max_len``) — overlap recomputes bit-identical
+        K/V, so correctness never depends on the slide."""
+        plen = len(seq.prompt)
+        width = _prefill_bucket(plen - seq.next_off, self.max_len)
+        start = min(seq.next_off, self.max_len - width)
+        span = min(width, plen - start)
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :span] = seq.prompt[start:start + span]
+        t0 = time.monotonic()
+        try:
+            cache, last = self._jit_chunk(
+                self.params, self.kv.cache, jnp.asarray(ids),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(plen - 1 - start, jnp.int32),
+                jnp.asarray(slot, jnp.int32))
+            first = int(np.argmax(np.asarray(last)))
+        except Exception as exc:  # noqa: BLE001 — fail one seq, not the loop
+            self._drop_slot(slot)
+            with self._lock:
+                self._resolve_locked(seq, error=errors.DecodeStepError(
+                    "suffix prefill failed: %s" % exc))
+                self._evicted += 1
+            _EVICTED.inc()
+            return
+        self.kv.cache = cache
+        # same stopwatch-pair contract as _prefill (allowlisted site)
+        suffix_ms = (time.monotonic() - t0) * 1000.0
+        suffix_tokens = plen - seq.next_off
+        self.admission.observe_prefill_ms(suffix_ms, tokens=suffix_tokens)
+        with self._lock:
+            self._prefilled_tokens += suffix_tokens
+        self._finish_prefill(seq, slot, first)
+
+    def _finish_prefill(self, seq, slot, first):
+        """Common prefill completion: store the prompt's path in the
+        trie (the row is a valid donor from here on — decode only
+        writes positions >= prompt_len) and activate the sequence."""
+        if self.prefix is not None:
+            self.prefix.insert(seq.prompt, slot)
         with self._lock:
             seq.slot = slot
-            seq.pos = plen
+            seq.pos = len(seq.prompt)
             seq.tok = first
             seq.tokens.append(first)
-            seq.ttft_ms = (self._clock() - seq.submitted_at) * 1000.0
+            now = self._clock()
+            seq.ttft_ms = (now - seq.submitted_at) * 1000.0
+            seq.last_emit = now
             self._tokens_total += 1
             self._by_slot[slot] = seq
             ttft = seq.ttft_ms
-            finished = len(seq.tokens) >= seq.max_new
-            if finished:
+            if len(seq.tokens) >= seq.max_new:
                 self._retire_locked(seq)
         _TTFT.observe(ttft)
         _TOKENS.inc()
         _SLOTS_OCCUPIED.set(self.kv.occupied)
 
+    def _plan_chunk(self, seq):
+        """Host-side plan for the next chunk of ``seq``'s prefill:
+        (padded ids [1, C], window start, last-valid index, tokens of
+        NEW progress, final?). The window slides back when it would
+        overrun ``max_len`` (or, on the final chunk, past the prompt
+        tail) — overlapped positions recompute identical K/V."""
+        plen = len(seq.prompt)
+        width = self.prefill_chunk
+        start = min(seq.next_off, max(0, self.max_len - width))
+        span = min(width, plen - start)
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :span] = seq.prompt[start:start + span]
+        end = start + span
+        progress = end - seq.next_off
+        final = end >= plen
+        last = (plen - 1 - start) if final else (span - 1)
+        return ids, start, last, progress, final
+
+    def _run_chunk(self, seq):
+        """One chunked-prefill quantum: fuse the chunk with the decode
+        step when rows are live (ONE dispatch — residents' ITL pays a
+        bounded chunk, not a monolithic prefill), solo otherwise."""
+        ids, start, last, progress, final = self._plan_chunk(seq)
+        toks = np.zeros(self.slots, np.int32)
+        # junk writes for non-live rows land at max_len-1: a position
+        # every future tenant overwrites before attending, so steps
+        # never corrupt cached prefixes or half-prefilled rows
+        pos = np.full(self.slots, self.max_len - 1, np.int32)
+        with self._lock:
+            active = dict(self._by_slot)
+            for slot, s in active.items():
+                toks[slot] = s.tok
+                pos[slot] = s.pos
+        t0 = time.monotonic()
+        try:
+            if active:
+                if faults.PLANE is not None:
+                    faults.PLANE.fire("serve.decode.step",
+                                      active=len(active),
+                                      step=self._steps_total)
+                cache, logits, clog = self._jit_fused(
+                    self.params, self.kv.cache, jnp.asarray(ids),
+                    jnp.asarray(start, jnp.int32),
+                    jnp.asarray(last, jnp.int32),
+                    jnp.asarray(seq.slot, jnp.int32),
+                    jnp.asarray(toks), jnp.asarray(pos))
+                logits = np.asarray(logits)
+            else:
+                cache, clog = self._jit_chunk(
+                    self.params, self.kv.cache, jnp.asarray(ids),
+                    jnp.asarray(start, jnp.int32),
+                    jnp.asarray(last, jnp.int32),
+                    jnp.asarray(seq.slot, jnp.int32))
+                logits = None
+        except Exception as exc:  # noqa: BLE001 — fail the quantum's
+            with self._lock:      # seqs, never the loop
+                if self._prefill_q and self._prefill_q[0] is seq:
+                    self._prefill_q.popleft()
+                self._evict_locked(seq, error=errors.DecodeStepError(
+                    "prefill chunk faulted for seq %d: %s"
+                    % (seq.id, exc)))
+            self._fail_step(active, exc)
+            return
+        self.kv.cache = cache
+        quantum_ms = (time.monotonic() - t0) * 1000.0
+        # the chunk's EWMA charge includes the fused step's share — a
+        # conservative (early-shedding) per-token estimate
+        self.admission.observe_prefill_ms(quantum_ms,
+                                          tokens=max(1, progress))
+        with self._lock:
+            self._prefilled_tokens += progress
+            seq.next_off += progress
+        if active:
+            self._finish_step(active, logits, quantum_ms)
+        if final:
+            with self._lock:
+                if self._prefill_q and self._prefill_q[0] is seq:
+                    self._prefill_q.popleft()
+            self._finish_prefill(seq, seq.slot,
+                                 int(np.argmax(np.asarray(clog))))
+
     def _run_step(self):
         toks = np.zeros(self.slots, np.int32)
-        pos = np.zeros(self.slots, np.int32)
+        # junk writes for non-live rows land at max_len-1 (see
+        # _run_chunk) — never position 0, which a cached prefix row's
+        # donor span may need intact
+        pos = np.full(self.slots, self.max_len - 1, np.int32)
         with self._lock:
             active = dict(self._by_slot)
             for slot, seq in active.items():
@@ -418,6 +757,20 @@ class DecodeEngine(object):
             return
         self.kv.cache = cache
         step_ms = (time.monotonic() - t0) * 1000.0
+        self._finish_step(active, logits, step_ms)
+
+    def _finish_step(self, active, logits, step_ms):
+        """Post-step bookkeeping shared by the pure and fused paths:
+        fold the interval into the ITL plane and advance every active
+        row (append token, retire/evict on completion/deadline).
+
+        Two ITL planes on purpose: the admission EWMA and the _ITL
+        histogram see ``step_ms`` (the device step cost the shed
+        projection prices), while each sequence's report ``itl_ms``
+        records the CLIENT-VISIBLE wall gap since its previous token —
+        the gap is what a monolithic prefill stall inflates and what
+        the chunked-prefill bound (tools/serve_bench.py, chunked arc)
+        is gated on."""
         self.admission.observe_itl_ms(step_ms)
         _ITL.observe(step_ms)
         _STEPS.inc()
@@ -428,7 +781,8 @@ class DecodeEngine(object):
             for slot, seq in active.items():
                 nxt = int(np.argmax(logits[slot]))
                 seq.tokens.append(nxt)
-                seq.itl_ms.append(step_ms)
+                seq.itl_ms.append((now - seq.last_emit) * 1000.0)
+                seq.last_emit = now
                 seq.pos += 1
                 seq.tok = nxt
                 self._tokens_total += 1
@@ -455,22 +809,42 @@ class DecodeEngine(object):
 
     def _retire_locked(self, seq):
         if seq.slot is not None:
-            del self._by_slot[seq.slot]
-            self.kv.free(seq.slot)
+            self._by_slot.pop(seq.slot, None)
+            self._release_slot_locked(seq.slot)
             seq.slot = None
         self._sequences_done += 1
         self._resolve_locked(seq)
 
     def _evict_locked(self, seq, error=None):
         if seq.slot is not None:
-            del self._by_slot[seq.slot]
-            self.kv.free(seq.slot)
+            self._by_slot.pop(seq.slot, None)
+            self._release_slot_locked(seq.slot, keep_cached=False)
             seq.slot = None
         self._evicted += 1
         _EVICTED.inc()
         if error is None:
             error = self.admission.shed_evicted()
         self._resolve_locked(seq, error=error)
+
+    def _release_slot_locked(self, slot, keep_cached=True):
+        """Return a slot to the allocator — or, on the RETIRE path with
+        its prompt stored in the trie, retain it as a cached prefix
+        donor (decode only wrote positions >= prompt_len, so the prefix
+        span is intact). Evictions always forget+free: a faulted or
+        deadline-killed row is not a trustworthy donor."""
+        if (keep_cached and self.prefix is not None
+                and self.prefix.has(slot)):
+            self.kv.retain(slot)
+        else:
+            if self.prefix is not None:
+                self.prefix.forget(slot)
+            self.kv.free(slot)
+
+    def _drop_slot(self, slot):
+        """Failure-path slot return (outside the engine lock)."""
+        if self.prefix is not None:
+            self.prefix.forget(slot)
+        self.kv.free(slot)
 
     def _resolve_locked(self, seq, error=None):
         seq.error = error
@@ -483,18 +857,35 @@ class DecodeEngine(object):
     def stats(self):
         with self._lock:
             waiting = len(self._waiting)
+            prefilling = len(self._prefill_q)
             active = len(self._by_slot)
             steps = self._steps_total
+            prefilled = self._prefilled_tokens
         occ = self.kv.occupied
+        if self.prefix is not None:
+            prefix = self.prefix.stats()
+            prefix["enabled"] = True
+            prefix["cached_rows"] = self.kv.cached_rows
+            reused = prefix["reuse_tokens"]
+            prefix["reuse_frac"] = (
+                reused / float(reused + prefilled)
+                if (reused + prefilled) else 0.0)
+        else:
+            prefix = {"enabled": False}
         return {
             "decode_slots_total": self.slots,
             "decode_slots_occupied": occ,
             "decode_slot_frac": occ / float(self.slots),
             "decode_waiting": waiting,
+            "decode_prefilling": prefilling,
             "decode_active": active,
             "decode_steps_total": steps,
             "decode_step_traces": self._step_traces,
             "decode_prefill_traces": self._prefill_traces,
+            "decode_chunk_traces": self._chunk_traces,
+            "decode_prefill_chunk": self.prefill_chunk,
+            "decode_prefilled_tokens": prefilled,
+            "decode_prefix": prefix,
             "decode_tokens_total": self._tokens_total,
             "decode_sequences_total": self._sequences_done,
             "decode_evicted_total": self._evicted,

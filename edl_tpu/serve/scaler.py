@@ -187,7 +187,7 @@ class ServeScaler(object):
         live = {ep: s for ep, s in stats_by_endpoint.items()
                 if isinstance(s, dict) and not s.get("draining")}
         occs, wait_fracs, shed_total = [], [], 0
-        slot_fracs = []
+        slot_fracs, reuse_fracs = [], []
         for s in live.values():
             occs.append(float(s.get("occupancy") or 0.0))
             slo_ms = s.get("slo_ms")
@@ -205,11 +205,19 @@ class ServeScaler(object):
             adm = s.get("decode_admission")
             if isinstance(adm, dict):
                 shed_total += int(adm.get("shed_total") or 0)
+            # prefix reuse discounts the prefill work a nominal token
+            # of traffic actually costs — journaled so a scale decision
+            # under cache-heavy traffic is explainable from the record
+            pfx = s.get("decode_prefix")
+            if isinstance(pfx, dict) and pfx.get("enabled"):
+                reuse_fracs.append(float(pfx.get("reuse_frac") or 0.0))
         return {
             "teachers": len(live),
             "occupancy": (sum(occs) / len(occs)) if occs else 0.0,
             "wait_frac": max(wait_fracs) if wait_fracs else 0.0,
             "slot_frac": max(slot_fracs) if slot_fracs else 0.0,
+            "prefix_reuse_frac": (sum(reuse_fracs) / len(reuse_fracs)
+                                  if reuse_fracs else 0.0),
             "shed_total": shed_total,
         }
 
@@ -249,10 +257,11 @@ class ServeScaler(object):
             self._out_streak = 0
             self._in_streak = 0
 
-        why = ("occupancy %.2f, slots %.2f, wait %.2fx slo, %d sheds "
-               "this tick, burn %s, %d teachers"
+        why = ("occupancy %.2f, slots %.2f, wait %.2fx slo, reuse %.2f, "
+               "%d sheds this tick, burn %s, %d teachers"
                % (sig["occupancy"], sig["slot_frac"], sig["wait_frac"],
-                  sheds_delta, severity or "ok", n))
+                  sig["prefix_reuse_frac"], sheds_delta,
+                  severity or "ok", n))
         cause = {"signals": sig, "sheds_delta": sheds_delta,
                  "burn_severity": severity}
 

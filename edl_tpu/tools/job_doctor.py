@@ -54,7 +54,12 @@ _DETECTOR_RANK = {"flight_recorder": 0, "stale_publisher": 1,
                   "straggler": 2, "slo_burn": 3, "breaker_flap": 4,
                   "queue_saturation": 5, "live_resize_fallback": 6,
                   "reshard_fallback": 7, "rebuild_fallback": 8,
-                  "prewarm_miss": 9, "decode_slot_starvation": 10}
+                  "prewarm_miss": 9, "decode_slot_starvation": 10,
+                  "prefix_thrash": 11}
+
+#: prefix_thrash fires only past this many LRU evictions — below it the
+#: cache is still warming up and eviction/hit ratios are noise
+_PREFIX_THRASH_EVICTIONS = 8
 
 
 def collect(coord):
@@ -169,16 +174,22 @@ def _decode_findings(obs):
       any pod being unhealthy. The fix is capacity, not repair: scale
       the teacher fleet out (ServeScaler folds the same
       ``decode_slot_frac`` signal into its journaled decisions) or
-      lower ``max_new_tokens``/raise slots."""
+      lower ``max_new_tokens``/raise slots.
+    - prefix_thrash: the prefix cache is churning — cached rows are
+      being LRU-evicted faster than lookups hit them, so the trie burns
+      slot turnover (and the copy bandwidth of retains) without paying
+      for itself. Either the traffic shares no prefixes (turn the cache
+      off: EDL_TPU_PREFIX_CACHE=0) or the working set of distinct
+      prefixes exceeds the slot count (raise ``slots`` or shard
+      prefix-affine traffic to the same replica via balance.py)."""
     findings = []
     for pod in sorted(obs):
         doc = obs[pod]
         total = _pod_gauge(doc, "edl_decode_slots_total")
         occupied = _pod_gauge(doc, "edl_decode_slots_occupied")
         queue = _pod_gauge(doc, "edl_decode_prefill_queue")
-        if not total or occupied is None or queue is None:
-            continue
-        if occupied >= total and queue > 0:
+        if total and occupied is not None and queue is not None \
+                and occupied >= total and queue > 0:
             findings.append({
                 "pod": pod,
                 "detector": "decode_slot_starvation",
@@ -191,6 +202,28 @@ def _decode_findings(obs):
                 "metric": "edl_decode_prefill_queue",
                 "value": queue,
                 "threshold": 0,
+                "event_ids": [],
+            })
+        evictions = _counter_total(
+            {pod: doc}, "edl_decode_prefix_evictions_total")
+        hits = _counter_total(
+            {pod: doc}, "edl_decode_prefix_hits_total") or 0.0
+        if evictions and evictions >= _PREFIX_THRASH_EVICTIONS \
+                and hits < evictions:
+            findings.append({
+                "pod": pod,
+                "detector": "prefix_thrash",
+                "severity": "warn",
+                "summary": ("prefix cache thrashing: %d LRU eviction(s) "
+                            "against %d hit(s) — cached KV rows churn "
+                            "faster than lookups reuse them; disable "
+                            "the cache (EDL_TPU_PREFIX_CACHE=0), raise "
+                            "slots, or route prefix-affine traffic to "
+                            "one replica (serve/kv_cache.PrefixCache)"
+                            % (int(evictions), int(hits))),
+                "metric": "edl_decode_prefix_evictions_total",
+                "value": evictions,
+                "threshold": _PREFIX_THRASH_EVICTIONS,
                 "event_ids": [],
             })
     return findings

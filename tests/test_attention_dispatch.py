@@ -151,6 +151,22 @@ def test_auto_dispatch_decode_shaped_queries_stay_dense():
                                  seq_kv=128) is None
 
 
+def test_auto_dispatch_chunk_shaped_queries_stay_dense():
+    """Chunked/suffix prefill (prefill_offset set) anchors row i's
+    causal frontier at offset+i, not i — the flash kernel's diagonal
+    starts at 0, so ANY non-None offset must stay dense, even an
+    otherwise flash-legal square shape. Offset 0 is still chunk-shaped:
+    the chunk attends the full cached row, not a square window."""
+    assert "chunk-shaped" in flash_dispatch_reason(128, 64,
+                                                   platform="tpu",
+                                                   offset=32)
+    assert "chunk-shaped" in flash_dispatch_reason(128, 64,
+                                                   platform="tpu",
+                                                   offset=0)
+    assert flash_dispatch_reason(128, 64, platform="tpu",
+                                 offset=None) is None
+
+
 def test_use_flash_true_rejects_decode_shaped_q():
     """Forcing the kernel onto a decode-shaped query is a loud
     ValueError, never a silently mis-masked context."""

@@ -995,8 +995,11 @@ def test_decode_bench_micro_schema():
     batched, and int8 engines) while beating the serial engine >= 1.5x
     under ONE fused step trace; every decode shed reason fires typed
     with zero admitted sequences stranded; slot saturation drives a
-    journaled scale-out whose drain also strands nothing; and the int8
-    teacher passes the logits parity gate at half the weight bytes.
+    journaled scale-out whose drain also strands nothing; the int8
+    teacher passes the logits parity gate at half the weight bytes;
+    shared-prefix reuse beats cold prefill >= 1.5x TTFT with identical
+    tokens and exact reuse accounting; and chunked prefill bounds the
+    storm ITL stall monolithic prefill demonstrably suffers.
     The parity and zero-stranded fields are MANDATORY: a report without
     them is a schema break, not a passing run."""
     import json
@@ -1036,5 +1039,21 @@ def test_decode_bench_micro_schema():
     # the quantization gate: close logits, genuinely smaller teacher
     assert out["quant"]["int8_logits_rel_err"] < 0.05
     assert out["quant"]["int8_bytes_ratio"] < 0.6
+
+    # shared-prefix reuse: >= 1.5x TTFT at >= 50% overlap, tokens
+    # IDENTICAL to cold prefill, and token-exact reuse accounting
+    assert out["prefix"]["overlap_frac"] >= 0.5
+    assert out["prefix"]["ttft_speedup"] >= 1.5
+    assert out["prefix"]["parity_ok"] is True
+    assert out["prefix"]["accounting_exact"] is True
+    assert out["prefix"]["hits"] >= 1
+
+    # chunked prefill bounds the storm stall monolithic prefill
+    # demonstrably suffers, under the same fixed-shape discipline
+    assert out["chunked"]["chunked_within_2x"] is True
+    assert out["chunked"]["monolithic_exceeds_2x"] is True
+    assert out["chunked"]["step_traces"] == 1
+    assert out["chunked"]["prefill_traces"] == 0
+    assert out["chunked"]["chunk_traces"] <= 2
 
     json.dumps(out)  # the whole report is JSON-serializable

@@ -35,7 +35,11 @@ def tiny():
 @pytest.fixture(scope="module")
 def engine(tiny):
     model, params = tiny
-    eng = DecodeEngine(model, params, slots=4, admission=False)
+    # prefix_cache=False: this module pins the PR18 cold-prefill
+    # semantics; the prefix-reuse and chunked-prefill paths have their
+    # own parity suite in tests/test_prefix_cache.py
+    eng = DecodeEngine(model, params, slots=4, admission=False,
+                       prefix_cache=False)
     eng.start()
     yield eng
     eng.stop()

@@ -1,0 +1,324 @@
+"""Shared-prefix KV reuse + chunked prefill tests (ISSUE 19): the
+prefix trie's ref-count/LRU mechanics and the slot cache's third
+(cached) state; partial-prefix reuse decoding TOKEN-IDENTICAL to cold
+prefill with exact ``reuse_tokens`` accounting; chunked offset-prefill
+matching monolithic prefill at the logit level and at the engine level
+under ONE fused step trace; drain with a half-prefilled chunked
+sequence stranding nothing; the ``EDL_TPU_PREFIX_CACHE=0`` kill switch
+reverting to cold prefill byte-identically; the
+``serve.decode.prefix_lookup`` chaos point degrading LOSSLESSLY to
+cold prefill; the per-token prefill EWMA (the long-prompt-poisoning
+fix); and the doctor's ``prefix_thrash`` finding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.models import gpt as gpt_mod
+from edl_tpu.robustness.faults import FaultPlane
+from edl_tpu.serve.admission import DecodeAdmission
+from edl_tpu.serve.decode_engine import DecodeEngine, _init_cache
+from edl_tpu.serve.kv_cache import PrefixCache, SlotKvCache
+from edl_tpu.utils import errors
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    model = gpt_mod.gpt_tiny(num_layers=2, d_model=32, num_heads=2,
+                             mlp_dim=64, vocab_size=64, max_len=64,
+                             dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    return model, params
+
+
+def _refs(model, params, prompts, max_new):
+    """Reference tokens per prompt via ONE batched ``gpt.generate``
+    call per prompt length (generate re-traces per call)."""
+    out, by_len = {}, {}
+    for p in prompts:
+        by_len.setdefault(len(p), []).append(p)
+    for group in by_len.values():
+        toks = np.asarray(gpt_mod.generate(
+            model, params, np.asarray(group, np.int32), max_new))
+        for p, row in zip(group, toks):
+            out[tuple(p)] = [int(t) for t in row]
+    return out
+
+
+# -- the trie ---------------------------------------------------------------
+
+
+def test_prefix_trie_insert_lookup_depth_cap():
+    pc = PrefixCache()
+    pc.insert([1, 2, 3, 4], 0)
+    assert pc.lookup([1, 2, 3, 4, 9]) == (0, 4)
+    # at least one suffix token must remain (the first output token
+    # comes from the last prompt position), so an IDENTICAL prompt
+    # reuses all but its final token
+    assert pc.lookup([1, 2, 3, 4]) == (0, 3)
+    assert pc.lookup([7, 7]) == (None, 0)
+    # peek never counts nor touches LRU
+    assert pc.peek_len([1, 2, 9]) == 2
+    s = pc.stats()
+    assert (s["hits"], s["misses"], s["reuse_tokens"]) == (2, 1, 7)
+    assert s["stored_paths"] == 1
+    pc.forget(0)
+    assert pc.lookup([1, 2, 3, 4, 9]) == (None, 0)
+    assert pc.stats()["stored_paths"] == 0
+
+
+def test_prefix_trie_one_path_per_slot_and_lru_eviction():
+    pc = PrefixCache()
+    pc.insert([1, 2, 3], 0)
+    pc.insert([1, 2, 4], 1)
+    pc.lookup([1, 2, 3, 5])          # bumps slot 0: slot 1 is now LRU
+    assert pc.evict_lru([0, 1]) == 1
+    assert pc.stats()["evictions"] == 1
+    # slot 1's branch is pruned; the shared [1, 2] spine survives
+    assert pc.lookup([1, 2, 4, 6]) == (0, 2)
+    # re-inserting a slot REPLACES its old path (one path per slot)
+    pc.insert([9, 9, 9], 0)
+    assert pc.lookup([1, 2, 3, 5]) == (None, 0)
+    assert pc.lookup([9, 9, 9, 1]) == (0, 3)
+    # no eligible candidate -> no victim
+    assert pc.evict_lru([5]) is None
+
+
+def test_slot_kv_cache_retain_release_states():
+    kv = SlotKvCache(lambda n: {"k": jnp.zeros((n, 4, 2, 2))}, slots=2)
+    a, b = kv.alloc(), kv.alloc()
+    kv.retain(a)                     # live -> cached
+    assert kv.cached_rows == 1 and kv.occupied == 1
+    assert kv.free_slots == 0 and kv.cached() == [a]
+    with pytest.raises(ValueError):
+        kv.free(a)                   # cached rows are not live
+    with pytest.raises(ValueError):
+        kv.release(b)                # live rows are not cached
+    kv.release(a)                    # cached -> free
+    assert kv.free_slots == 1 and kv.cached_rows == 0
+    assert kv.alloc() == a           # the released row is allocatable
+
+
+# -- per-token prefill EWMA (the long-prompt-poisoning fix) -----------------
+
+
+def test_admission_prefill_ewma_is_per_token():
+    adm = DecodeAdmission(max_waiting=1 << 30, slot_slack=1 << 30,
+                          ttft_slo_ms=8.0)
+    # one 500-token prefill at 1ms/token must NOT poison the estimate
+    # to 500ms-per-prompt (the pre-fix behavior)
+    adm.observe_prefill_ms(500.0, tokens=500)
+    assert adm.stats()["prefill_ms_per_token"] == pytest.approx(1.0)
+    # token-accurate projection: 5 suffix tokens against an EMPTY
+    # prefill queue admits regardless of the waiting count (liveness:
+    # an idle engine serves the head immediately)
+    adm.admit(free_slots=1, waiting=3, occupied=0, slots=4,
+              suffix_tokens=5, queued_prefill_tokens=0)
+    # 12 queued + 5 suffix tokens at 1ms/token = 17ms > the 8ms SLO
+    with pytest.raises(errors.OverloadedError, match="ttft"):
+        adm.admit(free_slots=1, waiting=1, occupied=0, slots=4,
+                  suffix_tokens=5, queued_prefill_tokens=12)
+
+
+# -- reuse parity + exact accounting ---------------------------------------
+
+
+def test_prefix_reuse_token_parity_and_exact_accounting(tiny):
+    model, params = tiny
+    eng = DecodeEngine(model, params, slots=4, admission=False,
+                       prefix_cache=True)
+    eng.start()
+    try:
+        shared = [3, 1, 4, 1, 5, 9, 2, 6]
+        prompts = [shared + [7, 7], shared + [8, 8], shared + [9, 9]]
+        refs = _refs(model, params, prompts, 6)
+        reports = [eng.generate(p, 6, timeout=120.0) for p in prompts]
+        for p, r in zip(prompts, reports):
+            assert r["tokens"] == refs[tuple(p)]
+        pfx = eng.stats()["decode_prefix"]
+        assert pfx["enabled"] is True
+        # prompts 2 and 3 each reused EXACTLY len(shared) tokens
+        assert pfx["hits"] == 2
+        assert pfx["reuse_tokens"] == 2 * len(shared)
+        # an identical resubmission reuses all but the last token and
+        # still decodes the exact reference
+        again = eng.generate(prompts[0], 6, timeout=120.0)
+        assert again["tokens"] == refs[tuple(prompts[0])]
+        pfx = eng.stats()["decode_prefix"]
+        assert pfx["hits"] == 3
+        assert pfx["reuse_tokens"] == 2 * len(shared) + len(prompts[0]) - 1
+        assert pfx["reuse_frac"] > 0
+        assert eng.drain(deadline_s=30.0)
+    finally:
+        eng.stop()
+
+
+# -- chunked prefill: logit parity, engine parity, one step trace ----------
+
+
+def test_chunked_prefill_logit_parity_vs_monolithic(tiny):
+    """Offset chunks recompute the SAME K/V and final-position logits
+    as one monolithic prefill — the model-layer contract the engine's
+    token parity rides on."""
+    model, params = tiny
+    prompt = np.array([[5, 3, 8, 1, 9, 2, 7, 4, 6, 1, 2]], np.int32)
+    plen = prompt.shape[1]
+
+    row = _init_cache(model, None, 1)
+    logits_full, muts_full = model.apply(
+        {"params": params, "cache": row}, jnp.asarray(prompt),
+        prefill=True, mutable=["cache"])
+
+    row2 = _init_cache(model, None, 1)
+    width = 4
+    chunk_last = None
+    for off in range(0, plen, width):
+        span = min(width, plen - off)
+        ids = np.zeros((1, width), np.int32)
+        ids[0, :span] = prompt[0, off:off + span]
+        logits_c, muts = model.apply(
+            {"params": params, "cache": row2}, jnp.asarray(ids),
+            prefill=True, prefill_offset=off, mutable=["cache"])
+        row2 = muts["cache"]
+        chunk_last = np.asarray(logits_c[0, span - 1])
+
+    np.testing.assert_allclose(
+        chunk_last, np.asarray(logits_full[0, plen - 1]),
+        rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(muts_full["cache"]),
+                    jax.tree_util.tree_leaves(row2)):
+        np.testing.assert_allclose(np.asarray(a)[:, :plen],
+                                   np.asarray(b)[:, :plen],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_chunked_engine_token_parity_one_step_trace(tiny):
+    model, params = tiny
+    eng = DecodeEngine(model, params, slots=4, admission=False,
+                       prefix_cache=False, prefill_chunk=3)
+    eng.start()
+    try:
+        prompts = [[1, 5, 9, 2, 4], [3, 3, 3, 1, 2], [9, 8, 7, 6, 5]]
+        refs = _refs(model, params, prompts, 6)
+        handles = [eng.submit(p, 6) for p in prompts]
+        for p, h in zip(prompts, handles):
+            assert h.result(timeout=120.0)["tokens"] == refs[tuple(p)]
+        s = eng.stats()
+        # fixed-shape discipline survives chunking: one fused step
+        # trace, every prefill routed through the bounded chunk traces
+        assert s["decode_step_traces"] == 1
+        assert s["decode_prefill_traces"] == 0
+        assert s["decode_chunk_traces"] <= 2  # solo + fused variants
+        assert s["decode_prefilled_tokens"] == sum(len(p) for p in prompts)
+        assert eng.drain(deadline_s=30.0)
+    finally:
+        eng.stop()
+
+
+def test_drain_with_half_prefilled_chunk_strands_nothing(tiny):
+    """A drain issued while a chunked sequence is mid-prefill must
+    finish that sequence (its chunks, then its decode), not strand
+    it."""
+    model, params = tiny
+    prompt = [(i * 7 + 3) % 64 or 1 for i in range(40)]
+    refs = _refs(model, params, [prompt], 4)
+    eng = DecodeEngine(model, params, slots=2, admission=False,
+                       prefix_cache=False, prefill_chunk=2)
+    eng.start()
+    try:
+        h = eng.submit(prompt, 4)  # 20 chunk quanta ahead of it
+        assert eng.drain(deadline_s=60.0)
+        rep = h.result(timeout=5.0)
+        assert rep["tokens"] == refs[tuple(prompt)]
+        s = eng.stats()
+        assert s["decode_evicted_total"] == 0
+        assert s["decode_prefilling"] == 0 and s["decode_active"] == 0
+    finally:
+        eng.stop()
+
+
+# -- the kill switch --------------------------------------------------------
+
+
+def test_prefix_kill_switch_env_reverts_to_cold_prefill(tiny, monkeypatch):
+    monkeypatch.setenv("EDL_TPU_PREFIX_CACHE", "0")
+    model, params = tiny
+    eng = DecodeEngine(model, params, slots=2, admission=False)
+    eng.start()
+    try:
+        assert eng.stats()["decode_prefix"] == {"enabled": False}
+        prompt = [2, 7, 1, 8, 2, 8]
+        refs = _refs(model, params, [prompt], 5)
+        for _ in range(2):
+            assert eng.generate(prompt, 5,
+                                timeout=120.0)["tokens"] == \
+                refs[tuple(prompt)]
+        # both runs prefilled the FULL prompt: nothing was reused
+        assert eng.stats()["decode_prefilled_tokens"] == 2 * len(prompt)
+        assert eng.drain(deadline_s=30.0)
+    finally:
+        eng.stop()
+
+
+# -- the chaos point (docs/fault_tolerance.md catalog row) ------------------
+
+
+def test_prefix_lookup_fault_is_lossless_cold_fallback(tiny):
+    """``serve.decode.prefix_lookup`` error fault: the lookup fails,
+    the sequence cold-prefills its FULL prompt, and the tokens are
+    exactly the reference — reuse is an optimization, never a
+    correctness dependency. The skipped lookup is counted a miss."""
+    model, params = tiny
+    eng = DecodeEngine(model, params, slots=4, admission=False,
+                       prefix_cache=True)
+    eng.start()
+    plane = FaultPlane(seed=5)
+    plane.inject("serve.decode.prefix_lookup", "error")
+    plane.install()
+    try:
+        shared = [6, 2, 8, 3, 1, 7]
+        prompts = [shared + [4, 4], shared + [5, 5]]
+        refs = _refs(model, params, prompts, 6)
+        for p in prompts:
+            assert eng.generate(p, 6, timeout=120.0)["tokens"] == \
+                refs[tuple(p)]
+        pfx = eng.stats()["decode_prefix"]
+        assert pfx["hits"] == 0 and pfx["misses"] == 2
+        assert eng.stats()["decode_evicted_total"] == 0  # lossless
+        assert plane.log == [("serve.decode.prefix_lookup", "error")] * 2
+        assert eng.drain(deadline_s=30.0)
+    finally:
+        plane.uninstall()
+        eng.stop()
+
+
+# -- the doctor's thrash detector ------------------------------------------
+
+
+def test_job_doctor_flags_prefix_thrash():
+    """Evictions outpacing hits past the warmup floor is a ranked
+    finding; a cache that is evicting but HITTING more is healthy churn
+    and stays silent, as does one below the floor."""
+    from edl_tpu.tools import job_doctor
+
+    def gauge(v):
+        return {"series": [{"labels": {}, "value": v}]}
+
+    def doc(evictions, hits):
+        return {"metrics": {"metrics": {
+            "edl_decode_prefix_evictions_total": gauge(evictions),
+            "edl_decode_prefix_hits_total": gauge(hits)}}}
+
+    report = job_doctor.diagnose(
+        {"job_id": "j", "job_status": None, "health": None,
+         "obs": {"pod-0": doc(12, 3), "pod-1": doc(12, 40),
+                 "pod-2": doc(2, 0)}})
+    found = [f for f in report["findings"]
+             if f["detector"] == "prefix_thrash"]
+    assert len(found) == 1
+    assert found[0]["pod"] == "pod-0"
+    assert found[0]["metric"] == "edl_decode_prefix_evictions_total"
+    assert "12" in found[0]["summary"]
+    job_doctor.render(report)  # human surface renders the finding

@@ -93,6 +93,15 @@ STOPWATCH_ALLOWLIST = {
         "step_ms feeds admission.observe_itl_ms (the ITL shed EWMA), "
         "per-seq itl_ms reports and the _ITL histogram; the serving "
         "device loop is outside the training time ledger by design",
+    ("edl_tpu/serve/decode_engine.py", "_prefill_suffix"):
+        "suffix_ms feeds admission.observe_prefill_ms (per-token TTFT "
+        "EWMA) like _prefill; the serving device loop is outside the "
+        "training time ledger by design",
+    ("edl_tpu/serve/decode_engine.py", "_run_chunk"):
+        "quantum_ms feeds BOTH admission EWMAs (observe_prefill_ms for "
+        "the chunk, observe_itl_ms via _finish_step for the fused "
+        "rows); the serving device loop is outside the training time "
+        "ledger by design",
 }
 
 
