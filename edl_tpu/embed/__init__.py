@@ -1,0 +1,33 @@
+"""Elastic sharded embedding plane for skewed CTR traffic.
+
+Parameter-server-style embedding tables (Li et al., OSDI'14) on the
+in-tree planes: table rows live host-side across pods, sharded by row
+span via the same ``costmodel.device_spans`` machinery the state plane
+uses, and served over the v2 tensor-frame RPC substrate. Three stacked
+perf optimisations, each proven by a ``rec_bench/v1`` arc
+(:mod:`edl_tpu.tools.rec_bench`):
+
+- **dedup + coalesce** — per-batch unique-key extraction and sort, ONE
+  pipelined batched-gather RPC per owner pod (ClientPool,
+  ``call_async``), scatter back to slot order;
+- **hot-key cache tier** — a client LRU for the zipf head with
+  write-through updates and version fencing, plus a replicated hot
+  tier for the hottest keys routed by a capacity-weighted consistent
+  hash (à la Kraken, ISCA'22);
+- **lookup–compute overlap** — double-buffered prefetch issuing batch
+  i+1's gathers while batch i's dense step runs, accounted as the
+  ``embed_wait`` TimeLedger state.
+
+Tables are *elastic*: a resize reshards row spans through span-overlap
+paste + peer range-reads, byte-identical to stop-resume (bench-gated).
+
+See docs/recommender.md for the design and runbook.
+"""
+
+from edl_tpu.embed.cache import HotKeyCache, HotSetTracker  # noqa: F401
+from edl_tpu.embed.client import (EmbedPlaneClient,  # noqa: F401
+                                  EmbedPrefetcher)
+from edl_tpu.embed.sharding import (owner_index,  # noqa: F401
+                                    partition_by_owner, reshard_moves,
+                                    row_spans)
+from edl_tpu.embed.table import EmbedShardServer, TableSpec  # noqa: F401

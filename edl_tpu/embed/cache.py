@@ -1,0 +1,194 @@
+"""Hot-key cache tier: client LRU with write-through + hot-set tracking.
+
+The zipf head is the whole game for CTR lookup traffic (Li et al.,
+OSDI'14 measured >90% of accesses hitting <10% of keys): a small LRU
+over (table, key) -> row absorbs the head so only the tail crosses the
+wire. Two coherence rules keep a cached row from ever being SERVED
+stale:
+
+- **write-through**: the client applies its own optimizer deltas to
+  the cached copies of the keys it wrote back — the exact same
+  ``row -= lr * grad`` the owner applies, so the cached bytes equal
+  the served bytes without a refetch;
+- **version fencing**: every entry is stamped with the owner's table
+  version at fetch/update time, and the lookup protocol returns the
+  keys OTHER writers touched since the client's watermark
+  (:meth:`EmbedPlaneClient.lookup` refetches any of those it served
+  from cache in the same batch — see client.py). ``put`` never lets an
+  older fetch overwrite a newer stamp, so a slow prefetch landing
+  after a write-through cannot roll a row back.
+
+:class:`HotSetTracker` measures the head empirically (decayed access
+counts) — its top-k is what the owner pushes to the replicated hot
+tier and what ``rec_bench`` compares against the predicted head mass.
+"""
+
+import heapq
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from edl_tpu.obs import metrics as obs_metrics
+
+CACHE_HITS = obs_metrics.counter(
+    "edl_embed_cache_hits_total", "embedding lookups served from the "
+    "hot-key cache")
+CACHE_MISSES = obs_metrics.counter(
+    "edl_embed_cache_misses_total", "embedding lookups that crossed "
+    "the wire")
+CACHE_EVICTIONS = obs_metrics.counter(
+    "edl_embed_cache_evictions_total", "hot-key cache LRU evictions")
+CACHE_STALE = obs_metrics.counter(
+    "edl_embed_cache_stale_refetch_total", "cache entries version-"
+    "fenced stale by a concurrent writer and refetched")
+
+
+class HotKeyCache(object):
+    """Thread-safe LRU over ``(table, key) -> (row, version)``.
+
+    ``capacity`` counts entries (a row is one fixed-size ndarray; the
+    byte budget is ``capacity * dim * 4`` and the caller sizes it).
+    Thread safety matters because the overlap prefetcher's worker
+    thread fills the cache while the training thread write-throughs."""
+
+    def __init__(self, capacity):
+        self._capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()  # (table, key) -> [row, version]
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.stale_refetches = 0
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def get_many(self, table, keys):
+        """Partition sorted-unique ``keys``: ``(hit_rows, miss_mask)``
+        where ``hit_rows`` maps key -> row COPY (the caller scatters it
+        into a batch buffer; a copy keeps a concurrent write-through
+        from mutating a row mid-scatter) and ``miss_mask`` is a bool
+        array over ``keys`` marking the ones that must cross the wire."""
+        hits = {}
+        miss = np.ones(len(keys), bool)
+        with self._lock:
+            for i, k in enumerate(keys):
+                ent = self._entries.get((table, int(k)))
+                if ent is None:
+                    continue
+                self._entries.move_to_end((table, int(k)))
+                hits[int(k)] = ent[0].copy()
+                miss[i] = False
+            self.hits += len(hits)
+            self.misses += int(miss.sum())
+        CACHE_HITS.inc(len(hits))
+        CACHE_MISSES.inc(int(miss.sum()))
+        return hits, miss
+
+    def put_many(self, table, keys, rows, version):
+        """Insert fetched rows stamped with the owner ``version``. An
+        existing entry with a NEWER stamp wins (a prefetch that raced a
+        write-through must not resurrect the pre-update row)."""
+        evicted = 0
+        with self._lock:
+            for k, row in zip(keys, rows):
+                tk = (table, int(k))
+                ent = self._entries.get(tk)
+                if ent is not None and ent[1] > version:
+                    continue
+                self._entries[tk] = [np.array(row, copy=True), version]
+                self._entries.move_to_end(tk)
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+                evicted += 1
+            self.evictions += evicted
+        if evicted:
+            CACHE_EVICTIONS.inc(evicted)
+
+    def apply_update(self, table, keys, deltas, version):
+        """Write-through: ``row -= delta`` on the cached copies of
+        ``keys`` (missing keys are skipped — absence is a miss, never
+        an error), restamped to the post-writeback ``version``."""
+        with self._lock:
+            for k, delta in zip(keys, deltas):
+                ent = self._entries.get((table, int(k)))
+                if ent is not None:
+                    ent[0] -= delta
+                    ent[1] = version
+
+    def invalidate(self, table=None, keys=None, stale=False):
+        """Drop entries: everything, one table, or specific keys.
+        ``stale=True`` counts the drops as version-fence refetches
+        (the caller is about to fetch them fresh)."""
+        dropped = 0
+        with self._lock:
+            if table is None:
+                dropped = len(self._entries)
+                self._entries.clear()
+            elif keys is None:
+                for tk in [tk for tk in self._entries
+                           if tk[0] == table]:
+                    del self._entries[tk]
+                    dropped += 1
+            else:
+                for k in keys:
+                    if self._entries.pop((table, int(k)),
+                                         None) is not None:
+                        dropped += 1
+            if stale:
+                self.stale_refetches += dropped
+        if stale and dropped:
+            CACHE_STALE.inc(dropped)
+        return dropped
+
+    def stats(self):
+        with self._lock:
+            looked = self.hits + self.misses
+            return {"entries": len(self._entries),
+                    "capacity": self._capacity,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions,
+                    "stale_refetches": self.stale_refetches,
+                    "hit_rate": (self.hits / looked) if looked else None}
+
+
+class HotSetTracker(object):
+    """Decayed access counts -> the measured hot set.
+
+    ``observe(keys, counts)`` folds one deduped batch in;  every
+    ``decay_every`` batches all counts are halved, so the top-k tracks
+    the RECENT head (a key that went cold decays out in
+    ``O(log count)`` windows instead of squatting forever)."""
+
+    def __init__(self, decay_every=64):
+        self._lock = threading.Lock()
+        self._counts = {}  # key -> decayed count
+        self._decay_every = int(decay_every)
+        self._batches = 0
+
+    def observe(self, keys, counts=None):
+        with self._lock:
+            if counts is None:
+                counts = np.ones(len(keys))
+            for k, c in zip(keys, counts):
+                k = int(k)
+                self._counts[k] = self._counts.get(k, 0.0) + float(c)
+            self._batches += 1
+            if self._batches % self._decay_every == 0:
+                self._counts = {k: c / 2.0
+                                for k, c in self._counts.items()
+                                if c >= 1.0}
+
+    def top(self, n):
+        """The ``n`` hottest keys, hottest first (ties by key for
+        determinism)."""
+        with self._lock:
+            best = heapq.nlargest(
+                int(n), ((c, -k) for k, c in self._counts.items()))
+        return [-nk for _, nk in best]
+
+    def count(self, key):
+        with self._lock:
+            return self._counts.get(int(key), 0.0)

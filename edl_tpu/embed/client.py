@@ -1,0 +1,695 @@
+"""Client side of the embedding plane: dedup, hot cache, overlap.
+
+:class:`EmbedPlaneClient` is the trainer-facing surface. One
+``lookup(table, keys)`` is, on the optimized path:
+
+1. **dedup** — ``np.unique`` collapses the batch's duplicate keys (the
+   zipf head makes this a large factor) and yields the inverse map for
+   the final scatter back to slot order;
+2. **cache** — the :class:`~edl_tpu.embed.cache.HotKeyCache` absorbs
+   unique keys it holds; only true misses cross the wire;
+3. **hot tier** — misses in the advertised hot set route to their
+   capacity-weighted consistent-hash replica (``embed.hot_lookup``,
+   version-checked; a stale or dead replica falls back to the owner);
+4. **coalesce** — the remaining misses, already sorted, partition into
+   per-owner contiguous runs and leave as ONE pipelined batched-gather
+   RPC per owner (``ClientPool.call_async``), all in flight at once;
+5. **fence** — each owner's response carries its table version and the
+   keys OTHER writers touched since this client's watermark; any such
+   key that was served from cache in this same batch is invalidated
+   and refetched before the batch is returned (counted as a
+   ``stale_refetch`` — a fenced row is never served), and the
+   watermark advances;
+6. **scatter** — rows land in unique order and ``inverse`` scatters
+   them to slot order. A short or missing response is a typed
+   :class:`~edl_tpu.utils.errors.EmbedLookupError`, never silent
+   zeros.
+
+``writeback(table, keys, grads, lr)`` accumulates duplicate-slot
+gradients per unique key (``np.add.at``), ships one fused
+``rows -= lr * acc`` per owner, and **write-through** applies the same
+float32 subtract to the cached copies — so cached bytes equal served
+bytes with no refetch.
+
+Failed coalesced RPCs are requeued under a
+:class:`~edl_tpu.robustness.policy.RetryPolicy` (chaos points
+``embed.lookup`` / ``embed.writeback`` fire INSIDE the retried
+closure, so an armed ``error_once`` proves fail→requeue→exact-result);
+retries are counted exactly (``edl_embed_*_retries_total``).
+
+Consistency model: a single writer sees its own writes exactly
+(write-through + fencing); concurrent writers are fenced on every
+owner round-trip. Hot-tier serves are additionally marked cache-served
+so an owner response in the same batch fences them too; a batch served
+ENTIRELY by replicas is bounded-stale by one advertisement period (the
+Kraken trade).
+
+:class:`EmbedPrefetcher` is the overlap half: a worker thread runs
+batch i+1's ``lookup`` while the training thread computes batch i;
+``wait()`` charges only the residual join to the new ``embed_wait``
+TimeLedger state. The worker must NOT touch the process ledger —
+background concurrency is not the training thread's lost time.
+Prefetched rows reflect the table before the overlapped step's
+writeback lands (bounded staleness 1, the async parameter-server
+regime); the cache's version guard keeps a late prefetch from rolling
+cached rows back.
+"""
+
+import queue
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from edl_tpu.distill.consistent_hash import ConsistentHash
+from edl_tpu.embed import sharding
+from edl_tpu.embed.cache import HotKeyCache, HotSetTracker
+from edl_tpu.obs import metrics as obs_metrics
+from edl_tpu.obs.ledger import LEDGER
+from edl_tpu.robustness import faults
+from edl_tpu.robustness.policy import RetryPolicy
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+LOOKUP_MS = obs_metrics.histogram(
+    "edl_embed_lookup_ms", "wall time of one batch embedding lookup "
+    "(dedup + cache + gather + scatter)")
+WRITEBACK_MS = obs_metrics.histogram(
+    "edl_embed_writeback_ms", "wall time of one batch sparse "
+    "optimizer write-back")
+UNIQUE_FRAC = obs_metrics.gauge(
+    "edl_embed_unique_key_frac", "unique/total key fraction of the "
+    "last looked-up batch (zipf head collapse)")
+LOOKUP_RETRIES = obs_metrics.counter(
+    "edl_embed_lookup_retries_total", "coalesced gather RPCs requeued "
+    "after a failure")
+WRITEBACK_RETRIES = obs_metrics.counter(
+    "edl_embed_writeback_retries_total", "write-back RPCs requeued "
+    "after a failure")
+HOT_HITS = obs_metrics.counter(
+    "edl_embed_hot_tier_hits_total", "lookups served by a replicated "
+    "hot-tier node instead of the owner")
+
+
+class EmbedPlaneClient(object):
+    """One trainer's handle on the sharded tables (module docstring).
+
+    ``endpoints`` maps member id -> RPC endpoint (the owner set);
+    ``pool`` is the shared :class:`~edl_tpu.rpc.pool.ClientPool`. The
+    table map (vocab, dim per table) comes from ``embed.manifest`` of
+    any member. ``cache_entries=0`` disables the cache tier;
+    ``dedup=False`` is the NAIVE arc: one RPC per key, no dedup, no
+    cache — kept as a first-class mode so rec_bench's baseline is the
+    real code path, not a simulation."""
+
+    def __init__(self, pool, endpoints, client_id="trainer-0",
+                 cache_entries=0, dedup=True, capacities=None,
+                 retry=None, decay_every=64):
+        self._pool = pool
+        self._client_id = str(client_id)
+        self._dedup = bool(dedup)
+        self._lock = threading.Lock()
+        self._retry = retry if retry is not None else RetryPolicy(
+            max_attempts=4, base_delay=0.02, max_delay=0.5, seed=0)
+        self._cache = (HotKeyCache(cache_entries) if cache_entries
+                       else None)
+        self._tracker = HotSetTracker(decay_every=decay_every)
+        self._capacities = dict(capacities or {})
+        self._hot_ring = ConsistentHash()
+        self._hot_keys = {}    # table -> set of advertised hot keys
+        self._since = {}       # (table, member) -> watermark version
+        self._lookups = 0
+        self._keys_total = 0
+        self._unique_total = 0
+        self._writebacks = 0
+        self._retries = 0
+        self._adopt(dict(endpoints))
+        self._tables = self._load_manifest()
+
+    # -- membership --------------------------------------------------------
+
+    def _adopt(self, endpoints):
+        self._endpoints = {str(m): e for m, e in endpoints.items()}
+        self._members = sorted(self._endpoints)
+        self._hot_ring.update(self._members, weights=self._capacities)
+
+    def _load_manifest(self):
+        man = self._pool.call(self._endpoints[self._members[0]],
+                              "embed.manifest")
+        if sorted(man["members"]) != self._members:
+            raise errors.StaleStateError(
+                "embed manifest members %r != client view %r"
+                % (sorted(man["members"]), self._members))
+        return {name: (int(t["vocab"]), int(t["dim"]))
+                for name, t in man["tables"].items()}
+
+    def resize(self, endpoints):
+        """Adopt a post-reshard member view. Rows changed owners, so
+        everything keyed on the old layout goes: watermarks reset (the
+        servers raised their log floors anyway), the cache drops
+        wholesale, and the hot set must be re-advertised against the
+        new ring."""
+        with self._lock:
+            self._adopt(dict(endpoints))
+            self._since.clear()
+            self._hot_keys.clear()
+        if self._cache is not None:
+            self._cache.invalidate()
+        self._tables = self._load_manifest()
+        logger.info("embed client %s: adopted %d-member layout",
+                    self._client_id, len(self._members))
+
+    def tables(self):
+        return dict(self._tables)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _watermark(self, table, owner):
+        with self._lock:
+            return self._since.get((table, owner), 0)
+
+    def _advance(self, table, owner, version):
+        with self._lock:
+            key = (table, owner)
+            if version > self._since.get(key, 0):
+                self._since[key] = version
+
+    def _attempt(self, method, table, owner, args):
+        """One attempt of one coalesced RPC: the chaos point fires
+        before the request leaves (INSIDE the retried path), then the
+        call goes out synchronously."""
+        if faults.PLANE is not None:
+            faults.PLANE.fire(method, table=table, member=owner,
+                              endpoint=self._endpoints[owner])
+        return self._pool.call(self._endpoints[owner], method, table,
+                               *args)
+
+    def _requeue(self, method, table, owner, args, first_err, err_cls,
+                 counter):
+        """A failed coalesced RPC is requeued under the retry policy;
+        every extra attempt is counted exactly. Exhausting the budget
+        raises the typed error — the step fails loudly, rows are never
+        fabricated."""
+        def note(_attempt, _exc):
+            with self._lock:
+                self._retries += 1
+            counter.inc()
+        with self._lock:
+            self._retries += 1
+        counter.inc()
+        try:
+            return self._retry.call(
+                lambda: self._attempt(method, table, owner, args),
+                on_retry=note)
+        except errors.EdlError as e:
+            raise err_cls(
+                "%s to %s failed after retries: %r (first: %r)"
+                % (method, owner, e, first_err)) from e
+
+    def _gather_round(self, method, table, parts, extra_of, err_cls,
+                      counter):
+        """Issue one pipelined RPC per owner (all in flight at once),
+        then collect — failures drop to the requeue path. Yields
+        ``(owner, keys, result)`` in owner order."""
+        pending = []
+        for owner, kslice in parts:
+            args = (kslice,) + tuple(extra_of(owner, kslice))
+            fut = err = None
+            try:
+                if faults.PLANE is not None:
+                    faults.PLANE.fire(method, table=table, member=owner,
+                                      endpoint=self._endpoints[owner])
+                fut = self._pool.call_async(self._endpoints[owner],
+                                            method, table, *args)
+            except errors.EdlError as e:
+                err = e
+            pending.append((owner, kslice, args, fut, err))
+        out = []
+        for owner, kslice, args, fut, err in pending:
+            res = None
+            if fut is not None:
+                try:
+                    res = fut.result()
+                except errors.EdlError as e:
+                    err = e
+            if res is None:
+                res = self._requeue(method, table, owner, args, err,
+                                    err_cls, counter)
+            out.append((owner, kslice, res))
+        return out
+
+    @staticmethod
+    def _check_rows(table, owner, keys, rows, dim):
+        rows = np.asarray(rows, np.float32)
+        if rows.shape != (keys.size, dim):
+            raise errors.EmbedLookupError(
+                "embed.lookup %s from %s: got %s rows for %d keys — "
+                "refusing to zero-fill" % (table, owner,
+                                           rows.shape, keys.size))
+        return rows
+
+    # -- lookup ------------------------------------------------------------
+
+    def lookup(self, table, keys):
+        """Rows for ``keys`` in slot order, ``[len(keys), dim]``."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        vocab, dim = self._tables[table]
+        if keys.size == 0:
+            return np.empty((0, dim), np.float32)
+        with LOOKUP_MS.time_ms():
+            if not self._dedup:
+                return self._lookup_naive(table, keys, vocab, dim)
+            return self._lookup_fast(table, keys, vocab, dim)
+
+    def _lookup_naive(self, table, keys, vocab, dim):
+        """The baseline arc: one RPC per SLOT (duplicates and all) —
+        pipelined so it measures per-request overhead, not client
+        serialization."""
+        n = len(self._members)
+        out = np.empty((keys.size, dim), np.float32)
+        pending = []
+        for i, k in enumerate(keys):
+            owner = self._members[int(
+                sharding.owner_index(int(k), vocab, n))]
+            one = np.array([k], np.int64)
+            fut = err = None
+            try:
+                if faults.PLANE is not None:
+                    faults.PLANE.fire("embed.lookup", table=table,
+                                      member=owner,
+                                      endpoint=self._endpoints[owner])
+                fut = self._pool.call_async(
+                    self._endpoints[owner], "embed.lookup", table, one,
+                    self._watermark(table, owner), self._client_id)
+            except errors.EdlError as e:
+                err = e
+            pending.append((i, owner, one, fut, err))
+        for i, owner, one, fut, err in pending:
+            res = None
+            if fut is not None:
+                try:
+                    res = fut.result()
+                except errors.EdlError as e:
+                    err = e
+            if res is None:
+                res = self._requeue(
+                    "embed.lookup", table, owner,
+                    (one, self._watermark(table, owner),
+                     self._client_id), err, errors.EmbedLookupError,
+                    LOOKUP_RETRIES)
+            out[i] = self._check_rows(table, owner, one, res["rows"],
+                                      dim)[0]
+            self._advance(table, owner, int(res["version"]))
+        with self._lock:
+            self._lookups += 1
+            self._keys_total += keys.size
+            self._unique_total += keys.size
+        UNIQUE_FRAC.set(1.0)
+        return out
+
+    def _lookup_fast(self, table, keys, vocab, dim):
+        uniq, inv, counts = np.unique(keys, return_inverse=True,
+                                      return_counts=True)
+        with self._lock:
+            self._lookups += 1
+            self._keys_total += keys.size
+            self._unique_total += uniq.size
+        UNIQUE_FRAC.set(uniq.size / keys.size)
+        self._tracker.observe(uniq, counts)
+        urows = np.empty((uniq.size, dim), np.float32)
+        filled = np.zeros(uniq.size, bool)
+        cache_served = np.zeros(uniq.size, bool)
+        if self._cache is not None:
+            hits, miss = self._cache.get_many(table, uniq)
+            for pos in np.flatnonzero(~miss):
+                urows[pos] = hits[int(uniq[pos])]
+            filled[~miss] = True
+            cache_served[~miss] = True
+        need_pos = np.flatnonzero(~filled)
+        # hot-tier routing for advertised keys among the misses
+        need_pos = self._hot_round(table, uniq, need_pos, urows,
+                                   filled, cache_served, vocab, dim)
+        # coalesced owner gathers for what remains
+        touched_all = set()
+        wholesale = False
+        contacted = set()
+        if need_pos.size:
+            need = uniq[need_pos]
+            parts = sharding.partition_by_owner(need, vocab,
+                                                self._members)
+            results = self._gather_round(
+                "embed.lookup", table, parts,
+                lambda owner, ks: (self._watermark(table, owner),
+                                   self._client_id),
+                errors.EmbedLookupError, LOOKUP_RETRIES)
+            for owner, kslice, res in results:
+                contacted.add(owner)
+                rows = self._check_rows(table, owner, kslice,
+                                        res["rows"], dim)
+                version = int(res["version"])
+                pos = np.searchsorted(uniq, kslice)
+                urows[pos] = rows
+                filled[pos] = True
+                if self._cache is not None:
+                    self._cache.put_many(table, kslice, rows, version)
+                t = res.get("touched")
+                if t is None:
+                    wholesale = True
+                else:
+                    touched_all.update(
+                        int(x) for x in np.asarray(t).reshape(-1))
+                self._advance(table, owner, version)
+        # An owner whose keys were ALL served locally was never
+        # contacted, so its touch log could not reach us. Probe it with
+        # an empty gather (one tiny RPC per such owner, pipelined like
+        # any part) so the fence below sees every writer — exactness
+        # must not depend on this batch happening to miss.
+        if cache_served.any():
+            n = len(self._members)
+            served_owners = {
+                self._members[int(i)] for i in np.atleast_1d(
+                    sharding.owner_index(uniq[cache_served], vocab, n))}
+            probes = [(owner, np.empty(0, np.int64))
+                      for owner in sorted(served_owners - contacted)]
+            if probes:
+                for owner, _, res in self._gather_round(
+                        "embed.lookup", table, probes,
+                        lambda owner, ks: (self._watermark(table, owner),
+                                           self._client_id),
+                        errors.EmbedLookupError, LOOKUP_RETRIES):
+                    t = res.get("touched")
+                    if t is None:
+                        wholesale = True
+                    else:
+                        touched_all.update(
+                            int(x) for x in np.asarray(t).reshape(-1))
+                    self._advance(table, owner, int(res["version"]))
+        if not filled.all():
+            raise errors.EmbedLookupError(
+                "embed %s: %d unique keys unserved — refusing to "
+                "zero-fill" % (table, int((~filled).sum())))
+        # version fence: cache-served keys a concurrent writer touched
+        # are refetched IN THIS BATCH — a fenced row is never returned
+        self._fence_round(table, uniq, urows, cache_served,
+                          touched_all, wholesale, vocab, dim)
+        return urows[inv]
+
+    def _hot_round(self, table, uniq, need_pos, urows, filled,
+                   cache_served, vocab, dim):
+        """Serve advertised hot keys from their consistent-hash
+        replicas. Partial and best-effort by contract: anything a
+        replica cannot answer at the fenced version (or a dead replica
+        entirely) stays in the miss set and rides the owner path."""
+        hot = self._hot_keys.get(table)
+        if not hot or need_pos.size == 0:
+            return need_pos
+        n = len(self._members)
+        groups = {}  # replica -> (positions list, min_version)
+        for pos in need_pos:
+            k = int(uniq[pos])
+            if k not in hot:
+                continue
+            replica, _ = self._hot_ring.get_node(
+                "hot:%s:%d" % (table, k))
+            owner = self._members[int(sharding.owner_index(k, vocab, n))]
+            if replica is None or replica == owner:
+                continue
+            plist, minv = groups.setdefault(replica, ([], 0))
+            plist.append(pos)
+            groups[replica] = (plist, max(minv, self._watermark(
+                table, owner)))
+        for replica, (plist, minv) in groups.items():
+            ks = np.array([int(uniq[p]) for p in plist], np.int64)
+            try:
+                res = self._pool.call(self._endpoints[replica],
+                                      "embed.hot_lookup", table, ks,
+                                      minv)
+            except errors.EdlError:
+                continue  # dead replica: the owner path covers it
+            found = np.asarray(res["found"], bool)
+            rows = np.asarray(res["rows"], np.float32)
+            got = 0
+            for j, p in enumerate(plist):
+                if not found[j]:
+                    continue
+                urows[p] = rows[got]
+                filled[p] = True
+                # replica serves ride the same fence as cache serves
+                cache_served[p] = True
+                got += 1
+            if got:
+                HOT_HITS.inc(got)
+                if self._cache is not None:
+                    self._cache.put_many(table, ks[found],
+                                         rows[:got], minv)
+        return np.flatnonzero(~filled)
+
+    def _fence_round(self, table, uniq, urows, cache_served,
+                     touched_all, wholesale, vocab, dim):
+        if self._cache is None and not wholesale:
+            return
+        if wholesale:
+            suspect = np.flatnonzero(cache_served)
+            if self._cache is not None:
+                # the log no longer covers our watermark (truncation or
+                # reshard): nothing cached is provably fresh
+                self._cache.invalidate(table)
+        else:
+            if not touched_all:
+                return
+            suspect = np.flatnonzero(
+                cache_served
+                & np.isin(uniq, np.fromiter(touched_all, np.int64)))
+        if suspect.size == 0:
+            return
+        stale_keys = uniq[suspect]
+        if self._cache is not None and not wholesale:
+            self._cache.invalidate(table, keys=stale_keys, stale=True)
+        parts = sharding.partition_by_owner(stale_keys, vocab,
+                                            self._members)
+        results = self._gather_round(
+            "embed.lookup", table, parts,
+            lambda owner, ks: (self._watermark(table, owner),
+                               self._client_id),
+            errors.EmbedLookupError, LOOKUP_RETRIES)
+        for owner, kslice, res in results:
+            rows = self._check_rows(table, owner, kslice, res["rows"],
+                                    dim)
+            version = int(res["version"])
+            pos = np.searchsorted(uniq, kslice)
+            urows[pos] = rows
+            if self._cache is not None:
+                self._cache.put_many(table, kslice, rows, version)
+            self._advance(table, owner, version)
+
+    # -- write-back --------------------------------------------------------
+
+    def writeback(self, table, keys, grads, lr):
+        """Sparse optimizer step: ``row[k] -= lr * sum(grads at k)``.
+
+        Duplicate-slot gradients are accumulated per unique key HERE
+        (``np.add.at``), so the owner applies one fused subtract per
+        key — the exact float math of a single-host reference step —
+        and the write-through to the cache repeats the identical
+        subtract, keeping cached bytes equal to served bytes."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        vocab, dim = self._tables[table]
+        grads = np.asarray(grads, np.float32).reshape(keys.size, dim)
+        if keys.size == 0:
+            return
+        with WRITEBACK_MS.time_ms():
+            uniq, inv = np.unique(keys, return_inverse=True)
+            acc = np.zeros((uniq.size, dim), np.float32)
+            np.add.at(acc, inv, grads)
+            parts = sharding.partition_by_owner(uniq, vocab,
+                                                self._members)
+            results = self._gather_round(
+                "embed.writeback", table, parts,
+                lambda owner, ks: (
+                    acc[np.searchsorted(uniq, ks)], np.float32(lr),
+                    self._watermark(table, owner), self._client_id),
+                errors.EmbedWritebackError, WRITEBACK_RETRIES)
+            touched_all = set()
+            wholesale = False
+            for owner, kslice, res in results:
+                version = int(res["version"])
+                if self._cache is not None:
+                    deltas = (np.float32(lr)
+                              * acc[np.searchsorted(uniq, kslice)])
+                    self._cache.apply_update(table, kslice, deltas,
+                                             version)
+                t = res.get("touched")
+                if t is None:
+                    wholesale = True
+                else:
+                    touched_all.update(
+                        int(x) for x in np.asarray(t).reshape(-1))
+                self._advance(table, owner, version)
+            with self._lock:
+                self._writebacks += 1
+            if self._cache is not None:
+                if wholesale:
+                    self._cache.invalidate(table)
+                elif touched_all:
+                    # other writers' keys: drop, the next lookup
+                    # refetches them fresh
+                    self._cache.invalidate(
+                        table,
+                        keys=np.fromiter(touched_all, np.int64))
+
+    # -- hot-set advertisement ---------------------------------------------
+
+    def push_hot(self, table, n):
+        """Advertise the measured hot set: fetch the ``n`` hottest
+        rows fresh from their owners (stamped with the owner version)
+        and push each to its capacity-weighted consistent-hash replica
+        (``embed.hot_put``; keys whose replica IS the owner are
+        skipped — the owner already serves them). Returns the number
+        of keys now advertised. Call periodically (the bench does it
+        every resync period); between calls the tier is bounded-stale
+        by the owner-version check on every hot_lookup."""
+        vocab, dim = self._tables[table]
+        top = np.array(sorted(int(k) for k in self._tracker.top(n)),
+                       np.int64)
+        if top.size == 0:
+            return 0
+        nmem = len(self._members)
+        results = self._gather_round(
+            "embed.lookup", table,
+            sharding.partition_by_owner(top, vocab, self._members),
+            lambda owner, ks: (self._watermark(table, owner),
+                               self._client_id),
+            errors.EmbedLookupError, LOOKUP_RETRIES)
+        advertised = set()
+        for owner, kslice, res in results:
+            rows = self._check_rows(table, owner, kslice, res["rows"],
+                                    dim)
+            version = int(res["version"])
+            self._advance(table, owner, version)
+            if self._cache is not None:
+                self._cache.put_many(table, kslice, rows, version)
+            groups = {}
+            for j, k in enumerate(kslice):
+                replica, _ = self._hot_ring.get_node(
+                    "hot:%s:%d" % (table, int(k)))
+                if replica is None or replica == owner:
+                    advertised.add(int(k))
+                    continue
+                groups.setdefault(replica, []).append(j)
+            for replica, idxs in groups.items():
+                try:
+                    self._pool.call(
+                        self._endpoints[replica], "embed.hot_put",
+                        table, kslice[idxs], rows[idxs], version)
+                    advertised.update(int(kslice[j]) for j in idxs)
+                except errors.EdlError as e:
+                    logger.warning("embed hot_put to %s failed: %r "
+                                   "(keys stay owner-served)",
+                                   replica, e)
+        with self._lock:
+            self._hot_keys[table] = advertised
+        return len(advertised)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self):
+        with self._lock:
+            stats = {
+                "lookups": self._lookups,
+                "writebacks": self._writebacks,
+                "keys_total": self._keys_total,
+                "unique_total": self._unique_total,
+                "unique_key_frac": (self._unique_total
+                                    / self._keys_total
+                                    if self._keys_total else None),
+                "retries": self._retries,
+                "members": len(self._members),
+                "hot_advertised": sum(len(s) for s
+                                      in self._hot_keys.values()),
+            }
+        p99 = LOOKUP_MS.percentile(0.99)
+        if p99 is not None:
+            stats["lookup_p99_ms"] = p99
+        if self._cache is not None:
+            for k, v in self._cache.stats().items():
+                stats["cache_%s" % k] = v
+        stats = {k: v for k, v in stats.items() if v is not None}
+        return obs_metrics.mirror_stats("edl_embed", stats)
+
+    def cache(self):
+        return self._cache
+
+    def tracker(self):
+        return self._tracker
+
+
+class EmbedPrefetcher(object):
+    """Double-buffered lookup–compute overlap (module docstring).
+
+    ``submit(keys)`` hands batch i+1's lookup to the worker thread;
+    ``wait()`` (training thread only) joins the oldest outstanding
+    lookup, charging the residual to the ``embed_wait`` ledger state —
+    with the pipeline warm that residual is near zero, which is
+    exactly what rec_bench's overlap arc gates."""
+
+    def __init__(self, client, table):
+        self._client = client
+        self._table = table
+        self._q = queue.Queue()
+        self._pending = deque()
+        self._lock = threading.Lock()
+        self.waits = 0
+        self.wait_s = 0.0
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="embed-prefetch")
+        self._worker.start()
+
+    def _run(self):
+        # NOTE: no LEDGER marks here — the ledger models the TRAINING
+        # thread's wall clock; this thread's time is the overlap win.
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            keys, ticket = item
+            try:
+                ticket[0] = self._client.lookup(self._table, keys)
+            except BaseException as e:  # noqa: BLE001 — surfaced at wait()
+                ticket[1] = e
+            ticket[2].set()
+
+    def submit(self, keys):
+        """Queue one batch's lookup; FIFO with :meth:`wait`."""
+        ticket = [None, None, threading.Event()]
+        self._pending.append(ticket)
+        self._q.put((np.asarray(keys, np.int64).reshape(-1), ticket))
+
+    def depth(self):
+        return len(self._pending)
+
+    def wait(self):
+        """Rows of the oldest submitted batch; the join (and only the
+        join) is accounted as ``embed_wait``."""
+        if not self._pending:
+            raise errors.StatusError("EmbedPrefetcher.wait with no "
+                                     "submitted batch")
+        ticket = self._pending.popleft()
+        t0 = time.perf_counter()
+        with LEDGER.state("embed_wait"):
+            ticket[2].wait()
+        with self._lock:
+            self.waits += 1
+            self.wait_s += time.perf_counter() - t0
+        if ticket[1] is not None:
+            raise ticket[1]
+        return ticket[0]
+
+    def stats(self):
+        with self._lock:
+            return {"waits": self.waits, "wait_s": self.wait_s,
+                    "outstanding": len(self._pending)}
+
+    def close(self):
+        self._q.put(None)
+        self._worker.join(timeout=10)

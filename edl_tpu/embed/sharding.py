@@ -1,0 +1,104 @@
+"""Row-span ownership for sharded embedding tables.
+
+A table of ``vocab`` rows is split across the member set exactly the
+way the parallel plane splits a leading axis across a mesh axis:
+:func:`edl_tpu.parallel.costmodel.device_spans` over a one-axis mesh
+whose size is the member count. Members are SORTED before span
+assignment, so ownership is a pure function of the member-id set —
+any process, given the same membership, derives the same span map with
+no coordination round (the relay-tree/partner-ring idiom).
+
+The span layout is contiguous equal blocks of ``ceil(vocab / n)``
+rows (the last block clamped), which makes the per-key owner a single
+integer divide (:func:`owner_index`) — the client's per-batch
+partition is one vectorized ``//`` over the deduped key array, not a
+hash-ring walk per key.
+
+:func:`reshard_moves` is the elastic half: the rows a member's NEW
+span needs that its OLD span did not hold, attributed to the old
+owners that hold them — the same span-overlap math PlacedTarget runs
+at restore time, on row intervals.
+"""
+
+import numpy as np
+
+from edl_tpu.parallel.costmodel import device_spans
+
+
+def row_spans(vocab, members):
+    """``{member_id: (lo, hi)}`` row spans of a ``vocab``-row table
+    over ``members`` (any iterable of ids; sorted internally so the
+    map is deterministic under shuffled membership). Members past the
+    table (more members than rows) own empty spans ``(vocab, vocab)``."""
+    ordered = sorted(members)
+    if not ordered:
+        return {}
+    spans = device_spans((int(vocab),), ("rows",),
+                         {"rows": len(ordered)})
+    return {m: spans[i][0] for i, m in enumerate(ordered)}
+
+
+def block_rows(vocab, n_members):
+    """Rows per ownership block: ``ceil(vocab / n)``."""
+    return -(-int(vocab) // int(n_members))
+
+
+def owner_index(keys, vocab, n_members):
+    """Vectorized owner index (position in the SORTED member list) for
+    ``keys`` (int ndarray). ``keys // block`` by construction of
+    :func:`row_spans`."""
+    return np.asarray(keys) // block_rows(vocab, n_members)
+
+
+def partition_by_owner(keys, vocab, members):
+    """Split a SORTED unique key array into per-owner runs:
+    ``[(member_id, keys_slice)]``, empty owners omitted. Sorted input
+    means each owner's keys are one contiguous slice (a view, not a
+    copy) — the coalesced-gather fast path."""
+    ordered = sorted(members)
+    keys = np.asarray(keys)
+    if keys.size == 0:
+        return []
+    idx = owner_index(keys, vocab, len(ordered))
+    # run boundaries of the (sorted, hence non-decreasing) owner index
+    cuts = np.flatnonzero(np.diff(idx)) + 1
+    out = []
+    for lo, hi in zip(np.r_[0, cuts], np.r_[cuts, keys.size]):
+        out.append((ordered[int(idx[lo])], keys[lo:hi]))
+    return out
+
+
+def span_overlap(a, b):
+    """Intersection of two row spans, or None when disjoint."""
+    lo, hi = max(a[0], b[0]), min(a[1], b[1])
+    return (lo, hi) if lo < hi else None
+
+
+def reshard_moves(vocab, old_members, new_members, me):
+    """What member ``me`` must do to hold its NEW span after a
+    membership change: ``(new_span, keep, pulls)`` where ``keep`` is
+    the sub-span already held locally (new ∩ old, possibly None) and
+    ``pulls`` is ``[(src_member, (lo, hi))]`` — the remaining rows
+    attributed to the OLD owners that hold them, in row order. The
+    union of ``keep`` and the pull spans tiles ``new_span`` exactly."""
+    old = row_spans(vocab, old_members)
+    new_span = row_spans(vocab, new_members)[me]
+    keep = span_overlap(old.get(me, (0, 0)), new_span)
+    pulls = []
+    for src, src_span in sorted(old.items(), key=lambda kv: kv[1]):
+        if src == me:
+            continue
+        ov = span_overlap(src_span, new_span)
+        if ov is None:
+            continue
+        # rows already held locally never cross the wire
+        if keep is not None:
+            if ov[0] >= keep[0] and ov[1] <= keep[1]:
+                continue
+            if ov[0] < keep[0]:
+                pulls.append((src, (ov[0], min(ov[1], keep[0]))))
+            if ov[1] > keep[1]:
+                pulls.append((src, (max(ov[0], keep[1]), ov[1])))
+        else:
+            pulls.append((src, ov))
+    return new_span, keep, pulls

@@ -1,0 +1,344 @@
+"""Host-side embedding shard server: the parameter-server plane.
+
+Each member hosts the row span :func:`~edl_tpu.embed.sharding.row_spans`
+assigns it, per table, as plain float32 host ndarrays, and serves them
+over the v2 tensor-frame RPC plane:
+
+- ``embed.manifest()`` — tables, spans, member view, version.
+- ``embed.lookup(table, keys, since)`` — batched gather of owned rows
+  plus the version fence: the response carries the server's current
+  ``version`` and the keys OTHER writers touched in ``(since, now]``
+  (``touched``; None when the dirty log no longer reaches back to
+  ``since`` — the client must invalidate wholesale).
+- ``embed.writeback(table, keys, grads, lr, since, writer)`` — the
+  sparse optimizer step ``rows[keys] -= lr * grads`` on DEDUPED keys
+  (the client accumulated duplicate-key gradients; the server applies
+  one fused subtract so the arithmetic matches a single-host reference
+  exactly). Bumps the version and logs (version, writer, keys).
+- ``embed.read_range(table, lo, hi)`` — row-range read for the elastic
+  reshard path (the ``state.read`` analogue, on rows).
+- ``embed.hot_put`` / ``embed.hot_lookup`` — the replicated hot tier:
+  owners push their measured-hottest rows (stamped with their version)
+  to replicas chosen by a capacity-weighted consistent hash; replicas
+  serve them back only at the exact stamped version (StaleStateError
+  otherwise — a replica NEVER serves a row older than the client's
+  watermark; the client falls back to the owner).
+
+Elasticity: :meth:`EmbedShardServer.reshard` re-derives this member's
+span under a new member set, keeps the overlap in place (span-overlap
+paste), range-reads the rest from the OLD owners, and adopts the new
+membership — with the dirty-log floor advanced so every client's next
+version fence forces a wholesale cache invalidation. Pull-then-adopt
+ordering across the fleet (every member pulls against the old spans
+before any member adopts) makes the reshard byte-identical to
+stop-resume; ``rec_bench`` gates exactly that.
+"""
+
+import threading
+from collections import deque
+
+import numpy as np
+
+from edl_tpu.rpc.server import RpcServer
+from edl_tpu.utils import errors
+from edl_tpu.utils.logger import logger
+
+#: bounded dirty log: total keys remembered across writeback records.
+#: Past this the floor advances and older watermarks fence wholesale.
+DIRTY_LOG_KEYS = 1 << 16
+
+
+class TableSpec(object):
+    """Shape + deterministic initializer of one embedding table.
+
+    ``init_fn(vocab, dim, lo, hi) -> float32 [hi-lo, dim]`` must be a
+    pure function of the ABSOLUTE row index so that any span layout
+    materializes the same logical table — that is what makes a resized
+    fleet's table equal a fresh one's, and the reshard byte-identity
+    provable. :func:`seeded_rows` is the default."""
+
+    def __init__(self, vocab, dim, init_fn=None, seed=0):
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.seed = int(seed)
+        self._init_fn = init_fn
+
+    def materialize(self, lo, hi):
+        if self._init_fn is not None:
+            rows = self._init_fn(self.vocab, self.dim, lo, hi)
+        else:
+            rows = seeded_rows(self.vocab, self.dim, lo, hi, self.seed)
+        rows = np.ascontiguousarray(rows, np.float32)
+        if rows.shape != (hi - lo, self.dim):
+            raise ValueError("init_fn returned %s, want %s"
+                             % (rows.shape, (hi - lo, self.dim)))
+        return rows
+
+
+def seeded_rows(vocab, dim, lo, hi, seed=0):
+    """Default init: N(0, 0.01) rows, each a pure function of its
+    absolute row index (one tiny per-row RandomState — init-time only),
+    so every span layout slices the same logical table."""
+    out = np.empty((hi - lo, dim), np.float32)
+    for r in range(lo, hi):
+        rng = np.random.RandomState((seed * 1000003 + r) % (1 << 31))
+        out[r - lo] = rng.standard_normal(dim) * 0.01
+    return out
+
+
+class EmbedShardServer(object):
+    """One member's shard of every table (module docstring)."""
+
+    def __init__(self, member_id, tables, members, host="127.0.0.1",
+                 port=0, dirty_log_keys=DIRTY_LOG_KEYS):
+        from edl_tpu.embed import sharding
+        self.member_id = str(member_id)
+        self._tables = dict(tables)  # name -> TableSpec
+        self._members = sorted(str(m) for m in members)
+        self._lock = threading.Lock()
+        self._version = 0
+        # dirty log: (version, writer, table, keys ndarray); floor =
+        # oldest version the log still covers (since < floor - 1 means
+        # the fence can no longer enumerate, answer touched=None)
+        self._dirty = deque()
+        self._dirty_keys = 0
+        self._dirty_budget = int(dirty_log_keys)
+        self._log_floor = 0
+        self._spans = {}  # table -> (lo, hi)
+        self._rows = {}   # table -> float32 [hi-lo, dim]
+        for name, spec in self._tables.items():
+            # a joiner constructed with the PRE-join membership owns an
+            # empty span until reshard()/adopt() pulls its share in
+            lo, hi = sharding.row_spans(spec.vocab, self._members).get(
+                self.member_id, (spec.vocab, spec.vocab))
+            self._spans[name] = (lo, hi)
+            self._rows[name] = spec.materialize(lo, hi)
+        # replicated hot tier: table -> {key: (row, owner_version)}
+        self._hot = {}
+        self._server = RpcServer(host=host, port=port)
+        self._server.register("embed.manifest", self._rpc_manifest)
+        self._server.register("embed.lookup", self._rpc_lookup)
+        self._server.register("embed.writeback", self._rpc_writeback)
+        self._server.register("embed.read_range", self._rpc_read_range)
+        self._server.register("embed.hot_put", self._rpc_hot_put)
+        self._server.register("embed.hot_lookup", self._rpc_hot_lookup)
+        self._server.start()
+
+    @property
+    def endpoint(self):
+        return self._server.endpoint
+
+    @property
+    def version(self):
+        with self._lock:
+            return self._version
+
+    def span(self, table):
+        with self._lock:
+            return self._spans[table]
+
+    def members(self):
+        with self._lock:
+            return list(self._members)
+
+    def stop(self):
+        self._server.stop()
+
+    # -- fencing helpers (call under self._lock) ----------------------------
+
+    def _log_write(self, writer, table, keys):
+        self._version += 1
+        self._dirty.append((self._version, writer, table,
+                            np.array(keys, np.int64)))
+        self._dirty_keys += len(keys)
+        while self._dirty_keys > self._dirty_budget and self._dirty:
+            old = self._dirty.popleft()
+            self._dirty_keys -= len(old[3])
+            self._log_floor = old[0]
+        return self._version
+
+    def _touched_since(self, since, table, exclude_writer=None):
+        """Keys of ``table`` written in ``(since, version]`` by anyone
+        but ``exclude_writer``; None when the log was truncated past
+        ``since`` (the wholesale-invalidate sentinel)."""
+        since = int(since)
+        if since < self._log_floor:
+            return None
+        touched = [rec[3] for rec in self._dirty
+                   if rec[0] > since and rec[2] == table
+                   and rec[1] != exclude_writer]
+        if not touched:
+            return np.empty(0, np.int64)
+        return np.unique(np.concatenate(touched))
+
+    def _owned(self, table, keys):
+        lo, hi = self._spans[table]
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        if keys.size and (keys.min() < lo or keys.max() >= hi):
+            raise errors.NotFoundError(
+                "embed %s: keys outside span [%d, %d) of member %s"
+                % (table, lo, hi, self.member_id))
+        return keys, lo
+
+    # -- served methods ----------------------------------------------------
+
+    def _rpc_manifest(self):
+        with self._lock:
+            return {"member": self.member_id,
+                    "members": list(self._members),
+                    "version": self._version,
+                    "tables": {name: {"vocab": spec.vocab,
+                                      "dim": spec.dim,
+                                      "span": list(self._spans[name])}
+                               for name, spec in self._tables.items()}}
+
+    def _rpc_lookup(self, table, keys, since=0, reader=None):
+        with self._lock:
+            keys, lo = self._owned(table, keys)
+            rows = self._rows[table][keys - lo]
+            touched = self._touched_since(since, table,
+                                          exclude_writer=reader)
+            return {"rows": rows, "version": self._version,
+                    "touched": touched}
+
+    def _rpc_writeback(self, table, keys, grads, lr, since=0,
+                       writer=None):
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            keys, lo = self._owned(table, keys)
+            # deduped keys: one fused subtract, bit-identical to the
+            # single-host reference step on the same accumulated grads
+            self._rows[table][keys - lo] -= np.float32(lr) * grads
+            touched = self._touched_since(since, table,
+                                          exclude_writer=writer)
+            version = self._log_write(writer, table, keys)
+            return {"version": version, "touched": touched}
+
+    def _rpc_read_range(self, table, lo, hi):
+        with self._lock:
+            span_lo, span_hi = self._spans[table]
+            lo, hi = int(lo), int(hi)
+            if lo < span_lo or hi > span_hi:
+                raise errors.NotFoundError(
+                    "embed %s: range [%d, %d) outside span [%d, %d)"
+                    % (table, lo, hi, span_lo, span_hi))
+            return {"rows": self._rows[table][lo - span_lo:hi - span_lo],
+                    "version": self._version}
+
+    # -- replicated hot tier -----------------------------------------------
+
+    def _rpc_hot_put(self, table, keys, rows, version):
+        """Accept hot rows from an owner, stamped with ITS version.
+        Newer stamps win; an older push never rolls a row back."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        rows = np.asarray(rows, np.float32)
+        version = int(version)
+        with self._lock:
+            tier = self._hot.setdefault(table, {})
+            for k, row in zip(keys, rows):
+                old = tier.get(int(k))
+                if old is not None and old[1] > version:
+                    continue
+                tier[int(k)] = (np.array(row, copy=True), version)
+            return {"held": len(tier)}
+
+    def _rpc_hot_lookup(self, table, keys, min_version):
+        """Serve replicated hot rows at stamp >= ``min_version``.
+        Partial by design: ``found`` masks the keys served; the client
+        routes the rest to the owner. A key held only at an OLDER stamp
+        is a miss, never a stale serve."""
+        keys = np.asarray(keys, np.int64).reshape(-1)
+        min_version = int(min_version)
+        with self._lock:
+            tier = self._hot.get(table, {})
+            found = np.zeros(len(keys), bool)
+            rows = []
+            for i, k in enumerate(keys):
+                ent = tier.get(int(k))
+                if ent is not None and ent[1] >= min_version:
+                    found[i] = True
+                    rows.append(ent[0])
+            return {"found": found,
+                    "rows": (np.stack(rows) if rows
+                             else np.empty((0,), np.float32))}
+
+    # -- elastic reshard ---------------------------------------------------
+
+    def reshard(self, new_members, endpoints, pool):
+        """Phase 1 of the two-phase reshard: pull this member's NEW
+        span against the OLD owners' still-live spans. ``endpoints``
+        maps OLD member ids to their RPC endpoints; ``pool`` is a
+        shared ClientPool. Returns the staged state; nothing is
+        swapped until :meth:`adopt` — so every member pulls a
+        consistent pre-reshard snapshot before any member mutates.
+
+        Rows already held locally are pasted from the live arrays
+        (span overlap); the rest arrive as pipelined ``embed.read_range``
+        futures, one per (old owner, table, sub-span)."""
+        from edl_tpu.embed import sharding
+        new_members = sorted(str(m) for m in new_members)
+        staged = {}
+        pending = []
+        with self._lock:
+            for name, spec in self._tables.items():
+                new_span, keep, pulls = sharding.reshard_moves(
+                    spec.vocab, self._members, new_members,
+                    self.member_id)
+                lo, hi = new_span
+                rows = np.zeros((hi - lo, spec.dim), np.float32)
+                filled = np.zeros(hi - lo, bool)
+                if keep is not None:
+                    old_lo = self._spans[name][0]
+                    rows[keep[0] - lo:keep[1] - lo] = \
+                        self._rows[name][keep[0] - old_lo:
+                                         keep[1] - old_lo]
+                    filled[keep[0] - lo:keep[1] - lo] = True
+                staged[name] = (new_span, rows, filled)
+                for src, (plo, phi) in pulls:
+                    fut = pool.call_async(endpoints[src],
+                                          "embed.read_range", name,
+                                          plo, phi)
+                    pending.append((name, plo, phi, src, fut))
+        for name, plo, phi, src, fut in pending:
+            (new_lo, _), rows, filled = staged[name]
+            got = np.asarray(fut.result()["rows"], np.float32)
+            if got.shape[0] != phi - plo:
+                raise errors.StaleStateError(
+                    "reshard pull %s[%d:%d) from %s returned %d rows"
+                    % (name, plo, phi, src, got.shape[0]))
+            rows[plo - new_lo:phi - new_lo] = got
+            filled[plo - new_lo:phi - new_lo] = True
+        for name, (span, rows, filled) in staged.items():
+            if not filled.all():
+                raise errors.StaleStateError(
+                    "reshard %s: %d rows uncovered"
+                    % (name, int((~filled).sum())))
+        return {"members": new_members,
+                "tables": {name: (span, rows)
+                           for name, (span, rows, _) in staged.items()}}
+
+    def adopt(self, staged):
+        """Phase 2: swap in the staged spans/rows and the new member
+        view. The dirty-log floor advances to the new version, so any
+        client watermark from before the reshard fences wholesale
+        (rows moved owners; per-key deltas are meaningless now). The
+        hot tier is dropped for the same reason."""
+        with self._lock:
+            self._members = list(staged["members"])
+            for name, (span, rows) in staged["tables"].items():
+                self._spans[name] = tuple(span)
+                self._rows[name] = rows
+            self._version += 1
+            self._dirty.clear()
+            self._dirty_keys = 0
+            self._log_floor = self._version
+            self._hot.clear()
+        logger.info("embed %s: adopted %d-member layout at v%d",
+                    self.member_id, len(self._members), self._version)
+
+    # test/bench surface ---------------------------------------------------
+
+    def table_bytes(self, table):
+        """(span, rows copy) — bench/test byte-identity probes."""
+        with self._lock:
+            return self._spans[table], self._rows[table].copy()

@@ -53,6 +53,83 @@ class DeepFM(nn.Module):
         return first_order + second_order + deep + bias  # logit
 
 
+class DeepFMTail(nn.Module):
+    """DeepFM decoupled from ``nn.Embed``: the dense tail over
+    PRE-GATHERED embedding rows.
+
+    ``rows`` is ``[batch, num_fields, 1 + embed_dim]`` — each field's
+    first-order weight and k-dim factor side by side, the combined-row
+    layout :func:`combined_embedding_table` produces and the sharded
+    embedding plane (:mod:`edl_tpu.embed`) serves. The op sequence and
+    parameter names (``deep_%d``, ``deep_out``, ``bias``) replicate
+    :class:`DeepFM` exactly, so the dense model's non-embedding param
+    subtree (:func:`dense_tail_params`) applies verbatim and the
+    logits match the dense path bitwise — the parity test's contract.
+    """
+
+    num_fields: int
+    embed_dim: int = 8
+    mlp_dims: Sequence[int] = (128, 64)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, rows):
+        w = rows[..., 0].astype(self.dtype)        # [b, fields]
+        vs = rows[..., 1:].astype(self.dtype)      # [b, fields, k]
+        # same python-sum accumulation order as the dense loop
+        first_order = sum(w[:, i] for i in range(self.num_fields))
+        sum_sq = jnp.square(vs.sum(axis=1))
+        sq_sum = jnp.square(vs).sum(axis=1)
+        second_order = 0.5 * (sum_sq - sq_sum).sum(axis=-1)
+        h = vs.reshape(vs.shape[0], self.num_fields * self.embed_dim)
+        for j, dim in enumerate(self.mlp_dims):
+            h = nn.relu(nn.Dense(dim, dtype=self.dtype,
+                                 param_dtype=jnp.float32,
+                                 name="deep_%d" % j)(h))
+        deep = nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="deep_out")(h)[:, 0]
+        bias = self.param("bias", nn.initializers.zeros, ())
+        return first_order + second_order + deep + bias  # logit
+
+
+def dense_tail_params(params):
+    """The subtree of a dense :class:`DeepFM` param tree that
+    :class:`DeepFMTail` consumes directly (everything but the
+    embeddings)."""
+    return {k: v for k, v in params.items()
+            if k.startswith("deep_") or k == "bias"}
+
+
+def field_offsets(field_vocab_sizes):
+    """Per-field base row in the flat combined table (fields stacked
+    in declaration order)."""
+    return np.concatenate(
+        [[0], np.cumsum(field_vocab_sizes)[:-1]]).astype(np.int64)
+
+
+def flat_ctr_keys(fields, field_vocab_sizes):
+    """Map per-field category ids ``[batch, num_fields]`` to keys into
+    the single flat combined table: ``id + field_offset``, flattened
+    row-major so ``reshape(batch, num_fields)`` restores slot order."""
+    offs = field_offsets(field_vocab_sizes)
+    return (np.asarray(fields, np.int64) + offs[None, :]).reshape(-1)
+
+
+def combined_embedding_table(params, field_vocab_sizes):
+    """Flatten a dense param tree's per-field embeddings into ONE host
+    table ``[sum(vocabs), 1 + k]``: row = ``[linear | factor]``. One
+    flat table means one sharded-plane table serves every field, and a
+    single gather of :func:`flat_ctr_keys` feeds :class:`DeepFMTail`."""
+    rows = []
+    for i, _ in enumerate(field_vocab_sizes):
+        lin = np.asarray(params["linear_%d" % i]["embedding"],
+                         np.float32)
+        fac = np.asarray(params["factor_%d" % i]["embedding"],
+                         np.float32)
+        rows.append(np.concatenate([lin, fac], axis=1))
+    return np.ascontiguousarray(np.concatenate(rows, axis=0))
+
+
 def create_model_and_loss(field_vocab_sizes=(100,) * 10, embed_dim=8,
                           mlp_dims=(64, 32)):
     model = DeepFM(field_vocab_sizes, embed_dim, mlp_dims)

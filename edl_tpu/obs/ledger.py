@@ -58,8 +58,8 @@ GOODPUT_KEY = "goodput"
 #: the exclusive states, in display order. ``compute`` is goodput;
 #: everything else is attributed badput; ``idle`` is the default owner
 #: of any second no instrumentation point claimed.
-STATES = ("compute", "data_wait", "ckpt_block", "resize_pause",
-          "restore", "barrier_wait", "idle")
+STATES = ("compute", "data_wait", "embed_wait", "ckpt_block",
+          "resize_pause", "restore", "barrier_wait", "idle")
 
 GOODPUT_STATE = "compute"
 

@@ -115,6 +115,19 @@ redundancy.rebuild       rebuild side, before a dead owner's shards
                          fallback drill: the restore must degrade to
                          the FS rung byte-identically and emit a
                          redundancy.fallback event (reason: fault)
+embed.lookup             client side, before a coalesced embedding
+                         gather leaves (ctx: table, member, endpoint)
+                         — fired INSIDE the retried closure, so
+                         ``error_once`` proves fail → requeue → the
+                         exact rows (retries counted, no silently-
+                         zero rows); a persistent ``error`` surfaces
+                         as a typed EmbedLookupError
+embed.writeback          client side, before a sparse optimizer
+                         write-back leaves (ctx: table, member,
+                         endpoint) — same requeue contract; a
+                         persistent ``error`` is EmbedWritebackError
+                         and the step fails rather than letting table
+                         and cache diverge
 ======================== ===============================================
 
 Fault kinds:

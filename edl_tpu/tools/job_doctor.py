@@ -55,11 +55,16 @@ _DETECTOR_RANK = {"flight_recorder": 0, "stale_publisher": 1,
                   "queue_saturation": 5, "live_resize_fallback": 6,
                   "reshard_fallback": 7, "rebuild_fallback": 8,
                   "prewarm_miss": 9, "decode_slot_starvation": 10,
-                  "prefix_thrash": 11}
+                  "prefix_thrash": 11, "embed_wait_dominant": 12}
 
 #: prefix_thrash fires only past this many LRU evictions — below it the
 #: cache is still warming up and eviction/hit ratios are noise
 _PREFIX_THRASH_EVICTIONS = 8
+
+#: embed_wait_dominant fires only when embedding-lookup wait both TOPS
+#: the fleet's badput attribution and claims at least this share of
+#: total wall time — a dominant-but-tiny state is not worth a finding
+_EMBED_WAIT_MIN_SHARE = 0.10
 
 
 def collect(coord):
@@ -229,6 +234,58 @@ def _decode_findings(obs):
     return findings
 
 
+def _embed_findings(obs):
+    """Doctor-local detector for the sharded embedding plane:
+
+    - embed_wait_dominant: summed across the fleet's ledger counters
+      (``edl_time_seconds_total``), ``embed_wait`` tops the badput
+      attribution AND claims at least ``_EMBED_WAIT_MIN_SHARE`` of
+      total wall time — training threads spend their stalls waiting on
+      embedding gathers. The levers, in order of cheapness: enable or
+      deepen the prefetch overlap (EmbedPrefetcher — the wait should
+      collapse to the residual join), grow the hot-key cache, widen
+      the hot replica tier (push_hot), or add embedding-owner pods so
+      per-owner gathers shrink. The finding pins the pod losing the
+      most time so a single slow owner link is distinguishable from a
+      fleet-wide capacity gap."""
+    from edl_tpu.obs.ledger import GOODPUT_STATE, pod_states
+    fleet = {}
+    worst_pod, worst_wait = None, 0.0
+    for pod in sorted(obs):
+        states = pod_states(obs[pod])
+        if not states:
+            continue
+        for state, sec in states.items():
+            fleet[state] = fleet.get(state, 0.0) + sec
+        wait = states.get("embed_wait", 0.0)
+        if wait > worst_wait:
+            worst_pod, worst_wait = pod, wait
+    total = sum(fleet.values())
+    wait = fleet.get("embed_wait", 0.0)
+    badput = {s: v for s, v in fleet.items()
+              if s != GOODPUT_STATE and v > 0}
+    if not badput or total <= 0 or wait <= 0:
+        return []
+    if max(badput, key=badput.get) != "embed_wait" \
+            or wait / total < _EMBED_WAIT_MIN_SHARE:
+        return []
+    return [{
+        "pod": worst_pod,
+        "detector": "embed_wait_dominant",
+        "severity": "warn",
+        "summary": ("embedding lookups dominate badput: %.1fs of "
+                    "embed_wait (%.0f%% of %.1fs fleet wall time), "
+                    "worst on %s — overlap lookups with compute "
+                    "(embed.EmbedPrefetcher), grow the hot-key cache "
+                    "/ replica tier, or add embedding-owner pods"
+                    % (wait, 100.0 * wait / total, total, worst_pod)),
+        "metric": "edl_time_seconds_total",
+        "value": round(wait, 3),
+        "threshold": round(_EMBED_WAIT_MIN_SHARE * total, 3),
+        "event_ids": [],
+    }]
+
+
 def _live_resize_findings(obs, timeline):
     """Doctor-local detectors for the live-resize path (these need no
     HealthMonitor — they read the obs docs directly):
@@ -387,7 +444,8 @@ def diagnose(collected, now=None):
         # still fire on monitor-less jobs (bench runs, early startup)
         report["findings"] = _render_findings(
             _live_resize_findings(obs, timeline)
-            + _decode_findings(obs), timeline, ())
+            + _decode_findings(obs) + _embed_findings(obs),
+            timeline, ())
         if report["findings"]:
             head = report["findings"][0]
             report["summary"] += ("; %d doctor-local finding(s), "
@@ -405,7 +463,7 @@ def diagnose(collected, now=None):
     out_findings = _render_findings(
         list(health.get("findings") or ())
         + _live_resize_findings(obs, timeline)
-        + _decode_findings(obs),
+        + _decode_findings(obs) + _embed_findings(obs),
         timeline, health.get("events") or ())
     report["findings"] = out_findings
     report["slos"] = health.get("slos") or []

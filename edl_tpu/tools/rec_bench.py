@@ -1,0 +1,370 @@
+"""Recommender (sharded-embedding) benchmark: the three stacked
+lookup optimisations, proven one arc at a time, plus elastic-reshard
+byte-identity.
+
+Workload: DeepFM on synthetic zipf(1.1) CTR traffic — per-field
+category ids drawn zipf-skewed (rejection-truncated to the vocab), the
+classic parameter-server regime where a few head keys absorb most of
+the traffic. Every arc trains the SAME pregenerated stream against a
+pristine copy of the same sharded table (embedding rows only; the
+dense tail is frozen so the arcs are deterministic and comparable):
+
+- ``naive``       — one RPC per SLOT key (``dedup=False``), no cache:
+                    the per-key parameter-server baseline.
+- ``dedup``       — unique-key extraction + ONE coalesced gather per
+                    owner pod (pipelined ``call_async``).
+- ``dedup_cache`` — dedup plus the hot-key LRU and the replicated hot
+                    tier (periodic ``push_hot``); this arc also
+                    measures cache hit rate against the predicted
+                    zipf head mass.
+- ``overlap``     — dedup+cache behind :class:`EmbedPrefetcher`:
+                    batch i+1's gathers in flight while batch i's
+                    dense step runs; ``embed_wait`` collapses to the
+                    residual join.
+
+The resize sub-arc reruns the dedup_cache config with a mid-run
+membership change (reshard via span-overlap paste + peer range reads)
+and replays the second half from a stop-resume snapshot on a fresh
+fleet; the stitched final tables must be BYTE-identical
+(``identical_ok``).
+
+Gates (exit code): dedup_cache ≥ ``min_speedup``× naive rows/s,
+overlap's measured embed_wait strictly below the no-overlap arc's,
+and resize byte-identity.
+
+Usage:
+    JAX_PLATFORMS=cpu python -m edl_tpu.tools.rec_bench --micro
+    python -m edl_tpu.tools.rec_bench --steps 200 --field-vocab 4096
+
+Emits one JSON object (schema "rec_bench/v1").
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+#: hermetic tier-1 smoke defaults: small enough for CI seconds, skewed
+#: enough that dedup+cache visibly beat per-key gathers
+MICRO = {"fields": 4, "field_vocab": 512, "embed_dim": 4,
+         "mlp_dims": (16, 8), "batch_size": 64, "steps": 16,
+         "naive_steps": 6, "zipf_a": 1.1, "cache_entries": 256,
+         "hot_n": 16, "owners": 2, "resize_to": 3, "lr": 0.05,
+         "min_speedup": 1.5, "seed": 7}
+FULL = {"fields": 8, "field_vocab": 4096, "embed_dim": 8,
+        "mlp_dims": (64, 32), "batch_size": 256, "steps": 120,
+        "naive_steps": 20, "zipf_a": 1.1, "cache_entries": 4096,
+        "hot_n": 128, "owners": 4, "resize_to": 6, "lr": 0.05,
+        "min_speedup": 1.5, "seed": 7}
+
+
+def _zipf_fields(rng, a, vocab, size):
+    """Zipf(a) ranks rejection-truncated to [0, vocab) — the key skew
+    stays exact zipf over the finite support."""
+    out = np.empty(size, np.int64)
+    have = 0
+    while have < size:
+        z = rng.zipf(a, size * 2)
+        z = z[z <= vocab][:size - have]
+        out[have:have + z.size] = z - 1
+        have += z.size
+    return out
+
+
+def predicted_head_mass(a, vocab, top):
+    """Fraction of zipf(a) traffic (truncated to ``vocab`` ranks) that
+    the ``top`` hottest keys receive: H(top,a) / H(vocab,a)."""
+    ranks = np.arange(1, vocab + 1, dtype=np.float64)
+    mass = ranks ** -a
+    return float(mass[:min(top, vocab)].sum() / mass.sum())
+
+
+def _make_traffic(cfg):
+    """Pregenerated (flat_keys, labels) per step — every arc replays
+    the identical stream."""
+    from edl_tpu.models import deepfm
+    rng = np.random.RandomState(cfg["seed"])
+    vocabs = (cfg["field_vocab"],) * cfg["fields"]
+    steps = []
+    for _ in range(cfg["steps"]):
+        fields = np.stack(
+            [_zipf_fields(rng, cfg["zipf_a"], cfg["field_vocab"],
+                          cfg["batch_size"])
+             for _ in range(cfg["fields"])], axis=1)
+        keys = deepfm.flat_ctr_keys(fields, vocabs)
+        labels = (rng.rand(cfg["batch_size"]) < 0.5).astype(np.float32)
+        steps.append((keys, labels))
+    return steps
+
+
+def _build_model(cfg):
+    """Dense DeepFM init -> (combined host table, jitted grad step)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from edl_tpu.models import deepfm
+    vocabs = (cfg["field_vocab"],) * cfg["fields"]
+    model = deepfm.DeepFM(vocabs, cfg["embed_dim"],
+                          tuple(cfg["mlp_dims"]))
+    dummy = jnp.zeros((1, cfg["fields"]), jnp.int32)
+    params = model.init(jax.random.PRNGKey(cfg["seed"]), dummy)["params"]
+    table = deepfm.combined_embedding_table(params, vocabs)
+    tail = deepfm.DeepFMTail(cfg["fields"], cfg["embed_dim"],
+                             tuple(cfg["mlp_dims"]))
+    tail_params = deepfm.dense_tail_params(params)
+
+    @jax.jit
+    def step(rows, labels):
+        def loss_fn(rows):
+            logit = tail.apply({"params": tail_params}, rows)
+            return optax.sigmoid_binary_cross_entropy(logit,
+                                                      labels).mean()
+        return jax.value_and_grad(loss_fn)(rows)
+
+    dim = 1 + cfg["embed_dim"]
+
+    def run_step(rows_flat, labels):
+        rows = rows_flat.reshape(cfg["batch_size"], cfg["fields"], dim)
+        loss, g = step(rows, labels)
+        return float(loss), np.asarray(g, np.float32).reshape(-1, dim)
+
+    return table, run_step, dim
+
+
+def _table_spec(table):
+    from edl_tpu.embed import TableSpec
+    return TableSpec(table.shape[0], table.shape[1],
+                     init_fn=lambda v, d, lo, hi: table[lo:hi])
+
+
+def _spawn_fleet(table, members):
+    from edl_tpu.embed import EmbedShardServer
+    spec = _table_spec(table)
+    return {m: EmbedShardServer(m, {"ctr": spec}, members)
+            for m in members}
+
+
+def _stitched(servers):
+    return np.concatenate(
+        [servers[m].table_bytes("ctr")[1] for m in sorted(servers)])
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    vals = sorted(values)
+    return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+
+def _run_arc(cfg, table, run_step, traffic, dedup, cache_entries,
+             overlap):
+    """One arc: fresh fleet + pristine table, train ``traffic``.
+    Returns (summary, client stats)."""
+    from edl_tpu.embed import EmbedPlaneClient, EmbedPrefetcher
+    from edl_tpu.rpc.pool import ClientPool
+    members = ["own%d" % i for i in range(cfg["owners"])]
+    servers = _spawn_fleet(table, members)
+    pool = ClientPool(timeout=30.0)
+    prefetcher = None
+    try:
+        client = EmbedPlaneClient(
+            pool, {m: s.endpoint for m, s in servers.items()},
+            dedup=dedup, cache_entries=cache_entries)
+        waits_ms = []
+        wait_s = 0.0
+        if overlap:
+            prefetcher = EmbedPrefetcher(client, "ctr")
+            prefetcher.submit(traffic[0][0])
+        t0 = time.perf_counter()
+        for i, (keys, labels) in enumerate(traffic):
+            tw = time.perf_counter()
+            if overlap:
+                rows = prefetcher.wait()
+                if i + 1 < len(traffic):
+                    prefetcher.submit(traffic[i + 1][0])
+            else:
+                rows = client.lookup("ctr", keys)
+            dt = time.perf_counter() - tw
+            wait_s += dt
+            waits_ms.append(dt * 1e3)
+            _, grads = run_step(rows, labels)
+            client.writeback("ctr", keys, grads, cfg["lr"])
+            if cache_entries and (i + 1) % 4 == 0:
+                client.push_hot("ctr", cfg["hot_n"])
+        wall = time.perf_counter() - t0
+        stats = client.stats()
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
+        for s in servers.values():
+            s.stop()
+        pool.close()
+    slots = sum(k.size for k, _ in traffic)
+    out = {
+        "steps": len(traffic),
+        "wall_ms": round(wall * 1e3, 3),
+        "rows_s": round(slots / wall, 1) if wall else None,
+        "lookup_ms_p50": round(_percentile(waits_ms, 0.50) or 0.0, 3),
+        "lookup_ms_p99": round(_percentile(waits_ms, 0.99) or 0.0, 3),
+        "embed_wait_s": round(wait_s, 4),
+        "unique_key_frac": stats.get("unique_key_frac"),
+        "retries": stats.get("retries", 0),
+    }
+    if cache_entries:
+        out["cache_hit_rate"] = stats.get("cache_hit_rate")
+        out["cache_evictions"] = stats.get("cache_evictions")
+        out["hot_advertised"] = stats.get("hot_advertised", 0)
+    return out
+
+
+def _run_resize(cfg, table, run_step, traffic):
+    """The elasticity proof: mid-run reshard vs stop-resume replay,
+    stitched tables compared bytewise."""
+    from edl_tpu.embed import EmbedPlaneClient
+    from edl_tpu.rpc.pool import ClientPool
+    half = len(traffic) // 2
+    members = ["own%d" % i for i in range(cfg["owners"])]
+    grown = ["own%d" % i for i in range(cfg["resize_to"])]
+    pause_ms = None
+
+    def train(client, stream):
+        for keys, labels in stream:
+            rows = client.lookup("ctr", keys)
+            _, grads = run_step(rows, labels)
+            client.writeback("ctr", keys, grads, cfg["lr"])
+
+    # live arc: train, reshard mid-run (pull-then-adopt), train on
+    servers = _spawn_fleet(table, members)
+    pool = ClientPool(timeout=30.0)
+    try:
+        client = EmbedPlaneClient(
+            pool, {m: s.endpoint for m, s in servers.items()},
+            cache_entries=cfg["cache_entries"])
+        train(client, traffic[:half])
+        snapshot = _stitched(servers)  # what stop-resume resumes from
+        t0 = time.perf_counter()
+        from edl_tpu.embed import EmbedShardServer
+        for m in grown:
+            if m not in servers:
+                # a joiner constructed against the OLD membership holds
+                # an empty span; its rows arrive via the reshard pulls
+                servers[m] = EmbedShardServer(m, {"ctr": _table_spec(
+                    table)}, members)
+        eps = {m: s.endpoint for m, s in servers.items()}
+        staged = {m: servers[m].reshard(grown, eps, pool)
+                  for m in grown}
+        for m in grown:
+            servers[m].adopt(staged[m])
+        client.resize({m: servers[m].endpoint for m in grown})
+        pause_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        train(client, traffic[half:])
+        live_final = _stitched({m: servers[m] for m in grown})
+    finally:
+        for s in servers.values():
+            s.stop()
+        pool.close()
+
+    # stop-resume arm: fresh grown fleet seeded from the snapshot,
+    # replay the identical second half
+    resumed = _spawn_fleet(snapshot, grown)
+    pool = ClientPool(timeout=30.0)
+    try:
+        client = EmbedPlaneClient(
+            pool, {m: s.endpoint for m, s in resumed.items()},
+            cache_entries=cfg["cache_entries"])
+        train(client, traffic[half:])
+        resume_final = _stitched(resumed)
+    finally:
+        for s in resumed.values():
+            s.stop()
+        pool.close()
+    return {
+        "steps": len(traffic),
+        "resize_at_step": half,
+        "members_from": len(members),
+        "members_to": len(grown),
+        "reshard_pause_ms": pause_ms,
+        "identical_ok": (live_final.shape == resume_final.shape
+                         and live_final.tobytes()
+                         == resume_final.tobytes()),
+    }
+
+
+def run(mode="micro", **overrides):
+    """Run every arc + the resize proof; returns the report dict."""
+    cfg = dict(MICRO if mode == "micro" else FULL)
+    cfg.update({k: v for k, v in overrides.items() if v is not None})
+    table, run_step, dim = _build_model(cfg)
+    traffic = _make_traffic(cfg)
+    # jit warm-up outside every timed arc
+    run_step(table[traffic[0][0]].reshape(-1), traffic[0][1])
+
+    naive = _run_arc(cfg, table, run_step, traffic[:cfg["naive_steps"]],
+                     dedup=False, cache_entries=0, overlap=False)
+    dedup = _run_arc(cfg, table, run_step, traffic, dedup=True,
+                     cache_entries=0, overlap=False)
+    cached = _run_arc(cfg, table, run_step, traffic, dedup=True,
+                      cache_entries=cfg["cache_entries"], overlap=False)
+    overlap = _run_arc(cfg, table, run_step, traffic, dedup=True,
+                       cache_entries=cfg["cache_entries"], overlap=True)
+    resize = _run_resize(cfg, table, run_step, traffic)
+
+    speedup = (round(cached["rows_s"] / naive["rows_s"], 3)
+               if naive["rows_s"] else None)
+    head = predicted_head_mass(
+        cfg["zipf_a"], cfg["field_vocab"],
+        max(1, cfg["cache_entries"] // cfg["fields"]))
+    gates = {
+        "speedup_ok": (speedup is not None
+                       and speedup >= cfg["min_speedup"]),
+        "overlap_ok": (overlap["embed_wait_s"]
+                       < cached["embed_wait_s"]),
+        "identical_ok": resize["identical_ok"],
+    }
+    return {
+        "schema": "rec_bench/v1",
+        "mode": mode,
+        "config": {k: (list(v) if isinstance(v, tuple) else v)
+                   for k, v in cfg.items()},
+        "table_rows": int(table.shape[0]),
+        "table_dim": dim,
+        "arcs": {"naive": naive, "dedup": dedup,
+                 "dedup_cache": cached, "overlap": overlap},
+        "speedup_dedup_cache_vs_naive": speedup,
+        "predicted_head_mass": round(head, 4),
+        "resize": resize,
+        "identical_ok": resize["identical_ok"],
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--micro", action="store_true",
+                    help="hermetic CI-sized run (the tier-1 smoke)")
+    ap.add_argument("--steps", type=int, default=None)
+    ap.add_argument("--batch-size", type=int, default=None)
+    ap.add_argument("--fields", type=int, default=None)
+    ap.add_argument("--field-vocab", type=int, default=None)
+    ap.add_argument("--owners", type=int, default=None,
+                    help="embedding-owner pods before the resize")
+    ap.add_argument("--resize-to", type=int, default=None)
+    ap.add_argument("--cache-entries", type=int, default=None)
+    ap.add_argument("--zipf-a", type=float, default=None)
+    args = ap.parse_args(argv)
+    out = run(mode="micro" if args.micro else "full",
+              steps=args.steps, batch_size=args.batch_size,
+              fields=args.fields, field_vocab=args.field_vocab,
+              owners=args.owners, resize_to=args.resize_to,
+              cache_entries=args.cache_entries, zipf_a=args.zipf_a)
+    json.dump(out, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -179,6 +179,22 @@ class RedundancyError(EdlError):
     the parity tier is strictly best-effort."""
 
 
+class EmbedLookupError(EdlError):
+    """A sharded embedding gather could not complete after the retry
+    budget (owner dead, persistent fault, or a shape-corrupt response
+    — a short/zero-row answer is promoted to this error, NEVER padded
+    with silent zeros). The training step that needed the rows fails
+    loudly instead of learning on fabricated embeddings."""
+
+
+class EmbedWritebackError(EdlError):
+    """A sparse embedding optimizer write-back could not be applied
+    after the retry budget. The owner either applied the update or
+    never saw it (the writeback RPC is one fused subtract); the caller
+    must treat the step as failed rather than proceed with the table
+    and cache divergent."""
+
+
 class LiveResizeError(EdlError):
     """The in-place live resize could not complete (out of scope,
     drain/reshard failure, rolled back). The trainer is left on its

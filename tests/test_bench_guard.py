@@ -1057,3 +1057,42 @@ def test_decode_bench_micro_schema():
     assert out["chunked"]["chunk_traces"] <= 2
 
     json.dumps(out)  # the whole report is JSON-serializable
+
+
+def test_rec_bench_micro_schema_and_gates():
+    """The sharded-embedding bench must keep working in a tiny CPU
+    config under tier-1 and honor its JSON contract (schema
+    rec_bench/v1). Unlike the other bench pins, this one DOES gate the
+    arcs: the dedup+hot-cache arc replaces per-slot RPCs with one
+    coalesced gather per owner, so its >=1.5x floor over the naive arc
+    has order-of-magnitude headroom (~19x on an idle box) and holds on
+    a noisy CI host; overlap must strictly cut embed_wait vs its
+    no-overlap twin; and the mid-run reshard must leave the stitched
+    table byte-identical to stop-resume."""
+    import json
+
+    from edl_tpu.tools import rec_bench
+
+    out = rec_bench.run(mode="micro")
+    assert out["schema"] == "rec_bench/v1"
+    for arc in ("naive", "dedup", "dedup_cache", "overlap"):
+        a = out["arcs"][arc]
+        assert a["rows_s"] > 0
+        assert a["lookup_ms_p99"] >= a["lookup_ms_p50"] >= 0
+        assert a["retries"] == 0  # no chaos in the bench
+    assert out["arcs"]["naive"]["unique_key_frac"] == 1.0
+    assert out["arcs"]["dedup"]["unique_key_frac"] < 1.0  # zipf head
+    cached = out["arcs"]["dedup_cache"]
+    assert 0 <= cached["cache_hit_rate"] <= 1
+    assert 0 < out["predicted_head_mass"] <= 1
+    # the three acceptance gates ride tier-1
+    assert out["speedup_dedup_cache_vs_naive"] >= 1.5
+    assert out["arcs"]["overlap"]["embed_wait_s"] \
+        < out["arcs"]["dedup_cache"]["embed_wait_s"]
+    assert out["resize"]["identical_ok"] is True
+    assert out["resize"]["members_from"] == 2
+    assert out["resize"]["members_to"] == 3
+    assert out["gates"] == {"speedup_ok": True, "overlap_ok": True,
+                            "identical_ok": True}
+    assert out["ok"] is True
+    json.dumps(out)  # the whole report is JSON-serializable

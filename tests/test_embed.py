@@ -1,0 +1,518 @@
+"""Sharded embedding plane (edl_tpu.embed): span ownership, dedup'd
+coalesced gathers, the hot-key cache tier (hit/evict/version-fence),
+write-back vs a single-host reference optimizer step, mid-resize
+reshard byte-identity, the chaos drills (a faulted gather degrades
+losslessly), the DeepFM sparse/dense parity contract, and the
+embed_wait ledger + job_doctor wiring."""
+
+import numpy as np
+import pytest
+
+from edl_tpu.embed import (EmbedPlaneClient, EmbedPrefetcher,
+                           EmbedShardServer, TableSpec)
+from edl_tpu.embed import cache as cache_mod
+from edl_tpu.embed import sharding
+from edl_tpu.robustness.faults import FaultPlane
+from edl_tpu.rpc.pool import ClientPool
+from edl_tpu.utils import errors
+
+VOCAB, DIM = 120, 4
+
+
+# ---------------------------------------------------------------------------
+# sharding: ownership is a pure function of the member-id SET
+
+
+def test_row_spans_deterministic_under_shuffle():
+    members = ["pod-c", "pod-a", "pod-b", "pod-d"]
+    spans = sharding.row_spans(1000, members)
+    for shuffled in (members[::-1], sorted(members),
+                     ["pod-b", "pod-d", "pod-a", "pod-c"]):
+        assert sharding.row_spans(1000, shuffled) == spans
+    # contiguous, ordered, tiling [0, vocab)
+    ordered = [spans[m] for m in sorted(spans)]
+    assert ordered[0][0] == 0 and ordered[-1][1] == 1000
+    for (_, hi), (lo, _) in zip(ordered, ordered[1:]):
+        assert hi == lo
+
+
+def test_row_spans_more_members_than_rows():
+    spans = sharding.row_spans(3, ["a", "b", "c", "d", "e"])
+    held = [m for m, (lo, hi) in spans.items() if hi > lo]
+    assert len(held) == 3
+    for m in set(spans) - set(held):
+        assert spans[m][0] == spans[m][1]  # empty, not invalid
+
+
+def test_owner_index_matches_span_containment():
+    members = ["m0", "m1", "m2"]
+    spans = sharding.row_spans(VOCAB, members)
+    keys = np.arange(VOCAB)
+    idx = sharding.owner_index(keys, VOCAB, len(members))
+    for k, i in zip(keys, idx):
+        lo, hi = spans[sorted(members)[int(i)]]
+        assert lo <= k < hi
+
+
+def test_partition_by_owner_contiguous_runs():
+    members = ["b", "a", "c"]
+    keys = np.array([0, 1, 41, 59, 80, 119])
+    parts = sharding.partition_by_owner(keys, VOCAB, members)
+    rebuilt = np.concatenate([ks for _, ks in parts])
+    assert np.array_equal(rebuilt, keys)
+    spans = sharding.row_spans(VOCAB, members)
+    for owner, ks in parts:
+        lo, hi = spans[owner]
+        assert ks.min() >= lo and ks.max() < hi
+
+
+def test_reshard_moves_tiles_new_span():
+    old = ["a", "b"]
+    new = ["a", "b", "c"]
+    for me in new:
+        new_span, keep, pulls = sharding.reshard_moves(VOCAB, old, new,
+                                                       me)
+        covered = []
+        if keep is not None:
+            covered.append(keep)
+        covered += [span for _, span in pulls]
+        covered.sort()
+        assert covered[0][0] == new_span[0]
+        assert covered[-1][1] == new_span[1]
+        for (_, hi), (lo, _) in zip(covered, covered[1:]):
+            assert hi == lo  # no gaps, no overlaps
+
+
+# ---------------------------------------------------------------------------
+# fixtures: a tiny live fleet
+
+
+@pytest.fixture
+def fleet():
+    tables = {"ctr": TableSpec(VOCAB, DIM, seed=11)}
+    members = ["a", "b"]
+    servers = {m: EmbedShardServer(m, tables, members) for m in members}
+    pool = ClientPool(timeout=10.0)
+    yield servers, pool, tables
+    for s in servers.values():
+        s.stop()
+    pool.close()
+
+
+def _endpoints(servers):
+    return {m: s.endpoint for m, s in servers.items()}
+
+
+def _reference_table(spec):
+    return spec.materialize(0, spec.vocab)
+
+
+# ---------------------------------------------------------------------------
+# dedup / scatter round-trip
+
+
+def test_dedup_scatter_roundtrip_exact(fleet):
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"])
+    client = EmbedPlaneClient(pool, _endpoints(servers),
+                              cache_entries=16)
+    keys = np.array([5, 61, 5, 0, 119, 61, 5, 7])  # dups across owners
+    rows = client.lookup("ctr", keys)
+    assert rows.shape == (len(keys), DIM)
+    assert np.array_equal(rows, ref[keys])
+    # and again, now largely cache-served — still exact
+    assert np.array_equal(client.lookup("ctr", keys), ref[keys])
+    assert client.cache().stats()["hits"] > 0
+
+
+def test_naive_client_same_rows(fleet):
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"])
+    naive = EmbedPlaneClient(pool, _endpoints(servers),
+                             client_id="naive", dedup=False)
+    keys = np.array([3, 3, 77, 118, 0])
+    assert np.array_equal(naive.lookup("ctr", keys), ref[keys])
+    assert naive.stats()["unique_key_frac"] == 1.0  # no dedup by design
+
+
+# ---------------------------------------------------------------------------
+# cache tier semantics
+
+
+def test_cache_lru_hit_then_evict():
+    c = cache_mod.HotKeyCache(2)
+    rows = np.arange(8, dtype=np.float32).reshape(4, 2)
+    c.put_many("t", [1, 2], rows[:2], version=1)
+    hits, miss = c.get_many("t", np.array([1, 2, 3]))
+    assert set(hits) == {1, 2} and list(miss) == [False, False, True]
+    # inserting 3 evicts the LRU entry: the get refreshed 1 then 2 in
+    # order, so 1 is now least-recent and goes first
+    c.put_many("t", [3], rows[2:3], version=1)
+    hits, _ = c.get_many("t", np.array([1, 2, 3]))
+    assert set(hits) == {2, 3}
+    assert c.stats()["evictions"] == 1
+
+
+def test_cache_version_guard_rejects_stale_put():
+    c = cache_mod.HotKeyCache(4)
+    new = np.ones((1, 2), np.float32)
+    old = np.zeros((1, 2), np.float32)
+    c.put_many("t", [7], new, version=5)
+    c.put_many("t", [7], old, version=3)  # late prefetch: must lose
+    hits, _ = c.get_many("t", np.array([7]))
+    assert np.array_equal(hits[7], new[0])
+
+
+def test_cache_write_through_matches_server_math():
+    c = cache_mod.HotKeyCache(4)
+    row = np.array([[1.0, 2.0]], np.float32)
+    c.put_many("t", [7], row, version=1)
+    delta = np.array([[0.25, -0.5]], np.float32)
+    c.apply_update("t", [7], delta, version=2)
+    hits, _ = c.get_many("t", np.array([7]))
+    assert np.array_equal(hits[7], (row - delta)[0])
+
+
+def test_cache_stale_invalidate_counts():
+    c = cache_mod.HotKeyCache(4)
+    c.put_many("t", [1, 2], np.zeros((2, 2), np.float32), version=1)
+    assert c.invalidate("t", keys=[1], stale=True) == 1
+    assert c.stats()["stale_refetches"] == 1
+    _, miss = c.get_many("t", np.array([1, 2]))
+    assert list(miss) == [True, False]
+
+
+def test_hot_set_tracker_decays_to_recent_head():
+    t = cache_mod.HotSetTracker(decay_every=2)
+    for _ in range(6):
+        t.observe([1, 1, 1, 2])
+    assert t.top(1) == [1]
+    for _ in range(12):
+        t.observe([9, 9, 9, 9, 2])
+    assert t.top(1) == [9]  # the old head decayed out
+
+
+def test_version_fence_never_serves_stale(fleet):
+    """Writer B updates keys client A holds cached; A's next batch
+    must refetch them (counted) and return the POST-write rows."""
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"]).copy()
+    a = EmbedPlaneClient(pool, _endpoints(servers), client_id="A",
+                         cache_entries=32)
+    b = EmbedPlaneClient(pool, _endpoints(servers), client_id="B")
+    keys = np.array([4, 5, 90])
+    assert np.array_equal(a.lookup("ctr", keys), ref[keys])  # A caches
+    grads = np.full((3, DIM), 2.0, np.float32)
+    b.writeback("ctr", keys, grads, lr=0.5)
+    ref[keys] -= np.float32(0.5) * grads
+    rows = a.lookup("ctr", keys)  # fence: touched-by-B → refetch
+    assert np.array_equal(rows, ref[keys])
+    assert a.cache().stats()["stale_refetches"] > 0
+
+
+def test_writeback_matches_single_host_reference(fleet):
+    """Duplicate-slot grads accumulate per unique key; the sharded
+    apply must be bit-identical to the single-host step."""
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"]).copy()
+    client = EmbedPlaneClient(pool, _endpoints(servers),
+                              cache_entries=32)
+    rng = np.random.RandomState(0)
+    for step in range(3):
+        keys = rng.randint(0, VOCAB, 40)
+        grads = rng.randn(40, DIM).astype(np.float32)
+        client.lookup("ctr", keys)
+        client.writeback("ctr", keys, grads, lr=0.1)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        acc = np.zeros((uniq.size, DIM), np.float32)
+        np.add.at(acc, inv, grads)
+        ref[uniq] -= np.float32(0.1) * acc
+    stitched = np.concatenate(
+        [servers[m].table_bytes("ctr")[1] for m in sorted(servers)])
+    assert stitched.tobytes() == ref.tobytes()
+    # the write-through cache serves the same bytes as the servers
+    keys = np.arange(VOCAB)
+    assert np.array_equal(client.lookup("ctr", keys), ref)
+
+
+# ---------------------------------------------------------------------------
+# elastic reshard
+
+
+def test_reshard_byte_identity_grow_and_shrink(fleet):
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"]).copy()
+    client = EmbedPlaneClient(pool, _endpoints(servers),
+                              cache_entries=32)
+    keys = np.array([1, 60, 60, 119, 2])
+    grads = np.ones((5, DIM), np.float32)
+    client.lookup("ctr", keys)
+    client.writeback("ctr", keys, grads, lr=0.2)
+    uniq, inv = np.unique(keys, return_inverse=True)
+    acc = np.zeros((uniq.size, DIM), np.float32)
+    np.add.at(acc, inv, grads)
+    ref[uniq] -= np.float32(0.2) * acc
+
+    # grow 2 -> 3: the joiner starts with an empty span and pulls
+    grown = ["a", "b", "c"]
+    servers["c"] = EmbedShardServer("c", tables, ["a", "b"])
+    eps = _endpoints(servers)
+    staged = {m: servers[m].reshard(grown, eps, pool) for m in grown}
+    for m in grown:
+        servers[m].adopt(staged[m])
+    client.resize(_endpoints(servers))
+    stitched = np.concatenate(
+        [servers[m].table_bytes("ctr")[1] for m in sorted(grown)])
+    assert stitched.tobytes() == ref.tobytes()
+    assert np.array_equal(client.lookup("ctr", keys), ref[keys])
+
+    # shrink 3 -> 2: pulls complete against the OLD spans before adopt
+    back = ["a", "b"]
+    eps = _endpoints(servers)
+    staged = {m: servers[m].reshard(back, eps, pool) for m in back}
+    for m in back:
+        servers[m].adopt(staged[m])
+    servers.pop("c").stop()
+    client.resize(_endpoints(servers))
+    stitched = np.concatenate(
+        [servers[m].table_bytes("ctr")[1] for m in sorted(back)])
+    assert stitched.tobytes() == ref.tobytes()
+    assert np.array_equal(client.lookup("ctr", keys), ref[keys])
+
+
+# ---------------------------------------------------------------------------
+# chaos drills: faulted gathers degrade losslessly
+
+
+def test_chaos_lookup_error_once_is_lossless(fleet):
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"])
+    plane = FaultPlane(seed=3).install()
+    try:
+        fault = plane.inject("embed.lookup", "error_once",
+                             error="ConnectError")
+        client = EmbedPlaneClient(pool, _endpoints(servers))
+        keys = np.array([2, 70, 2, 111])
+        rows = client.lookup("ctr", keys)
+        # retried, requeued, EXACT rows — never silently-zero
+        assert np.array_equal(rows, ref[keys])
+        assert fault.fired == 1
+        assert client.stats()["retries"] >= 1  # exact accounting
+    finally:
+        plane.uninstall()
+
+
+def test_chaos_lookup_persistent_error_is_typed(fleet):
+    servers, pool, tables = fleet
+    plane = FaultPlane(seed=3).install()
+    try:
+        plane.inject("embed.lookup", "error", error="ConnectError")
+        from edl_tpu.robustness.policy import RetryPolicy
+        client = EmbedPlaneClient(
+            pool, _endpoints(servers),
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01, seed=0))
+        with pytest.raises(errors.EmbedLookupError):
+            client.lookup("ctr", np.array([1, 2, 3]))
+    finally:
+        plane.uninstall()
+
+
+def test_chaos_writeback_error_once_and_persistent(fleet):
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"]).copy()
+    plane = FaultPlane(seed=3).install()
+    try:
+        fault = plane.inject("embed.writeback", "error_once",
+                             error="ConnectError")
+        client = EmbedPlaneClient(pool, _endpoints(servers))
+        keys = np.array([8, 100])
+        grads = np.ones((2, DIM), np.float32)
+        client.writeback("ctr", keys, grads, lr=0.5)
+        ref[keys] -= np.float32(0.5) * grads
+        assert fault.fired == 1
+        assert np.array_equal(client.lookup("ctr", keys), ref[keys])
+
+        from edl_tpu.robustness.policy import RetryPolicy
+        plane.inject("embed.writeback", "error", error="ConnectError")
+        strict = EmbedPlaneClient(
+            pool, _endpoints(servers), client_id="strict",
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01, seed=0))
+        with pytest.raises(errors.EmbedWritebackError):
+            strict.writeback("ctr", keys, grads, lr=0.5)
+    finally:
+        plane.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# DeepFM sparse/dense parity
+
+
+def test_deepfm_sparse_parity_bitwise():
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import deepfm
+    vocabs = (16, 24, 8)
+    model = deepfm.DeepFM(vocabs, embed_dim=4, mlp_dims=(16, 8))
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 3), jnp.int32))["params"]
+    batch = deepfm.synthetic_ctr_batch(13, vocabs, seed=5)
+    dense = np.asarray(model.apply({"params": params},
+                                   batch["fields"]))
+    table = deepfm.combined_embedding_table(params, vocabs)
+    keys = deepfm.flat_ctr_keys(batch["fields"], vocabs)
+    rows = table[keys].reshape(13, 3, 5)
+    tail = deepfm.DeepFMTail(num_fields=3, embed_dim=4,
+                             mlp_dims=(16, 8))
+    sparse = np.asarray(tail.apply(
+        {"params": deepfm.dense_tail_params(params)},
+        jnp.asarray(rows)))
+    assert np.array_equal(dense, sparse)  # bitwise, not allclose
+
+
+def test_deepfm_sparse_parity_through_plane(fleet_large=None):
+    """Same parity with the rows actually served by the sharded plane
+    (gather → scatter → device), duplicates and all."""
+    import jax
+    import jax.numpy as jnp
+
+    from edl_tpu.models import deepfm
+    vocabs = (16, 24, 8)
+    model = deepfm.DeepFM(vocabs, embed_dim=4, mlp_dims=(16, 8))
+    params = model.init(jax.random.PRNGKey(2),
+                        jnp.zeros((1, 3), jnp.int32))["params"]
+    table = deepfm.combined_embedding_table(params, vocabs)
+    spec = TableSpec(table.shape[0], table.shape[1],
+                     init_fn=lambda v, d, lo, hi: table[lo:hi])
+    members = ["a", "b"]
+    servers = {m: EmbedShardServer(m, {"ctr": spec}, members)
+               for m in members}
+    pool = ClientPool(timeout=10.0)
+    try:
+        client = EmbedPlaneClient(pool, _endpoints(servers),
+                                  cache_entries=32)
+        batch = deepfm.synthetic_ctr_batch(9, vocabs, seed=6)
+        keys = deepfm.flat_ctr_keys(batch["fields"], vocabs)
+        rows = client.lookup("ctr", keys).reshape(9, 3, 5)
+        tail = deepfm.DeepFMTail(num_fields=3, embed_dim=4,
+                                 mlp_dims=(16, 8))
+        sparse = np.asarray(tail.apply(
+            {"params": deepfm.dense_tail_params(params)},
+            jnp.asarray(rows)))
+        dense = np.asarray(model.apply({"params": params},
+                                       batch["fields"]))
+        assert np.array_equal(dense, sparse)
+    finally:
+        for s in servers.values():
+            s.stop()
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# overlap: prefetcher + embed_wait accounting
+
+
+def test_prefetcher_fifo_and_embed_wait_state(fleet):
+    from edl_tpu.obs import ledger as ledger_mod
+    assert "embed_wait" in ledger_mod.STATES
+    servers, pool, tables = fleet
+    ref = _reference_table(tables["ctr"])
+    client = EmbedPlaneClient(pool, _endpoints(servers),
+                              cache_entries=16)
+    pf = EmbedPrefetcher(client, "ctr")
+    try:
+        before = ledger_mod.LEDGER.totals().get("embed_wait", 0.0)
+        pf.submit(np.array([1, 2, 3]))
+        pf.submit(np.array([4, 4]))
+        assert np.array_equal(pf.wait(), ref[[1, 2, 3]])
+        assert np.array_equal(pf.wait(), ref[[4, 4]])
+        after = ledger_mod.LEDGER.totals().get("embed_wait", 0.0)
+        assert after >= before  # the join was charged to embed_wait
+        assert pf.stats()["waits"] == 2
+        with pytest.raises(errors.StatusError):
+            pf.wait()  # nothing submitted
+    finally:
+        pf.close()
+
+
+def test_prefetcher_surfaces_lookup_errors(fleet):
+    servers, pool, tables = fleet
+    plane = FaultPlane(seed=3).install()
+    try:
+        plane.inject("embed.lookup", "error", error="ConnectError")
+        from edl_tpu.robustness.policy import RetryPolicy
+        client = EmbedPlaneClient(
+            pool, _endpoints(servers), client_id="pf-err",
+            retry=RetryPolicy(max_attempts=2, base_delay=0.01, seed=0))
+        pf = EmbedPrefetcher(client, "ctr")
+        try:
+            pf.submit(np.array([1]))
+            with pytest.raises(errors.EmbedLookupError):
+                pf.wait()
+        finally:
+            pf.close()
+    finally:
+        plane.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics mirrored in stats(), doctor finding
+
+
+def test_stats_mirrors_metrics(fleet):
+    from edl_tpu.obs import metrics as obs_metrics
+    servers, pool, tables = fleet
+    client = EmbedPlaneClient(pool, _endpoints(servers),
+                              cache_entries=8)
+    keys = np.array([1, 1, 2, 60])
+    client.lookup("ctr", keys)
+    client.writeback("ctr", keys, np.ones((4, DIM), np.float32), 0.1)
+    stats = client.stats()
+    assert stats["lookups"] == 1 and stats["writebacks"] == 1
+    assert 0 < stats["unique_key_frac"] <= 1.0
+    fams = obs_metrics.REGISTRY.families()
+    for name in ("edl_embed_lookup_ms", "edl_embed_writeback_ms",
+                 "edl_embed_unique_key_frac",
+                 "edl_embed_cache_hits_total",
+                 "edl_embed_cache_evictions_total"):
+        assert name in fams, name
+    # mirror_stats published the numeric stats as gauges
+    assert "edl_embed_lookups" in fams
+
+
+def _obs_doc(states):
+    series = [{"labels": {"state": s}, "value": v}
+              for s, v in states.items()]
+    return {"schema": "obs_pub/v1",
+            "metrics": {"metrics": {"edl_time_seconds_total": {
+                "kind": "counter", "series": series}}}}
+
+
+def test_job_doctor_embed_wait_dominant():
+    from edl_tpu.tools import job_doctor
+    obs = {"pod0": _obs_doc({"compute": 50.0, "embed_wait": 30.0,
+                             "data_wait": 5.0}),
+           "pod1": _obs_doc({"compute": 60.0, "embed_wait": 40.0})}
+    findings = job_doctor._embed_findings(obs)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f["detector"] == "embed_wait_dominant"
+    assert f["pod"] == "pod1"  # loses the most time
+    assert f["metric"] == "edl_time_seconds_total"
+    assert "embed_wait" in f["summary"]
+    # ranked: a known detector, not the unknown-rank bucket
+    assert "embed_wait_dominant" in job_doctor._DETECTOR_RANK
+    # and it rides diagnose() end to end on a monitor-less collect doc
+    report = job_doctor.diagnose({"health": None, "obs": obs})
+    assert any(x["detector"] == "embed_wait_dominant"
+               for x in report["findings"])
+
+
+def test_job_doctor_embed_wait_quiet_when_minor():
+    from edl_tpu.tools import job_doctor
+    # embed_wait present but neither dominant nor over the share floor
+    obs = {"pod0": _obs_doc({"compute": 95.0, "embed_wait": 2.0,
+                             "data_wait": 3.0})}
+    assert job_doctor._embed_findings(obs) == []
+    # no ledger counters at all → no finding, no crash
+    assert job_doctor._embed_findings({"pod0": {"metrics": {}}}) == []

@@ -52,7 +52,8 @@ def test_dump_writes_parseable_blackbox(tmp_path):
     assert "boom at step 42" in box["exception"]["message"]
     assert "RuntimeError" in box["exception"]["traceback"]
     assert set(box["ledger"]) == set(
-        ("compute", "data_wait", "ckpt_block", "resize_pause",
+        ("compute", "data_wait", "embed_wait", "ckpt_block",
+         "resize_pause",
          "restore", "barrier_wait", "idle"))
     assert box["context"]["resize_timing"] == {"pause_s": 1.25}
     # the thread dump must at least see this (the main) thread

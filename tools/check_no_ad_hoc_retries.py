@@ -22,7 +22,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGES = ("rpc", "coordination", "distill", "liveft", "controller",
-            "data", "serve", "parallel", "runtime")
+            "data", "serve", "parallel", "runtime", "embed")
 
 # (relpath, enclosing function) -> why the raw sleep-in-loop is OK
 ALLOWLIST = {
